@@ -1,0 +1,27 @@
+"""serf-tpu: a TPU-native cluster-membership / gossip framework.
+
+A ground-up rebuild of the capabilities of al8n/serf (SWIM + Lifeguard gossip,
+Lamport-clocked event/query dissemination, push/pull anti-entropy, Vivaldi
+network coordinates, snapshot/resume, key management) as a two-plane system:
+
+- **host plane** (``serf_tpu.host``): an asyncio Serf engine with the same
+  public API surface as the reference (`new/join/leave/user_event/query/
+  members/stats/...`), pluggable transports (in-memory loopback, UDP/TCP),
+  and full protocol semantics.  This is both a usable small/medium-cluster
+  implementation and the parity oracle for the device plane.
+- **device plane** (``serf_tpu.models``, ``serf_tpu.ops``,
+  ``serf_tpu.parallel``): the whole cluster's state as struct-of-arrays in
+  HBM; a gossip round is a sparse neighbor-gather plus a ``vmap``-ed local
+  Lamport-merge transition under ``jit``, sharded over a device mesh with
+  ``shard_map`` + ``ppermute`` for cross-chip edges.  Simulates million-node
+  SWIM clusters to convergence.
+
+Reference layer map: /root/reference README.md:110-144 (see SURVEY.md §1).
+"""
+
+__version__ = "0.1.0"
+
+from serf_tpu.types.clock import LamportClock, LamportTime
+from serf_tpu.options import Options
+
+__all__ = ["LamportClock", "LamportTime", "Options", "__version__"]

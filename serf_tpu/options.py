@@ -1,0 +1,111 @@
+"""Configuration: all protocol knobs with reference-parity defaults.
+
+Reference: serf-core/src/options.rs:495-530 (serf knobs) and the memberlist
+tunables serf's tests exercise (serf-core/src/serf/base/tests.rs:25-39).
+Durations are seconds (float) instead of the reference's humantime strings.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Dict, Optional
+
+from serf_tpu.types.tags import Tags
+
+# Hard caps (reference serf-core/src/serf.rs:40-44)
+USER_EVENT_SIZE_LIMIT = 9 * 1024     # 9 KiB hard cap on encoded user events
+SNAPSHOT_SIZE_LIMIT = 128 * 1024     # min snapshot compaction threshold
+
+
+@dataclass(frozen=True)
+class MemberlistOptions:
+    """SWIM-layer tunables (reference memberlist LAN profile; SURVEY.md §2.9)."""
+
+    bind_addr: object = None                 # transport-specific
+    gossip_interval: float = 0.2             # LAN default 200ms
+    gossip_nodes: int = 3                    # fan-out per gossip tick
+    probe_interval: float = 1.0
+    probe_timeout: float = 0.5
+    indirect_checks: int = 3
+    suspicion_mult: int = 4
+    suspicion_max_timeout_mult: int = 6
+    retransmit_mult: int = 4
+    push_pull_interval: float = 30.0
+    awareness_max_multiplier: int = 8        # Lifeguard local-health ceiling
+    timeout: float = 10.0                    # stream (push/pull) op timeout
+    metric_labels: Dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def lan(cls) -> "MemberlistOptions":
+        return cls()
+
+    @classmethod
+    def local(cls) -> "MemberlistOptions":
+        """Compressed timings for in-process tests (reference base/tests.rs:25-39)."""
+        return cls(
+            gossip_interval=0.005,
+            probe_interval=0.05,
+            probe_timeout=0.025,
+            suspicion_mult=1,
+            push_pull_interval=0.0,  # disabled unless a test enables it
+            timeout=2.0,
+        )
+
+
+@dataclass(frozen=True)
+class Options:
+    """Serf-layer knobs, defaults matching reference options.rs:495-530."""
+
+    broadcast_timeout: float = 5.0
+    leave_propagate_delay: float = 1.0
+    coalesce_period: float = 0.0          # 0 = coalescing off
+    quiescent_period: float = 0.0
+    user_coalesce_period: float = 0.0
+    user_quiescent_period: float = 0.0
+    reap_interval: float = 15.0
+    reconnect_interval: float = 30.0
+    reconnect_timeout: float = 24 * 3600.0
+    tombstone_timeout: float = 24 * 3600.0
+    flap_timeout: float = 60.0
+    queue_check_interval: float = 30.0
+    queue_depth_warning: int = 128
+    max_queue_depth: int = 4096
+    min_queue_depth: int = 0
+    recent_intent_timeout: float = 300.0
+    event_buffer_size: int = 512
+    query_buffer_size: int = 512
+    query_timeout_mult: int = 16
+    query_size_limit: int = 1024
+    query_response_size_limit: int = 1024
+    memberlist: MemberlistOptions = field(default_factory=MemberlistOptions.lan)
+    snapshot_path: Optional[str] = None
+    snapshot_min_compact_size: int = SNAPSHOT_SIZE_LIMIT
+    rejoin_after_leave: bool = False
+    enable_id_conflict_resolution: bool = True
+    disable_coordinates: bool = False
+    tags: Tags = field(default_factory=Tags)
+    max_user_event_size: int = 512
+    keyring_file: Optional[str] = None
+
+    def replace(self, **kw) -> "Options":
+        return replace(self, **kw)
+
+    def validate(self) -> None:
+        if self.max_user_event_size > USER_EVENT_SIZE_LIMIT:
+            raise ValueError(
+                f"max_user_event_size {self.max_user_event_size} exceeds hard cap "
+                f"{USER_EVENT_SIZE_LIMIT}"
+            )
+
+    @classmethod
+    def local(cls, **kw) -> "Options":
+        """Test profile: compressed timers (reference base/tests.rs:25-39)."""
+        defaults = dict(
+            memberlist=MemberlistOptions.local(),
+            reap_interval=1.0,
+            reconnect_interval=1.0,
+            recent_intent_timeout=5.0,
+            queue_check_interval=1.0,
+        )
+        defaults.update(kw)
+        return cls(**defaults)

@@ -1,0 +1,55 @@
+"""Typed protocol state: clocks, members, tags, filters, messages, coordinates.
+
+Mirrors the capability surface of reference serf-core/src/types/ (SURVEY.md §2.4)
+with a Python-native (host plane) and array-native (device plane) design.
+"""
+
+from serf_tpu.types.clock import LamportClock, LamportTime
+from serf_tpu.types.member import Member, MemberState, MemberStatus, Node
+from serf_tpu.types.tags import Tags
+from serf_tpu.types.messages import (
+    MessageType,
+    JoinMessage,
+    LeaveMessage,
+    UserEventMessage,
+    UserEvents,
+    PushPullMessage,
+    QueryMessage,
+    QueryResponseMessage,
+    QueryFlag,
+    ConflictResponseMessage,
+    KeyRequestMessage,
+    KeyResponseMessage,
+    encode_message,
+    decode_message,
+    encode_relay_message,
+)
+from serf_tpu.types.filters import Filter, IdFilter, TagFilter
+
+__all__ = [
+    "LamportClock",
+    "LamportTime",
+    "Member",
+    "MemberState",
+    "MemberStatus",
+    "Node",
+    "Tags",
+    "MessageType",
+    "JoinMessage",
+    "LeaveMessage",
+    "UserEventMessage",
+    "UserEvents",
+    "PushPullMessage",
+    "QueryMessage",
+    "QueryResponseMessage",
+    "QueryFlag",
+    "ConflictResponseMessage",
+    "KeyRequestMessage",
+    "KeyResponseMessage",
+    "encode_message",
+    "decode_message",
+    "encode_relay_message",
+    "Filter",
+    "IdFilter",
+    "TagFilter",
+]

@@ -1,0 +1,43 @@
+"""Lamport clock semantics (reference serf-core/src/types/clock.rs:175-191)."""
+
+import threading
+
+from serf_tpu.types.clock import LamportClock
+
+
+def test_basic():
+    c = LamportClock()
+    assert c.time() == 0
+    assert c.increment() == 1  # returns post-increment value (clock.rs fetch_add+1)
+    assert c.time() == 1
+    c.witness(41)
+    assert c.time() == 42
+    c.witness(41)  # stale witness: no-op
+    assert c.time() == 42
+    c.witness(30)
+    assert c.time() == 42
+
+
+def test_witness_equal_bumps():
+    c = LamportClock(10)
+    c.witness(10)
+    assert c.time() == 11
+
+
+def test_concurrent_increments():
+    c = LamportClock()
+    N, T = 1000, 8
+    seen = [set() for _ in range(T)]
+
+    def worker(i):
+        for _ in range(N):
+            seen[i].add(c.increment())
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    all_seen = set().union(*seen)
+    assert len(all_seen) == N * T  # every increment returned a unique value
+    assert c.time() == N * T
