@@ -1,0 +1,106 @@
+"""Transmit-limited broadcast queue.
+
+The retransmit-limited gossip queue the reference takes from memberlist-core
+(SURVEY.md §2.3/§2.9): each queued broadcast is re-gossiped until it has been
+transmitted ``retransmit_mult * ceil(log10(n+1))`` times, drained
+highest-remaining-retransmits-first under a per-packet byte budget.
+
+Serf's three queues (intent/event/query) use *no invalidation* — Lamport-time
+dedup supersedes it (reference broadcast.rs:15-45); the SWIM layer's own
+queue invalidates older broadcasts about the same node.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+from typing import Callable, List, Optional
+
+
+class Broadcast:
+    """One queued message."""
+
+    __slots__ = ("msg", "name", "transmits", "notify", "_seq")
+
+    def __init__(self, msg: bytes, name: Optional[str] = None,
+                 notify: Optional[asyncio.Event] = None):
+        self.msg = msg
+        self.name = name      # invalidation key (None = never invalidates)
+        self.transmits = 0
+        self.notify = notify
+        self._seq = 0
+
+    def finished(self) -> None:
+        if self.notify is not None:
+            self.notify.set()
+
+
+def retransmit_limit(retransmit_mult: int, n: int) -> int:
+    return retransmit_mult * max(1, math.ceil(math.log10(n + 1)))
+
+
+class TransmitLimitedQueue:
+    """Priority queue keyed by (fewest transmits first, newest first).
+
+    ``node_count_fn`` is the live NodeCalculator the reference wires in
+    (serf-core/src/serf.rs:123-131) — the retransmit limit tracks cluster
+    size as it changes.
+    """
+
+    def __init__(self, retransmit_mult: int, node_count_fn: Callable[[], int]):
+        self.retransmit_mult = retransmit_mult
+        self.node_count_fn = node_count_fn
+        self._items: List[Broadcast] = []
+        self._seq = 0
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def num_queued(self) -> int:
+        return len(self._items)
+
+    def queue_broadcast(self, b: Broadcast) -> None:
+        if b.name is not None:
+            # invalidate older broadcasts about the same subject
+            for old in [x for x in self._items if x.name == b.name]:
+                self._items.remove(old)
+                old.finished()
+        self._seq += 1
+        b._seq = self._seq
+        self._items.append(b)
+
+    def get_broadcasts(self, overhead: int, limit: int) -> List[bytes]:
+        """Drain up to ``limit`` bytes of broadcasts, ``overhead`` bytes
+        charged per message (envelope/frame cost).  Mutates transmit counts
+        and retires exhausted broadcasts."""
+        if not self._items:
+            return []
+        transmit_max = retransmit_limit(self.retransmit_mult, self.node_count_fn())
+        # fewest transmits first; among equal, newest (highest seq) first
+        self._items.sort(key=lambda b: (b.transmits, -b._seq))
+        out: List[bytes] = []
+        used = 0
+        retired: List[Broadcast] = []
+        for b in self._items:
+            cost = overhead + len(b.msg)
+            if used + cost > limit:
+                continue
+            used += cost
+            out.append(b.msg)
+            b.transmits += 1
+            if b.transmits >= transmit_max:
+                retired.append(b)
+        for b in retired:
+            self._items.remove(b)
+            b.finished()
+        return out
+
+    def prune(self, max_retained: int) -> None:
+        """Drop the most-transmitted items beyond ``max_retained``
+        (reference QueueChecker, base.rs:683-740)."""
+        if len(self._items) <= max_retained:
+            return
+        self._items.sort(key=lambda b: (b.transmits, -b._seq))
+        for b in self._items[max_retained:]:
+            b.finished()
+        del self._items[max_retained:]

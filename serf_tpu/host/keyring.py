@@ -1,0 +1,108 @@
+"""Secret keyring: AES-GCM packet/stream encryption with rotatable keys.
+
+Reference capability: memberlist's ``SecretKey``/keyring with AES encryption,
+orchestrated cluster-wide by serf's key manager (SURVEY.md §2.7/§2.9).
+Encrypt with the primary key; decrypt by trying every installed key, so the
+cluster stays connected mid-rotation.
+
+Wire format: ``[0x01 version][12-byte nonce][ciphertext+tag]``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from base64 import b64decode, b64encode
+from typing import List, Optional
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+ENCRYPTION_VERSION = 1
+KEY_SIZES = (16, 24, 32)
+NONCE_SIZE = 12
+
+
+class KeyringError(Exception):
+    pass
+
+
+class SecretKeyring:
+    def __init__(self, primary: bytes, keys: Optional[List[bytes]] = None):
+        _check_key(primary)
+        self._lock = threading.Lock()
+        self._primary = primary
+        self._keys: List[bytes] = [primary]
+        for k in keys or []:
+            if k != primary:
+                _check_key(k)
+                self._keys.append(k)
+
+    # key management --------------------------------------------------------
+
+    def primary_key(self) -> bytes:
+        return self._primary
+
+    def keys(self) -> List[bytes]:
+        with self._lock:
+            return list(self._keys)
+
+    def install(self, key: bytes) -> None:
+        _check_key(key)
+        with self._lock:
+            if key not in self._keys:
+                self._keys.append(key)
+
+    def use_key(self, key: bytes) -> None:
+        with self._lock:
+            if key not in self._keys:
+                raise KeyringError("cannot use a key that is not installed")
+            self._primary = key
+
+    def remove(self, key: bytes) -> None:
+        with self._lock:
+            if key == self._primary:
+                raise KeyringError("cannot remove the primary key")
+            if key in self._keys:
+                self._keys.remove(key)
+
+    # crypto ----------------------------------------------------------------
+
+    def encrypt(self, plaintext: bytes, aad: bytes = b"") -> bytes:
+        nonce = os.urandom(NONCE_SIZE)
+        ct = AESGCM(self._primary).encrypt(nonce, plaintext, aad or None)
+        return bytes([ENCRYPTION_VERSION]) + nonce + ct
+
+    def decrypt(self, buf: bytes, aad: bytes = b"") -> bytes:
+        if len(buf) < 1 + NONCE_SIZE + 16 or buf[0] != ENCRYPTION_VERSION:
+            raise KeyringError("malformed encrypted payload")
+        nonce, ct = buf[1 : 1 + NONCE_SIZE], buf[1 + NONCE_SIZE :]
+        for key in self.keys():
+            try:
+                return AESGCM(key).decrypt(nonce, ct, aad or None)
+            except Exception:
+                continue
+        raise KeyringError("no installed key decrypts this payload")
+
+    # persistence (reference writes keyring file mode 0600, base.rs:399-434)
+
+    def save(self, path: str) -> None:
+        # primary first, so load() restores the rotation state
+        keys = [self._primary] + [k for k in self.keys() if k != self._primary]
+        data = json.dumps([b64encode(k).decode() for k in keys])
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(data)
+
+    @classmethod
+    def load(cls, path: str) -> "SecretKeyring":
+        with open(path) as f:
+            keys = [b64decode(s) for s in json.load(f)]
+        if not keys:
+            raise KeyringError(f"keyring file {path} is empty")
+        return cls(keys[0], keys[1:])
+
+
+def _check_key(key: bytes) -> None:
+    if len(key) not in KEY_SIZES:
+        raise KeyringError(f"key must be 16/24/32 bytes, got {len(key)}")

@@ -1,0 +1,728 @@
+"""The SWIM + Lifeguard membership engine (host plane).
+
+Re-implements the layer the reference takes from ``memberlist-core``
+(SURVEY.md §2.9): probe/ack/indirect-probe failure detection with Lifeguard
+local-health awareness, suspicion with confirmation-shortened timeouts,
+alive/suspect/dead dissemination over a transmit-limited gossip queue,
+push/pull full-state anti-entropy over streams, and the delegate callback
+surface serf hooks into.
+
+Object API parity (grep-verified list in SURVEY.md §2.9): ``join``,
+``join_many``, ``leave``, ``shutdown``, ``send``, ``update_node``,
+``local_id``, ``local_node``, ``num_online_members``, ``health_score``,
+``keyring``, ``encryption_enabled``, ``members``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from serf_tpu import codec
+from serf_tpu.host import messages as sm
+from serf_tpu.host.broadcast import Broadcast, TransmitLimitedQueue
+from serf_tpu.host.delegate import SwimDelegate
+from serf_tpu.host.keyring import KeyringError, SecretKeyring
+from serf_tpu.host.messages import SwimState
+from serf_tpu.host.transport import Transport
+from serf_tpu.options import MemberlistOptions
+from serf_tpu.types.member import Node
+from serf_tpu.utils import metrics
+
+log = logging.getLogger("serf_tpu.memberlist")
+
+
+@dataclass
+class NodeState:
+    node: Node
+    incarnation: int = 0
+    state: SwimState = SwimState.ALIVE
+    meta: bytes = b""
+    state_change: float = field(default_factory=time.monotonic)
+
+    @property
+    def id(self) -> str:
+        return self.node.id
+
+    @property
+    def addr(self):
+        return self.node.addr
+
+
+class _Awareness:
+    """Lifeguard local-health multiplier (NSA): degrade our own probe
+    timeouts when we are likely the slow one."""
+
+    def __init__(self, max_mult: int):
+        self.max = max(1, max_mult)
+        self.score = 0
+
+    def apply_delta(self, delta: int) -> None:
+        self.score = min(self.max - 1, max(0, self.score + delta))
+
+    def scale(self, timeout: float) -> float:
+        return timeout * (self.score + 1)
+
+
+class _Suspicion:
+    """Suspicion timer whose deadline shrinks as independent confirmations
+    arrive (Lifeguard)."""
+
+    def __init__(self, k: int, min_t: float, max_t: float, from_node: str):
+        self.k = max(1, k)
+        self.min_t = min_t
+        self.max_t = max_t
+        # the original accuser is remembered for dedup but is NOT an
+        # *independent* confirmation: the timer starts at max_t
+        self.confirmations = {from_node}
+        self.start = time.monotonic()
+
+    def confirm(self, from_node: str) -> bool:
+        if from_node in self.confirmations:
+            return False
+        self.confirmations.add(from_node)
+        return True
+
+    def deadline(self) -> float:
+        c = len(self.confirmations) - 1  # independent confirmations only
+        frac = math.log(c + 1) / math.log(self.k + 1)
+        timeout = max(self.min_t, self.max_t - (self.max_t - self.min_t) * frac)
+        return self.start + timeout
+
+
+class Memberlist:
+    def __init__(
+        self,
+        transport: Transport,
+        opts: MemberlistOptions,
+        node_id: str,
+        delegate: Optional[SwimDelegate] = None,
+        keyring: Optional[SecretKeyring] = None,
+        rng: Optional[random.Random] = None,
+    ):
+        self.transport = transport
+        self.opts = opts
+        self.delegate = delegate or SwimDelegate()
+        self._keyring = keyring
+        self.rng = rng or random.Random()
+
+        self.local = Node(node_id, transport.local_addr)
+        self._incarnation = 1
+        self._nodes: Dict[str, NodeState] = {}
+        self._probe_order: List[str] = []
+        self._probe_index = 0
+        self._seq = 0
+        self._ack_futures: Dict[int, asyncio.Future] = {}
+        self._nack_counts: Dict[int, int] = {}
+        self._suspicions: Dict[str, _Suspicion] = {}
+        self._probing: set = set()  # node ids with an in-flight probe
+        self._awareness = _Awareness(opts.awareness_max_multiplier)
+        self.broadcasts = TransmitLimitedQueue(
+            opts.retransmit_mult, lambda: max(1, self.num_online_members())
+        )
+        self._leaving = False
+        self._shutdown = False
+        self._tasks: List[asyncio.Task] = []
+        self._bg: set = set()  # dynamic tasks (suspicion timers, stream serves)
+        self._started = False
+
+    def _spawn(self, coro, name: str) -> asyncio.Task:
+        t = asyncio.create_task(coro, name=name)
+        self._bg.add(t)
+        t.add_done_callback(self._bg.discard)
+        return t
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self) -> None:
+        """Set the local node alive and spin up the protocol loops."""
+        meta = self.delegate.node_meta(512)
+        me = NodeState(self.local, self._incarnation, SwimState.ALIVE, meta)
+        self._nodes[self.local.id] = me
+        self._probe_order.append(self.local.id)
+        self.delegate.notify_join(me)
+        self._tasks = [
+            asyncio.create_task(self._packet_loop(), name=f"ml-packet-{self.local.id}"),
+            asyncio.create_task(self._stream_loop(), name=f"ml-stream-{self.local.id}"),
+            asyncio.create_task(self._probe_loop(), name=f"ml-probe-{self.local.id}"),
+            asyncio.create_task(self._gossip_loop(), name=f"ml-gossip-{self.local.id}"),
+        ]
+        if self.opts.push_pull_interval > 0:
+            self._tasks.append(
+                asyncio.create_task(self._push_pull_loop(), name=f"ml-pp-{self.local.id}")
+            )
+        self._started = True
+
+    async def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        for t in [*self._tasks, *self._bg]:
+            t.cancel()
+        for t in [*self._tasks, *list(self._bg)]:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        await self.transport.shutdown()
+
+    async def leave(self, timeout: float) -> None:
+        """Broadcast a voluntary leave (Dead with from==self) and wait for it
+        to be gossiped out (or ``timeout``)."""
+        self._leaving = True
+        me = self._nodes.get(self.local.id)
+        if me is None:
+            return
+        me.state = SwimState.LEFT
+        me.state_change = time.monotonic()
+        done = asyncio.Event()
+        msg = sm.Dead(me.incarnation, self.local.id, self.local.id)
+        self._queue_broadcast(sm.encode_swim(msg), name=self.local.id, notify=done)
+        if self._any_alive_peer():
+            try:
+                await asyncio.wait_for(done.wait(), timeout)
+            except asyncio.TimeoutError:
+                log.warning("leave broadcast not fully disseminated before timeout")
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def local_id(self) -> str:
+        return self.local.id
+
+    def local_node(self) -> Node:
+        return self.local
+
+    def local_state(self) -> Optional[NodeState]:
+        return self._nodes.get(self.local.id)
+
+    def members(self) -> List[NodeState]:
+        return list(self._nodes.values())
+
+    def online_members(self) -> List[NodeState]:
+        return [n for n in self._nodes.values() if n.state == SwimState.ALIVE]
+
+    def num_online_members(self) -> int:
+        return sum(1 for n in self._nodes.values() if n.state == SwimState.ALIVE)
+
+    def health_score(self) -> int:
+        return self._awareness.score
+
+    def keyring(self) -> Optional[SecretKeyring]:
+        return self._keyring
+
+    def encryption_enabled(self) -> bool:
+        return self._keyring is not None
+
+    async def join(self, addr) -> None:
+        """Push/pull state sync with a seed node (reference join path,
+        SURVEY.md §3.2)."""
+        await self._push_pull_with(addr, join=True)
+
+    async def join_many(self, addrs: Sequence) -> Tuple[int, List[Exception]]:
+        ok, errs = 0, []
+        for a in addrs:
+            try:
+                await self.join(a)
+                ok += 1
+            except Exception as e:  # noqa: BLE001 - joins best-effort by design
+                errs.append(e)
+        return ok, errs
+
+    async def send(self, addr, buf: bytes) -> None:
+        """Unreliable user-plane send (serf query responses/acks/relays)."""
+        await self._send_packet(addr, sm.encode_swim(sm.UserMsg(buf)))
+
+    async def update_node(self, timeout: float) -> None:
+        """Re-advertise local meta (after a tag change): broadcast a fresh
+        alive with a bumped incarnation."""
+        me = self._nodes[self.local.id]
+        self._incarnation += 1
+        me.incarnation = self._incarnation
+        me.meta = self.delegate.node_meta(512)
+        msg = sm.Alive(me.incarnation, self.local, me.meta)
+        done = asyncio.Event()
+        self._queue_broadcast(sm.encode_swim(msg), name=self.local.id, notify=done)
+        if self._any_alive_peer():
+            try:
+                await asyncio.wait_for(done.wait(), timeout)
+            except asyncio.TimeoutError:
+                pass
+
+    # ------------------------------------------------------------------
+    # wire helpers
+    # ------------------------------------------------------------------
+
+    async def _send_packet(self, addr, buf: bytes) -> None:
+        if self._keyring is not None:
+            buf = self._keyring.encrypt(buf)
+        metrics.observe("memberlist.packet.sent", len(buf), self.opts.metric_labels)
+        await self.transport.send_packet(addr, buf)
+
+    def _decrypt(self, buf: bytes) -> Optional[bytes]:
+        if self._keyring is None:
+            return buf
+        try:
+            return self._keyring.decrypt(buf)
+        except KeyringError:
+            metrics.incr("memberlist.packet.decrypt_failed", 1, self.opts.metric_labels)
+            return None
+
+    def _queue_broadcast(self, buf: bytes, name: Optional[str] = None,
+                         notify: Optional[asyncio.Event] = None) -> None:
+        self.broadcasts.queue_broadcast(Broadcast(buf, name=name, notify=notify))
+
+    def _any_alive_peer(self) -> bool:
+        return any(
+            n.state == SwimState.ALIVE and n.id != self.local.id
+            for n in self._nodes.values()
+        )
+
+    def _next_seq(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    # ------------------------------------------------------------------
+    # protocol loops
+    # ------------------------------------------------------------------
+
+    async def _packet_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                src, raw = await self.transport.recv_packet()
+            except ConnectionError:
+                return
+            buf = self._decrypt(raw)
+            if buf is None:
+                continue
+            metrics.observe("memberlist.packet.received", len(buf), self.opts.metric_labels)
+            try:
+                msg = sm.decode_swim(buf)
+            except codec.DecodeError as e:
+                log.debug("dropping undecodable packet from %r: %s", src, e)
+                continue
+            for m in msg if isinstance(msg, list) else [msg]:
+                try:
+                    await self._handle_message(src, m)
+                except Exception:  # noqa: BLE001 - one bad message must not kill the loop
+                    log.exception("error handling %s from %r", type(m).__name__, src)
+
+    async def _handle_message(self, src, m) -> None:
+        if isinstance(m, sm.Ping):
+            await self._handle_ping(src, m)
+        elif isinstance(m, sm.IndirectPing):
+            await self._handle_indirect_ping(src, m)
+        elif isinstance(m, sm.Ack):
+            self._handle_ack(m)
+        elif isinstance(m, sm.Nack):
+            self._handle_nack(m)
+        elif isinstance(m, sm.Suspect):
+            self._handle_suspect(m)
+        elif isinstance(m, sm.Alive):
+            self._handle_alive(m)
+        elif isinstance(m, sm.Dead):
+            self._handle_dead(m)
+        elif isinstance(m, sm.UserMsg):
+            self.delegate.notify_message(m.payload)
+        else:
+            log.debug("unhandled packet-plane message %s", type(m).__name__)
+
+    async def _handle_ping(self, src, p: sm.Ping) -> None:
+        if p.target and p.target != self.local.id:
+            log.warning("misdirected ping for %r arrived at %r", p.target, self.local.id)
+            return
+        payload = self.delegate.ack_payload()
+        await self._send_packet(src, sm.encode_swim(sm.Ack(p.seq, payload)))
+
+    async def _handle_indirect_ping(self, src, ip: sm.IndirectPing) -> None:
+        """Probe ``target`` on behalf of ``source``; relay ack or nack."""
+        seq = self._next_seq()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._ack_futures[seq] = fut
+        await self._send_packet(
+            ip.target.addr, sm.encode_swim(sm.Ping(seq, self.local, ip.target.id))
+        )
+        try:
+            await asyncio.wait_for(fut, self.opts.probe_timeout)
+            await self._send_packet(src, sm.encode_swim(sm.Ack(ip.seq)))
+        except asyncio.TimeoutError:
+            await self._send_packet(src, sm.encode_swim(sm.Nack(ip.seq)))
+        finally:
+            self._ack_futures.pop(seq, None)
+
+    def _handle_ack(self, a: sm.Ack) -> None:
+        fut = self._ack_futures.get(a.seq)
+        if fut is not None and not fut.done():
+            fut.set_result((time.monotonic(), a.payload))
+
+    def _handle_nack(self, n: sm.Nack) -> None:
+        # only track nacks for probes still in flight (no unbounded growth)
+        if n.seq in self._ack_futures:
+            self._nack_counts[n.seq] = self._nack_counts.get(n.seq, 0) + 1
+
+    # --- state transitions -------------------------------------------------
+
+    def _refute(self, incarnation: int) -> None:
+        """Someone claims we are suspect/dead: bump past their incarnation and
+        broadcast alive.  Lifeguard: being refuted degrades our own health."""
+        me = self._nodes[self.local.id]
+        self._incarnation = max(self._incarnation, incarnation) + 1
+        me.incarnation = self._incarnation
+        self._awareness.apply_delta(1)
+        msg = sm.Alive(me.incarnation, self.local, me.meta)
+        self._queue_broadcast(sm.encode_swim(msg), name=self.local.id)
+
+    def _handle_alive(self, a: sm.Alive) -> None:
+        if self._leaving and a.node.id == self.local.id:
+            return
+        err = self.delegate.notify_alive(a)
+        if err is not None:
+            log.debug("alive for %r vetoed: %s", a.node.id, err)
+            return
+        ns = self._nodes.get(a.node.id)
+        if ns is None:
+            ns = NodeState(a.node, a.incarnation, SwimState.ALIVE, a.meta)
+            self._nodes[a.node.id] = ns
+            # insert at a random probe position so new nodes get probed fairly
+            idx = self.rng.randint(0, len(self._probe_order))
+            self._probe_order.insert(idx, a.node.id)
+            self.delegate.notify_join(ns)
+            self._queue_broadcast(sm.encode_swim(a), name=a.node.id)
+            metrics.incr("memberlist.node.join", 1, self.opts.metric_labels)
+            return
+        # address conflict: same id, different address
+        if ns.addr != a.node.addr:
+            if a.node.id == self.local.id:
+                # it is about us: refute with higher incarnation
+                if a.incarnation >= self._incarnation:
+                    self._refute(a.incarnation)
+            else:
+                self.delegate.notify_conflict(ns, a)
+            return
+        if a.node.id == self.local.id:
+            # a rebroadcast of our own alive: refute only if it beats us
+            if a.incarnation > self._incarnation:
+                self._refute(a.incarnation)
+            return
+        if a.incarnation <= ns.incarnation and ns.state == SwimState.ALIVE:
+            if a.incarnation == ns.incarnation and a.meta != ns.meta:
+                ns.meta = a.meta
+                self.delegate.notify_update(ns)
+            return
+        if a.incarnation < ns.incarnation:
+            return
+        # a.incarnation > ns.incarnation, or equal while suspect/dead requires >
+        if a.incarnation == ns.incarnation and ns.state != SwimState.ALIVE:
+            return  # alive does not clear suspicion at equal incarnation
+        meta_changed = a.meta != ns.meta
+        was_gone = ns.state in (SwimState.DEAD, SwimState.LEFT)
+        ns.incarnation = a.incarnation
+        ns.meta = a.meta
+        if ns.state != SwimState.ALIVE:
+            ns.state = SwimState.ALIVE
+            ns.state_change = time.monotonic()
+            self._suspicions.pop(ns.id, None)
+        if was_gone:
+            self.delegate.notify_join(ns)
+            metrics.incr("memberlist.node.join", 1, self.opts.metric_labels)
+        elif meta_changed:
+            self.delegate.notify_update(ns)
+        self._queue_broadcast(sm.encode_swim(a), name=a.node.id)
+
+    def _handle_suspect(self, s: sm.Suspect) -> None:
+        ns = self._nodes.get(s.node)
+        if ns is None or s.incarnation < ns.incarnation:
+            return
+        if s.node == self.local.id:
+            if not self._leaving:
+                self._refute(s.incarnation)
+            return
+        if ns.state == SwimState.SUSPECT:
+            susp = self._suspicions.get(s.node)
+            if susp is not None and susp.confirm(s.from_node):
+                self._queue_broadcast(sm.encode_swim(s), name=s.node)
+            return
+        if ns.state != SwimState.ALIVE:
+            return
+        ns.state = SwimState.SUSPECT
+        ns.state_change = time.monotonic()
+        self._start_suspicion(ns, s.incarnation, s.from_node)
+        self._queue_broadcast(sm.encode_swim(s), name=s.node)
+        metrics.incr("memberlist.node.suspect", 1, self.opts.metric_labels)
+
+    def _start_suspicion(self, ns: NodeState, incarnation: int, from_node: str) -> None:
+        n = max(1, self.num_online_members())
+        min_t = self.opts.suspicion_mult * max(1.0, math.log10(max(n, 1) + 1)) * self.opts.probe_interval
+        max_t = self.opts.suspicion_max_timeout_mult * min_t
+        susp = _Suspicion(self.opts.indirect_checks, min_t, max_t, from_node)
+        self._suspicions[ns.id] = susp
+        self._spawn(self._suspicion_timer(ns.id, incarnation),
+                    name=f"ml-susp-{self.local.id}-{ns.id}")
+
+    async def _suspicion_timer(self, node_id: str, incarnation: int) -> None:
+        while not self._shutdown:
+            susp = self._suspicions.get(node_id)
+            ns = self._nodes.get(node_id)
+            if susp is None or ns is None or ns.state != SwimState.SUSPECT:
+                return
+            now = time.monotonic()
+            deadline = susp.deadline()
+            if now >= deadline:
+                self._suspicions.pop(node_id, None)
+                self._mark_dead(ns, max(incarnation, ns.incarnation), self.local.id)
+                return
+            await asyncio.sleep(min(deadline - now, self.opts.probe_interval))
+
+    def _mark_dead(self, ns: NodeState, incarnation: int, from_node: str) -> None:
+        d = sm.Dead(incarnation, ns.id, from_node)
+        self._handle_dead(d)
+
+    def _handle_dead(self, d: sm.Dead) -> None:
+        ns = self._nodes.get(d.node)
+        if ns is None:
+            return
+        is_leave = d.from_node == d.node
+        if d.incarnation < ns.incarnation and not is_leave:
+            return
+        if d.node == self.local.id:
+            if not self._leaving:
+                self._refute(d.incarnation)
+            return
+        if ns.state in (SwimState.DEAD, SwimState.LEFT):
+            return
+        ns.incarnation = max(ns.incarnation, d.incarnation)
+        ns.state = SwimState.LEFT if is_leave else SwimState.DEAD
+        ns.state_change = time.monotonic()
+        self._suspicions.pop(d.node, None)
+        self._queue_broadcast(sm.encode_swim(d), name=d.node)
+        self.delegate.notify_leave(ns)
+        metrics.incr("memberlist.node.dead", 1, self.opts.metric_labels)
+
+    # --- probe / gossip / push-pull loops ---------------------------------
+
+    async def _probe_loop(self) -> None:
+        while not self._shutdown:
+            await asyncio.sleep(self.opts.probe_interval)
+            try:
+                target = self._next_probe_target()
+                if target is not None and target.id not in self._probing:
+                    # run the probe concurrently so an awareness-scaled slow
+                    # probe never stalls detection of other members
+                    self._probing.add(target.id)
+                    t = self._spawn(self._probe_node(target),
+                                    name=f"ml-probe1-{self.local.id}-{target.id}")
+                    t.add_done_callback(
+                        lambda _t, nid=target.id: self._probing.discard(nid))
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001
+                log.exception("probe iteration failed")
+
+    def _next_probe_target(self) -> Optional[NodeState]:
+        """Round-robin over a shuffled order, reshuffling each full pass
+        (SWIM's bounded-detection-time trick)."""
+        n = len(self._probe_order)
+        for _ in range(n):
+            if self._probe_index >= len(self._probe_order):
+                self.rng.shuffle(self._probe_order)
+                self._probe_index = 0
+            node_id = self._probe_order[self._probe_index]
+            self._probe_index += 1
+            ns = self._nodes.get(node_id)
+            if ns is None:
+                self._probe_order.remove(node_id)
+                self._probe_index = max(0, self._probe_index - 1)
+                continue
+            if ns.id == self.local.id or ns.state in (SwimState.DEAD, SwimState.LEFT):
+                continue
+            return ns
+        return None
+
+    async def _probe_node(self, ns: NodeState) -> None:
+        seq = self._next_seq()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._ack_futures[seq] = fut
+        sent = time.monotonic()
+        try:
+            await self._send_packet(ns.addr, sm.encode_swim(sm.Ping(seq, self.local, ns.id)))
+            timeout = self._awareness.scale(self.opts.probe_timeout)
+            try:
+                _, payload = await asyncio.wait_for(fut, timeout)
+                rtt = time.monotonic() - sent
+                self._awareness.apply_delta(-1)
+                self.delegate.notify_ping_complete(ns, rtt, payload)
+                return
+            except asyncio.TimeoutError:
+                pass
+            # indirect probes through k random alive peers
+            peers = [
+                p for p in self._nodes.values()
+                if p.state == SwimState.ALIVE and p.id not in (self.local.id, ns.id)
+            ]
+            self.rng.shuffle(peers)
+            relays = peers[: self.opts.indirect_checks]
+            if relays:
+                seq2 = self._next_seq()
+                fut2: asyncio.Future = asyncio.get_running_loop().create_future()
+                self._ack_futures[seq2] = fut2
+                ip = sm.IndirectPing(seq2, self.local, ns.node)
+                for r in relays:
+                    await self._send_packet(r.addr, sm.encode_swim(ip))
+                nacks = 0
+                try:
+                    await asyncio.wait_for(fut2, self._awareness.scale(self.opts.probe_timeout) * 2)
+                    self._awareness.apply_delta(-1)
+                    return
+                except asyncio.TimeoutError:
+                    pass
+                finally:
+                    self._ack_futures.pop(seq2, None)
+                    nacks = self._nack_counts.pop(seq2, 0)
+                # Lifeguard: missing nacks mean *we* may be degraded
+                missed_nacks = len(relays) - nacks
+                self._awareness.apply_delta(1 + max(0, missed_nacks))
+            else:
+                self._awareness.apply_delta(1)
+            if ns.state == SwimState.ALIVE:
+                metrics.incr("memberlist.probe.failed", 1, self.opts.metric_labels)
+                s = sm.Suspect(ns.incarnation, ns.id, self.local.id)
+                self._handle_suspect(s)
+        finally:
+            self._ack_futures.pop(seq, None)
+
+    async def _gossip_loop(self) -> None:
+        while not self._shutdown:
+            await asyncio.sleep(self.opts.gossip_interval)
+            try:
+                await self._gossip_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001
+                log.exception("gossip tick failed")
+
+    async def _gossip_once(self) -> None:
+        # gossip to alive + suspect nodes, and occasionally to dead ones
+        # (gives partitioned/dead nodes a chance to refute and recover)
+        candidates = [
+            n for n in self._nodes.values()
+            if n.id != self.local.id and (
+                n.state in (SwimState.ALIVE, SwimState.SUSPECT)
+                or (n.state == SwimState.DEAD
+                    and time.monotonic() - n.state_change < 10 * self.opts.probe_interval)
+            )
+        ]
+        if not candidates:
+            return
+        self.rng.shuffle(candidates)
+        budget = self.transport.max_packet_size
+        # Drain once per tick and send the same payload to all k targets —
+        # one queue "transmit" fans out to gossip_nodes deliveries, matching
+        # memberlist's dissemination rate.
+        parts = self.broadcasts.get_broadcasts(4, budget)
+        used = sum(len(p) + 4 for p in parts)
+        extra = self.delegate.broadcast_messages(6, budget - used)
+        parts.extend(sm.encode_swim(sm.UserMsg(u)) for u in extra)
+        if not parts:
+            return
+        packet = sm.encode_compound(parts) if len(parts) > 1 else parts[0]
+        for target in candidates[: self.opts.gossip_nodes]:
+            await self._send_packet(target.addr, packet)
+
+    async def _push_pull_loop(self) -> None:
+        while not self._shutdown:
+            await asyncio.sleep(self.opts.push_pull_interval)
+            peers = [n for n in self.online_members() if n.id != self.local.id]
+            if not peers:
+                continue
+            peer = self.rng.choice(peers)
+            try:
+                await self._push_pull_with(peer.addr, join=False)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                log.debug("periodic push/pull with %r failed: %s", peer.id, e)
+
+    def _local_push_states(self) -> List[sm.PushNodeState]:
+        return [
+            sm.PushNodeState(n.node, n.incarnation, n.state, n.meta)
+            for n in self._nodes.values()
+        ]
+
+    async def _push_pull_with(self, addr, join: bool) -> None:
+        stream = await self.transport.dial(addr, timeout=self.opts.timeout)
+        try:
+            out = sm.PushPull(join, tuple(self._local_push_states()),
+                              self.delegate.local_state(join))
+            await stream.send_frame(self._maybe_encrypt(sm.encode_swim(out)))
+            reply_raw = await stream.recv_frame(self.opts.timeout)
+            reply = self._decode_stream_msg(reply_raw)
+            if not isinstance(reply, sm.PushPull):
+                raise codec.DecodeError("expected push/pull reply")
+            self._merge_remote(reply, join)
+        finally:
+            await stream.close()
+
+    async def _stream_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                src, stream = await self.transport.accept()
+            except ConnectionError:
+                return
+            self._spawn(self._serve_stream(src, stream),
+                        name=f"ml-serve-{self.local.id}")
+
+    async def _serve_stream(self, src, stream) -> None:
+        try:
+            raw = await stream.recv_frame(self.opts.timeout)
+            msg = self._decode_stream_msg(raw)
+            if isinstance(msg, sm.PushPull):
+                out = sm.PushPull(False, tuple(self._local_push_states()),
+                                  self.delegate.local_state(msg.join))
+                await stream.send_frame(self._maybe_encrypt(sm.encode_swim(out)))
+                self._merge_remote(msg, msg.join)
+            elif isinstance(msg, sm.UserMsg):
+                self.delegate.notify_message(msg.payload)
+        except (codec.DecodeError, ConnectionError, TimeoutError, KeyringError) as e:
+            log.debug("stream from %r failed: %s", src, e)
+        except Exception:  # noqa: BLE001
+            log.exception("stream handler error from %r", src)
+        finally:
+            await stream.close()
+
+    def _maybe_encrypt(self, buf: bytes) -> bytes:
+        return self._keyring.encrypt(buf) if self._keyring is not None else buf
+
+    def _decode_stream_msg(self, raw: bytes):
+        buf = self._decrypt(raw)
+        if buf is None:
+            raise KeyringError("undecryptable stream frame")
+        return sm.decode_swim(buf)
+
+    def _merge_remote(self, pp: sm.PushPull, join: bool) -> None:
+        err = self.delegate.notify_merge(pp.states)
+        if err is not None:
+            log.warning("push/pull merge vetoed: %s", err)
+            return
+        for st in pp.states:
+            if st.state == SwimState.ALIVE:
+                self._handle_alive(sm.Alive(st.incarnation, st.node, st.meta))
+            elif st.state in (SwimState.SUSPECT, SwimState.DEAD):
+                # Remote suspect AND dead both merge as *suspect* (memberlist
+                # semantics): gives a live node the chance to refute instead
+                # of resurrect-then-kill churn.  Unknown nodes are skipped —
+                # we never first-learn a node from its death notice.
+                if st.node.id in self._nodes:
+                    self._handle_suspect(sm.Suspect(st.incarnation, st.node.id, self.local.id))
+            elif st.state == SwimState.LEFT:
+                if st.node.id in self._nodes:
+                    self._handle_dead(sm.Dead(st.incarnation, st.node.id, st.node.id))
+        if pp.user_data:
+            self.delegate.merge_remote_state(pp.user_data, join)

@@ -1,0 +1,217 @@
+"""Transports: how gossip packets and push/pull streams move.
+
+The reference's transport seam is the ``Transport`` trait with packet
+(unreliable datagram) and stream (reliable, framed) planes
+(SURVEY.md §2.9; reference serf/Cargo.toml:24-56 wires TCP/UDP, TLS, QUIC).
+serf-tpu ships:
+
+- ``LoopbackTransport`` — in-memory network for in-process multi-node
+  clusters and tests, with first-class fault injection (per-edge drop
+  functions, partitions, latency), standing in for the reference's
+  CI loopback-subnet strategy (ci/setup_subnet_ubuntu.sh).
+- ``UdpTransport`` — real UDP datagrams + TCP streams (see ``net.py``).
+
+Fault injection is part of the transport contract because the device plane
+treats drop masks as input tensors; the host plane mirrors that.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+PACKET_BUDGET = 1400  # UDP-safe payload budget per gossip packet (bytes)
+
+
+class Stream:
+    """Reliable bidirectional framed byte stream."""
+
+    async def send_frame(self, buf: bytes) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    async def recv_frame(self, timeout: Optional[float] = None) -> bytes:  # pragma: no cover
+        raise NotImplementedError
+
+    async def close(self) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class Transport:
+    """Packet + stream planes bound to one local address."""
+
+    @property
+    def local_addr(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    @property
+    def max_packet_size(self) -> int:
+        return PACKET_BUDGET
+
+    async def send_packet(self, addr, buf: bytes) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    async def recv_packet(self) -> Tuple[object, bytes]:  # pragma: no cover
+        """Returns (source_addr, payload)."""
+        raise NotImplementedError
+
+    async def dial(self, addr, timeout: Optional[float] = None) -> Stream:  # pragma: no cover
+        raise NotImplementedError
+
+    async def accept(self) -> Stream:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    async def shutdown(self) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Loopback
+# ---------------------------------------------------------------------------
+
+
+class _LoopbackStream(Stream):
+    def __init__(self, peer_q: asyncio.Queue, my_q: asyncio.Queue):
+        self._peer_q = peer_q
+        self._my_q = my_q
+        self._closed = False
+
+    async def send_frame(self, buf: bytes) -> None:
+        if self._closed:
+            raise ConnectionError("stream closed")
+        await self._peer_q.put(buf)
+
+    async def recv_frame(self, timeout: Optional[float] = None) -> bytes:
+        try:
+            if timeout is None:
+                item = await self._my_q.get()
+            else:
+                item = await asyncio.wait_for(self._my_q.get(), timeout)
+        except asyncio.TimeoutError:
+            raise TimeoutError("stream recv timeout") from None
+        if item is None:
+            raise ConnectionError("stream closed by peer")
+        return item
+
+    async def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            await self._peer_q.put(None)
+
+
+@dataclass
+class LoopbackNetwork:
+    """Shared in-memory fabric.  Addresses are plain strings/ints.
+
+    ``drop_fn(src, dst, buf) -> bool`` returning True drops the packet;
+    ``latency_fn(src, dst) -> float`` delays delivery.  Partitions are a
+    convenience wrapper over ``drop_fn`` affecting packets AND streams.
+    """
+
+    transports: Dict[object, "LoopbackTransport"] = field(default_factory=dict)
+    drop_fn: Optional[Callable[[object, object, bytes], bool]] = None
+    latency_fn: Optional[Callable[[object, object], float]] = None
+    _partitions: Optional[List[set]] = None
+    rng: random.Random = field(default_factory=lambda: random.Random(0))
+
+    def bind(self, addr) -> "LoopbackTransport":
+        if addr in self.transports:
+            raise OSError(f"address {addr!r} already bound")
+        t = LoopbackTransport(self, addr)
+        self.transports[addr] = t
+        return t
+
+    def _release(self, addr) -> None:
+        self.transports.pop(addr, None)
+
+    # fault injection -------------------------------------------------------
+
+    def partition(self, *groups: set) -> None:
+        """Only nodes within the same group can communicate."""
+        self._partitions = [set(g) for g in groups]
+
+    def heal(self) -> None:
+        self._partitions = None
+
+    def set_drop_rate(self, p: float, seed: int = 0) -> None:
+        rng = random.Random(seed)
+        self.drop_fn = (lambda s, d, b: rng.random() < p) if p > 0 else None
+
+    def _blocked(self, src, dst) -> bool:
+        if self._partitions is not None:
+            for g in self._partitions:
+                if src in g and dst in g:
+                    return False
+            return True
+        return False
+
+    def _should_drop(self, src, dst, buf: bytes) -> bool:
+        if self._blocked(src, dst):
+            return True
+        if self.drop_fn is not None and self.drop_fn(src, dst, buf):
+            return True
+        return False
+
+
+class LoopbackTransport(Transport):
+    def __init__(self, net: LoopbackNetwork, addr):
+        self._net = net
+        self._addr = addr
+        self._packets: asyncio.Queue = asyncio.Queue()
+        self._accepts: asyncio.Queue = asyncio.Queue()
+        self._shut = False
+
+    @property
+    def local_addr(self):
+        return self._addr
+
+    async def send_packet(self, addr, buf: bytes) -> None:
+        if self._shut:
+            raise ConnectionError("transport shut down")
+        net = self._net
+        if net._should_drop(self._addr, addr, buf):
+            return  # silently dropped, like UDP
+        target = net.transports.get(addr)
+        if target is None or target._shut:
+            return  # unreachable, like UDP
+        if net.latency_fn is not None:
+            delay = net.latency_fn(self._addr, addr)
+            if delay > 0:
+                asyncio.get_running_loop().call_later(
+                    delay, target._packets.put_nowait, (self._addr, buf)
+                )
+                return
+        target._packets.put_nowait((self._addr, buf))
+
+    async def recv_packet(self) -> Tuple[object, bytes]:
+        item = await self._packets.get()
+        if item is None:
+            raise ConnectionError("transport shut down")
+        return item
+
+    async def dial(self, addr, timeout: Optional[float] = None) -> Stream:
+        if self._net._blocked(self._addr, addr):
+            raise ConnectionError(f"no route to {addr!r} (partition)")
+        target = self._net.transports.get(addr)
+        if target is None or target._shut:
+            raise ConnectionError(f"connection refused: {addr!r}")
+        a2b: asyncio.Queue = asyncio.Queue()
+        b2a: asyncio.Queue = asyncio.Queue()
+        ours = _LoopbackStream(peer_q=a2b, my_q=b2a)
+        theirs = _LoopbackStream(peer_q=b2a, my_q=a2b)
+        target._accepts.put_nowait((self._addr, theirs))
+        return ours
+
+    async def accept(self) -> Tuple[object, Stream]:
+        item = await self._accepts.get()
+        if item is None:
+            raise ConnectionError("transport shut down")
+        return item
+
+    async def shutdown(self) -> None:
+        if not self._shut:
+            self._shut = True
+            self._net._release(self._addr)
+            self._packets.put_nowait(None)
+            self._accepts.put_nowait(None)

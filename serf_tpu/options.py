@@ -47,7 +47,7 @@ class MemberlistOptions:
             probe_interval=0.05,
             probe_timeout=0.025,
             suspicion_mult=1,
-            push_pull_interval=0.0,  # disabled unless a test enables it
+            push_pull_interval=0.25,  # fast anti-entropy repair for tests
             timeout=2.0,
         )
 

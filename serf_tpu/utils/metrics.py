@@ -1,0 +1,81 @@
+"""In-process metrics facade: counters, gauges, histograms with labels.
+
+Analog of the reference's ``metrics`` crate facade (SURVEY.md §5): the engine
+emits at the same points with the same metric names (``serf.events``,
+``serf.member.join``, ``serf.queue.*`` depth gauges, message-size histograms,
+...).  A process-global ``MetricsSink`` collects; swap it out to export.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+LabelSet = Tuple[Tuple[str, str], ...]
+
+
+def _labels(labels: Optional[Dict[str, str]]) -> LabelSet:
+    if not labels:
+        return ()
+    return tuple(sorted(labels.items()))
+
+
+class MetricsSink:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[Tuple[str, LabelSet], float] = defaultdict(float)
+        self.gauges: Dict[Tuple[str, LabelSet], float] = {}
+        self.histograms: Dict[Tuple[str, LabelSet], List[float]] = defaultdict(list)
+
+    def incr(self, name: str, value: float = 1.0, labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self.counters[(name, _labels(labels))] += value
+
+    def gauge(self, name: str, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self.gauges[(name, _labels(labels))] = value
+
+    def observe(self, name: str, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self.histograms[(name, _labels(labels))].append(value)
+
+    # inspection helpers (tests, stats)
+    def counter(self, name: str, labels: Optional[Dict[str, str]] = None) -> float:
+        return self.counters.get((name, _labels(labels)), 0.0)
+
+    def gauge_value(self, name: str, labels: Optional[Dict[str, str]] = None) -> Optional[float]:
+        return self.gauges.get((name, _labels(labels)))
+
+    def histogram(self, name: str, labels: Optional[Dict[str, str]] = None) -> List[float]:
+        return self.histograms.get((name, _labels(labels)), [])
+
+    def reset(self) -> None:
+        with self._lock:
+            self.counters.clear()
+            self.gauges.clear()
+            self.histograms.clear()
+
+
+_global = MetricsSink()
+
+
+def global_sink() -> MetricsSink:
+    return _global
+
+
+def set_global_sink(sink: MetricsSink) -> None:
+    global _global
+    _global = sink
+
+
+def incr(name: str, value: float = 1.0, labels: Optional[Dict[str, str]] = None) -> None:
+    _global.incr(name, value, labels)
+
+
+def gauge(name: str, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+    _global.gauge(name, value, labels)
+
+
+def observe(name: str, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+    _global.observe(name, value, labels)

@@ -1,0 +1,56 @@
+"""Keyring: install/use/remove semantics, encryption round-trip, persistence."""
+
+import pytest
+
+from serf_tpu.host.keyring import KeyringError, SecretKeyring
+
+K1, K2, K3 = bytes(range(16)), bytes(range(16, 48)), bytes(range(8, 32))
+
+
+def test_encrypt_decrypt_round_trip():
+    ring = SecretKeyring(K1)
+    ct = ring.encrypt(b"gossip", b"aad")
+    assert ring.decrypt(ct, b"aad") == b"gossip"
+    with pytest.raises(KeyringError):
+        ring.decrypt(ct, b"wrong-aad")
+    with pytest.raises(KeyringError):
+        ring.decrypt(b"\x01" + b"0" * 30)
+
+
+def test_rotation_any_installed_key_decrypts():
+    ring = SecretKeyring(K1)
+    ct_old = ring.encrypt(b"old")
+    ring.install(K2)
+    ring.use_key(K2)
+    assert ring.decrypt(ct_old) == b"old"          # old-key traffic still readable
+    ct_new = ring.encrypt(b"new")
+    peer = SecretKeyring(K1, [K2])
+    assert peer.decrypt(ct_new) == b"new"          # peer mid-rotation reads new traffic
+    with pytest.raises(KeyringError):
+        ring.remove(K2)                            # cannot remove primary
+    ring.remove(K1)
+    with pytest.raises(KeyringError):
+        ring.decrypt(ct_old)                       # removed key no longer decrypts
+
+
+def test_save_load_preserves_rotated_primary(tmp_path):
+    ring = SecretKeyring(K1)
+    ring.install(K2)
+    ring.use_key(K2)
+    p = str(tmp_path / "keyring.json")
+    ring.save(p)
+    import os
+    assert oct(os.stat(p).st_mode & 0o777) == "0o600"
+    loaded = SecretKeyring.load(p)
+    assert loaded.primary_key() == K2              # rotation survives persistence
+    assert set(loaded.keys()) == {K1, K2}
+
+
+def test_bad_key_sizes_rejected():
+    with pytest.raises(KeyringError):
+        SecretKeyring(b"short")
+    ring = SecretKeyring(K1)
+    with pytest.raises(KeyringError):
+        ring.install(b"also-bad")
+    with pytest.raises(KeyringError):
+        ring.use_key(K3)  # not installed

@@ -1,0 +1,235 @@
+"""SWIM layer tests: join/converge, failure detection, refutation, leave,
+partition behavior, encryption.
+
+Analog of the reference's multi-node-in-process strategy (SURVEY.md §4):
+N real Memberlist instances on a loopback fabric with compressed protocol
+timings (gossip 5 ms / probe 50 ms), convergence asserted by polling with a
+7 s deadline (reference base/tests.rs:25-96).
+"""
+
+import asyncio
+
+import pytest
+
+from serf_tpu.host.keyring import SecretKeyring
+from serf_tpu.host.memberlist import Memberlist
+from serf_tpu.host.messages import SwimState
+from serf_tpu.host.transport import LoopbackNetwork
+from serf_tpu.options import MemberlistOptions
+
+pytestmark = pytest.mark.asyncio
+
+DEADLINE = 7.0
+
+
+async def wait_until(cond, deadline=DEADLINE, interval=0.01, msg="condition"):
+    loop = asyncio.get_running_loop()
+    end = loop.time() + deadline
+    while loop.time() < end:
+        if cond():
+            return
+        await asyncio.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+async def make_cluster(net, n, opts=None, keyring=None, start_port=0):
+    nodes = []
+    for i in range(start_port, start_port + n):
+        t = net.bind(f"addr-{i}")
+        ml = Memberlist(t, opts or MemberlistOptions.local(), f"node-{i}", keyring=keyring)
+        await ml.start()
+        nodes.append(ml)
+    return nodes
+
+
+async def join_all(nodes):
+    for ml in nodes[1:]:
+        await ml.join(nodes[0].transport.local_addr)
+
+
+async def shutdown_all(nodes):
+    for ml in nodes:
+        await ml.shutdown()
+
+
+async def test_join_two_nodes():
+    net = LoopbackNetwork()
+    nodes = await make_cluster(net, 2)
+    try:
+        await nodes[1].join(nodes[0].transport.local_addr)
+        await wait_until(lambda: all(m.num_online_members() == 2 for m in nodes),
+                         msg="2-node convergence")
+        assert {n.id for n in nodes[0].members()} == {"node-0", "node-1"}
+    finally:
+        await shutdown_all(nodes)
+
+
+async def test_join_converges_10_nodes():
+    net = LoopbackNetwork()
+    nodes = await make_cluster(net, 10)
+    try:
+        await join_all(nodes)
+        await wait_until(lambda: all(m.num_online_members() == 10 for m in nodes),
+                         msg="10-node convergence")
+    finally:
+        await shutdown_all(nodes)
+
+
+async def test_failure_detection():
+    net = LoopbackNetwork()
+    nodes = await make_cluster(net, 4)
+    try:
+        await join_all(nodes)
+        await wait_until(lambda: all(m.num_online_members() == 4 for m in nodes))
+        victim = nodes[3]
+        await victim.shutdown()
+        await wait_until(
+            lambda: all(m.num_online_members() == 3 for m in nodes[:3]),
+            msg="failure detected on all survivors",
+        )
+        await wait_until(
+            lambda: all(m._nodes["node-3"].state == SwimState.DEAD for m in nodes[:3]),
+            msg="suspicion expires into DEAD",
+        )
+    finally:
+        await shutdown_all(nodes[:3])
+
+
+async def test_graceful_leave_is_left_not_dead():
+    net = LoopbackNetwork()
+    nodes = await make_cluster(net, 3)
+    try:
+        await join_all(nodes)
+        await wait_until(lambda: all(m.num_online_members() == 3 for m in nodes))
+        await nodes[2].leave(2.0)
+        await wait_until(
+            lambda: all(m._nodes["node-2"].state == SwimState.LEFT for m in nodes[:2]),
+            msg="leave disseminated as LEFT",
+        )
+        await nodes[2].shutdown()
+    finally:
+        await shutdown_all(nodes[:2])
+
+
+async def test_refute_suspicion():
+    """A healthy node accused of being suspect must refute and stay alive."""
+    net = LoopbackNetwork()
+    nodes = await make_cluster(net, 3)
+    try:
+        await join_all(nodes)
+        await wait_until(lambda: all(m.num_online_members() == 3 for m in nodes))
+        # drop only packets TO node-2 briefly so node-0/1 suspect it
+        net.drop_fn = lambda s, d, b: d == "addr-2"
+        await wait_until(
+            lambda: nodes[0]._nodes["node-2"].state != SwimState.ALIVE,
+            msg="node-2 suspected/dead while unreachable",
+        )
+        net.drop_fn = None
+        await wait_until(
+            lambda: all(m._nodes["node-2"].state == SwimState.ALIVE for m in nodes[:2]),
+            msg="node-2 refutes and is alive again",
+        )
+        inc = nodes[0]._nodes["node-2"].incarnation
+        assert inc > 1  # refutation bumped the incarnation
+    finally:
+        await shutdown_all(nodes)
+
+
+async def test_partition_and_heal():
+    net = LoopbackNetwork()
+    nodes = await make_cluster(net, 4)
+    opts = nodes[0].opts
+    try:
+        await join_all(nodes)
+        await wait_until(lambda: all(m.num_online_members() == 4 for m in nodes))
+        net.partition({"addr-0", "addr-1"}, {"addr-2", "addr-3"})
+        await wait_until(
+            lambda: nodes[0].num_online_members() == 2 and nodes[2].num_online_members() == 2,
+            msg="partition splits membership",
+        )
+        net.heal()
+        # push/pull re-merges after heal (gossip to dead nodes also helps)
+        for src, dst in [(1, 2), (3, 0)]:
+            try:
+                await nodes[src]._push_pull_with(nodes[dst].transport.local_addr, join=False)
+            except ConnectionError:
+                pass
+        await wait_until(
+            lambda: all(m.num_online_members() == 4 for m in nodes),
+            msg="heal re-merges the cluster",
+        )
+    finally:
+        await shutdown_all(nodes)
+
+
+async def test_encrypted_cluster_converges():
+    key = bytes(range(32))
+    ring = SecretKeyring(key)
+    net = LoopbackNetwork()
+    nodes = await make_cluster(net, 3, keyring=ring)
+    try:
+        await join_all(nodes)
+        await wait_until(lambda: all(m.num_online_members() == 3 for m in nodes))
+        assert nodes[0].encryption_enabled()
+    finally:
+        await shutdown_all(nodes)
+
+
+async def test_encrypted_rejects_plaintext_peer():
+    ring = SecretKeyring(bytes(range(16)))
+    net = LoopbackNetwork()
+    enc = await make_cluster(net, 2, keyring=ring)
+    plain = await make_cluster(net, 1, start_port=10)
+    try:
+        await enc[1].join(enc[0].transport.local_addr)
+        with pytest.raises(Exception):
+            await plain[0].join(enc[0].transport.local_addr)
+        await wait_until(lambda: enc[0].num_online_members() == 2)
+        assert enc[0].num_online_members() == 2  # plaintext node never got in
+    finally:
+        await shutdown_all(enc + plain)
+
+
+async def test_user_message_delivery():
+    net = LoopbackNetwork()
+    nodes = await make_cluster(net, 2)
+    got = []
+    nodes[0].delegate.notify_message = got.append
+    try:
+        await nodes[1].join(nodes[0].transport.local_addr)
+        await wait_until(lambda: all(m.num_online_members() == 2 for m in nodes))
+        await nodes[1].send(nodes[0].transport.local_addr, b"hello-serf-plane")
+        await wait_until(lambda: got == [b"hello-serf-plane"], msg="user message arrives")
+    finally:
+        await shutdown_all(nodes)
+
+
+async def test_update_node_propagates_meta():
+    net = LoopbackNetwork()
+    nodes = await make_cluster(net, 3)
+    try:
+        await join_all(nodes)
+        await wait_until(lambda: all(m.num_online_members() == 3 for m in nodes))
+        nodes[0].delegate.node_meta = lambda limit: b"fresh-meta"
+        await nodes[0].update_node(2.0)
+        await wait_until(
+            lambda: all(m._nodes["node-0"].meta == b"fresh-meta" for m in nodes[1:]),
+            msg="meta update gossiped",
+        )
+    finally:
+        await shutdown_all(nodes)
+
+
+async def test_health_score_degrades_when_isolated():
+    net = LoopbackNetwork()
+    nodes = await make_cluster(net, 3)
+    try:
+        await join_all(nodes)
+        await wait_until(lambda: all(m.num_online_members() == 3 for m in nodes))
+        assert nodes[0].health_score() == 0
+        # isolate node-0: its probes all fail -> Lifeguard degrades its health
+        net.drop_fn = lambda s, d, b: s == "addr-0" or d == "addr-0"
+        await wait_until(lambda: nodes[0].health_score() > 0,
+                         msg="isolated node's health degrades")
+    finally:
+        await shutdown_all(nodes)
