@@ -143,9 +143,36 @@ def iter_fields(buf: bytes, pos: int = 0, end: int | None = None) -> Iterator[Tu
         yield field, wt, value, pos
 
 
-def read_double(raw: bytes) -> float:
+def read_double(raw) -> float:
+    if not isinstance(raw, (bytes, bytearray)) or len(raw) != 8:
+        raise DecodeError("expected fixed64 field")
     return struct.unpack("<d", raw)[0]
 
 
-def read_u64(raw: bytes) -> int:
+def read_u64(raw) -> int:
+    if not isinstance(raw, (bytes, bytearray)) or len(raw) != 8:
+        raise DecodeError("expected fixed64 field")
     return struct.unpack("<Q", raw)[0]
+
+
+# Wire-type guards: decoders use these so a field encoded with the wrong wire
+# type raises DecodeError at decode time instead of producing a type-confused
+# message that explodes later inside a protocol handler.
+
+def as_uint(v) -> int:
+    if not isinstance(v, int):
+        raise DecodeError("wire type mismatch: expected varint field")
+    return v
+
+
+def as_bytes(v) -> bytes:
+    if not isinstance(v, (bytes, bytearray, memoryview)):
+        raise DecodeError("wire type mismatch: expected length-delimited field")
+    return bytes(v)
+
+
+def as_str(v) -> str:
+    try:
+        return as_bytes(v).decode("utf-8")
+    except UnicodeDecodeError as e:
+        raise DecodeError(f"invalid utf-8 in string field: {e}") from e

@@ -1,1 +1,56 @@
-"""(package)"""
+"""Host plane: the asyncio Serf engine with reference-parity API surface.
+
+Quick start::
+
+    from serf_tpu.host import Serf, LoopbackNetwork, EventSubscriber
+    from serf_tpu.options import Options
+
+    net = LoopbackNetwork()
+    a = await Serf.create(net.bind("a"), Options.local(), "node-a")
+    b = await Serf.create(net.bind("b"), Options.local(), "node-b")
+    await b.join("a")
+    await a.user_event("deploy", b"v2")
+"""
+
+from serf_tpu.host.serf import Serf, SerfState, Stats
+from serf_tpu.host.events import (
+    EventSubscriber,
+    MemberEvent,
+    MemberEventType,
+    QueryEvent,
+    UserEvent,
+)
+from serf_tpu.host.query import NodeResponse, QueryParam, QueryResponse
+from serf_tpu.host.transport import LoopbackNetwork, LoopbackTransport, Transport
+from serf_tpu.host.memberlist import Memberlist
+from serf_tpu.host.keyring import SecretKeyring
+from serf_tpu.host.delegate import CompositeDelegate, MergeDelegate, ReconnectDelegate
+from serf_tpu.host.coordinate import Coordinate, CoordinateClient, CoordinateOptions
+from serf_tpu.host.key_manager import KeyManager, KeyResponse
+
+__all__ = [
+    "Serf",
+    "SerfState",
+    "Stats",
+    "EventSubscriber",
+    "MemberEvent",
+    "MemberEventType",
+    "QueryEvent",
+    "UserEvent",
+    "NodeResponse",
+    "QueryParam",
+    "QueryResponse",
+    "LoopbackNetwork",
+    "LoopbackTransport",
+    "Transport",
+    "Memberlist",
+    "SecretKeyring",
+    "CompositeDelegate",
+    "MergeDelegate",
+    "ReconnectDelegate",
+    "Coordinate",
+    "CoordinateClient",
+    "CoordinateOptions",
+    "KeyManager",
+    "KeyResponse",
+]

@@ -1,0 +1,228 @@
+"""Event model and delivery pipeline: member/user/query events, subscriber
+channels, and coalescers.
+
+Reference: serf-core/src/event.rs (Event enum, EventProducer/Subscriber,
+QueryEvent respond machinery) and serf-core/src/coalesce* (member/user
+coalescers driven by coalesce/quiescent timers) — SURVEY.md §2.2.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from serf_tpu.types.clock import LamportTime
+from serf_tpu.types.member import Member
+from serf_tpu.types.messages import (
+    QueryFlag,
+    QueryResponseMessage,
+    encode_message,
+    encode_relay_message,
+)
+from serf_tpu.types.member import Node
+
+log = logging.getLogger("serf_tpu.events")
+
+
+class MemberEventType(enum.IntEnum):
+    JOIN = 0
+    LEAVE = 1
+    FAILED = 2
+    UPDATE = 3
+    REAP = 4
+
+
+@dataclass(frozen=True)
+class MemberEvent:
+    ty: MemberEventType
+    members: Tuple[Member, ...]
+
+
+@dataclass(frozen=True)
+class UserEvent:
+    ltime: LamportTime
+    name: str
+    payload: bytes
+    coalesce: bool = False
+
+
+@dataclass
+class QueryEvent:
+    """A query delivered to the application; ``respond`` sends the answer
+    back to the originator (direct send + relay through ``relay_factor``
+    random members) with a deadline check (reference event.rs:19-99)."""
+
+    ltime: LamportTime
+    name: str
+    payload: bytes
+    id: int
+    from_node: Node
+    relay_factor: int
+    deadline: float            # monotonic
+    _serf: object = field(default=None, repr=False)
+    _responded: bool = field(default=False, repr=False)
+
+    def expired(self) -> bool:
+        return time.monotonic() > self.deadline
+
+    async def respond(self, payload: bytes) -> None:
+        if self._responded:
+            raise RuntimeError("query already responded")
+        if self.expired():
+            raise TimeoutError("query deadline already passed")
+        serf = self._serf
+        msg = QueryResponseMessage(
+            ltime=self.ltime, id=self.id, from_node=serf.memberlist.local_node(),
+            flags=QueryFlag.NONE, payload=payload,
+        )
+        raw = encode_message(msg)
+        if len(raw) > serf.opts.query_response_size_limit:
+            raise ValueError(
+                f"query response is {len(raw)} bytes, limit "
+                f"{serf.opts.query_response_size_limit}"
+            )
+        self._responded = True
+        await serf.memberlist.send(self.from_node.addr, raw)
+        await serf.relay_response(self.relay_factor, self.from_node, raw)
+
+
+Event = object  # MemberEvent | UserEvent | QueryEvent
+
+
+class EventSubscriber:
+    """Async stream of events (bounded queue; drops-oldest on overflow so a
+    slow consumer cannot wedge the protocol)."""
+
+    def __init__(self, maxsize: int = 4096):
+        self._q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
+
+    def _push(self, ev) -> None:
+        while True:
+            try:
+                self._q.put_nowait(ev)
+                return
+            except asyncio.QueueFull:
+                try:
+                    self._q.get_nowait()  # drop oldest
+                    log.warning("event subscriber overflow: dropping oldest event")
+                except asyncio.QueueEmpty:
+                    pass
+
+    async def next(self, timeout: Optional[float] = None):
+        if timeout is None:
+            return await self._q.get()
+        return await asyncio.wait_for(self._q.get(), timeout)
+
+    def try_next(self):
+        try:
+            return self._q.get_nowait()
+        except asyncio.QueueEmpty:
+            return None
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        return await self._q.get()
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+
+# ---------------------------------------------------------------------------
+# Coalescing
+# ---------------------------------------------------------------------------
+
+
+class MemberEventCoalescer:
+    """Keep only the latest member event per node within the window; flush one
+    merged MemberEvent per type (reference coalesce/member.rs:24-113).
+    Update events always pass (tags changes must not be suppressed)."""
+
+    def __init__(self):
+        self.latest: Dict[str, MemberEventType] = {}
+        self.members: Dict[str, Member] = {}
+
+    def handle(self, ev) -> bool:
+        if not isinstance(ev, MemberEvent):
+            return False
+        for m in ev.members:
+            self.latest[m.node.id] = ev.ty
+            self.members[m.node.id] = m
+        return True
+
+    def flush(self) -> List[MemberEvent]:
+        by_type: Dict[MemberEventType, List[Member]] = {}
+        for node_id, ty in self.latest.items():
+            by_type.setdefault(ty, []).append(self.members[node_id])
+        self.latest.clear()
+        self.members.clear()
+        return [
+            MemberEvent(ty, tuple(sorted(ms, key=lambda m: m.node.id)))
+            for ty, ms in sorted(by_type.items())
+        ]
+
+
+class UserEventCoalescer:
+    """Dedup user events by (ltime, name) within the window
+    (reference coalesce/user.rs)."""
+
+    def __init__(self):
+        self.seen: Dict[Tuple[int, str], UserEvent] = {}
+
+    def handle(self, ev) -> bool:
+        if not (isinstance(ev, UserEvent) and ev.coalesce):
+            return False
+        self.seen[(ev.ltime, ev.name)] = ev
+        return True
+
+    def flush(self) -> List[UserEvent]:
+        out = [self.seen[k] for k in sorted(self.seen)]
+        self.seen.clear()
+        return out
+
+
+async def coalesce_loop(
+    inbox: asyncio.Queue,
+    out: EventSubscriber,
+    coalescer,
+    coalesce_period: float,
+    quiescent_period: float,
+) -> None:
+    """Buffer coalescable events; flush on the coalesce quantum or after a
+    quiescent gap (reference coalesce.rs:22-155).  Non-coalescable events pass
+    straight through."""
+    pending = False
+    flush_deadline = None
+    loop = asyncio.get_running_loop()
+    while True:
+        if pending:
+            now = loop.time()
+            timeout = max(0.0, min(flush_deadline - now, quiescent_period))
+        else:
+            timeout = None
+        try:
+            if timeout is None:
+                ev = await inbox.get()
+            else:
+                ev = await asyncio.wait_for(inbox.get(), timeout)
+        except asyncio.TimeoutError:
+            for flushed in coalescer.flush():
+                out._push(flushed)
+            pending = False
+            flush_deadline = None
+            continue
+        if ev is None:  # shutdown: flush what we have
+            for flushed in coalescer.flush():
+                out._push(flushed)
+            return
+        if coalescer.handle(ev):
+            if not pending:
+                pending = True
+                flush_deadline = loop.time() + coalesce_period
+        else:
+            out._push(ev)

@@ -1,0 +1,124 @@
+"""Internal query service: handle ``_serf_*`` queries before they reach the
+application.
+
+Reference: serf-core/src/serf/internal_query.rs:32-486 — `_serf_ping`,
+`_serf_conflict` (answer with our view of the conflicted id's address), and
+the four keyring ops, with size-aware truncation of key-list responses.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from serf_tpu.host.events import QueryEvent
+from serf_tpu.host.keyring import KeyringError
+from serf_tpu.types.messages import (
+    ConflictResponseMessage,
+    KeyRequestMessage,
+    KeyResponseMessage,
+    decode_message,
+    encode_message,
+)
+from serf_tpu import codec
+
+log = logging.getLogger("serf_tpu.internal_query")
+
+# minimum bytes to encode one key in a list response; used for truncation
+# (reference MIN_ENCODED_KEY_LENGTH = 25, internal_query.rs)
+MIN_ENCODED_KEY_LENGTH = 25
+
+
+async def handle_internal_query(serf, ev: QueryEvent) -> None:
+    try:
+        if ev.name == "_serf_ping":
+            pass  # intentionally no response (reference: ack-only)
+        elif ev.name == "_serf_conflict":
+            await _handle_conflict(serf, ev)
+        elif ev.name == "_serf_install_key":
+            await _handle_key_op(serf, ev, "install")
+        elif ev.name == "_serf_use_key":
+            await _handle_key_op(serf, ev, "use")
+        elif ev.name == "_serf_remove_key":
+            await _handle_key_op(serf, ev, "remove")
+        elif ev.name == "_serf_list_keys":
+            await _handle_list_keys(serf, ev)
+        else:
+            log.warning("unhandled internal query %r", ev.name)
+    except Exception:  # noqa: BLE001
+        log.exception("internal query %r failed", ev.name)
+
+
+async def _handle_conflict(serf, ev: QueryEvent) -> None:
+    """Respond with the member we have for the conflicted id
+    (reference internal_query.rs handle_conflict)."""
+    node_id = ev.payload.decode("utf-8", errors="replace")
+    if node_id == serf.local_id:
+        # local node is the conflicted one; answer with our own view
+        member = serf.local_member()
+    else:
+        ms = serf._members.get(node_id)
+        if ms is None:
+            return
+        member = ms.member
+    await ev.respond(encode_message(ConflictResponseMessage(member)))
+
+
+def _keyring_or_error(serf):
+    ring = serf.memberlist.keyring()
+    if ring is None:
+        return None, "encryption is not enabled"
+    return ring, None
+
+
+async def _handle_key_op(serf, ev: QueryEvent, op: str) -> None:
+    ring, err = _keyring_or_error(serf)
+    if err is not None:
+        await _respond_key(serf, ev, KeyResponseMessage(False, err))
+        return
+    try:
+        req = decode_message(ev.payload)
+    except codec.DecodeError as e:
+        await _respond_key(serf, ev, KeyResponseMessage(False, f"bad request: {e}"))
+        return
+    if not isinstance(req, KeyRequestMessage):
+        await _respond_key(serf, ev, KeyResponseMessage(False, "bad request type"))
+        return
+    try:
+        if op == "install":
+            ring.install(req.key)
+        elif op == "use":
+            ring.use_key(req.key)
+        elif op == "remove":
+            ring.remove(req.key)
+        if serf.opts.keyring_file:
+            ring.save(serf.opts.keyring_file)
+        await _respond_key(serf, ev, KeyResponseMessage(True))
+    except (KeyringError, OSError) as e:
+        await _respond_key(serf, ev, KeyResponseMessage(False, str(e)))
+
+
+async def _handle_list_keys(serf, ev: QueryEvent) -> None:
+    ring, err = _keyring_or_error(serf)
+    if err is not None:
+        await _respond_key(serf, ev, KeyResponseMessage(False, err))
+        return
+    keys = ring.keys()
+    primary = ring.primary_key()
+    # size-aware truncation (reference key_list_response_with_correct_size)
+    limit = serf.opts.query_response_size_limit
+    max_keys = max(1, (limit - MIN_ENCODED_KEY_LENGTH) // MIN_ENCODED_KEY_LENGTH)
+    msg = ""
+    if len(keys) > max_keys:
+        msg = f"truncated key list to {max_keys} of {len(keys)} keys"
+        keys = keys[:max_keys]
+        if primary not in keys:
+            keys[0] = primary
+    await _respond_key(
+        serf, ev, KeyResponseMessage(True, msg, tuple(keys), primary))
+
+
+async def _respond_key(serf, ev: QueryEvent, msg: KeyResponseMessage) -> None:
+    try:
+        await ev.respond(encode_message(msg))
+    except (TimeoutError, ValueError) as e:
+        log.warning("could not respond to %r: %s", ev.name, e)

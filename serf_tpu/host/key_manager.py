@@ -1,0 +1,76 @@
+"""Cluster-wide keyring orchestration over internal queries.
+
+Reference: serf-core/src/key_manager.rs:24-120 — each op broadcasts a
+``_serf_*_key`` query and aggregates per-node ``KeyResponseMessage``s into a
+``KeyResponse`` summary.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from serf_tpu import codec
+from serf_tpu.host.query import QueryParam
+from serf_tpu.types.messages import (
+    KeyRequestMessage,
+    KeyResponseMessage,
+    decode_message,
+    encode_message,
+)
+
+
+@dataclass
+class KeyResponse:
+    """Aggregated result of a cluster key operation."""
+
+    messages: Dict[str, str] = field(default_factory=dict)  # node -> error/info
+    num_nodes: int = 0
+    num_resp: int = 0
+    num_err: int = 0
+    keys: Dict[bytes, int] = field(default_factory=dict)          # key -> count
+    primary_keys: Dict[bytes, int] = field(default_factory=dict)  # key -> count
+
+
+class KeyManager:
+    def __init__(self, serf):
+        self.serf = serf
+
+    async def install_key(self, key: bytes) -> KeyResponse:
+        return await self._key_op("_serf_install_key", key)
+
+    async def use_key(self, key: bytes) -> KeyResponse:
+        return await self._key_op("_serf_use_key", key)
+
+    async def remove_key(self, key: bytes) -> KeyResponse:
+        return await self._key_op("_serf_remove_key", key)
+
+    async def list_keys(self) -> KeyResponse:
+        return await self._key_op("_serf_list_keys", None)
+
+    async def _key_op(self, name: str, key: Optional[bytes]) -> KeyResponse:
+        payload = encode_message(KeyRequestMessage(key or b""))
+        resp = await self.serf.query(name, payload, QueryParam())
+        out = KeyResponse(num_nodes=self.serf.num_members())
+        async for r in resp.responses():
+            out.num_resp += 1
+            try:
+                msg = decode_message(r.payload)
+            except codec.DecodeError as e:
+                out.num_err += 1
+                out.messages[r.from_id] = f"undecodable response: {e}"
+                continue
+            if not isinstance(msg, KeyResponseMessage):
+                out.num_err += 1
+                out.messages[r.from_id] = "unexpected response type"
+                continue
+            if not msg.result:
+                out.num_err += 1
+            if msg.message:
+                out.messages[r.from_id] = msg.message
+            for k in msg.keys:
+                out.keys[k] = out.keys.get(k, 0) + 1
+            if msg.primary_key:
+                out.primary_keys[msg.primary_key] = \
+                    out.primary_keys.get(msg.primary_key, 0) + 1
+        return out

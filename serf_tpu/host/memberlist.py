@@ -319,7 +319,10 @@ class Memberlist:
         if isinstance(m, sm.Ping):
             await self._handle_ping(src, m)
         elif isinstance(m, sm.IndirectPing):
-            await self._handle_indirect_ping(src, m)
+            # spawned: this handler waits for an ack that arrives through the
+            # same packet loop — awaiting it inline would self-deadlock
+            self._spawn(self._handle_indirect_ping(src, m),
+                        name=f"ml-indirect-{self.local.id}")
         elif isinstance(m, sm.Ack):
             self._handle_ack(m)
         elif isinstance(m, sm.Nack):
@@ -400,12 +403,11 @@ class Memberlist:
             return
         # address conflict: same id, different address
         if ns.addr != a.node.addr:
-            if a.node.id == self.local.id:
-                # it is about us: refute with higher incarnation
-                if a.incarnation >= self._incarnation:
-                    self._refute(a.incarnation)
-            else:
-                self.delegate.notify_conflict(ns, a)
+            self.delegate.notify_conflict(ns, a)
+            if a.node.id == self.local.id and a.incarnation >= self._incarnation:
+                # it is about us: refute with higher incarnation; the
+                # delegate's conflict resolution decides who survives
+                self._refute(a.incarnation)
             return
         if a.node.id == self.local.id:
             # a rebroadcast of our own alive: refute only if it beats us

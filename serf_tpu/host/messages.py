@@ -56,11 +56,11 @@ class Ping:
         seq, src, tgt = 0, Node(""), ""
         for f, _w, v, _p in codec.iter_fields(buf):
             if f == 1:
-                seq = v
+                seq = codec.as_uint(v)
             elif f == 2:
-                src = Node.decode(v)
+                src = Node.decode(codec.as_bytes(v))
             elif f == 3:
-                tgt = v.decode("utf-8")
+                tgt = codec.as_str(v)
         return cls(seq, src, tgt)
 
 
@@ -84,11 +84,11 @@ class IndirectPing:
         seq, src, tgt = 0, Node(""), Node("")
         for f, _w, v, _p in codec.iter_fields(buf):
             if f == 1:
-                seq = v
+                seq = codec.as_uint(v)
             elif f == 2:
-                src = Node.decode(v)
+                src = Node.decode(codec.as_bytes(v))
             elif f == 3:
-                tgt = Node.decode(v)
+                tgt = Node.decode(codec.as_bytes(v))
         return cls(seq, src, tgt)
 
 
@@ -113,9 +113,9 @@ class Ack:
         seq, payload = 0, b""
         for f, _w, v, _p in codec.iter_fields(buf):
             if f == 1:
-                seq = v
+                seq = codec.as_uint(v)
             elif f == 2:
-                payload = bytes(v)
+                payload = codec.as_bytes(v)
         return cls(seq, payload)
 
 
@@ -136,7 +136,7 @@ class Nack:
         seq = 0
         for f, _w, v, _p in codec.iter_fields(buf):
             if f == 1:
-                seq = v
+                seq = codec.as_uint(v)
         return cls(seq)
 
 
@@ -158,11 +158,11 @@ class Suspect:
         inc, node, frm = 0, "", ""
         for f, _w, v, _p in codec.iter_fields(buf):
             if f == 1:
-                inc = v
+                inc = codec.as_uint(v)
             elif f == 2:
-                node = v.decode("utf-8")
+                node = codec.as_str(v)
             elif f == 3:
-                frm = v.decode("utf-8")
+                frm = codec.as_str(v)
         return cls(inc, node, frm)
 
 
@@ -186,11 +186,11 @@ class Alive:
         inc, node, meta = 0, Node(""), b""
         for f, _w, v, _p in codec.iter_fields(buf):
             if f == 1:
-                inc = v
+                inc = codec.as_uint(v)
             elif f == 2:
-                node = Node.decode(v)
+                node = Node.decode(codec.as_bytes(v))
             elif f == 3:
-                meta = bytes(v)
+                meta = codec.as_bytes(v)
         return cls(inc, node, meta)
 
 
@@ -215,11 +215,11 @@ class Dead:
         inc, node, frm = 0, "", ""
         for f, _w, v, _p in codec.iter_fields(buf):
             if f == 1:
-                inc = v
+                inc = codec.as_uint(v)
             elif f == 2:
-                node = v.decode("utf-8")
+                node = codec.as_str(v)
             elif f == 3:
-                frm = v.decode("utf-8")
+                frm = codec.as_str(v)
         return cls(inc, node, frm)
 
 
@@ -245,13 +245,13 @@ class PushNodeState:
         node, inc, st, meta = Node(""), 0, SwimState.ALIVE, b""
         for f, _w, v, _p in codec.iter_fields(buf):
             if f == 1:
-                node = Node.decode(v)
+                node = Node.decode(codec.as_bytes(v))
             elif f == 2:
-                inc = v
+                inc = codec.as_uint(v)
             elif f == 3:
-                st = SwimState(v)
+                st = SwimState(codec.as_uint(v))
             elif f == 4:
-                meta = bytes(v)
+                meta = codec.as_bytes(v)
         return cls(node, inc, st, meta)
 
 
@@ -279,11 +279,11 @@ class PushPull:
         join, states, user = False, [], b""
         for f, _w, v, _p in codec.iter_fields(buf):
             if f == 1:
-                join = bool(v)
+                join = bool(codec.as_uint(v))
             elif f == 2:
-                states.append(PushNodeState.decode(v))
+                states.append(PushNodeState.decode(codec.as_bytes(v)))
             elif f == 3:
-                user = bytes(v)
+                user = codec.as_bytes(v)
         return cls(join, tuple(states), user)
 
 
@@ -303,7 +303,7 @@ class UserMsg:
         payload = b""
         for f, _w, v, _p in codec.iter_fields(buf):
             if f == 1:
-                payload = bytes(v)
+                payload = codec.as_bytes(v)
         return cls(payload)
 
 
@@ -345,7 +345,7 @@ def decode_swim(buf: bytes):
             out = []
             for f, _w, v, _p in codec.iter_fields(body):
                 if f == 1:
-                    sub = decode_swim(bytes(v))
+                    sub = decode_swim(codec.as_bytes(v))
                     if isinstance(sub, list):
                         out.extend(sub)
                     else:

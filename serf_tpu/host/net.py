@@ -1,0 +1,133 @@
+"""Real-socket transport: UDP datagrams + length-framed TCP streams.
+
+Capability parity with the reference's ``NetTransport`` (TCP/UDP wiring,
+serf/Cargo.toml:24-56): the packet plane is UDP, the stream plane (push/pull
+anti-entropy, large sends) is TCP with 4-byte big-endian length frames.
+Loopback (`transport.py`) remains the default for in-process clusters; this
+backend is the cross-process conformance path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Optional, Tuple
+
+from serf_tpu.host.transport import Stream, Transport
+
+MAX_FRAME = 32 * 1024 * 1024  # sanity bound on a single stream frame
+
+
+class TcpStream(Stream):
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._r = reader
+        self._w = writer
+
+    async def send_frame(self, buf: bytes) -> None:
+        self._w.write(struct.pack(">I", len(buf)) + buf)
+        await self._w.drain()
+
+    async def recv_frame(self, timeout: Optional[float] = None) -> bytes:
+        async def _read() -> bytes:
+            hdr = await self._r.readexactly(4)
+            (ln,) = struct.unpack(">I", hdr)
+            if ln > MAX_FRAME:
+                raise ConnectionError(f"frame of {ln} bytes exceeds limit")
+            return await self._r.readexactly(ln)
+
+        try:
+            if timeout is None:
+                return await _read()
+            return await asyncio.wait_for(_read(), timeout)
+        except asyncio.TimeoutError:
+            raise TimeoutError("stream recv timeout") from None
+        except asyncio.IncompleteReadError as e:
+            raise ConnectionError("stream closed by peer") from e
+
+    async def close(self) -> None:
+        try:
+            self._w.close()
+            await self._w.wait_closed()
+        except (ConnectionError, OSError):
+            pass
+
+
+class _UdpProtocol(asyncio.DatagramProtocol):
+    def __init__(self, queue: asyncio.Queue):
+        self._q = queue
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        self._q.put_nowait((addr, data))
+
+
+class NetTransport(Transport):
+    """Bind with ``await NetTransport.bind(("127.0.0.1", 7946))`` — one port
+    serves both UDP packets and TCP streams."""
+
+    def __init__(self):
+        self._addr: Optional[Tuple[str, int]] = None
+        self._packets: asyncio.Queue = asyncio.Queue()
+        self._accepts: asyncio.Queue = asyncio.Queue()
+        self._udp_transport = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._shut = False
+
+    @classmethod
+    async def bind(cls, addr: Tuple[str, int]) -> "NetTransport":
+        t = cls()
+        loop = asyncio.get_running_loop()
+        t._udp_transport, _ = await loop.create_datagram_endpoint(
+            lambda: _UdpProtocol(t._packets), local_addr=addr)
+        sock = t._udp_transport.get_extra_info("socket")
+        bound = sock.getsockname()[:2]
+
+        async def on_conn(reader, writer):
+            peer = writer.get_extra_info("peername")
+            t._accepts.put_nowait((peer, TcpStream(reader, writer)))
+
+        t._server = await asyncio.start_server(on_conn, host=bound[0], port=bound[1])
+        t._addr = (bound[0], bound[1])
+        return t
+
+    @property
+    def local_addr(self):
+        return self._addr
+
+    async def send_packet(self, addr, buf: bytes) -> None:
+        if self._shut:
+            raise ConnectionError("transport shut down")
+        self._udp_transport.sendto(buf, tuple(addr))
+
+    async def recv_packet(self):
+        item = await self._packets.get()
+        if item is None:
+            raise ConnectionError("transport shut down")
+        return item
+
+    async def dial(self, addr, timeout: Optional[float] = None) -> Stream:
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(addr[0], addr[1]), timeout)
+        except asyncio.TimeoutError:
+            raise TimeoutError(f"dial {addr!r} timed out") from None
+        except OSError as e:
+            raise ConnectionError(f"connection refused: {addr!r}: {e}") from e
+        return TcpStream(reader, writer)
+
+    async def accept(self):
+        item = await self._accepts.get()
+        if item is None:
+            raise ConnectionError("transport shut down")
+        return item
+
+    async def shutdown(self) -> None:
+        if self._shut:
+            return
+        self._shut = True
+        if self._udp_transport is not None:
+            self._udp_transport.close()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        self._packets.put_nowait(None)
+        self._accepts.put_nowait(None)

@@ -1,0 +1,1154 @@
+"""The Serf engine: Lamport-clocked cluster state machine over SWIM gossip.
+
+Re-implements the reference's serf-core layer (SURVEY.md §2.1/§3): three
+Lamport clocks, the member table with buffered intents, the message handlers
+with dedup ring buffers and rebroadcast decisions, three transmit-limited
+broadcast queues piggy-backed onto gossip, the query engine, push/pull
+anti-entropy of serf state, background Reaper/Reconnector/QueueCheckers, and
+the public API (new/join/leave/shutdown/user_event/query/set_tags/members/
+stats/remove_failed_node/coordinate/key_manager).
+
+Reference call stacks mirrored here: bootstrap base.rs:62-344, join
+api.rs:318-342, user_event api.rs:241-297, query base.rs:875-944, failure
+path base.rs:1375-1440 + 612-681 + 483-610.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import logging
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from serf_tpu import codec
+from serf_tpu.host.broadcast import Broadcast, TransmitLimitedQueue
+from serf_tpu.host.coordinate import Coordinate, CoordinateClient, CoordinateOptions
+from serf_tpu.host.delegate import CompositeDelegate, SwimDelegate
+from serf_tpu.host.events import (
+    EventSubscriber,
+    MemberEvent,
+    MemberEventType,
+    MemberEventCoalescer,
+    QueryEvent,
+    UserEvent,
+    UserEventCoalescer,
+    coalesce_loop,
+)
+from serf_tpu.host.keyring import SecretKeyring
+from serf_tpu.host.memberlist import Memberlist, NodeState
+from serf_tpu.host.messages import SwimState
+from serf_tpu.host.query import (
+    NodeResponse,
+    QueryParam,
+    QueryResponse,
+    default_query_timeout,
+    random_members,
+    should_process_query,
+)
+from serf_tpu.host.transport import Transport
+from serf_tpu.options import Options, USER_EVENT_SIZE_LIMIT
+from serf_tpu.types.clock import LamportClock, LamportTime
+from serf_tpu.types.member import (
+    IntentType,
+    Member,
+    MemberState,
+    MemberStatus,
+    Node,
+    NodeIntent,
+    recent_intent,
+    reap_intents,
+    upsert_intent,
+)
+from serf_tpu.types.messages import (
+    ConflictResponseMessage,
+    JoinMessage,
+    LeaveMessage,
+    MessageType,
+    PushPullMessage,
+    QueryFlag,
+    QueryMessage,
+    QueryResponseMessage,
+    RelayMessage,
+    UserEventMessage,
+    UserEvents,
+    decode_message,
+    encode_message,
+    encode_relay_message,
+)
+from serf_tpu.types.tags import Tags
+from serf_tpu.utils import metrics
+
+log = logging.getLogger("serf_tpu.serf")
+
+# Internal query name-space (reference event/crate_event.rs:60-69)
+INTERNAL_PING = "_serf_ping"
+INTERNAL_CONFLICT = "_serf_conflict"
+INTERNAL_INSTALL_KEY = "_serf_install_key"
+INTERNAL_USE_KEY = "_serf_use_key"
+INTERNAL_REMOVE_KEY = "_serf_remove_key"
+INTERNAL_LIST_KEYS = "_serf_list_keys"
+PING_VERSION = 1
+
+
+class SerfState(enum.IntEnum):
+    ALIVE = 0
+    LEAVING = 1
+    LEFT = 2
+    SHUTDOWN = 3
+
+
+@dataclass
+class Stats:
+    """Operator snapshot (reference api.rs:586-602)."""
+
+    members: int
+    failed: int
+    left: int
+    health_score: int
+    member_time: LamportTime
+    event_time: LamportTime
+    query_time: LamportTime
+    intent_queue: int
+    event_queue: int
+    query_queue: int
+    encrypted: bool
+    coordinate_resets: int
+
+
+class _SerfSwimDelegate(SwimDelegate):
+    """Bridge: SWIM layer callbacks into the serf engine
+    (reference SerfDelegate, serf-core/src/serf/delegate.rs)."""
+
+    def __init__(self):
+        self.serf: Optional["Serf"] = None  # back-linked after construction
+
+    # -- node meta / messages ----------------------------------------------
+
+    def node_meta(self, limit: int) -> bytes:
+        s = self.serf
+        raw = s._tags.encode()
+        if len(raw) > limit:
+            log.error("encoded tags exceed meta limit; advertising none")
+            return b""
+        return raw
+
+    def notify_message(self, raw: bytes) -> None:
+        s = self.serf
+        if s is None or s.state == SerfState.SHUTDOWN:
+            return
+        metrics.observe("serf.messages.received", len(raw), s._labels)
+        try:
+            msg = decode_message(raw)
+        except codec.DecodeError as e:
+            log.debug("undecodable serf message: %s", e)
+            return
+        s._dispatch(msg, raw)
+
+    def broadcast_messages(self, overhead: int, limit: int) -> List[bytes]:
+        s = self.serf
+        if s is None:
+            return []
+        out: List[bytes] = []
+        used = 0
+        for q in (s.intent_broadcasts, s.event_broadcasts, s.query_broadcasts):
+            msgs = q.get_broadcasts(overhead, limit - used)
+            for m in msgs:
+                used += overhead + len(m)
+                metrics.observe("serf.messages.sent", len(m), s._labels)
+            out.extend(msgs)
+        return out
+
+    # -- anti-entropy -------------------------------------------------------
+
+    def local_state(self, join: bool) -> bytes:
+        s = self.serf
+        status_ltimes: Dict[str, LamportTime] = {}
+        left: List[str] = []
+        for ms in s._members.values():
+            status_ltimes[ms.id] = ms.status_time
+            if ms.member.status == MemberStatus.LEFT:
+                left.append(ms.id)
+        events = tuple(ue for ue in s._event_buffer if ue is not None)
+        pp = PushPullMessage(
+            ltime=s.clock.time(),
+            status_ltimes=status_ltimes,
+            left_members=tuple(left),
+            event_ltime=s.event_clock.time(),
+            events=events,
+            query_ltime=s.query_clock.time(),
+        )
+        return encode_message(pp)
+
+    def merge_remote_state(self, buf: bytes, is_join: bool) -> None:
+        s = self.serf
+        try:
+            pp = decode_message(buf)
+        except codec.DecodeError as e:
+            log.warning("bad remote serf state: %s", e)
+            return
+        if not isinstance(pp, PushPullMessage):
+            log.warning("remote serf state was %s", type(pp).__name__)
+            return
+        if pp.ltime > 0:
+            s.clock.witness(pp.ltime - 1)
+        if pp.event_ltime > 0:
+            s.event_clock.witness(pp.event_ltime - 1)
+        if pp.query_ltime > 0:
+            s.query_clock.witness(pp.query_ltime - 1)
+        # left members FIRST so their status_ltimes entries apply as leaves
+        # (reference delegate.rs:490-523 ordering requirement)
+        left_set = set(pp.left_members)
+        for node_id in pp.left_members:
+            lt = pp.status_ltimes.get(node_id, 0)
+            s._handle_node_leave_intent(LeaveMessage(lt, node_id), rebroadcast=False)
+        for node_id, lt in pp.status_ltimes.items():
+            if node_id in left_set:
+                continue
+            s._handle_node_join_intent(JoinMessage(lt, node_id), rebroadcast=False)
+        # user events: replay through the normal handler (dedup + min_time)
+        if is_join and s._event_join_ignore:
+            s._event_min_time = pp.event_ltime + 1
+        for cell in pp.events:
+            if cell is None:
+                continue
+            for ev in cell.events:
+                s._handle_user_event(
+                    UserEventMessage(cell.ltime, ev.name, ev.payload, ev.cc),
+                    rebroadcast=False,
+                )
+
+    # -- membership notifications ------------------------------------------
+
+    def notify_join(self, ns: NodeState) -> None:
+        self.serf._handle_node_join(ns)
+
+    def notify_leave(self, ns: NodeState) -> None:
+        self.serf._handle_node_leave(ns)
+
+    def notify_update(self, ns: NodeState) -> None:
+        self.serf._handle_node_update(ns)
+
+    def notify_alive(self, alive) -> Optional[str]:
+        return None
+
+    def notify_merge(self, peers) -> Optional[str]:
+        s = self.serf
+        if s.user_delegate is not None:
+            members = []
+            for st in peers:
+                tags = _decode_tags(st.meta)
+                members.append(Member(st.node, tags, _swim_to_status(st.state)))
+            return s.user_delegate.notify_merge(members)
+        return None
+
+    def notify_conflict(self, existing: NodeState, other) -> None:
+        s = self.serf
+        if existing.id != s.local_id:
+            # observers only log (reference: resolution is driven by the
+            # conflicted node itself, base.rs:1658-1670)
+            log.warning("node id %r claimed by both %r and %r",
+                        existing.id, existing.addr, other.node.addr)
+            return
+        if s.opts.enable_id_conflict_resolution and not s._conflict_resolving:
+            s._conflict_resolving = True
+            s._spawn(s._resolve_node_conflict(existing, other), "serf-conflict")
+
+    # -- ping plane (Vivaldi) ----------------------------------------------
+
+    def ack_payload(self) -> bytes:
+        s = self.serf
+        if s is None or s.coord_client is None:
+            return b""
+        return bytes([PING_VERSION]) + s.coord_client.get_coordinate().encode()
+
+    def notify_ping_complete(self, ns: NodeState, rtt: float, payload: bytes) -> None:
+        s = self.serf
+        if s is None or s.coord_client is None or not payload:
+            return
+        if payload[0] != PING_VERSION:
+            log.warning("unsupported ping version %d from %s", payload[0], ns.id)
+            metrics.incr("serf.coordinate.rejected", 1, s._labels)
+            return
+        try:
+            other = Coordinate.decode(payload[1:])
+        except codec.DecodeError as e:
+            log.warning("bad coordinate from %s: %s", ns.id, e)
+            metrics.incr("serf.coordinate.rejected", 1, s._labels)
+            return
+        if rtt <= 0.0:
+            metrics.incr("serf.coordinate.zero-rtt", 1, s._labels)
+            return
+        start = time.monotonic()
+        try:
+            s.coord_client.update(ns.id, other, rtt)
+        except ValueError as e:
+            log.debug("coordinate update rejected for %s: %s", ns.id, e)
+            metrics.incr("serf.coordinate.rejected", 1, s._labels)
+            return
+        metrics.observe("serf.coordinate.adjustment-ms",
+                        (time.monotonic() - start) * 1e3, s._labels)
+        s._coord_cache[ns.id] = other
+        s._coord_cache[s.local_id] = s.coord_client.get_coordinate()
+
+
+def _decode_tags(meta: bytes) -> Tags:
+    if not meta:
+        return Tags()
+    try:
+        return Tags.decode(meta)
+    except codec.DecodeError:
+        return Tags()
+
+
+def _swim_to_status(state: SwimState) -> MemberStatus:
+    return {
+        SwimState.ALIVE: MemberStatus.ALIVE,
+        SwimState.SUSPECT: MemberStatus.ALIVE,
+        SwimState.DEAD: MemberStatus.FAILED,
+        SwimState.LEFT: MemberStatus.LEFT,
+    }[state]
+
+
+class Serf:
+    """Public handle (reference ``Serf<T, D>``, serf-core/src/serf.rs:177)."""
+
+    # ------------------------------------------------------------------
+    # construction (reference new_in, base.rs:62-344)
+    # ------------------------------------------------------------------
+
+    def __init__(self, transport: Transport, opts: Options,
+                 node_id: str,
+                 user_delegate: Optional[CompositeDelegate] = None,
+                 keyring: Optional[SecretKeyring] = None,
+                 rng: Optional[random.Random] = None):
+        opts.validate()
+        self.opts = opts
+        self.user_delegate = user_delegate
+        self.rng = rng or random.Random()
+        self._labels = dict(opts.memberlist.metric_labels)
+        self._tags = opts.tags
+        self._tags.check_meta_size()
+
+        self.clock = LamportClock()
+        self.event_clock = LamportClock()
+        self.query_clock = LamportClock()
+        # seed clocks so no message is ever sent at ltime 0 (base.rs:196-205)
+        self.clock.increment()
+        self.event_clock.increment()
+        self.query_clock.increment()
+
+        self._members: Dict[str, MemberState] = {}
+        self._failed: List[MemberState] = []
+        self._left: List[MemberState] = []
+        self._recent_intents: Dict[str, NodeIntent] = {}
+
+        self._event_buffer: List[Optional[UserEvents]] = [None] * opts.event_buffer_size
+        self._event_min_time: LamportTime = 0
+        self._event_join_ignore = False
+        self._query_buffer: List[Optional[Tuple[LamportTime, Set[int]]]] = \
+            [None] * opts.query_buffer_size
+        self._query_min_time: LamportTime = 0
+        self._query_responses: Dict[Tuple[LamportTime, int], QueryResponse] = {}
+
+        self.state = SerfState.ALIVE
+        self._state_lock = asyncio.Lock()
+        self._join_lock = asyncio.Lock()
+
+        self._delegate = _SerfSwimDelegate()
+        self.memberlist = Memberlist(
+            transport, opts.memberlist, node_id,
+            delegate=self._delegate, keyring=keyring, rng=self.rng,
+        )
+        self._delegate.serf = self  # back-link (reference SerfWeakRef)
+
+        def _num_nodes() -> int:
+            return max(1, len(self._members))
+
+        rm = opts.memberlist.retransmit_mult
+        self.intent_broadcasts = TransmitLimitedQueue(rm, _num_nodes)
+        self.event_broadcasts = TransmitLimitedQueue(rm, _num_nodes)
+        self.query_broadcasts = TransmitLimitedQueue(rm, _num_nodes)
+
+        self.coord_client: Optional[CoordinateClient] = None
+        self._coord_cache: Dict[str, Coordinate] = {}
+        if not opts.disable_coordinates:
+            self.coord_client = CoordinateClient(CoordinateOptions(), rng=self.rng)
+
+        self._event_inbox: asyncio.Queue = asyncio.Queue()
+        self._subscriber: Optional[EventSubscriber] = None
+        self.snapshotter = None  # wired by serf_tpu.host.snapshot
+        self._key_manager = None
+
+        self._tasks: List[asyncio.Task] = []
+        self._bg: set = set()
+        self._shutdown_event = asyncio.Event()
+        self._conflict_resolving = False
+
+    def _spawn(self, coro, name: str) -> asyncio.Task:
+        t = asyncio.create_task(coro, name=f"{name}-{self.local_id}")
+        self._bg.add(t)
+        t.add_done_callback(self._bg.discard)
+        return t
+
+    @classmethod
+    async def create(cls, transport: Transport, opts: Options, node_id: str,
+                     user_delegate: Optional[CompositeDelegate] = None,
+                     keyring: Optional[SecretKeyring] = None,
+                     subscriber: Optional[EventSubscriber] = None,
+                     rng: Optional[random.Random] = None) -> "Serf":
+        """Async constructor: snapshot replay, memberlist start, background
+        tasks, auto-rejoin (reference Serf::new + new_in)."""
+        s = cls(transport, opts, node_id, user_delegate, keyring, rng)
+        s._subscriber = subscriber
+
+        # event pipeline: inbox -> (coalescers) -> subscriber
+        if subscriber is not None:
+            member_c = MemberEventCoalescer() if opts.coalesce_period > 0 else None
+            user_c = UserEventCoalescer() if opts.user_coalesce_period > 0 else None
+            if member_c or user_c:
+                s._tasks.append(asyncio.create_task(
+                    s._coalesce_pipeline(member_c, user_c), name=f"serf-coalesce-{node_id}"))
+            else:
+                s._tasks.append(asyncio.create_task(
+                    s._passthrough_pipeline(), name=f"serf-events-{node_id}"))
+        else:
+            s._tasks.append(asyncio.create_task(
+                s._drain_pipeline(), name=f"serf-drain-{node_id}"))
+
+        # snapshot replay (reference base.rs:130-155)
+        replay_nodes: List[Node] = []
+        if opts.snapshot_path:
+            from serf_tpu.host.snapshot import open_and_replay_snapshot, Snapshotter
+            replay = open_and_replay_snapshot(opts.snapshot_path,
+                                              opts.rejoin_after_leave)
+            s.clock.witness(replay.last_clock)
+            s.event_clock.witness(replay.last_event_clock)
+            s.query_clock.witness(replay.last_query_clock)
+            s._event_min_time = replay.last_event_clock + 1
+            s._query_min_time = replay.last_query_clock + 1
+            replay_nodes = replay.alive_nodes
+            s.snapshotter = Snapshotter(
+                opts.snapshot_path, replay, s._labels,
+                clock_fn=lambda: (s.clock.time(), s.event_clock.time(),
+                                  s.query_clock.time()),
+                min_compact_size=opts.snapshot_min_compact_size)
+            s._tasks.append(asyncio.create_task(
+                s.snapshotter.run(), name=f"serf-snapshot-{node_id}"))
+
+        await s.memberlist.start()
+
+        # key manager (encryption feature)
+        if keyring is not None:
+            from serf_tpu.host.key_manager import KeyManager
+            s._key_manager = KeyManager(s)
+
+        # background tasks (reference base.rs:284-335)
+        s._tasks.append(asyncio.create_task(s._reaper(), name=f"serf-reaper-{node_id}"))
+        s._tasks.append(asyncio.create_task(s._reconnector(), name=f"serf-reconnect-{node_id}"))
+        for qname, q in (("intent", s.intent_broadcasts),
+                         ("event", s.event_broadcasts),
+                         ("query", s.query_broadcasts)):
+            s._tasks.append(asyncio.create_task(
+                s._queue_checker(qname, q), name=f"serf-qc-{qname}-{node_id}"))
+
+        # auto-rejoin snapshot nodes (reference handle_rejoin, base.rs:1782)
+        if replay_nodes and (opts.rejoin_after_leave or not getattr(
+                s.snapshotter, "left_before", False)):
+            s._spawn(s._handle_rejoin(replay_nodes), "serf-rejoin")
+        return s
+
+    # ------------------------------------------------------------------
+    # event pipelines
+    # ------------------------------------------------------------------
+
+    async def _passthrough_pipeline(self) -> None:
+        while True:
+            ev = await self._event_inbox.get()
+            if ev is None:
+                return
+            if self.snapshotter is not None:
+                self.snapshotter.observe(ev)
+            self._subscriber._push(ev)
+
+    async def _drain_pipeline(self) -> None:
+        while True:
+            ev = await self._event_inbox.get()
+            if ev is None:
+                return
+            if self.snapshotter is not None:
+                self.snapshotter.observe(ev)
+
+    async def _coalesce_pipeline(self, member_c, user_c) -> None:
+        """Chain: inbox -> member coalescer -> user coalescer -> subscriber
+        (reference wires coalescers as channel wrappers, base.rs:88-115)."""
+        mid: asyncio.Queue = asyncio.Queue()
+        out = self._subscriber
+
+        async def tee() -> None:
+            while True:
+                ev = await self._event_inbox.get()
+                if self.snapshotter is not None and ev is not None:
+                    self.snapshotter.observe(ev)
+                await mid.put(ev)
+                if ev is None:
+                    return
+
+        t = asyncio.create_task(tee())
+        try:
+            if member_c and user_c:
+                mid2: asyncio.Queue = asyncio.Queue()
+                relay = EventSubscriber()
+
+                async def pump() -> None:
+                    while True:
+                        ev = await relay._q.get()
+                        await mid2.put(ev)
+
+                p = asyncio.create_task(pump())
+                try:
+                    await asyncio.gather(
+                        coalesce_loop(mid, relay, member_c,
+                                      self.opts.coalesce_period,
+                                      self.opts.quiescent_period),
+                        coalesce_loop(mid2, out, user_c,
+                                      self.opts.user_coalesce_period,
+                                      self.opts.user_quiescent_period),
+                    )
+                finally:
+                    p.cancel()
+            elif member_c:
+                await coalesce_loop(mid, out, member_c,
+                                    self.opts.coalesce_period,
+                                    self.opts.quiescent_period)
+            else:
+                await coalesce_loop(mid, out, user_c,
+                                    self.opts.user_coalesce_period,
+                                    self.opts.user_quiescent_period)
+        finally:
+            t.cancel()
+
+    def _emit(self, ev) -> None:
+        self._event_inbox.put_nowait(ev)
+
+    # ------------------------------------------------------------------
+    # public API (reference api.rs)
+    # ------------------------------------------------------------------
+
+    @property
+    def local_id(self) -> str:
+        return self.memberlist.local_id()
+
+    def local_member(self) -> Member:
+        ms = self._members.get(self.local_id)
+        if ms is not None:
+            return ms.member
+        return Member(self.memberlist.local_node(), self._tags, MemberStatus.ALIVE)
+
+    def members(self) -> List[Member]:
+        return [ms.member for ms in self._members.values()]
+
+    def num_members(self) -> int:
+        return len(self._members)
+
+    def encryption_enabled(self) -> bool:
+        return self.memberlist.encryption_enabled()
+
+    def key_manager(self):
+        return self._key_manager
+
+    def tags(self) -> Tags:
+        return self._tags
+
+    async def set_tags(self, tags: Tags) -> None:
+        """Hot-swap tags and re-advertise meta (reference api.rs:219-235)."""
+        tags.check_meta_size()
+        self._tags = tags
+        await self.memberlist.update_node(self.opts.broadcast_timeout)
+
+    def stats(self) -> Stats:
+        return Stats(
+            members=len(self._members),
+            failed=len(self._failed),
+            left=len(self._left),
+            health_score=self.memberlist.health_score(),
+            member_time=self.clock.time(),
+            event_time=self.event_clock.time(),
+            query_time=self.query_clock.time(),
+            intent_queue=len(self.intent_broadcasts),
+            event_queue=len(self.event_broadcasts),
+            query_queue=len(self.query_broadcasts),
+            encrypted=self.encryption_enabled(),
+            coordinate_resets=(self.coord_client.stats()["resets"]
+                               if self.coord_client else 0),
+        )
+
+    def coordinate(self) -> Optional[Coordinate]:
+        return self.coord_client.get_coordinate() if self.coord_client else None
+
+    def cached_coordinate(self, node_id: str) -> Optional[Coordinate]:
+        return self._coord_cache.get(node_id)
+
+    # -- join / leave -------------------------------------------------------
+
+    async def join(self, addr, ignore_old: bool = False) -> None:
+        """(reference api.rs:318-417)"""
+        if self.state != SerfState.ALIVE:
+            raise RuntimeError(f"cannot join while {self.state.name}")
+        async with self._join_lock:
+            self._event_join_ignore = ignore_old
+            try:
+                await self.memberlist.join(addr)
+                await self._broadcast_join(self.clock.increment())
+            finally:
+                self._event_join_ignore = False
+
+    async def join_many(self, addrs: Sequence, ignore_old: bool = False
+                        ) -> Tuple[int, List[Exception]]:
+        if self.state != SerfState.ALIVE:
+            raise RuntimeError(f"cannot join while {self.state.name}")
+        async with self._join_lock:
+            self._event_join_ignore = ignore_old
+            try:
+                ok, errs = await self.memberlist.join_many(addrs)
+                if ok > 0:
+                    await self._broadcast_join(self.clock.increment())
+                return ok, errs
+            finally:
+                self._event_join_ignore = False
+
+    async def _broadcast_join(self, ltime: LamportTime) -> None:
+        """(reference base.rs:364-397)"""
+        msg = JoinMessage(ltime, self.local_id)
+        self._handle_node_join_intent(msg, rebroadcast=False)
+        self._queue(self.intent_broadcasts, encode_message(msg))
+
+    async def leave(self) -> None:
+        """Graceful leave: broadcast intent, drain, memberlist leave
+        (reference api.rs:422-499)."""
+        if self.state in (SerfState.LEFT, SerfState.SHUTDOWN):
+            return
+        async with self._state_lock:
+            self.state = SerfState.LEAVING
+            if self.snapshotter is not None:
+                await self.snapshotter.leave()
+            ltime = self.clock.increment()
+            msg = LeaveMessage(ltime, self.local_id)
+            self._handle_node_leave_intent(msg, rebroadcast=False)
+            if self._has_alive_peers():
+                done = asyncio.Event()
+                self._queue(self.intent_broadcasts, encode_message(msg), notify=done)
+                try:
+                    await asyncio.wait_for(done.wait(), self.opts.broadcast_timeout)
+                except asyncio.TimeoutError:
+                    log.warning("timeout while waiting for leave broadcast")
+            await self.memberlist.leave(self.opts.broadcast_timeout)
+            if self._has_alive_peers():
+                await asyncio.sleep(self.opts.leave_propagate_delay)
+            self.state = SerfState.LEFT
+
+    def _has_alive_peers(self) -> bool:
+        return any(ms.member.status == MemberStatus.ALIVE
+                   and ms.id != self.local_id for ms in self._members.values())
+
+    async def shutdown(self) -> None:
+        """(reference api.rs:525-558)"""
+        if self.state == SerfState.SHUTDOWN:
+            return
+        self.state = SerfState.SHUTDOWN
+        self._shutdown_event.set()
+        await self.memberlist.shutdown()
+        for t in [*self._tasks, *self._bg]:
+            t.cancel()
+        for t in [*self._tasks, *list(self._bg)]:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        for key, resp in list(self._query_responses.items()):
+            resp.close()
+        self._query_responses.clear()
+        if self.snapshotter is not None:
+            await self.snapshotter.shutdown()
+
+    async def remove_failed_node(self, node_id: str, prune: bool = False) -> None:
+        """Force-leave: broadcast a leave intent on behalf of a failed node
+        (reference api.rs:505-515, base.rs force_leave)."""
+        ltime = self.clock.increment()
+        msg = LeaveMessage(ltime, node_id, prune)
+        if not self._handle_node_leave_intent(msg, rebroadcast=False) \
+                and node_id not in self._members and node_id not in self._recent_intents:
+            return  # nothing known about this node
+        if not self._has_alive_peers():
+            return
+        done = asyncio.Event()
+        self._queue(self.intent_broadcasts, encode_message(msg), notify=done)
+        try:
+            await asyncio.wait_for(done.wait(), self.opts.broadcast_timeout)
+        except asyncio.TimeoutError:
+            log.warning("timeout broadcasting force-leave for %s", node_id)
+
+    # -- user events --------------------------------------------------------
+
+    async def user_event(self, name: str, payload: bytes, coalesce: bool = True) -> None:
+        """(reference api.rs:241-299)"""
+        size = len(name) + len(payload)
+        if size > self.opts.max_user_event_size:
+            raise ValueError(
+                f"user event exceeds configured limit of "
+                f"{self.opts.max_user_event_size} bytes before encoding")
+        if size > USER_EVENT_SIZE_LIMIT:
+            raise ValueError(f"user event exceeds sane limit of {USER_EVENT_SIZE_LIMIT} bytes")
+        ltime = self.event_clock.increment()
+        msg = UserEventMessage(ltime, name, payload, coalesce)
+        raw = encode_message(msg)
+        if len(raw) > USER_EVENT_SIZE_LIMIT:
+            raise ValueError(
+                f"encoded user event exceeds sane limit of {USER_EVENT_SIZE_LIMIT} bytes")
+        metrics.incr("serf.events", 1, self._labels)
+        metrics.incr(f"serf.events.{name}", 1, self._labels)
+        self._handle_user_event(msg, rebroadcast=False)
+        self._queue(self.event_broadcasts, raw)
+
+    # -- queries ------------------------------------------------------------
+
+    async def query(self, name: str, payload: bytes,
+                    params: Optional[QueryParam] = None) -> QueryResponse:
+        """(reference api.rs:304-313, base.rs:875-944)"""
+        params = params or QueryParam()
+        timeout = params.timeout or default_query_timeout(
+            max(1, len(self._members)),
+            self.opts.memberlist.gossip_interval,
+            self.opts.query_timeout_mult,
+        )
+        ltime = self.query_clock.increment()
+        qid = self.rng.getrandbits(32)
+        flags = QueryFlag.NONE
+        if params.request_ack:
+            flags |= QueryFlag.ACK
+        msg = QueryMessage(
+            ltime=ltime, id=qid, from_node=self.memberlist.local_node(),
+            filters=tuple(params.filters), flags=flags,
+            relay_factor=params.relay_factor,
+            timeout_ns=int(timeout * 1e9), name=name, payload=payload,
+        )
+        raw = encode_message(msg)
+        if len(raw) > self.opts.query_size_limit:
+            raise ValueError(f"query exceeds limit of {self.opts.query_size_limit} bytes")
+        resp = QueryResponse(ltime, qid, timeout, params.request_ack,
+                             len(self._members))
+        self._query_responses[(ltime, qid)] = resp
+        self._spawn(self._expire_query(resp), "serf-query-expire")
+        self._handle_query(msg, rebroadcast=False)
+        self._queue(self.query_broadcasts, raw)
+        return resp
+
+    async def _expire_query(self, resp: QueryResponse) -> None:
+        await asyncio.sleep(max(0.0, resp.deadline - time.monotonic()))
+        resp.close()
+        self._query_responses.pop((resp.ltime, resp.id), None)
+
+    async def relay_response(self, relay_factor: int, target: Node, raw: bytes) -> None:
+        """Redundantly relay a query response through k random members
+        (reference query.rs:523-601)."""
+        if relay_factor == 0 or len(self._members) < relay_factor + 1:
+            return
+        relay = encode_relay_message(target, raw)
+        picks = random_members(
+            relay_factor, self.members(),
+            {self.local_id, target.id}, self.rng)
+        for m in picks:
+            await self.memberlist.send(m.node.addr, relay)
+
+    # ------------------------------------------------------------------
+    # inbound dispatch (reference delegate.rs notify_message, 157-315)
+    # ------------------------------------------------------------------
+
+    def _dispatch(self, msg, raw: bytes) -> None:
+        if isinstance(msg, LeaveMessage):
+            if self._handle_node_leave_intent(msg):
+                self._queue(self.intent_broadcasts, raw)
+        elif isinstance(msg, JoinMessage):
+            if self._handle_node_join_intent(msg):
+                self._queue(self.intent_broadcasts, raw)
+        elif isinstance(msg, UserEventMessage):
+            if self._handle_user_event(msg):
+                self._queue(self.event_broadcasts, raw)
+        elif isinstance(msg, QueryMessage):
+            if self._handle_query(msg):
+                self._queue(self.query_broadcasts, raw)
+        elif isinstance(msg, QueryResponseMessage):
+            self._handle_query_response(msg)
+        elif isinstance(msg, RelayMessage):
+            self._handle_relay(msg)
+        else:
+            log.debug("unhandled serf message %s", type(msg).__name__)
+
+    def _handle_relay(self, msg: RelayMessage) -> None:
+        if msg.node.id == self.local_id or msg.node.addr == self.memberlist.local_node().addr:
+            try:
+                inner = decode_message(msg.payload)
+            except codec.DecodeError as e:
+                log.debug("bad relayed message: %s", e)
+                return
+            self._dispatch(inner, msg.payload)
+        else:
+            self._spawn(self.memberlist.send(msg.node.addr, msg.payload), "serf-relay-fwd")
+
+    def _queue(self, q: TransmitLimitedQueue, raw: bytes,
+               notify: Optional[asyncio.Event] = None) -> None:
+        q.queue_broadcast(Broadcast(raw, name=None, notify=notify))
+
+    # ------------------------------------------------------------------
+    # member-event handlers (reference base.rs:1206-1866)
+    # ------------------------------------------------------------------
+
+    def _handle_node_join(self, ns: NodeState) -> None:
+        """memberlist says a node is alive (reference base.rs:1206-1334)."""
+        tags = _decode_tags(ns.meta)
+        old = self._members.get(ns.id)
+        status_time = 0
+        status = MemberStatus.ALIVE
+        jt = recent_intent(self._recent_intents, ns.id, IntentType.JOIN)
+        if jt is not None:
+            status_time = jt
+        lt = recent_intent(self._recent_intents, ns.id, IntentType.LEAVE)
+        if lt is not None and lt > status_time:
+            status = MemberStatus.LEAVING
+            status_time = lt
+        self._recent_intents.pop(ns.id, None)
+        if old is None:
+            ms = MemberState(
+                Member(ns.node, tags, status), status_time, 0.0)
+            self._members[ns.id] = ms
+        else:
+            # rejoin: flap detection (reference base.rs:1236-1249)
+            if old.member.status in (MemberStatus.FAILED, MemberStatus.LEFT):
+                if time.monotonic() - old.leave_time < self.opts.flap_timeout:
+                    metrics.incr("serf.member.flap", 1, self._labels)
+                self._failed = [m for m in self._failed if m.id != ns.id]
+                self._left = [m for m in self._left if m.id != ns.id]
+            ms = old
+            ms.member = Member(ns.node, tags, status,
+                               old.member.protocol_version,
+                               old.member.delegate_version)
+            if status_time:
+                ms.status_time = status_time
+        metrics.incr("serf.member.join", 1, self._labels)
+        self._emit(MemberEvent(MemberEventType.JOIN, (ms.member,)))
+
+    def _handle_node_leave(self, ns: NodeState) -> None:
+        """memberlist says a node failed or left (reference base.rs:1375-1440)."""
+        ms = self._members.get(ns.id)
+        if ms is None:
+            return
+        cur = ms.member.status
+        if cur == MemberStatus.LEAVING or ns.state == SwimState.LEFT:
+            ms.member = ms.member.with_status(MemberStatus.LEFT)
+            ms.leave_time = time.monotonic()
+            self._left.append(ms)
+            ty = MemberEventType.LEAVE
+            metrics.incr("serf.member.leave", 1, self._labels)
+        elif cur == MemberStatus.ALIVE:
+            ms.member = ms.member.with_status(MemberStatus.FAILED)
+            ms.leave_time = time.monotonic()
+            self._failed.append(ms)
+            ty = MemberEventType.FAILED
+            metrics.incr("serf.member.failed", 1, self._labels)
+        else:
+            return
+        self._emit(MemberEvent(ty, (ms.member,)))
+
+    def _handle_node_update(self, ns: NodeState) -> None:
+        """tags/meta changed (reference base.rs:1576-1624)."""
+        ms = self._members.get(ns.id)
+        if ms is None:
+            return
+        tags = _decode_tags(ns.meta)
+        if tags == ms.member.tags:
+            return
+        ms.member = Member(ns.node, tags, ms.member.status,
+                           ms.member.protocol_version, ms.member.delegate_version)
+        metrics.incr("serf.member.update", 1, self._labels)
+        self._emit(MemberEvent(MemberEventType.UPDATE, (ms.member,)))
+
+    def _handle_node_join_intent(self, msg: JoinMessage,
+                                 rebroadcast: bool = True) -> bool:
+        """(reference base.rs:1338-1373); returns whether to rebroadcast."""
+        self.clock.witness(msg.ltime)
+        ms = self._members.get(msg.id)
+        if ms is None:
+            return upsert_intent(self._recent_intents, msg.id, IntentType.JOIN,
+                                 msg.ltime)
+        if msg.ltime <= ms.status_time:
+            return False
+        ms.status_time = msg.ltime
+        if ms.member.status == MemberStatus.LEAVING:
+            # join intent refutes an in-flight leave
+            ms.member = ms.member.with_status(MemberStatus.ALIVE)
+        return True
+
+    def _handle_node_leave_intent(self, msg: LeaveMessage,
+                                  rebroadcast: bool = True) -> bool:
+        """(reference base.rs:1442-1572, incl. consul#8179 fix and
+        self-refutation); returns whether to rebroadcast."""
+        self.clock.witness(msg.ltime)
+        ms = self._members.get(msg.id)
+        if ms is None:
+            return upsert_intent(self._recent_intents, msg.id, IntentType.LEAVE,
+                                 msg.ltime)
+        if msg.ltime <= ms.status_time:
+            return False
+        # stale leave about ourselves while alive: refute (base.rs:1468-1480)
+        if msg.id == self.local_id and self.state == SerfState.ALIVE:
+            log.warning("refuting a stale leave intent about ourselves")
+            self._spawn(self._broadcast_join(self.clock.increment()),
+                        "serf-refute-leave")
+            return False
+        status = ms.member.status
+        if status == MemberStatus.ALIVE:
+            ms.member = ms.member.with_status(MemberStatus.LEAVING)
+            ms.status_time = msg.ltime
+            if msg.prune:
+                self._handle_prune(ms)
+            return True
+        if status == MemberStatus.FAILED:
+            # failed node declared left: move to graceful-left so reapers use
+            # tombstone timing; emit a Leave event (consul semantics)
+            ms.member = ms.member.with_status(MemberStatus.LEFT)
+            ms.status_time = msg.ltime
+            ms.leave_time = time.monotonic()
+            self._failed = [m for m in self._failed if m.id != msg.id]
+            self._left.append(ms)
+            self._emit(MemberEvent(MemberEventType.LEAVE, (ms.member,)))
+            if msg.prune:
+                self._handle_prune(ms)
+            return True
+        if status in (MemberStatus.LEAVING, MemberStatus.LEFT):
+            # already leaving/left: update time, do NOT rebroadcast
+            # (anti-infinite-rebroadcast, reference base.rs:1482-1496)
+            ms.status_time = msg.ltime
+            if msg.prune:
+                self._handle_prune(ms)
+        return False
+
+    def _handle_prune(self, ms: MemberState) -> None:
+        """Erase a member entirely (reference base.rs:1628-1653)."""
+        node_id = ms.id
+        log.info("pruning member %s", node_id)
+        self._erase_member(ms)
+
+    def _erase_member(self, ms: MemberState) -> None:
+        node_id = ms.id
+        self._members.pop(node_id, None)
+        self._failed = [m for m in self._failed if m.id != node_id]
+        self._left = [m for m in self._left if m.id != node_id]
+        if self.coord_client is not None:
+            self.coord_client.forget_node(node_id)
+            self._coord_cache.pop(node_id, None)
+
+    # ------------------------------------------------------------------
+    # user event / query handlers (reference base.rs:750-1202)
+    # ------------------------------------------------------------------
+
+    def _handle_user_event(self, msg: UserEventMessage,
+                           rebroadcast: bool = True) -> bool:
+        """(reference base.rs:750-837); returns whether to rebroadcast."""
+        self.event_clock.witness(msg.ltime)
+        if msg.ltime < self._event_min_time:
+            return False
+        buf_len = len(self._event_buffer)
+        cur = self.event_clock.time()
+        if msg.ltime + buf_len < cur:
+            log.warning("received old event %s from time %d (current: %d)",
+                        msg.name, msg.ltime, cur)
+            return False
+        idx = msg.ltime % buf_len
+        cell = self._event_buffer[idx]
+        if cell is not None and cell.ltime == msg.ltime:
+            for prev in cell.events:
+                if prev.name == msg.name and prev.payload == msg.payload:
+                    return False
+            self._event_buffer[idx] = UserEvents(
+                cell.ltime, cell.events + (msg,))
+        else:
+            self._event_buffer[idx] = UserEvents(msg.ltime, (msg,))
+        metrics.incr("serf.events", 1, self._labels)
+        metrics.incr(f"serf.events.{msg.name}", 1, self._labels)
+        self._emit(UserEvent(msg.ltime, msg.name, msg.payload, msg.cc))
+        return True
+
+    def _handle_query(self, msg: QueryMessage, rebroadcast: bool = True) -> bool:
+        """(reference base.rs:972-1154); returns whether to rebroadcast."""
+        self.query_clock.witness(msg.ltime)
+        if msg.ltime < self._query_min_time:
+            return False
+        buf_len = len(self._query_buffer)
+        cur = self.query_clock.time()
+        if msg.ltime + buf_len < cur:
+            log.warning("received old query %s from time %d (current: %d)",
+                        msg.name, msg.ltime, cur)
+            return False
+        idx = msg.ltime % buf_len
+        cell = self._query_buffer[idx]
+        if cell is not None and cell[0] == msg.ltime:
+            if msg.id in cell[1]:
+                return False
+            cell[1].add(msg.id)
+        else:
+            self._query_buffer[idx] = (msg.ltime, {msg.id})
+        rebroadcast_out = not msg.no_broadcast()
+        metrics.incr("serf.queries", 1, self._labels)
+        metrics.incr(f"serf.queries.{msg.name}", 1, self._labels)
+        if not should_process_query(msg.filters, self.local_id, self._tags):
+            return rebroadcast_out
+        if msg.ack():
+            ack = QueryResponseMessage(
+                ltime=msg.ltime, id=msg.id,
+                from_node=self.memberlist.local_node(), flags=QueryFlag.ACK)
+            raw = encode_message(ack)
+            self._spawn(self._send_and_relay(msg, raw), "serf-query-ack")
+        ev = QueryEvent(
+            ltime=msg.ltime, name=msg.name, payload=msg.payload, id=msg.id,
+            from_node=msg.from_node, relay_factor=msg.relay_factor,
+            deadline=time.monotonic() + msg.timeout_ns / 1e9, _serf=self,
+        )
+        if msg.name.startswith("_serf_"):
+            from serf_tpu.host.internal_query import handle_internal_query
+            self._spawn(handle_internal_query(self, ev), "serf-internal-query")
+        else:
+            self._emit(ev)
+        return rebroadcast_out
+
+    async def _send_and_relay(self, msg: QueryMessage, raw: bytes) -> None:
+        await self.memberlist.send(msg.from_node.addr, raw)
+        await self.relay_response(msg.relay_factor, msg.from_node, raw)
+
+    def _handle_query_response(self, msg: QueryResponseMessage) -> None:
+        """(reference base.rs:1158-1202)"""
+        resp = self._query_responses.get((msg.ltime, msg.id))
+        if resp is None:
+            return
+        if msg.ack():
+            resp.handle_ack(msg.from_node.id, self._labels)
+        else:
+            resp.handle_response(msg.from_node.id, msg.payload, self._labels)
+
+    # ------------------------------------------------------------------
+    # conflict resolution (reference base.rs:1658-1780)
+    # ------------------------------------------------------------------
+
+    async def _resolve_node_conflict(self, existing: NodeState, other) -> None:
+        """Majority vote via an internal query about OUR OWN id: every node
+        answers with the address it has for the conflicted id; if the
+        majority disagrees with our address, we are the usurper and shut
+        down (reference base.rs:1685-1780)."""
+        try:
+            local = self.memberlist.local_node()
+            payload = local.id.encode("utf-8")
+            resp = await self.query(INTERNAL_CONFLICT, payload, QueryParam())
+            responses = 0
+            matching = 0
+            async for r in resp.responses():
+                try:
+                    inner = decode_message(r.payload)
+                except codec.DecodeError:
+                    continue
+                if not isinstance(inner, ConflictResponseMessage):
+                    continue
+                if inner.member.node.id != local.id:
+                    continue
+                responses += 1
+                if inner.member.node.addr == local.addr:
+                    matching += 1
+            majority = responses // 2 + 1
+            if responses > 0 and matching < majority:
+                log.error(
+                    "minority in node-id conflict (%d/%d agree with us); shutting down",
+                    matching, responses)
+                await self.shutdown()
+        finally:
+            self._conflict_resolving = False
+
+    # ------------------------------------------------------------------
+    # background tasks (reference base.rs:483-740)
+    # ------------------------------------------------------------------
+
+    async def _reaper(self) -> None:
+        while not self._shutdown_event.is_set():
+            await asyncio.sleep(self.opts.reap_interval)
+            try:
+                now = time.monotonic()
+                self._reap(self._failed, now, self.opts.reconnect_timeout,
+                           use_reconnect_override=True)
+                self._reap(self._left, now, self.opts.tombstone_timeout)
+                reap_intents(self._recent_intents, now, self.opts.recent_intent_timeout)
+            except Exception:  # noqa: BLE001
+                log.exception("reaper tick failed")
+
+    def _reap(self, lst: List[MemberState], now: float, timeout: float,
+              use_reconnect_override: bool = False) -> None:
+        for ms in list(lst):
+            t = timeout
+            if use_reconnect_override and self.user_delegate is not None:
+                t = self.user_delegate.reconnect_timeout(ms.member, timeout)
+            if now - ms.leave_time > t:
+                log.info("reaping member %s", ms.id)
+                self._erase_member(ms)
+                self._emit(MemberEvent(MemberEventType.REAP, (ms.member,)))
+
+    async def _reconnector(self) -> None:
+        """(reference base.rs:612-681)"""
+        while not self._shutdown_event.is_set():
+            await asyncio.sleep(self.opts.reconnect_interval)
+            try:
+                if not self._failed:
+                    continue
+                n = max(1, len(self._members))
+                prob = len(self._failed) / n
+                if self.rng.random() > prob:
+                    continue
+                ms = self.rng.choice(self._failed)
+                addr = ms.member.node.addr
+                log.debug("attempting reconnect to %s", ms.id)
+                try:
+                    await self.memberlist.join(addr)
+                except (ConnectionError, TimeoutError, OSError):
+                    pass
+            except Exception:  # noqa: BLE001
+                log.exception("reconnector tick failed")
+
+    async def _queue_checker(self, name: str, q: TransmitLimitedQueue) -> None:
+        """(reference base.rs:683-740)"""
+        while not self._shutdown_event.is_set():
+            await asyncio.sleep(self.opts.queue_check_interval)
+            depth = len(q)
+            metrics.gauge(f"serf.queue.{name}", depth, self._labels)
+            if depth > self.opts.queue_depth_warning:
+                log.warning("queue %s depth: %d", name, depth)
+            max_depth = self.opts.max_queue_depth
+            if self.opts.min_queue_depth > 0:
+                max_depth = max(self.opts.min_queue_depth, 2 * len(self._members))
+            if depth > max_depth:
+                log.warning("queue %s depth (%d) exceeds limit (%d); pruning",
+                            name, depth, max_depth)
+                q.prune(max_depth)
+
+    async def _handle_rejoin(self, nodes: List[Node]) -> None:
+        """(reference base.rs:1782-1808): shuffle snapshot nodes and rejoin
+        the first that answers."""
+        nodes = list(nodes)
+        self.rng.shuffle(nodes)
+        for node in nodes:
+            if node.id == self.local_id:
+                continue
+            try:
+                await self.memberlist.join(node.addr)
+                log.info("rejoined cluster via %s", node.id)
+                await self._broadcast_join(self.clock.increment())
+                return
+            except (ConnectionError, TimeoutError, OSError):
+                continue
+        log.warning("failed to rejoin any previously known node")

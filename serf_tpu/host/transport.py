@@ -9,7 +9,8 @@ serf-tpu ships:
   clusters and tests, with first-class fault injection (per-edge drop
   functions, partitions, latency), standing in for the reference's
   CI loopback-subnet strategy (ci/setup_subnet_ubuntu.sh).
-- ``UdpTransport`` — real UDP datagrams + TCP streams (see ``net.py``).
+- ``NetTransport`` (``serf_tpu.host.net``) — real UDP datagrams + TCP
+  streams for cross-process conformance.
 
 Fault injection is part of the transport contract because the device plane
 treats drop masks as input tensors; the host plane mirrors that.

@@ -70,13 +70,13 @@ def decode_filter(buf: bytes) -> Filter:
     tag, expr = "", ""
     for f, _wt, v, _p in codec.iter_fields(buf):
         if f == 1:
-            kind = v
+            kind = codec.as_uint(v)
         elif f == 2:
-            ids.append(v.decode("utf-8"))
+            ids.append(codec.as_str(v))
         elif f == 3:
-            tag = v.decode("utf-8")
+            tag = codec.as_str(v)
         elif f == 4:
-            expr = v.decode("utf-8")
+            expr = codec.as_str(v)
     if kind == IdFilter.KIND:
         return IdFilter(tuple(ids))
     if kind == TagFilter.KIND:

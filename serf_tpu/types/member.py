@@ -59,18 +59,18 @@ class Node:
         nid, addr = "", None
         for f, _wt, v, _p in codec.iter_fields(buf):
             if f == 1:
-                nid = v.decode("utf-8")
+                nid = codec.as_str(v)
             elif f == 2:
-                s = v.decode("utf-8")
+                s = codec.as_str(v)
                 host, _, port = s.rpartition(":")
                 try:
                     addr = (host, int(port))
                 except ValueError as e:
                     raise codec.DecodeError(f"bad host:port address {s!r}") from e
             elif f == 3:
-                addr = v
+                addr = codec.as_uint(v)
             elif f == 4:
-                addr = v.decode("utf-8")
+                addr = codec.as_str(v)
         return cls(nid, addr)
 
 
@@ -102,15 +102,15 @@ class Member:
         node, tags, status, pv, dv = Node(""), Tags(), MemberStatus.NONE, 1, 1
         for f, _wt, v, _p in codec.iter_fields(buf):
             if f == 1:
-                node = Node.decode(v)
+                node = Node.decode(codec.as_bytes(v))
             elif f == 2:
-                tags = Tags.decode(v)
+                tags = Tags.decode(codec.as_bytes(v))
             elif f == 3:
-                status = MemberStatus(v)
+                status = MemberStatus(codec.as_uint(v))
             elif f == 4:
-                pv = v
+                pv = codec.as_uint(v)
             elif f == 5:
-                dv = v
+                dv = codec.as_uint(v)
         return cls(node, tags, status, pv, dv)
 
 

@@ -58,9 +58,9 @@ class JoinMessage:
         lt, nid = 0, ""
         for f, _wt, v, _p in codec.iter_fields(buf):
             if f == 1:
-                lt = v
+                lt = codec.as_uint(v)
             elif f == 2:
-                nid = v.decode("utf-8")
+                nid = codec.as_str(v)
         return cls(lt, nid)
 
 
@@ -85,11 +85,11 @@ class LeaveMessage:
         lt, nid, prune = 0, "", False
         for f, _wt, v, _p in codec.iter_fields(buf):
             if f == 1:
-                lt = v
+                lt = codec.as_uint(v)
             elif f == 2:
-                nid = v.decode("utf-8")
+                nid = codec.as_str(v)
             elif f == 3:
-                prune = bool(v)
+                prune = bool(codec.as_uint(v))
         return cls(lt, nid, prune)
 
 
@@ -118,13 +118,13 @@ class UserEventMessage:
         lt, name, payload, cc = 0, "", b"", False
         for f, _wt, v, _p in codec.iter_fields(buf):
             if f == 1:
-                lt = v
+                lt = codec.as_uint(v)
             elif f == 2:
-                name = v.decode("utf-8")
+                name = codec.as_str(v)
             elif f == 3:
-                payload = bytes(v)
+                payload = codec.as_bytes(v)
             elif f == 4:
-                cc = bool(v)
+                cc = bool(codec.as_uint(v))
         return cls(lt, name, payload, cc)
 
 
@@ -148,9 +148,9 @@ class UserEvents:
         evs: List[UserEventMessage] = []
         for f, _wt, v, _p in codec.iter_fields(buf):
             if f == 1:
-                lt = v
+                lt = codec.as_uint(v)
             elif f == 2:
-                evs.append(UserEventMessage.decode_body(v))
+                evs.append(UserEventMessage.decode_body(codec.as_bytes(v)))
         return cls(lt, tuple(evs))
 
 
@@ -189,23 +189,23 @@ class PushPullMessage:
         events: List[UserEvents] = []
         for f, _wt, v, _p in codec.iter_fields(buf):
             if f == 1:
-                lt = v
+                lt = codec.as_uint(v)
             elif f == 2:
                 nid, t = "", 0
-                for f2, _w2, v2, _p2 in codec.iter_fields(v):
+                for f2, _w2, v2, _p2 in codec.iter_fields(codec.as_bytes(v)):
                     if f2 == 1:
-                        nid = v2.decode("utf-8")
+                        nid = codec.as_str(v2)
                     elif f2 == 2:
-                        t = v2
+                        t = codec.as_uint(v2)
                 sl[nid] = t
             elif f == 3:
-                left.append(v.decode("utf-8"))
+                left.append(codec.as_str(v))
             elif f == 4:
-                ev_lt = v
+                ev_lt = codec.as_uint(v)
             elif f == 5:
-                events.append(UserEvents.decode(v))
+                events.append(UserEvents.decode(codec.as_bytes(v)))
             elif f == 6:
-                q_lt = v
+                q_lt = codec.as_uint(v)
         return cls(lt, sl, tuple(left), ev_lt, tuple(events), q_lt)
 
 
@@ -252,23 +252,23 @@ class QueryMessage:
         filters: List[Filter] = []
         for f, _wt, v, _p in codec.iter_fields(buf):
             if f == 1:
-                kw["ltime"] = v
+                kw["ltime"] = codec.as_uint(v)
             elif f == 2:
-                kw["id"] = v
+                kw["id"] = codec.as_uint(v)
             elif f == 3:
-                kw["from_node"] = Node.decode(v)
+                kw["from_node"] = Node.decode(codec.as_bytes(v))
             elif f == 4:
-                filters.append(decode_filter(v))
+                filters.append(decode_filter(codec.as_bytes(v)))
             elif f == 5:
-                kw["flags"] = QueryFlag(v)
+                kw["flags"] = QueryFlag(codec.as_uint(v))
             elif f == 6:
-                kw["relay_factor"] = v
+                kw["relay_factor"] = codec.as_uint(v)
             elif f == 7:
-                kw["timeout_ns"] = v
+                kw["timeout_ns"] = codec.as_uint(v)
             elif f == 8:
-                kw["name"] = v.decode("utf-8")
+                kw["name"] = codec.as_str(v)
             elif f == 9:
-                kw["payload"] = bytes(v)
+                kw["payload"] = codec.as_bytes(v)
         return cls(filters=tuple(filters), **kw)
 
 
@@ -301,15 +301,15 @@ class QueryResponseMessage:
         lt, qid, frm, flags, payload = 0, 0, Node(""), QueryFlag.NONE, b""
         for f, _wt, v, _p in codec.iter_fields(buf):
             if f == 1:
-                lt = v
+                lt = codec.as_uint(v)
             elif f == 2:
-                qid = v
+                qid = codec.as_uint(v)
             elif f == 3:
-                frm = Node.decode(v)
+                frm = Node.decode(codec.as_bytes(v))
             elif f == 4:
-                flags = QueryFlag(v)
+                flags = QueryFlag(codec.as_uint(v))
             elif f == 5:
-                payload = bytes(v)
+                payload = codec.as_bytes(v)
         return cls(lt, qid, frm, flags, payload)
 
 
@@ -329,7 +329,7 @@ class ConflictResponseMessage:
         member = Member(Node(""))
         for f, _wt, v, _p in codec.iter_fields(buf):
             if f == 1:
-                member = Member.decode(v)
+                member = Member.decode(codec.as_bytes(v))
         return cls(member)
 
 
@@ -349,7 +349,7 @@ class KeyRequestMessage:
         key = b""
         for f, _wt, v, _p in codec.iter_fields(buf):
             if f == 1:
-                key = bytes(v)
+                key = codec.as_bytes(v)
         return cls(key)
 
 
@@ -379,13 +379,13 @@ class KeyResponseMessage:
         res, msg, keys, pk = True, "", [], b""
         for f, _wt, v, _p in codec.iter_fields(buf):
             if f == 1:
-                res = bool(v)
+                res = bool(codec.as_uint(v))
             elif f == 2:
-                msg = v.decode("utf-8")
+                msg = codec.as_str(v)
             elif f == 3:
-                keys.append(bytes(v))
+                keys.append(codec.as_bytes(v))
             elif f == 4:
-                pk = bytes(v)
+                pk = codec.as_bytes(v)
         return cls(res, msg, tuple(keys), pk)
 
 
@@ -445,9 +445,9 @@ def decode_message(buf: bytes):
             node, payload = Node(""), b""
             for f, _wt, v, _p in codec.iter_fields(body):
                 if f == 1:
-                    node = Node.decode(v)
+                    node = Node.decode(codec.as_bytes(v))
                 elif f == 2:
-                    payload = bytes(v)
+                    payload = codec.as_bytes(v)
             return RelayMessage(node, payload)
         return _DECODERS[ty](body)
     except codec.DecodeError:

@@ -1,0 +1,418 @@
+"""Serf engine tests: the scenario suite the reference pins under
+serf-core/src/serf/base/tests/ and serf/test/main/net/** (SURVEY.md §4) —
+join intents, leave variants, events, queries, tags, conflict handling,
+reaping, stats, coordinates.
+"""
+
+import asyncio
+
+import pytest
+
+from serf_tpu.host import (
+    EventSubscriber,
+    LoopbackNetwork,
+    MemberEvent,
+    MemberEventType,
+    QueryEvent,
+    QueryParam,
+    Serf,
+    SerfState,
+    UserEvent,
+)
+from serf_tpu.options import Options
+from serf_tpu.types.member import MemberStatus
+from serf_tpu.types.filters import IdFilter, TagFilter
+from serf_tpu.types.tags import Tags
+
+pytestmark = pytest.mark.asyncio
+DEADLINE = 7.0
+
+
+async def wait_until(cond, deadline=DEADLINE, interval=0.01, msg="condition"):
+    loop = asyncio.get_running_loop()
+    end = loop.time() + deadline
+    while loop.time() < end:
+        if cond():
+            return
+        await asyncio.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+async def make_cluster(net, n, subscribe=(), opts_fn=None, start=0):
+    nodes, subs = [], {}
+    for i in range(start, start + n):
+        opts = opts_fn(i) if opts_fn else Options.local()
+        sub = EventSubscriber() if i in subscribe else None
+        s = await Serf.create(net.bind(f"addr-{i}"), opts, f"node-{i}",
+                              subscriber=sub)
+        nodes.append(s)
+        if sub:
+            subs[i] = sub
+    return nodes, subs
+
+
+async def join_all(nodes):
+    for s in nodes[1:]:
+        await s.join("addr-" + nodes[0].local_id.split("-")[1])
+
+
+def alive_members(s):
+    return [m for m in s.members() if m.status == MemberStatus.ALIVE]
+
+
+async def shutdown_all(nodes):
+    for s in nodes:
+        await s.shutdown()
+
+
+async def test_create_single_node():
+    net = LoopbackNetwork()
+    s = await Serf.create(net.bind("a"), Options.local(), "solo")
+    try:
+        assert s.state == SerfState.ALIVE
+        assert s.num_members() == 1
+        assert s.members()[0].node.id == "solo"
+        st = s.stats()
+        assert st.members == 1 and not st.encrypted
+    finally:
+        await s.shutdown()
+
+
+async def test_join_members_converge():
+    net = LoopbackNetwork()
+    nodes, _ = await make_cluster(net, 5)
+    try:
+        await join_all(nodes)
+        await wait_until(lambda: all(len(alive_members(s)) == 5 for s in nodes),
+                         msg="5 alive members everywhere")
+        for s in nodes:
+            assert {m.node.id for m in s.members()} == {f"node-{i}" for i in range(5)}
+    finally:
+        await shutdown_all(nodes)
+
+
+async def test_join_events_emitted():
+    net = LoopbackNetwork()
+    nodes, subs = await make_cluster(net, 3, subscribe={0})
+    try:
+        await join_all(nodes)
+        seen = set()
+
+        async def collect():
+            while len(seen) < 3:
+                ev = await subs[0].next(timeout=DEADLINE)
+                if isinstance(ev, MemberEvent) and ev.ty == MemberEventType.JOIN:
+                    seen.update(m.node.id for m in ev.members)
+
+        await asyncio.wait_for(collect(), DEADLINE)
+        assert seen == {"node-0", "node-1", "node-2"}
+    finally:
+        await shutdown_all(nodes)
+
+
+async def test_user_event_dissemination():
+    net = LoopbackNetwork()
+    nodes, subs = await make_cluster(net, 5, subscribe={0, 4})
+    try:
+        await join_all(nodes)
+        await wait_until(lambda: all(len(alive_members(s)) == 5 for s in nodes))
+        await nodes[2].user_event("deploy", b"v2", coalesce=False)
+
+        async def got_event(sub):
+            while True:
+                ev = await sub.next(timeout=DEADLINE)
+                if isinstance(ev, UserEvent) and ev.name == "deploy":
+                    return ev
+
+        ev0 = await asyncio.wait_for(got_event(subs[0]), DEADLINE)
+        ev4 = await asyncio.wait_for(got_event(subs[4]), DEADLINE)
+        assert ev0.payload == ev4.payload == b"v2"
+        assert ev0.ltime == ev4.ltime
+    finally:
+        await shutdown_all(nodes)
+
+
+async def test_user_event_dedup_no_redelivery():
+    net = LoopbackNetwork()
+    nodes, subs = await make_cluster(net, 3, subscribe={1})
+    try:
+        await join_all(nodes)
+        await wait_until(lambda: all(len(alive_members(s)) == 3 for s in nodes))
+        await nodes[0].user_event("once", b"x", coalesce=False)
+        count = 0
+
+        async def count_events():
+            nonlocal count
+            while True:
+                ev = await subs[1].next(timeout=1.0)
+                if isinstance(ev, UserEvent) and ev.name == "once":
+                    count += 1
+
+        try:
+            await asyncio.wait_for(count_events(), 2.0)
+        except (asyncio.TimeoutError, TimeoutError):
+            pass
+        assert count == 1  # gossip redundancy must not re-deliver
+    finally:
+        await shutdown_all(nodes)
+
+
+async def test_user_event_size_limit():
+    net = LoopbackNetwork()
+    s = await Serf.create(net.bind("a"), Options.local(), "solo")
+    try:
+        with pytest.raises(ValueError):
+            await s.user_event("big", b"x" * 600)
+        big_opts = Options.local(max_user_event_size=9 * 1024)
+        with pytest.raises(ValueError):
+            await Serf(net.bind("b"), Options(max_user_event_size=10 * 1024), "b").user_event("x", b"")
+    finally:
+        await s.shutdown()
+
+
+async def test_query_responses_and_acks():
+    net = LoopbackNetwork()
+    nodes, subs = await make_cluster(net, 4, subscribe={1, 2, 3})
+    try:
+        await join_all(nodes)
+        await wait_until(lambda: all(len(alive_members(s)) == 4 for s in nodes))
+
+        async def responder(i):
+            while True:
+                ev = await subs[i].next()
+                if isinstance(ev, QueryEvent) and ev.name == "whoami":
+                    await ev.respond(f"i-am-node-{i}".encode())
+                    return
+
+        tasks = [asyncio.create_task(responder(i)) for i in (1, 2, 3)]
+        resp = await nodes[0].query("whoami", b"", QueryParam(request_ack=True, timeout=3.0))
+        results = {r.from_id: r.payload async for r in resp.responses()}
+        for t in tasks:
+            t.cancel()
+        assert results == {f"node-{i}": f"i-am-node-{i}".encode() for i in (1, 2, 3)}
+    finally:
+        await shutdown_all(nodes)
+
+
+async def test_query_id_filter():
+    net = LoopbackNetwork()
+    nodes, subs = await make_cluster(net, 3, subscribe={1, 2})
+    try:
+        await join_all(nodes)
+        await wait_until(lambda: all(len(alive_members(s)) == 3 for s in nodes))
+        hits = []
+
+        async def watcher(i):
+            while True:
+                ev = await subs[i].next()
+                if isinstance(ev, QueryEvent) and ev.name == "targeted":
+                    hits.append(i)
+                    await ev.respond(b"yes")
+
+        tasks = [asyncio.create_task(watcher(i)) for i in (1, 2)]
+        resp = await nodes[0].query(
+            "targeted", b"", QueryParam(filters=(IdFilter(("node-1",)),), timeout=2.0))
+        results = [r.from_id async for r in resp.responses()]
+        for t in tasks:
+            t.cancel()
+        assert results == ["node-1"]
+        assert hits == [1]  # node-2 never saw it
+    finally:
+        await shutdown_all(nodes)
+
+
+async def test_query_tag_filter():
+    net = LoopbackNetwork()
+
+    def opts_fn(i):
+        role = "web" if i in (0, 1) else "db"
+        return Options.local(tags=Tags(role=role))
+
+    nodes, subs = await make_cluster(net, 3, subscribe={1, 2}, opts_fn=opts_fn)
+    try:
+        await join_all(nodes)
+        await wait_until(lambda: all(len(alive_members(s)) == 3 for s in nodes))
+
+        async def watcher(i):
+            while True:
+                ev = await subs[i].next()
+                if isinstance(ev, QueryEvent) and ev.name == "webs":
+                    await ev.respond(b"web-here")
+
+        tasks = [asyncio.create_task(watcher(i)) for i in (1, 2)]
+        resp = await nodes[0].query(
+            "webs", b"", QueryParam(filters=(TagFilter("role", "^web$"),), timeout=2.0))
+        results = sorted([r.from_id async for r in resp.responses()])
+        for t in tasks:
+            t.cancel()
+        assert results == ["node-0", "node-1"] or results == ["node-1"]
+        # node-0 also matches but never responds (it's the originator and has
+        # no subscriber); node-2 (db) must not be in the results
+        assert "node-2" not in results
+    finally:
+        await shutdown_all(nodes)
+
+
+async def test_graceful_leave_events():
+    net = LoopbackNetwork()
+    nodes, subs = await make_cluster(net, 3, subscribe={0})
+    try:
+        await join_all(nodes)
+        await wait_until(lambda: all(len(alive_members(s)) == 3 for s in nodes))
+        await nodes[2].leave()
+        assert nodes[2].state == SerfState.LEFT
+
+        async def got_leave():
+            while True:
+                ev = await subs[0].next(timeout=DEADLINE)
+                if isinstance(ev, MemberEvent) and ev.ty == MemberEventType.LEAVE:
+                    return {m.node.id for m in ev.members}
+
+        ids = await asyncio.wait_for(got_leave(), DEADLINE)
+        assert ids == {"node-2"}
+        ms = [m for m in nodes[0].members() if m.node.id == "node-2"][0]
+        assert ms.status == MemberStatus.LEFT
+    finally:
+        await shutdown_all(nodes)
+
+
+async def test_failed_member_and_force_leave():
+    net = LoopbackNetwork()
+    nodes, subs = await make_cluster(net, 3, subscribe={0})
+    try:
+        await join_all(nodes)
+        await wait_until(lambda: all(len(alive_members(s)) == 3 for s in nodes))
+        await nodes[2].shutdown()
+        await wait_until(
+            lambda: any(m.status == MemberStatus.FAILED
+                        for m in nodes[0].members() if m.node.id == "node-2"),
+            msg="node-2 marked failed")
+        # force-leave flips failed -> left
+        await nodes[0].remove_failed_node("node-2")
+        await wait_until(
+            lambda: all(
+                any(m.node.id == "node-2" and m.status == MemberStatus.LEFT
+                    for m in s.members())
+                for s in nodes[:2]),
+            msg="force-leave converts failed to left everywhere")
+    finally:
+        await shutdown_all(nodes[:2])
+
+
+async def test_remove_failed_node_prune():
+    net = LoopbackNetwork()
+    nodes, _ = await make_cluster(net, 3)
+    try:
+        await join_all(nodes)
+        await wait_until(lambda: all(len(alive_members(s)) == 3 for s in nodes))
+        await nodes[2].shutdown()
+        await wait_until(
+            lambda: any(m.status == MemberStatus.FAILED
+                        for m in nodes[0].members() if m.node.id == "node-2"))
+        await nodes[0].remove_failed_node("node-2", prune=True)
+        await wait_until(
+            lambda: all(all(m.node.id != "node-2" for m in s.members())
+                        for s in nodes[:2]),
+            msg="prune erases the member everywhere")
+    finally:
+        await shutdown_all(nodes[:2])
+
+
+async def test_set_tags_propagates_update_event():
+    net = LoopbackNetwork()
+    nodes, subs = await make_cluster(net, 3, subscribe={1})
+    try:
+        await join_all(nodes)
+        await wait_until(lambda: all(len(alive_members(s)) == 3 for s in nodes))
+        await nodes[0].set_tags(Tags(role="lead", dc="eu"))
+
+        async def got_update():
+            while True:
+                ev = await subs[1].next(timeout=DEADLINE)
+                if isinstance(ev, MemberEvent) and ev.ty == MemberEventType.UPDATE:
+                    return ev.members[0]
+
+        m = await asyncio.wait_for(got_update(), DEADLINE)
+        assert m.node.id == "node-0"
+        assert m.tags == Tags(role="lead", dc="eu")
+        m0 = [m for m in nodes[2].members() if m.node.id == "node-0"][0]
+        await wait_until(lambda: [m for m in nodes[2].members()
+                                  if m.node.id == "node-0"][0].tags == Tags(role="lead", dc="eu"),
+                         msg="tags visible on node-2")
+    finally:
+        await shutdown_all(nodes)
+
+
+async def test_stats_and_queue_depths():
+    net = LoopbackNetwork()
+    nodes, _ = await make_cluster(net, 3)
+    try:
+        await join_all(nodes)
+        await wait_until(lambda: all(len(alive_members(s)) == 3 for s in nodes))
+        st = nodes[0].stats()
+        assert st.members == 3
+        assert st.member_time >= 1
+        assert st.failed == 0
+    finally:
+        await shutdown_all(nodes)
+
+
+async def test_coordinates_develop():
+    net = LoopbackNetwork()
+    net.latency_fn = lambda s, d: 0.01  # 10ms RTT one-way-ish
+    nodes, _ = await make_cluster(net, 3)
+    try:
+        await join_all(nodes)
+        await wait_until(lambda: all(len(alive_members(s)) == 3 for s in nodes))
+        await wait_until(
+            lambda: nodes[0].cached_coordinate("node-1") is not None,
+            msg="coordinate learned from pings")
+        c0 = nodes[0].coordinate()
+        assert c0 is not None and c0.is_valid()
+    finally:
+        await shutdown_all(nodes)
+
+
+async def test_rejoin_intent_refutes_leave():
+    """A node that left can rejoin; join intent with newer ltime flips status
+    back to alive everywhere (reference join-intent tests)."""
+    net = LoopbackNetwork()
+    nodes, _ = await make_cluster(net, 3)
+    try:
+        await join_all(nodes)
+        await wait_until(lambda: all(len(alive_members(s)) == 3 for s in nodes))
+        await nodes[2].leave()
+        await nodes[2].shutdown()
+        await wait_until(
+            lambda: all(any(m.node.id == "node-2" and m.status == MemberStatus.LEFT
+                            for m in s.members()) for s in nodes[:2]),
+            msg="node-2 left everywhere")
+        # restart node-2 on the same address and rejoin
+        s2 = await Serf.create(net.bind("addr-2"), Options.local(), "node-2")
+        nodes[2] = s2
+        await s2.join("addr-0")
+        await wait_until(
+            lambda: all(len(alive_members(s)) == 3 for s in [nodes[0], nodes[1], s2]),
+            msg="node-2 alive everywhere after rejoin")
+    finally:
+        await shutdown_all(nodes)
+
+
+async def test_net_transport_real_sockets():
+    """Conformance: a serf cluster over real UDP/TCP on 127.0.0.1
+    (reference runs its whole suite this way; we pin one end-to-end flow)."""
+    from serf_tpu.host.net import NetTransport
+    t0 = await NetTransport.bind(("127.0.0.1", 0))
+    t1 = await NetTransport.bind(("127.0.0.1", 0))
+    s0 = await Serf.create(t0, Options.local(), "net-0")
+    s1 = await Serf.create(t1, Options.local(), "net-1")
+    try:
+        await s1.join(t0.local_addr)
+        await wait_until(lambda: s0.num_members() == 2 and s1.num_members() == 2,
+                         msg="2-node convergence over real sockets")
+        await s0.user_event("hello", b"udp", coalesce=False)
+        await wait_until(lambda: s1.event_clock.time() >= 2,
+                         msg="user event over real sockets")
+    finally:
+        await s0.shutdown()
+        await s1.shutdown()
