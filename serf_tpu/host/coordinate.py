@@ -185,7 +185,7 @@ class CoordinateClient:
         with self._lock:
             rtt_f = self._latency_filter(node_id, rtt)
             self._update_vivaldi(other, rtt_f)
-            self._update_adjustment(other, rtt)
+            self._update_adjustment(other, rtt_f)
             self._update_gravity()
             if not self.coord.is_valid():
                 self.resets += 1
@@ -203,7 +203,7 @@ class CoordinateClient:
 
     def _update_vivaldi(self, other: Coordinate, rtt: float) -> None:
         rtt = max(rtt, 1.0e-9)
-        dist = self.coord.raw_distance_to(other)
+        dist = self.coord.distance_to(other)  # adjustment-inclusive (reference)
         wrongness = abs(dist - rtt) / rtt
         total_error = max(self.coord.error + other.error, 1.0e-9)
         weight = self.coord.error / total_error
@@ -228,7 +228,7 @@ class CoordinateClient:
         )
 
     def _update_gravity(self) -> None:
-        dist = self.origin.raw_distance_to(self.coord)
+        dist = self.origin.distance_to(self.coord)  # adjustment-inclusive
         force = -1.0 * (dist / self.opts.gravity_rho) ** 2
         self.coord = self.coord.apply_force(self.opts.height_min, force, self.origin, self.rng)
 

@@ -53,14 +53,13 @@ async def _handle_conflict(serf, ev: QueryEvent) -> None:
     (reference internal_query.rs handle_conflict)."""
     node_id = ev.payload.decode("utf-8", errors="replace")
     if node_id == serf.local_id:
-        # local node is the conflicted one; answer with our own view
-        member = serf.local_member()
-    else:
-        ms = serf._members.get(node_id)
-        if ms is None:
-            return
-        member = ms.member
-    await ev.respond(encode_message(ConflictResponseMessage(member)))
+        # never vote about ourselves — the conflicted nodes are the parties,
+        # observers are the electorate (reference internal_query.rs:131-134)
+        return
+    ms = serf._members.get(node_id)
+    if ms is None:
+        return
+    await ev.respond(encode_message(ConflictResponseMessage(ms.member)))
 
 
 def _keyring_or_error(serf):

@@ -631,6 +631,9 @@ class Serf:
         if self.state in (SerfState.LEFT, SerfState.SHUTDOWN):
             return
         async with self._state_lock:
+            # re-check after acquiring: a concurrent leave() may have finished
+            if self.state in (SerfState.LEFT, SerfState.SHUTDOWN):
+                return
             self.state = SerfState.LEAVING
             if self.snapshotter is not None:
                 await self.snapshotter.leave()
@@ -707,8 +710,7 @@ class Serf:
         if len(raw) > USER_EVENT_SIZE_LIMIT:
             raise ValueError(
                 f"encoded user event exceeds sane limit of {USER_EVENT_SIZE_LIMIT} bytes")
-        metrics.incr("serf.events", 1, self._labels)
-        metrics.incr(f"serf.events.{name}", 1, self._labels)
+        # metrics are counted once, inside the handler (reference base.rs:818)
         self._handle_user_event(msg, rebroadcast=False)
         self._queue(self.event_broadcasts, raw)
 

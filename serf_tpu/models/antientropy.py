@@ -1,0 +1,66 @@
+"""Device-plane push/pull anti-entropy and partition/heal.
+
+Maps the reference's periodic full-state push/pull sync
+(SURVEY.md §2.9, delegate.rs:386-554) onto the array representation: each
+node picks one random partner and merges the partner's *entire* knowledge
+bitset (not just budgeted packets) — a masked elementwise OR, which is how
+"pairwise state-sync as a batched merge of status_ltimes maps" (SURVEY.md §7
+stage 6) lands on the device plane.
+
+Partition = an i32 group id per node; edges across groups carry nothing.
+Heal = drop the mask.  Two-cluster merge parity is the baseline config #4
+scenario (BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    GossipState,
+    unpack_bits,
+)
+
+
+def push_pull_round(state: GossipState, cfg: GossipConfig, key: jax.Array,
+                    group=None) -> GossipState:
+    """Each alive node full-syncs with one random partner.
+
+    Newly learned facts get fresh transmit budgets, so anti-entropy
+    re-energizes dissemination of still-relevant facts after a partition
+    heals — the same effect as the reference replaying intents out of the
+    push/pull status_ltimes map.
+    """
+    n, k = cfg.n, cfg.k_facts
+    partners = jax.random.randint(key, (n,), 0, n)
+    partner_known = state.known[partners]                     # u32[N, W]
+    ok = state.alive & state.alive[partners]
+    if group is not None:
+        ok = ok & (group == group[partners])
+    incoming = jnp.where(ok[:, None], partner_known, jnp.uint32(0))
+    new_words = incoming & ~state.known
+    known = state.known | new_words
+    new_mask = unpack_bits(new_words, k)
+    budgets = jnp.where(new_mask, jnp.uint8(cfg.transmit_limit), state.budgets)
+    learned_round = jnp.where(new_mask, state.round, state.learned_round)
+    return state._replace(known=known, budgets=budgets,
+                          learned_round=learned_round)
+
+
+def make_partition(n: int, split: float = 0.5) -> jnp.ndarray:
+    """Two-group partition vector: first ``split`` fraction is group 0."""
+    cut = int(n * split)
+    return jnp.where(jnp.arange(n) < cut, 0, 1).astype(jnp.int32)
+
+
+def knowledge_agreement(state: GossipState, cfg: GossipConfig) -> jnp.ndarray:
+    """Scalar in [0,1]: mean pairwise-agreement proxy — fraction of
+    (alive node, valid fact) cells known.  1.0 = fully merged."""
+    known = unpack_bits(state.known, cfg.k_facts)
+    valid = state.facts.valid[None, :]
+    alive = state.alive[:, None]
+    cells = jnp.sum(valid & alive)
+    hit = jnp.sum(known & valid & alive)
+    return jnp.where(cells > 0, hit / jnp.maximum(cells, 1), 1.0)

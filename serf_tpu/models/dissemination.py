@@ -1,0 +1,240 @@
+"""Device-plane gossip dissemination: the cluster as arrays in HBM.
+
+This is the TPU-native re-design of serf's dissemination machinery
+(SURVEY.md §7, stage 3/4).  The mapping from the reference:
+
+- serf's broadcast queues + ring dedup buffers (serf-core/src/broadcast.rs,
+  base.rs:750-837) become a bounded **fact table**: K slots of immutable
+  facts ``(subject, kind, incarnation, ltime)``.  New facts overwrite ring
+  slots, exactly like the reference's ``buffer[ltime % len]`` dedup cells.
+- each simulated node's state is a row: a packed bitset of which facts it
+  knows (``known``: N×W uint32), per-fact remaining transmit budget
+  (``budgets``: N×K uint8 — the TransmitLimitedQueue, vectorized), and the
+  round at which each fact was learned (for suspicion timers and metrics).
+- a gossip round = sample ``fanout`` peers per node, gather their packed
+  packet words, bitwise-OR, then a masked Lamport-style merge — pure
+  elementwise math plus one gather, which is exactly what the MXU-era memory
+  system wants.  No scatter: the round uses *pull* sampling (each node
+  pulls from ``fanout`` random peers), which converges like push-gossip and
+  keeps the kernel gather-only; transmit budgets still decrement once per
+  round per selected fact, matching the reference's drain-once-per-tick
+  semantics (memberlist gossip).
+- packet-byte budgets degenerate to the fact-table bound K (a fact slot is
+  O(16B), K·16B < the reference's 1400B UDP budget for K ≤ 64).
+
+Everything here is jit-compatible with static shapes; dynamic membership is
+a liveness mask (SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# fact kinds (precedence for view resolution: higher wins at equal
+# incarnation; alive refutes suspect at *higher* incarnation only)
+K_NONE = 0
+K_JOIN = 1        # serf join intent (ltime-ordered)
+K_LEAVE = 2       # serf leave intent (ltime-ordered)
+K_ALIVE = 3       # swim alive (incarnation-ordered; refutes suspect/dead)
+K_SUSPECT = 4     # swim suspicion (starts a timer at each knower)
+K_DEAD = 5        # swim death declaration
+K_USER_EVENT = 6  # user event broadcast (subject = event id)
+
+
+class FactTable(NamedTuple):
+    """K immutable dissemination facts (the global 'what is being gossiped')."""
+
+    subject: jnp.ndarray       # i32[K] node id or event id
+    kind: jnp.ndarray          # u8[K]
+    incarnation: jnp.ndarray   # u32[K]
+    ltime: jnp.ndarray         # u32[K]
+    valid: jnp.ndarray         # bool[K]
+
+
+class GossipState(NamedTuple):
+    """The whole simulated cluster, struct-of-arrays."""
+
+    facts: FactTable
+    known: jnp.ndarray          # u32[N, W]  packed known-fact bitset
+    budgets: jnp.ndarray        # u8[N, K]   remaining transmits per fact
+    learned_round: jnp.ndarray  # i32[N, K]  round each fact was learned (-1)
+    alive: jnp.ndarray          # bool[N]    ground-truth liveness
+    incarnation: jnp.ndarray    # u32[N]     ground-truth own incarnation
+    round: jnp.ndarray          # i32 scalar
+    next_slot: jnp.ndarray      # i32 scalar ring cursor for fact injection
+
+
+@dataclasses.dataclass(frozen=True)
+class GossipConfig:
+    """Static configuration (shapes + protocol constants)."""
+
+    n: int                      # number of simulated nodes
+    k_facts: int = 64           # fact-table capacity (ring)
+    fanout: int = 3             # gossip_nodes
+    retransmit_mult: int = 4    # transmit budget = mult * ceil(log10(n+1))
+
+    @property
+    def words(self) -> int:
+        assert self.k_facts % 32 == 0, "k_facts must be a multiple of 32"
+        return self.k_facts // 32
+
+    @property
+    def transmit_limit(self) -> int:
+        import math
+        return self.retransmit_mult * max(1, math.ceil(math.log10(self.n + 1)))
+
+
+def make_state(cfg: GossipConfig) -> GossipState:
+    n, k, w = cfg.n, cfg.k_facts, cfg.words
+    facts = FactTable(
+        subject=jnp.full((k,), -1, jnp.int32),
+        kind=jnp.zeros((k,), jnp.uint8),
+        incarnation=jnp.zeros((k,), jnp.uint32),
+        ltime=jnp.zeros((k,), jnp.uint32),
+        valid=jnp.zeros((k,), bool),
+    )
+    return GossipState(
+        facts=facts,
+        known=jnp.zeros((n, w), jnp.uint32),
+        budgets=jnp.zeros((n, k), jnp.uint8),
+        learned_round=jnp.full((n, k), -1, jnp.int32),
+        alive=jnp.ones((n,), bool),
+        incarnation=jnp.ones((n,), jnp.uint32),
+        round=jnp.asarray(0, jnp.int32),
+        next_slot=jnp.asarray(0, jnp.int32),
+    )
+
+
+# -- bit packing helpers -----------------------------------------------------
+
+def _bit_weights() -> jnp.ndarray:
+    return (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+
+
+def pack_bits(mask: jnp.ndarray) -> jnp.ndarray:
+    """bool[..., K] -> u32[..., K/32]"""
+    *lead, k = mask.shape
+    m = mask.reshape(*lead, k // 32, 32).astype(jnp.uint32)
+    return jnp.sum(m * _bit_weights(), axis=-1, dtype=jnp.uint32)
+
+
+def unpack_bits(words: jnp.ndarray, k: int) -> jnp.ndarray:
+    """u32[..., W] -> bool[..., K]"""
+    bits = (words[..., :, None] >> jnp.arange(32, dtype=jnp.uint32)) & jnp.uint32(1)
+    *lead, w, _ = bits.shape
+    return bits.reshape(*lead, k).astype(bool)
+
+
+# -- fact injection ----------------------------------------------------------
+
+def inject_fact(state: GossipState, cfg: GossipConfig, subject, kind,
+                incarnation, ltime, origin) -> GossipState:
+    """Place one fact into the next ring slot; ``origin`` knows it first.
+
+    Overwriting an old slot retires that fact everywhere (the ring is the
+    same bounded-buffer semantics as the reference's dedup cells).  Traceable
+    under jit (origin/subject/... may be traced scalars).
+    """
+    slot = state.next_slot % cfg.k_facts
+    facts = FactTable(
+        subject=state.facts.subject.at[slot].set(jnp.asarray(subject, jnp.int32)),
+        kind=state.facts.kind.at[slot].set(jnp.asarray(kind, jnp.uint8)),
+        incarnation=state.facts.incarnation.at[slot].set(jnp.asarray(incarnation, jnp.uint32)),
+        ltime=state.facts.ltime.at[slot].set(jnp.asarray(ltime, jnp.uint32)),
+        valid=state.facts.valid.at[slot].set(True),
+    )
+    word, bit = slot // 32, slot % 32
+    bitmask = (jnp.uint32(1) << bit.astype(jnp.uint32)
+               if hasattr(bit, "astype") else jnp.uint32(1 << int(bit)))
+    # clear the slot's bit everywhere (fact replaced), then set at origin
+    known = state.known.at[:, word].set(state.known[:, word] & ~bitmask)
+    known = known.at[origin, word].set(known[origin, word] | bitmask)
+    budgets = state.budgets.at[:, slot].set(0)
+    budgets = budgets.at[origin, slot].set(cfg.transmit_limit)
+    learned = state.learned_round.at[:, slot].set(-1)
+    learned = learned.at[origin, slot].set(state.round)
+    return state._replace(facts=facts, known=known, budgets=budgets,
+                          learned_round=learned,
+                          next_slot=state.next_slot + 1)
+
+
+# -- the gossip round kernel -------------------------------------------------
+
+def round_step(state: GossipState, cfg: GossipConfig,
+               key: jax.Array, group=None) -> GossipState:
+    """One gossip round: select packets, pull-exchange, Lamport-merge.
+
+    Vectorized translation of the reference hot path: `get_broadcasts` drain
+    (budget decrement) + `SerfDelegate::broadcast_messages` piggybacking +
+    per-receiver `handle_*` first-sight rebroadcast decision
+    (reference delegate.rs:317-384, base.rs:783-813).
+
+    ``group`` (optional i32[N]) is the partition mask: packets only flow
+    between nodes in the same group — the device analog of the reference's
+    block-diagonal adjacency partition (SURVEY.md §7 stage 6).
+    """
+    n, k, w = cfg.n, cfg.k_facts, cfg.words
+
+    # 1. packet selection: all facts with remaining budget, from alive nodes
+    sending = (state.budgets > 0) & state.alive[:, None]
+    packets = pack_bits(sending)                              # u32[N, W]
+
+    # 2. budget decrement: one transmit per selected fact per round
+    budgets = jnp.where(sending, state.budgets - 1, state.budgets)
+
+    # 3. pull-exchange: each alive node samples `fanout` peers and ORs
+    #    their packet words
+    srcs = jax.random.randint(key, (n, cfg.fanout), 0, n)     # i32[N, F]
+    gathered = packets[srcs]                                  # u32[N, F, W]
+    if group is not None:
+        allowed = (group[srcs] == group[:, None])             # bool[N, F]
+        gathered = jnp.where(allowed[:, :, None], gathered, jnp.uint32(0))
+    incoming = jax.lax.reduce(gathered, jnp.uint32(0),
+                              jnp.bitwise_or, (1,))           # u32[N, W]
+
+    # 4. merge: learn facts we did not know; dead nodes learn nothing
+    alive_col = state.alive[:, None]
+    new_words = incoming & ~state.known & jnp.where(alive_col, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+    known = state.known | new_words
+    new_mask = unpack_bits(new_words, k)                      # bool[N, K]
+
+    # 5. fresh budgets + learn stamps for newly learned facts
+    budgets = jnp.where(new_mask, jnp.uint8(cfg.transmit_limit), budgets)
+    learned_round = jnp.where(new_mask, state.round, state.learned_round)
+
+    return state._replace(known=known, budgets=budgets,
+                          learned_round=learned_round,
+                          round=state.round + 1)
+
+
+def run_rounds(state: GossipState, cfg: GossipConfig, key: jax.Array,
+               num_rounds: int) -> GossipState:
+    """lax.scan driver: the whole simulation stays on-device."""
+
+    def body(carry, subkey):
+        return round_step(carry, cfg, subkey), ()
+
+    keys = jax.random.split(key, num_rounds)
+    final, _ = jax.lax.scan(body, state, keys)
+    return final
+
+
+# -- metrics -----------------------------------------------------------------
+
+def coverage(state: GossipState, cfg: GossipConfig) -> jnp.ndarray:
+    """Fraction of alive nodes that know each fact: f32[K]."""
+    known = unpack_bits(state.known, cfg.k_facts)             # bool[N, K]
+    alive = state.alive[:, None]
+    num = jnp.sum(known & alive, axis=0).astype(jnp.float32)
+    den = jnp.maximum(jnp.sum(state.alive), 1).astype(jnp.float32)
+    return num / den
+
+
+def fully_disseminated(state: GossipState, cfg: GossipConfig) -> jnp.ndarray:
+    """bool[K]: every alive node knows the fact (for valid facts)."""
+    cov = coverage(state, cfg)
+    return jnp.where(state.facts.valid, cov >= 1.0, True)

@@ -1,0 +1,229 @@
+"""Device-plane failure detection: probe / suspect / refute / declare-dead.
+
+Vectorizes the SWIM failure-detector semantics the reference gets from
+memberlist (SURVEY.md §2.9, §3.5): every round each alive node probes one
+random peer; a missed ack yields a *suspicion fact* injected into the shared
+fact ring (bounded per round, like the reference's broadcast queue); nodes
+that learn they are suspected refute by bumping their incarnation and
+emitting an alive fact; suspicions that age past the suspicion window
+without refutation are promoted to dead declarations.
+
+The per-edge drop mask is a first-class input (the device analog of the
+reference's test-only ``MessageDropper``, SURVEY.md §4): fault injection is
+an input tensor, not a code path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from serf_tpu.models.dissemination import (
+    FactTable,
+    GossipConfig,
+    GossipState,
+    K_ALIVE,
+    K_DEAD,
+    K_SUSPECT,
+    inject_fact,
+    round_step,
+    unpack_bits,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class FailureConfig:
+    suspicion_rounds: int = 12     # suspicion timeout in gossip rounds
+    max_new_facts: int = 8         # injection bound per category per round
+    probe_drop_rate: float = 0.0   # chance an ack is lost (fault injection)
+
+
+def _facts_about(state: GossipState, kinds, min_inc_of_subject=None):
+    """bool[K]: table slots that are valid facts of one of ``kinds``."""
+    m = jnp.zeros_like(state.facts.valid)
+    for k in kinds:
+        m = m | (state.facts.kind == k)
+    return m & state.facts.valid
+
+
+def _subject_covered(state: GossipState, cfg: GossipConfig,
+                     kinds) -> jnp.ndarray:
+    """bool[N]: subject already has a valid fact of ``kinds`` with
+    incarnation >= the subject's current ground-truth incarnation."""
+    k_mask = _facts_about(state, kinds)
+    subj = state.facts.subject
+    inc_ok = state.facts.incarnation >= state.incarnation[jnp.clip(subj, 0)]
+    active = k_mask & inc_ok
+    covered = jnp.zeros((cfg.n,), bool)
+    covered = covered.at[jnp.clip(subj, 0)].max(active)
+    return covered
+
+
+def _bounded_inject(state: GossipState, cfg: GossipConfig, candidates,
+                    kind: int, incarnations, origins, max_new: int,
+                    key: jax.Array) -> GossipState:
+    """Inject up to ``max_new`` facts for candidate subjects (bool[N]).
+
+    Random tie-break keeps the choice unbiased; static-shape top_k keeps it
+    jit-compatible.  Non-candidates inject a no-op (slot overwritten with
+    valid=False is avoided by gating on ``any``: we gate with lax.cond-free
+    masking — an invalid injection writes subject=-1, valid=False).
+    """
+    n = cfg.n
+    score = candidates.astype(jnp.float32) * (
+        1.0 + jax.random.uniform(key, (n,)))
+    vals, idx = jax.lax.top_k(score, max_new)
+    for i in range(max_new):
+        subject = idx[i]
+        is_real = vals[i] > 0.0
+        st2 = inject_fact(
+            state, cfg,
+            subject=jnp.where(is_real, subject, -1),
+            kind=jnp.where(is_real, jnp.uint8(kind), jnp.uint8(0)),
+            incarnation=incarnations[subject],
+            ltime=state.round.astype(jnp.uint32),
+            origin=origins[subject],
+        )
+        # only advance the ring if a real fact was written; otherwise keep
+        # the previous state entirely
+        state = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(is_real, new, old), st2, state)
+    return state
+
+
+def probe_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
+                key: jax.Array) -> GossipState:
+    """Probe + suspicion injection."""
+    n = cfg.n
+    k_target, k_drop, k_pick = jax.random.split(key, 3)
+    targets = jax.random.randint(k_target, (n,), 0, n)
+    dropped = jax.random.bernoulli(k_drop, fcfg.probe_drop_rate, (n,))
+    prober_ok = state.alive
+    ack = state.alive[targets] & ~dropped
+    detected = prober_ok & ~ack & (targets != jnp.arange(n))
+
+    # which subjects were detected, and by whom.  The scatter must be masked:
+    # writing a default for non-detecting probers would hand subject 0 a
+    # bogus (possibly dead) detector whose packets never flow.  scatter-max
+    # of detector+1 (0 = none) composes correctly under duplicate targets.
+    subject_detected = jnp.zeros((n,), bool).at[targets].max(detected)
+    det_writes = jnp.where(detected, jnp.arange(n, dtype=jnp.int32) + 1, 0)
+    detector_plus1 = jnp.zeros((n,), jnp.int32).at[targets].max(det_writes)
+    detector_of = jnp.maximum(detector_plus1 - 1, 0)
+
+    already = _subject_covered(state, cfg, (K_SUSPECT, K_DEAD))
+    candidates = subject_detected & ~already
+    return _bounded_inject(state, cfg, candidates, K_SUSPECT,
+                           state.incarnation, detector_of,
+                           fcfg.max_new_facts, k_pick)
+
+
+def refute_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
+                 key: jax.Array) -> GossipState:
+    """Alive nodes that know they are suspected/declared-dead bump their
+    incarnation and emit an alive fact (reference _refute semantics)."""
+    n, k = cfg.n, cfg.k_facts
+    known = unpack_bits(state.known, k)                      # bool[N, K]
+    accusation = _facts_about(state, (K_SUSPECT, K_DEAD))    # bool[K]
+    about_me = state.facts.subject[None, :] == jnp.arange(n)[:, None]
+    inc_beats_me = state.facts.incarnation[None, :] >= state.incarnation[:, None]
+    accused = jnp.any(known & accusation[None, :] & about_me & inc_beats_me,
+                      axis=1) & state.alive
+
+    new_inc = jnp.where(accused, state.incarnation + 1, state.incarnation)
+    state = state._replace(incarnation=new_inc)
+    return _bounded_inject(state, cfg, accused, K_ALIVE, new_inc,
+                           jnp.arange(n, dtype=jnp.int32),
+                           fcfg.max_new_facts, key)
+
+
+def declare_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
+                  key: jax.Array) -> GossipState:
+    """Suspicions that aged out without refutation become dead declarations."""
+    n, k = cfg.n, cfg.k_facts
+    known = unpack_bits(state.known, k)
+    suspect = _facts_about(state, (K_SUSPECT,))
+    aged = (state.round - state.learned_round) >= fcfg.suspicion_rounds
+    # a refutation is an alive fact about the same subject with strictly
+    # higher incarnation present in the table
+    refuted = jnp.zeros((k,), bool)
+    alive_facts = _facts_about(state, (K_ALIVE,))
+    same_subject = state.facts.subject[:, None] == state.facts.subject[None, :]
+    higher_inc = state.facts.incarnation[None, :] > state.facts.incarnation[:, None]
+    refuted = jnp.any(same_subject & alive_facts[None, :] & higher_inc, axis=1)
+
+    expired = known & suspect[None, :] & aged & ~refuted[None, :] \
+        & state.alive[:, None]
+    # subjects with at least one expired suspicion at some knower
+    subj = jnp.clip(state.facts.subject, 0)
+    subject_expired = jnp.zeros((n,), bool).at[subj].max(jnp.any(expired, axis=0))
+    already_dead = _subject_covered(state, cfg, (K_DEAD,))
+    candidates = subject_expired & ~already_dead
+    # declarer: lowest-id knower with the expired suspicion
+    any_expired_fact = jnp.any(expired, axis=1)              # bool[N] knowers
+    declarer = jnp.argmax(any_expired_fact).astype(jnp.int32)
+    declarers = jnp.full((n,), declarer, jnp.int32)
+    return _bounded_inject(state, cfg, candidates, K_DEAD,
+                           state.incarnation, declarers,
+                           fcfg.max_new_facts, key)
+
+
+def swim_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
+               key: jax.Array) -> GossipState:
+    """One full protocol round: gossip exchange + probe + refute + declare."""
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    state = round_step(state, cfg, k1)
+    state = probe_round(state, cfg, fcfg, k2)
+    state = refute_round(state, cfg, fcfg, k3)
+    state = declare_round(state, cfg, fcfg, k4)
+    return state
+
+
+def run_swim(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
+             key: jax.Array, num_rounds: int) -> GossipState:
+    def body(carry, subkey):
+        return swim_round(carry, cfg, fcfg, subkey), ()
+
+    keys = jax.random.split(key, num_rounds)
+    final, _ = jax.lax.scan(body, state, keys)
+    return final
+
+
+# -- views / metrics ---------------------------------------------------------
+
+def believed_dead(state: GossipState, cfg: GossipConfig,
+                  fcfg: FailureConfig) -> jnp.ndarray:
+    """bool[N, N']→ compressed: for each node i (knower) and table slot j,
+    whether i currently believes the fact's subject is dead; reduced to
+    bool[N_subjects] 'every alive node believes subject dead'."""
+    n, k = cfg.n, cfg.k_facts
+    known = unpack_bits(state.known, k)
+    dead_fact = _facts_about(state, (K_DEAD,))
+    aged_suspect = _facts_about(state, (K_SUSPECT,)) & True
+    aged = (state.round - state.learned_round) >= fcfg.suspicion_rounds
+    evidence = known & (dead_fact[None, :] | (aged_suspect[None, :] & aged))
+    # refutation: knower also knows an alive fact about the same subject with
+    # strictly higher incarnation
+    alive_fact = _facts_about(state, (K_ALIVE,))
+    same_subject = state.facts.subject[:, None] == state.facts.subject[None, :]
+    higher = state.facts.incarnation[None, :] > state.facts.incarnation[:, None]
+    refutes = same_subject & alive_fact[None, :] & higher    # [K, K]
+    knower_refutes = jnp.einsum("nk,jk->nj", known.astype(jnp.float32),
+                                refutes.astype(jnp.float32)) > 0
+    active = evidence & ~knower_refutes                      # bool[N, K]
+    subj = jnp.clip(state.facts.subject, 0)
+    alive_n = jnp.maximum(jnp.sum(state.alive), 1)
+    per_fact_believers = jnp.sum(active & state.alive[:, None], axis=0)
+    all_believe = per_fact_believers >= alive_n
+    believed = jnp.zeros((n,), bool).at[subj].max(
+        all_believe & state.facts.valid)
+    return believed
+
+
+def detection_complete(state: GossipState, cfg: GossipConfig,
+                       fcfg: FailureConfig) -> jnp.ndarray:
+    """Scalar bool: every dead node is believed dead by every alive node."""
+    believed = believed_dead(state, cfg, fcfg)
+    return jnp.all(jnp.where(~state.alive, believed, True))

@@ -1,0 +1,115 @@
+"""The flagship device-plane model: a full SWIM/serf cluster simulation.
+
+Composes the four device-plane subsystems into one jit-able round function —
+the "one model running end-to-end" of SURVEY.md §7:
+
+- dissemination (fact gossip with transmit-limited budgets)
+- failure detection (probe/suspect/refute/declare)
+- anti-entropy push/pull (periodic full sync; partition/heal aware)
+- Vivaldi coordinates (co-trained on the same peer samples)
+
+The whole cluster state is one pytree of HBM-resident arrays; a simulation
+is ``lax.scan`` over ``cluster_round``; multi-chip runs shard every N-major
+array over the device mesh (see ``serf_tpu.parallel``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from serf_tpu.models.antientropy import push_pull_round
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    GossipState,
+    make_state,
+    round_step,
+)
+from serf_tpu.models.failure import (
+    FailureConfig,
+    declare_round,
+    probe_round,
+    refute_round,
+)
+from serf_tpu.models.vivaldi import (
+    VivaldiConfig,
+    VivaldiState,
+    ground_truth_rtt,
+    make_vivaldi,
+    vivaldi_update,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterConfig:
+    gossip: GossipConfig
+    failure: FailureConfig = FailureConfig()
+    vivaldi: VivaldiConfig = VivaldiConfig()
+    push_pull_every: int = 0       # rounds between anti-entropy syncs; 0=off
+    with_failure: bool = True
+    with_vivaldi: bool = True
+
+    @property
+    def n(self) -> int:
+        return self.gossip.n
+
+
+class ClusterState(NamedTuple):
+    gossip: GossipState
+    vivaldi: VivaldiState
+    positions: jnp.ndarray   # f32[N, P] hidden latency-space ground truth
+    group: jnp.ndarray       # i32[N] partition group (all zeros = healed)
+
+
+def make_cluster(cfg: ClusterConfig, key: jax.Array) -> ClusterState:
+    n = cfg.n
+    positions = jax.random.uniform(key, (n, 3), jnp.float32) * 0.05
+    return ClusterState(
+        gossip=make_state(cfg.gossip),
+        vivaldi=make_vivaldi(n, cfg.vivaldi),
+        positions=positions,
+        group=jnp.zeros((n,), jnp.int32),
+    )
+
+
+def cluster_round(state: ClusterState, cfg: ClusterConfig,
+                  key: jax.Array) -> ClusterState:
+    """One full protocol round for every simulated node."""
+    k_gossip, k_probe, k_refute, k_declare, k_pp, k_viv, k_peer = \
+        jax.random.split(key, 7)
+    g = state.gossip
+    g = round_step(g, cfg.gossip, k_gossip, group=state.group)
+    if cfg.with_failure:
+        g = probe_round(g, cfg.gossip, cfg.failure, k_probe)
+        g = refute_round(g, cfg.gossip, cfg.failure, k_refute)
+        g = declare_round(g, cfg.gossip, cfg.failure, k_declare)
+    if cfg.push_pull_every > 0:
+        g = jax.lax.cond(
+            g.round % cfg.push_pull_every == 0,
+            lambda s: push_pull_round(s, cfg.gossip, k_pp, group=state.group),
+            lambda s: s,
+            g)
+    viv = state.vivaldi
+    if cfg.with_vivaldi:
+        n = cfg.n
+        peers = jax.random.randint(k_peer, (n,), 0, n)
+        same_group = state.group == state.group[peers]
+        reachable = g.alive & g.alive[peers] & same_group \
+            & (peers != jnp.arange(n))
+        rtt = ground_truth_rtt(state.positions, jnp.arange(n), peers)
+        viv = vivaldi_update(viv, cfg.vivaldi, peers, rtt, k_viv,
+                             active=reachable)
+    return ClusterState(g, viv, state.positions, state.group)
+
+
+def run_cluster(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
+                num_rounds: int) -> ClusterState:
+    def body(carry, subkey):
+        return cluster_round(carry, cfg, subkey), ()
+
+    keys = jax.random.split(key, num_rounds)
+    final, _ = jax.lax.scan(body, state, keys)
+    return final

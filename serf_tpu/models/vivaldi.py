@@ -1,0 +1,197 @@
+"""Device-plane Vivaldi: N×8 coordinate estimation co-trained with gossip.
+
+Vectorizes the host CoordinateClient math (serf_tpu/host/coordinate.py; the
+scalar parity oracle for reference serf-core/src/types/coordinate.rs) over
+every node at once: per round, each node takes one RTT observation against
+its gossip/probe partner and applies the error-weighted spring relaxation,
+rolling adjustment, and gravity — pure elementwise f32 math that XLA fuses
+into a handful of kernels.  Baseline config #5 (BASELINE.json): 1M-node
+latency-graph estimation.
+
+Deviation from the host plane (documented): the per-peer median latency
+filter would need O(N²) state at cluster scale, so the device plane feeds
+raw RTT samples (equivalent to ``latency_filter_size=1``); the parity test
+pins device-vs-host equality under that setting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+ZERO_THRESHOLD = 1.0e-9
+
+
+@dataclasses.dataclass(frozen=True)
+class VivaldiConfig:
+    """Defaults match the reference (coordinate.rs:52-204)."""
+
+    dimensionality: int = 8
+    error_max: float = 1.5
+    ce: float = 0.25
+    cc: float = 0.25
+    adjustment_window: int = 20
+    height_min: float = 10.0e-6
+    gravity_rho: float = 150.0
+
+
+class VivaldiState(NamedTuple):
+    vec: jnp.ndarray          # f32[N, D]
+    height: jnp.ndarray       # f32[N]
+    error: jnp.ndarray        # f32[N]
+    adjustment: jnp.ndarray   # f32[N]
+    adj_samples: jnp.ndarray  # f32[N, window] rolling rtt-dist samples
+    adj_index: jnp.ndarray    # i32 scalar ring cursor
+
+
+def make_vivaldi(n: int, cfg: VivaldiConfig) -> VivaldiState:
+    return VivaldiState(
+        vec=jnp.zeros((n, cfg.dimensionality), jnp.float32),
+        height=jnp.full((n,), cfg.height_min, jnp.float32),
+        error=jnp.full((n,), cfg.error_max, jnp.float32),
+        adjustment=jnp.zeros((n,), jnp.float32),
+        adj_samples=jnp.zeros((n, cfg.adjustment_window), jnp.float32),
+        adj_index=jnp.asarray(0, jnp.int32),
+    )
+
+
+def _raw_distance(vec_a, h_a, vec_b, h_b):
+    return jnp.linalg.norm(vec_a - vec_b, axis=-1) + h_a + h_b
+
+
+def estimated_rtt(state: VivaldiState, i, j) -> jnp.ndarray:
+    """Adjusted distance estimate between node indices (vectorized)."""
+    dist = _raw_distance(state.vec[i], state.height[i],
+                         state.vec[j], state.height[j])
+    adjusted = dist + state.adjustment[i] + state.adjustment[j]
+    return jnp.where(adjusted > 0.0, adjusted, dist)
+
+
+def _unit_vectors(diff: jnp.ndarray, key: jax.Array):
+    """Unit vectors along ``diff`` rows; random directions where coincident
+    (reference coordinate.rs apply_force)."""
+    mag = jnp.linalg.norm(diff, axis=-1)
+    rnd = jax.random.uniform(key, diff.shape) - 0.5
+    rnd_mag = jnp.maximum(jnp.linalg.norm(rnd, axis=-1), ZERO_THRESHOLD)
+    coincident = mag <= ZERO_THRESHOLD
+    unit = jnp.where(coincident[:, None], rnd / rnd_mag[:, None],
+                     diff / jnp.maximum(mag, ZERO_THRESHOLD)[:, None])
+    return unit, jnp.where(coincident, 0.0, mag)
+
+
+def vivaldi_update(state: VivaldiState, cfg: VivaldiConfig,
+                   peer: jnp.ndarray, rtt: jnp.ndarray,
+                   key: jax.Array, active=None) -> VivaldiState:
+    """One observation per node: node i measured ``rtt[i]`` against
+    ``peer[i]``.  Nodes with ``active[i]=False`` keep their state.
+
+    Faithful vectorization of CoordinateClient.update (host plane), which is
+    itself the reference's update path (coordinate.rs:727-762 + gravity
+    699-705): vivaldi force -> adjustment window -> gravity.
+    """
+    n = state.vec.shape[0]
+    if active is None:
+        active = jnp.ones((n,), bool)
+    k_force, k_grav = jax.random.split(key)
+    rtt = jnp.maximum(rtt, ZERO_THRESHOLD)
+
+    p_vec = state.vec[peer]
+    p_h = state.height[peer]
+    p_err = state.error[peer]
+
+    # -- vivaldi spring relaxation (adjustment-inclusive distance, matching
+    # the host oracle / reference distance_to semantics)
+    raw = _raw_distance(state.vec, state.height, p_vec, p_h)
+    adjusted = raw + state.adjustment + state.adjustment[peer]
+    dist = jnp.where(adjusted > 0.0, adjusted, raw)
+    wrongness = jnp.abs(dist - rtt) / rtt
+    total_err = jnp.maximum(state.error + p_err, ZERO_THRESHOLD)
+    weight = state.error / total_err
+    error = jnp.minimum(
+        state.error * (1.0 - cfg.ce * weight) + wrongness * cfg.ce * weight,
+        cfg.error_max)
+    force = cfg.cc * weight * (rtt - dist)
+    unit, mag = _unit_vectors(state.vec - p_vec, k_force)
+    vec = state.vec + unit * force[:, None]
+    height = jnp.where(
+        mag > 0.0,
+        jnp.maximum(cfg.height_min,
+                    (state.height + p_h) * force / jnp.maximum(mag, ZERO_THRESHOLD)
+                    + state.height),
+        state.height)
+
+    # -- adjustment term (recomputed against the post-force position)
+    dist2 = _raw_distance(vec, height, p_vec, p_h)
+    sample = rtt - dist2
+    idx = state.adj_index % cfg.adjustment_window
+    adj_samples = jnp.where(
+        active[:, None],
+        state.adj_samples.at[:, idx].set(sample),
+        state.adj_samples)
+    adjustment = jnp.sum(adj_samples, axis=1) / (2.0 * cfg.adjustment_window)
+
+    # -- gravity toward the origin (adjustment-inclusive from the origin's
+    # viewpoint: origin adjustment is 0, ours applies)
+    origin_raw = jnp.linalg.norm(vec, axis=-1) + height + cfg.height_min
+    origin_adj = origin_raw + adjustment
+    origin_dist = jnp.where(origin_adj > 0.0, origin_adj, origin_raw)
+    g_force = -1.0 * (origin_dist / cfg.gravity_rho) ** 2
+    g_unit, g_mag = _unit_vectors(vec, k_grav)
+    g_vec = vec + g_unit * g_force[:, None]
+    g_height = jnp.where(
+        g_mag > 0.0,
+        jnp.maximum(cfg.height_min,
+                    (height + cfg.height_min) * g_force
+                    / jnp.maximum(g_mag, ZERO_THRESHOLD) + height),
+        height)
+
+    # -- NaN/Inf safety: reset invalid rows (reference validity check)
+    cand = VivaldiState(g_vec, g_height, error, adjustment, adj_samples,
+                        (state.adj_index + 1) % cfg.adjustment_window)
+    bad = ~(jnp.all(jnp.isfinite(cand.vec), axis=-1)
+            & jnp.isfinite(cand.height) & jnp.isfinite(cand.error)
+            & jnp.isfinite(cand.adjustment))
+    fresh = make_vivaldi(n, cfg)
+    act = active & ~bad
+
+    def pick(new, old, fresh_arr):
+        if new.ndim == 0:
+            return new
+        mask = act if new.ndim == 1 else act[:, None]
+        bmask = bad if new.ndim == 1 else bad[:, None]
+        out = jnp.where(mask, new, old)
+        return jnp.where(bmask & (active if new.ndim == 1 else active[:, None]),
+                         fresh_arr, out)
+
+    return VivaldiState(
+        vec=pick(cand.vec, state.vec, fresh.vec),
+        height=pick(cand.height, state.height, fresh.height),
+        error=pick(cand.error, state.error, fresh.error),
+        adjustment=pick(cand.adjustment, state.adjustment, fresh.adjustment),
+        adj_samples=pick(cand.adj_samples, state.adj_samples, fresh.adj_samples),
+        adj_index=cand.adj_index,
+    )
+
+
+def ground_truth_rtt(positions: jnp.ndarray, i, j,
+                     base: float = 0.005) -> jnp.ndarray:
+    """Synthetic latency graph: euclidean distance over hidden positions
+    plus a base propagation delay (the '1M-node latency graph' of baseline
+    config #5)."""
+    return base + jnp.linalg.norm(positions[i] - positions[j], axis=-1)
+
+
+def mean_relative_error(state: VivaldiState, cfg: VivaldiConfig,
+                        positions: jnp.ndarray, key: jax.Array,
+                        samples: int = 4096) -> jnp.ndarray:
+    """Estimation quality: mean |est-true|/true over random pairs."""
+    n = state.vec.shape[0]
+    k1, k2 = jax.random.split(key)
+    i = jax.random.randint(k1, (samples,), 0, n)
+    j = jax.random.randint(k2, (samples,), 0, n)
+    est = estimated_rtt(state, i, j)
+    true = ground_truth_rtt(positions, i, j)
+    return jnp.mean(jnp.abs(est - true) / jnp.maximum(true, 1e-9))

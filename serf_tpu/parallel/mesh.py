@@ -1,0 +1,62 @@
+"""Device-mesh sharding for the cluster simulation.
+
+The scaling story (SURVEY.md §5 "long-context" translation): the member
+table and every per-node array shard across chips on the node dimension —
+the gossip analog of data/sequence parallelism.  Cross-shard gossip edges
+are handled by XLA-inserted collectives: ``packets[srcs]`` with a sharded
+``packets`` and replicated index space becomes an all-gather of the packed
+packet words (N×W uint32 is small: 32 MB at 1M nodes), which rides ICI.
+
+We annotate shardings with ``NamedSharding``/``PartitionSpec`` and let
+GSPMD place the collectives — the pick-a-mesh / annotate / let-XLA-insert
+recipe — rather than hand-scheduling shard_map loops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from serf_tpu.models.swim import ClusterState
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    import numpy as np
+    return Mesh(np.array(devices), (NODE_AXIS,))
+
+
+def _spec_for(path: str, arr) -> P:
+    """Per-node arrays shard on their first (N) axis; facts and scalars are
+    replicated."""
+    if arr.ndim == 0:
+        return P()
+    # fact-table arrays are K-major and replicated; everything under
+    # 'gossip.facts' or with a non-N leading dim stays replicated
+    if "facts" in path:
+        return P()
+    if "adj_index" in path:
+        return P()
+    return P(NODE_AXIS)
+
+
+def state_shardings(state: ClusterState, mesh: Mesh):
+    """A pytree of NamedShardings matching ``state``."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+    specs = []
+    for path, leaf in flat:
+        pstr = jax.tree_util.keystr(path)
+        specs.append(NamedSharding(mesh, _spec_for(pstr, leaf)))
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def shard_state(state: ClusterState, mesh: Mesh) -> ClusterState:
+    return jax.device_put(state, state_shardings(state, mesh))

@@ -9,11 +9,19 @@ parameterized by backend.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override: the environment's site hook registers the axon (real TPU
+# tunnel) PJRT plugin at interpreter start; tests must run on the virtual
+# 8-device CPU mesh.  Env alone is not enough — the config update after
+# import is what reliably wins over the plugin registration.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402

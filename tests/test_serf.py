@@ -160,14 +160,21 @@ async def test_user_event_dedup_no_redelivery():
 async def test_user_event_size_limit():
     net = LoopbackNetwork()
     s = await Serf.create(net.bind("a"), Options.local(), "solo")
+    big = await Serf.create(net.bind("b"),
+                            Options.local(max_user_event_size=9 * 1024), "big")
     try:
+        # configured limit (default 512)
         with pytest.raises(ValueError):
             await s.user_event("big", b"x" * 600)
-        big_opts = Options.local(max_user_event_size=9 * 1024)
+        # raw size within the 9 KiB hard cap but ENCODED size above it
         with pytest.raises(ValueError):
-            await Serf(net.bind("b"), Options(max_user_event_size=10 * 1024), "b").user_event("x", b"")
+            await big.user_event("abc", b"x" * (9 * 1024 - 6))
+        # options exceeding the hard cap are rejected up front
+        with pytest.raises(ValueError):
+            Options(max_user_event_size=10 * 1024).validate()
     finally:
         await s.shutdown()
+        await big.shutdown()
 
 
 async def test_query_responses_and_acks():
