@@ -1,0 +1,125 @@
+"""toyregistry: an eventually-consistent service registry over serf-tpu.
+
+Capability parity with the reference's ``examples/toyconsul`` (584 LoC of
+Rust; SURVEY.md §2.10): each agent runs a Serf node; ``register`` publishes
+a service as a user event, every agent folds events into a local registry,
+and ``list`` answers from local state — eventually consistent by gossip.
+Queries give a consistent-read path (scatter ``list`` to all agents).
+
+Run a demo cluster in-process:
+
+    python examples/toyregistry.py demo
+
+or drive agents programmatically (see ``ToyRegistry``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+from typing import Dict, Optional
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root when run directly
+
+from serf_tpu.host import (  # noqa: E402
+    EventSubscriber,
+    LoopbackNetwork,
+    QueryEvent,
+    QueryParam,
+    Serf,
+    UserEvent,
+)
+from serf_tpu.options import Options  # noqa: E402
+
+
+class ToyRegistry:
+    """One agent: a Serf node + a registry folded from user events."""
+
+    def __init__(self, serf: Serf, subscriber: EventSubscriber):
+        self.serf = serf
+        self.registry: Dict[str, str] = {}
+        self._sub = subscriber
+        self._task: Optional[asyncio.Task] = None
+
+    @classmethod
+    async def start(cls, transport, opts: Options, node_id: str) -> "ToyRegistry":
+        sub = EventSubscriber()
+        serf = await Serf.create(transport, opts, node_id, subscriber=sub)
+        agent = cls(serf, sub)
+        agent._task = asyncio.create_task(agent._run(), name=f"toyreg-{node_id}")
+        return agent
+
+    async def _run(self) -> None:
+        async for ev in self._sub:
+            try:
+                if isinstance(ev, UserEvent) and ev.name == "register":
+                    entry = json.loads(ev.payload.decode())
+                    self.registry[entry["name"]] = entry["addr"]
+                elif isinstance(ev, UserEvent) and ev.name == "deregister":
+                    self.registry.pop(ev.payload.decode(), None)
+                elif isinstance(ev, QueryEvent) and ev.name == "list":
+                    try:
+                        await ev.respond(json.dumps(self.registry).encode())
+                    except (TimeoutError, ValueError):
+                        pass
+            except (json.JSONDecodeError, KeyError, UnicodeDecodeError) as e:
+                # a malformed event from a peer must not kill the fold loop
+                print(f"{self.serf.local_id}: ignoring malformed event "
+                      f"{getattr(ev, 'name', '?')!r}: {e}", file=sys.stderr)
+
+    # -- the three verbs of the reference example --------------------------
+
+    async def register(self, name: str, addr: str) -> None:
+        payload = json.dumps({"name": name, "addr": addr}).encode()
+        await self.serf.user_event("register", payload, coalesce=False)
+
+    async def deregister(self, name: str) -> None:
+        await self.serf.user_event("deregister", name.encode(), coalesce=False)
+
+    def list_local(self) -> Dict[str, str]:
+        return dict(self.registry)
+
+    async def list_consistent(self, timeout: float = 2.0) -> Dict[str, str]:
+        """Scatter a list query; merge every agent's view."""
+        resp = await self.serf.query("list", b"", QueryParam(timeout=timeout))
+        merged: Dict[str, str] = dict(self.registry)
+        async for r in resp.responses():
+            merged.update(json.loads(r.payload.decode()))
+        return merged
+
+    async def shutdown(self) -> None:
+        if self._task:
+            self._task.cancel()
+        await self.serf.shutdown()
+
+
+async def demo() -> None:
+    net = LoopbackNetwork()
+    agents = []
+    for i in range(5):
+        a = await ToyRegistry.start(net.bind(f"agent-{i}"), Options.local(),
+                                    f"agent-{i}")
+        agents.append(a)
+    for a in agents[1:]:
+        await a.serf.join("agent-0")
+    print("5-agent cluster up")
+
+    await agents[0].register("api", "10.0.0.1:8080")
+    await agents[2].register("db", "10.0.0.2:5432")
+    await asyncio.sleep(0.3)
+    for a in agents:
+        print(f"{a.serf.local_id}: {a.list_local()}")
+    print("consistent view:", await agents[4].list_consistent())
+    await agents[1].deregister("db")
+    await asyncio.sleep(0.3)
+    print("after deregister:", agents[3].list_local())
+    for a in agents:
+        await a.shutdown()
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "demo":
+        asyncio.run(demo())
+    else:
+        print(__doc__)

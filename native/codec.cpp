@@ -1,0 +1,134 @@
+// Native wire-codec hot path for serf-tpu.
+//
+// The host plane's inner decode loop (protobuf-style tag|wiretype field
+// scanning with LEB128 varints) is the per-packet cost on every gossip
+// message; this scanner does one pass in C++ and hands Python a packed
+// field table.  Capability parity target: the reference's zero-copy
+// `*Ref<'a>` decode views (serf-core/src/types/, SURVEY.md §2.4) — same
+// fail-closed semantics as the Python implementation in
+// serf_tpu/codec/__init__.py, which remains the semantic oracle.
+//
+// Build: g++ -O2 -shared -fPIC -o libserfcodec.so codec.cpp
+// ABI: plain C, consumed via ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstddef>
+
+namespace {
+
+constexpr uint64_t U64_MAX = ~0ULL;
+
+// Decode one LEB128 varint.  Returns bytes consumed, 0 on truncation/overflow.
+inline long varint(const unsigned char* buf, long len, uint64_t* value) {
+    uint64_t result = 0;
+    int shift = 0;
+    for (long i = 0; i < len; ++i) {
+        if (shift > 63) return 0;  // >64-bit varint
+        uint64_t b = buf[i];
+        uint64_t chunk = (b & 0x7F);
+        // overflow check: chunk must fit in the remaining bits
+        if (shift == 63 && chunk > 1) return 0;
+        result |= chunk << shift;
+        if (!(b & 0x80)) {
+            *value = result;
+            return i + 1;
+        }
+        shift += 7;
+    }
+    return 0;  // truncated
+}
+
+}  // namespace
+
+extern "C" {
+
+// Scan a message body into a packed field table.
+//
+// Each scanned field writes 4 entries into `out`:
+//   [field_no, wire_type, value_or_offset, length]
+// - WT_VARINT (0):          value_or_offset = the value, length = 0
+// - WT_FIXED64 (1):         value_or_offset = byte offset, length = 8
+// - WT_LENGTH_DELIMITED(2): value_or_offset = payload offset, length = n
+// - WT_FIXED32 (5):         value_or_offset = byte offset, length = 4
+//
+// Returns the number of fields scanned, or -1 on malformed input
+// (truncation, overlong varint, unknown wire type, field table overflow).
+long serf_scan_fields(const unsigned char* buf, long len,
+                      uint64_t* out, long max_fields) {
+    long pos = 0;
+    long count = 0;
+    while (pos < len) {
+        uint64_t key;
+        long used = varint(buf + pos, len - pos, &key);
+        if (used == 0) return -1;
+        pos += used;
+        uint64_t field = key >> 3;
+        uint64_t wt = key & 0x7;
+        if (count >= max_fields) return -1;
+        uint64_t* slot = out + count * 4;
+        slot[0] = field;
+        slot[1] = wt;
+        switch (wt) {
+            case 0: {  // varint
+                uint64_t v;
+                used = varint(buf + pos, len - pos, &v);
+                if (used == 0) return -1;
+                pos += used;
+                slot[2] = v;
+                slot[3] = (uint64_t)pos;  // post-field offset (for new_pos)
+                break;
+            }
+            case 1: {  // fixed64
+                if (pos + 8 > len) return -1;
+                slot[2] = (uint64_t)pos;
+                slot[3] = 8;
+                pos += 8;
+                break;
+            }
+            case 2: {  // length-delimited
+                uint64_t n;
+                used = varint(buf + pos, len - pos, &n);
+                if (used == 0) return -1;
+                pos += used;
+                if (n > (uint64_t)(len - pos)) return -1;
+                slot[2] = (uint64_t)pos;
+                slot[3] = n;
+                pos += (long)n;
+                break;
+            }
+            case 5: {  // fixed32
+                if (pos + 4 > len) return -1;
+                slot[2] = (uint64_t)pos;
+                slot[3] = 4;
+                pos += 4;
+                break;
+            }
+            default:
+                return -1;
+        }
+        ++count;
+    }
+    return count;
+}
+
+// Encode a varint into out (must have >= 10 bytes); returns length written.
+long serf_varint_encode(uint64_t value, unsigned char* out) {
+    long i = 0;
+    while (true) {
+        unsigned char b = value & 0x7F;
+        value >>= 7;
+        if (value) {
+            out[i++] = b | 0x80;
+        } else {
+            out[i++] = b;
+            return i;
+        }
+    }
+}
+
+// Decode a varint; returns bytes consumed or 0 on error.
+long serf_varint_decode(const unsigned char* buf, long len, uint64_t* value) {
+    return varint(buf, len, value);
+}
+
+}  // extern "C"

@@ -27,6 +27,12 @@ class DecodeError(Exception):
     """Raised on malformed wire data (truncation, bad tag, overlong varint)."""
 
 
+def _native_scan(buf: bytes, pos: int, end: int):
+    """Lazy import to avoid a cycle; returns None when native is absent."""
+    from serf_tpu.codec import _native
+    return _native.scan_fields(buf, pos, end)
+
+
 def encode_varint(value: int) -> bytes:
     """LEB128 unsigned varint."""
     if value < 0:
@@ -114,9 +120,21 @@ def iter_fields(buf: bytes, pos: int = 0, end: int | None = None) -> Iterator[Tu
     - WT_FIXED64         -> 8 raw bytes (caller interprets as u64 or f64)
     - WT_LENGTH_DELIMITED-> bytes view
     - WT_FIXED32         -> 4 raw bytes
+
+    Uses the native C++ scanner (native/codec.cpp) when built; the Python
+    loop below is the semantic oracle and the fallback.
     """
     if end is None:
         end = len(buf)
+    elif end < len(buf):
+        # bound the scan: a varint must not be read past `end`
+        buf = buf[:end]
+    scanned = _native_scan(buf, pos, end)
+    if scanned is not None:
+        if scanned == -1:
+            raise DecodeError("malformed message body (native scanner)")
+        yield from scanned
+        return
     while pos < end:
         key, pos = decode_varint(buf, pos)
         field, wt = split_tag(key)

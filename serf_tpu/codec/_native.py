@@ -1,0 +1,128 @@
+"""ctypes loader for the native codec scanner (native/codec.cpp).
+
+Builds the shared library on first use with g++ (the image has the native
+toolchain but no pybind11; plain C ABI + ctypes keeps the binding thin).
+Set ``SERF_TPU_NO_NATIVE=1`` to force the pure-Python path.  The Python
+implementation in ``serf_tpu.codec`` is always the semantic oracle; parity
+is pinned by tests/test_native_codec.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import sys
+import threading
+from typing import Iterator, Optional, Tuple
+
+log = logging.getLogger("serf_tpu.codec.native")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "codec.cpp")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_SO = os.path.join(_BUILD_DIR, "libserfcodec.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", _SO + ".tmp", _SRC],
+            check=True, capture_output=True, timeout=120)
+        os.replace(_SO + ".tmp", _SO)
+        return True
+    except (subprocess.SubprocessError, OSError) as e:
+        log.debug("native codec build failed: %s", e)
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The shared library, building it if needed; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None:
+        return _lib
+    if os.environ.get("SERF_TPU_NO_NATIVE") == "1":
+        return None
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
+            if not os.path.exists(_SRC) or not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:
+            log.debug("native codec load failed: %s", e)
+            return None
+        lib.serf_scan_fields.restype = ctypes.c_long
+        lib.serf_scan_fields.argtypes = [
+            ctypes.c_char_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_long]
+        lib.serf_varint_encode.restype = ctypes.c_long
+        lib.serf_varint_encode.argtypes = [
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_ubyte)]
+        lib.serf_varint_decode.restype = ctypes.c_long
+        lib.serf_varint_decode.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.POINTER(ctypes.c_uint64)]
+        _lib = lib
+        return _lib
+
+
+_tls = threading.local()
+
+
+def _scratch(n_fields: int):
+    """Reusable per-thread output buffer (ctypes allocation dominates the
+    cost of scanning small packets otherwise)."""
+    buf = getattr(_tls, "buf", None)
+    if buf is None or len(buf) < n_fields * 4:
+        cap = max(n_fields * 4, 1024)
+        buf = (ctypes.c_uint64 * cap)()
+        _tls.buf = buf
+    return buf
+
+
+def scan_fields(buf: bytes, pos: int, end: int):
+    """Native one-pass field scan of ``buf[pos:end]``.
+
+    Returns a list of (field, wire_type, value, new_pos) tuples with the
+    same semantics as the pure-Python ``iter_fields``, or None if the
+    native library is unavailable.  Raises nothing itself — malformed input
+    returns the sentinel -1 count which the caller converts to DecodeError.
+    """
+    lib = load()
+    if lib is None:
+        return None
+    if not isinstance(buf, bytes):
+        buf = bytes(buf)  # ctypes c_char_p needs immutable bytes
+    body = buf if (pos == 0 and end == len(buf)) else buf[pos:end]
+    n = end - pos
+    max_fields = n // 2 + 1
+    out = _scratch(max_fields)
+    count = lib.serf_scan_fields(body, n, out, max_fields)
+    if count < 0:
+        return -1
+    result = []
+    for i in range(count):
+        base = i * 4
+        field = out[base]
+        wt = out[base + 1]
+        voff = out[base + 2]
+        length = out[base + 3]
+        if wt == 0:
+            value = int(voff)
+            new_pos = pos + int(length)  # C stores the post-field offset here
+        else:
+            value = body[voff : voff + length]
+            new_pos = pos + int(voff) + int(length)
+        result.append((int(field), int(wt), value, new_pos))
+    return result

@@ -1,0 +1,66 @@
+"""Device-plane checkpoint/resume: snapshot the whole simulated cluster.
+
+The device analog of the host snapshotter (SURVEY.md §7 stage 9): the
+``ClusterState``/``GossipState`` pytree is written as a flat ``.npz``
+(atomic-rename on save) and restored bit-exactly — resume continues from the
+same round with the same RNG discipline (keys are caller supplied, so a
+resumed run with the same keys is identical to an unbroken one; pinned by
+tests).  Restore fails closed (``ValueError``) on corrupt files and on any
+shape or dtype mismatch against the template.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _flatten(state) -> dict:
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    return {jax.tree_util.keystr(path): np.asarray(leaf) for path, leaf in flat}
+
+
+def save(path: str, state: Any) -> None:
+    """Write the state pytree; atomic replace so a crash never leaves a
+    half-written checkpoint (same guarantee as the host snapshot compactor)."""
+    arrays = _flatten(state)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, path)
+
+
+def restore(path: str, template: Any) -> Any:
+    """Load into the shape of ``template`` (the make_* result for the same
+    config); raises FileNotFoundError/ValueError on missing or mismatched
+    checkpoints."""
+    import zipfile
+
+    try:
+        with np.load(path) as data:
+            flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+            leaves = []
+            for path_k, leaf in flat:
+                key = jax.tree_util.keystr(path_k)
+                if key not in data:
+                    raise ValueError(f"checkpoint missing array {key!r}")
+                arr = data[key]
+                if arr.shape != leaf.shape:
+                    raise ValueError(
+                        f"checkpoint array {key!r} has shape {arr.shape}, "
+                        f"state expects {leaf.shape}")
+                if arr.dtype != np.asarray(leaf).dtype:
+                    raise ValueError(
+                        f"checkpoint array {key!r} has dtype {arr.dtype}, "
+                        f"state expects {np.asarray(leaf).dtype}")
+                leaves.append(jnp.asarray(arr, dtype=leaf.dtype))
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+    except FileNotFoundError:
+        raise
+    except (zipfile.BadZipFile, KeyError, OSError) as e:
+        # any zip/npy-level malformation fails closed as ValueError
+        raise ValueError(f"corrupt checkpoint {path!r}: {e}") from e

@@ -1,0 +1,96 @@
+"""Device-plane serf membership: Lamport-ordered join/leave intent views.
+
+The serf layer on top of SWIM: member status is decided by the
+highest-Lamport-time intent each node knows (reference handlers
+``handle_node_join_intent`` / ``handle_node_leave_intent``,
+serf-core/src/serf/base.rs:1338-1572).  On the device plane intents are
+facts (kind K_JOIN / K_LEAVE with an ltime); a node's view of a subject is a
+pure function of the facts it knows — the batched merge semilattice of
+SURVEY.md §7 ("hard parts"): max-ltime wins, strictly-greater to supersede,
+so round-batched application reaches the same fixpoint as the reference's
+serialized application for any intent set with distinct ltimes.  (At equal
+ltimes the reference is arrival-order dependent; the device rule breaks ties
+toward LEAVE, the conservative choice.)
+
+Status lattice (mirrors ``serf_tpu.types.member.MemberStatus``):
+NONE(0) / ALIVE(1) / LEAVING(2).  FAILED/LEFT come from composing with the
+SWIM plane (``serf_tpu.models.failure``): a swim-dead subject whose freshest
+intent is LEAVE resolves LEFT, otherwise FAILED — the same
+Leaving->Left / Alive->Failed split as reference base.rs:1375-1440.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    GossipState,
+    K_JOIN,
+    K_LEAVE,
+    unpack_bits,
+)
+
+# resolved view statuses
+V_NONE = 0
+V_ALIVE = 1
+V_LEAVING = 2
+V_LEFT = 3
+V_FAILED = 4
+
+
+def intent_views(state: GossipState, cfg: GossipConfig,
+                 subjects: jnp.ndarray) -> jnp.ndarray:
+    """u8[N, S]: each node's serf-status view of each subject in
+    ``subjects`` (i32[S]), from the join/leave intent facts it knows.
+
+    Per (knower, subject): the known intent with the highest ltime wins;
+    ties prefer LEAVE.  No known intent -> NONE.
+    """
+    n, k = cfg.n, cfg.k_facts
+    known = unpack_bits(state.known, k)                       # bool[N, K]
+    facts = state.facts
+    is_join = (facts.kind == K_JOIN) & facts.valid
+    is_leave = (facts.kind == K_LEAVE) & facts.valid
+    # [S, K] fact-about-subject masks
+    about = facts.subject[None, :] == subjects[:, None]
+    ltime = facts.ltime.astype(jnp.uint32)
+
+    def per_knower(known_row):
+        # known_row: bool[K]
+        jmask = known_row[None, :] & about & is_join[None, :]     # [S, K]
+        lmask = known_row[None, :] & about & is_leave[None, :]
+        jbest = jnp.max(jnp.where(jmask, ltime[None, :], 0), axis=1)
+        lbest = jnp.max(jnp.where(lmask, ltime[None, :], 0), axis=1)
+        status = jnp.where(
+            (jbest == 0) & (lbest == 0), V_NONE,
+            jnp.where(jbest > lbest, V_ALIVE, V_LEAVING))
+        return status.astype(jnp.uint8)
+
+    return jax.vmap(per_knower)(known)                        # u8[N, S]
+
+
+def composed_views(state: GossipState, cfg: GossipConfig,
+                   subjects: jnp.ndarray,
+                   swim_dead: jnp.ndarray) -> jnp.ndarray:
+    """Compose intent views with the SWIM plane: ``swim_dead`` (bool[N, S] —
+    knower i believes subject j dead) refines ALIVE->FAILED and
+    LEAVING->LEFT (reference base.rs:1375-1440)."""
+    views = intent_views(state, cfg, subjects)
+    return jnp.where(
+        swim_dead,
+        jnp.where(views == V_LEAVING, jnp.uint8(V_LEFT), jnp.uint8(V_FAILED)),
+        views)
+
+
+def converged(state: GossipState, cfg: GossipConfig,
+              subjects: jnp.ndarray) -> jnp.ndarray:
+    """bool: all alive knowers agree on every subject's view."""
+    views = intent_views(state, cfg, subjects)
+    alive = state.alive
+    # compare every row to the first alive row
+    first = jnp.argmax(alive)
+    ref = views[first]
+    agree = jnp.all(views == ref[None, :], axis=1) | ~alive
+    return jnp.all(agree)

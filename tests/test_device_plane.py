@@ -306,3 +306,55 @@ def test_failure_detection_when_node_zero_dies():
             break
     else:
         raise AssertionError("death of node 0 never fully detected")
+
+
+def test_checkpoint_resume_bit_exact():
+    """Device checkpoint/resume: a resumed run with the same keys must be
+    bit-identical to an unbroken run (SURVEY.md §7 stage 9)."""
+    import tempfile, os
+    from serf_tpu.models import checkpoint
+
+    cfg = ClusterConfig(gossip=GossipConfig(n=256, k_facts=32),
+                        push_pull_every=8)
+    state = make_cluster(cfg, jax.random.key(0))
+    state = state._replace(
+        gossip=inject_fact(state.gossip, cfg.gossip, 1, K_USER_EVENT, 0, 1, 0))
+    step = jax.jit(functools.partial(cluster_round, cfg=cfg))
+    keys = jax.random.split(jax.random.key(9), 20)
+
+    # unbroken run
+    a = state
+    for k in keys:
+        a = step(a, key=k)
+
+    # run 10, checkpoint, restore, run 10 more
+    b = state
+    for k in keys[:10]:
+        b = step(b, key=k)
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "ck.npz")
+        checkpoint.save(p, b)
+        template = make_cluster(cfg, jax.random.key(0))
+        template = template._replace(
+            gossip=inject_fact(template.gossip, cfg.gossip, 1, K_USER_EVENT, 0, 1, 0))
+        b = checkpoint.restore(p, template)
+    for k in keys[10:]:
+        b = step(b, key=k)
+
+    for la, lb in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        assert bool(jnp.all(la == lb))
+
+
+def test_checkpoint_shape_mismatch_rejected():
+    import tempfile, os
+    from serf_tpu.models import checkpoint
+
+    cfg_a = ClusterConfig(gossip=GossipConfig(n=128, k_facts=32))
+    cfg_b = ClusterConfig(gossip=GossipConfig(n=256, k_facts=32))
+    sa = make_cluster(cfg_a, jax.random.key(0))
+    sb = make_cluster(cfg_b, jax.random.key(0))
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "ck.npz")
+        checkpoint.save(p, sa)
+        with pytest.raises(ValueError):
+            checkpoint.restore(p, sb)

@@ -1,0 +1,48 @@
+"""The toyregistry example (reference examples/toyconsul parity) must work
+as documented."""
+
+import asyncio
+import sys
+
+import pytest
+
+sys.path.insert(0, "/root/repo/examples")
+
+pytestmark = pytest.mark.asyncio
+
+
+async def test_toyregistry_end_to_end():
+    from toyregistry import ToyRegistry
+    from serf_tpu.host import LoopbackNetwork
+    from serf_tpu.options import Options
+
+    net = LoopbackNetwork()
+    agents = []
+    for i in range(4):
+        a = await ToyRegistry.start(net.bind(f"agent-{i}"), Options.local(),
+                                    f"agent-{i}")
+        agents.append(a)
+    try:
+        for a in agents[1:]:
+            await a.serf.join("agent-0")
+        await agents[0].register("api", "10.0.0.1:8080")
+        await agents[2].register("db", "10.0.0.2:5432")
+        deadline = asyncio.get_running_loop().time() + 7.0
+        want = {"api": "10.0.0.1:8080", "db": "10.0.0.2:5432"}
+        while asyncio.get_running_loop().time() < deadline:
+            if all(a.list_local() == want for a in agents):
+                break
+            await asyncio.sleep(0.01)
+        assert all(a.list_local() == want for a in agents)
+        merged = await agents[3].list_consistent(timeout=1.0)
+        assert merged == want
+        await agents[1].deregister("db")
+        deadline = asyncio.get_running_loop().time() + 7.0
+        while asyncio.get_running_loop().time() < deadline:
+            if all("db" not in a.list_local() for a in agents):
+                break
+            await asyncio.sleep(0.01)
+        assert all(a.list_local() == {"api": "10.0.0.1:8080"} for a in agents)
+    finally:
+        for a in agents:
+            await a.shutdown()

@@ -1,0 +1,164 @@
+"""Native C++ codec scanner: build, parity with the Python oracle, fuzz.
+
+The analog of the reference's per-type round-trip + fuzz strategy applied
+across the two implementations: for any input, the native scanner and the
+pure-Python loop must produce identical field tables or identical failures.
+"""
+
+import random
+
+import pytest
+
+from serf_tpu import codec
+from serf_tpu.codec import _native
+
+
+def _python_iter(buf):
+    """The pure-Python field loop, bypassing the native dispatch."""
+    out = []
+    pos, end = 0, len(buf)
+    while pos < end:
+        key, pos = codec.decode_varint(buf, pos)
+        field, wt = codec.split_tag(key)
+        if wt == codec.WT_VARINT:
+            value, pos = codec.decode_varint(buf, pos)
+        elif wt == codec.WT_FIXED64:
+            if pos + 8 > end:
+                raise codec.DecodeError("truncated fixed64")
+            value = buf[pos:pos + 8]
+            pos += 8
+        elif wt == codec.WT_LENGTH_DELIMITED:
+            ln, pos = codec.decode_varint(buf, pos)
+            if pos + ln > end:
+                raise codec.DecodeError("truncated length-delimited field")
+            value = buf[pos:pos + ln]
+            pos += ln
+        elif wt == codec.WT_FIXED32:
+            if pos + 4 > end:
+                raise codec.DecodeError("truncated fixed32")
+            value = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise codec.DecodeError(f"unknown wire type {wt}")
+        out.append((field, wt, value))
+    return out
+
+
+needs_native = pytest.mark.skipif(_native.load() is None,
+                                  reason="native codec unavailable (no g++?)")
+
+
+@needs_native
+def test_native_builds_and_loads():
+    assert _native.load() is not None
+
+
+@needs_native
+def test_native_varint_parity():
+    lib = _native.load()
+    import ctypes
+    for v in [0, 1, 127, 128, 300, 2**32 - 1, 2**63 - 1, 2**64 - 1]:
+        out = (ctypes.c_ubyte * 10)()
+        n = lib.serf_varint_encode(v, out)
+        assert bytes(out[:n]) == codec.encode_varint(v)
+        val = ctypes.c_uint64()
+        used = lib.serf_varint_decode(bytes(out[:n]), n, ctypes.byref(val))
+        assert used == n and val.value == v
+
+
+@needs_native
+def test_native_scan_parity_on_valid_messages():
+    from serf_tpu.types.messages import QueryMessage, QueryFlag, encode_message
+    from serf_tpu.types.member import Node
+
+    rng = random.Random(1)
+    for _ in range(200):
+        msg = QueryMessage(
+            ltime=rng.getrandbits(48), id=rng.getrandbits(32),
+            from_node=Node(f"n{rng.randrange(100)}", ("h", rng.randrange(1, 65536))),
+            flags=QueryFlag(rng.randint(0, 3)), relay_factor=rng.randint(0, 9),
+            timeout_ns=rng.getrandbits(40), name="q" * rng.randint(1, 9),
+            payload=bytes(rng.randrange(256) for _ in range(rng.randint(0, 50))))
+        body = encode_message(msg)[1:]
+        native = _native.scan_fields(body, 0, len(body))
+        py = _python_iter(body)
+        assert native != -1
+        assert [(f, w, v) for f, w, v, _ in native] == py
+
+
+@needs_native
+def test_native_scan_parity_fuzz():
+    """Random bytes: both implementations accept with identical results or
+    both reject."""
+    rng = random.Random(7)
+    for _ in range(3000):
+        buf = bytes(rng.randrange(256) for _ in range(rng.randint(0, 60)))
+        native = _native.scan_fields(buf, 0, len(buf))
+        try:
+            py = _python_iter(buf)
+            assert native != -1, f"python accepted, native rejected: {buf.hex()}"
+            assert [(f, w, v) for f, w, v, _ in native] == py
+        except codec.DecodeError:
+            assert native == -1, f"python rejected, native accepted: {buf.hex()}"
+
+
+@needs_native
+def test_decode_message_uses_native_and_agrees():
+    """End-to-end: full message decoding with native on vs off must agree."""
+    import os
+    from serf_tpu.types.messages import (JoinMessage, PushPullMessage,
+                                         UserEvents, UserEventMessage,
+                                         encode_message, decode_message)
+
+    msgs = [
+        JoinMessage(5, "node-a"),
+        PushPullMessage(7, {"a": 1, "b": 2}, ("x",), 3,
+                        (UserEvents(2, (UserEventMessage(2, "e", b"p"),)),), 4),
+    ]
+    for m in msgs:
+        assert decode_message(encode_message(m)) == m
+
+
+@needs_native
+def test_bytearray_and_memoryview_inputs():
+    """Mutable recv buffers must decode identically to bytes (review finding)."""
+    from serf_tpu.types.messages import JoinMessage, encode_message
+    wire = encode_message(JoinMessage(9, "n"))
+    for cast in (bytes, bytearray, memoryview):
+        out = list(codec.iter_fields(cast(wire[1:])))
+        assert [(f, w, v) for f, w, v, _ in out] == \
+            [(f, w, v) for f, w, v, _ in codec.iter_fields(wire[1:])]
+
+
+@needs_native
+def test_bounded_end_parity():
+    """iter_fields with end < len(buf) must not read varints past end, and
+    native/python must agree (review finding)."""
+    buf = bytes([0x08, 0xFF, 0x01, 0x00])
+    with pytest.raises(codec.DecodeError):
+        list(codec.iter_fields(buf, 0, 2))
+    import serf_tpu.codec._native as nat
+    saved = nat._lib, nat._tried
+    nat._lib, nat._tried = None, True
+    try:
+        with pytest.raises(codec.DecodeError):
+            list(codec.iter_fields(buf, 0, 2))
+    finally:
+        nat._lib, nat._tried = saved
+
+
+@needs_native
+def test_new_pos_tracking():
+    """The 4th tuple element is a real resume position on both paths."""
+    body = (codec.encode_varint_field(1, 300)
+            + codec.encode_bytes_field(2, b"xyz")
+            + codec.encode_varint_field(3, 7))
+    native = list(codec.iter_fields(body))
+    import serf_tpu.codec._native as nat
+    saved = nat._lib, nat._tried
+    nat._lib, nat._tried = None, True
+    try:
+        py = list(codec.iter_fields(body))
+    finally:
+        nat._lib, nat._tried = saved
+    assert native == py

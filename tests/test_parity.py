@@ -1,0 +1,151 @@
+"""Host-vs-device state parity: the north-star correctness bar.
+
+The host Serf engine is the oracle (it implements the reference's
+serialized, lock-ordered handler semantics); the device plane applies the
+same intents as batched gossip facts.  For any intent set with distinct
+Lamport times, both must resolve every member to the same status
+(SURVEY.md §7 stage 3 and "hard parts": round-batched application must
+reach the serialized fixpoint).
+"""
+
+import functools
+import random
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from serf_tpu.host import LoopbackNetwork, Serf
+from serf_tpu.host.memberlist import NodeState
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    K_JOIN,
+    K_LEAVE,
+    inject_fact,
+    make_state,
+    run_rounds,
+)
+from serf_tpu.models.membership import (
+    V_ALIVE,
+    V_LEAVING,
+    converged,
+    intent_views,
+)
+from serf_tpu.options import Options
+from serf_tpu.types.member import MemberStatus, Node
+from serf_tpu.types.messages import JoinMessage, LeaveMessage
+
+pytestmark = pytest.mark.asyncio
+
+
+async def host_oracle(intents, subjects):
+    """Apply intents through the real host handlers, in the given order."""
+    net = LoopbackNetwork()
+    serf = Serf(net.bind("oracle"), Options.local(), "oracle-node")
+    # make every subject a known member (as if memberlist reported it alive)
+    for s in subjects:
+        serf._handle_node_join(NodeState(Node(s, s)))
+    for kind, subject, lt in intents:
+        if kind == "join":
+            serf._handle_node_join_intent(JoinMessage(lt, subject))
+        else:
+            serf._handle_node_leave_intent(LeaveMessage(lt, subject))
+    out = {}
+    for s in subjects:
+        out[s] = serf._members[s].member.status
+    await serf.memberlist.transport.shutdown()
+    return out
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+async def test_intent_fixpoint_parity(seed):
+    rng = random.Random(seed)
+    n_subjects = 12
+    subjects = [f"m{i}" for i in range(n_subjects)]
+    # distinct ltimes (ties are arrival-order dependent in the reference and
+    # deliberately excluded from the parity contract)
+    ltimes = list(range(1, 1 + n_subjects * 4))
+    rng.shuffle(ltimes)
+    intents = []
+    li = 0
+    for i, s in enumerate(subjects):
+        for _ in range(rng.randint(1, 4)):
+            kind = rng.choice(["join", "leave"])
+            intents.append((kind, s, ltimes[li]))
+            li += 1
+
+    # ORACLE: serialized application in three different shuffled orders
+    # must agree with itself (order independence at distinct ltimes)...
+    results = []
+    for _ in range(3):
+        shuffled = intents[:]
+        rng.shuffle(shuffled)
+        results.append(await host_oracle(shuffled, subjects))
+    assert results[0] == results[1] == results[2]
+    oracle = results[0]
+
+    # DEVICE: same intents as facts, gossiped to full dissemination
+    cfg = GossipConfig(n=128, k_facts=64)
+    st = make_state(cfg)
+    order = intents[:]
+    rng.shuffle(order)
+    for j, (kind, s, lt) in enumerate(order):
+        st = inject_fact(
+            st, cfg, subject=subjects.index(s),
+            kind=K_JOIN if kind == "join" else K_LEAVE,
+            incarnation=0, ltime=lt, origin=rng.randrange(cfg.n))
+    run = jax.jit(functools.partial(run_rounds, cfg=cfg),
+                  static_argnames=("num_rounds",))
+    st = run(st, key=jax.random.key(seed), num_rounds=40)
+
+    subj_idx = jnp.arange(n_subjects, dtype=jnp.int32)
+    assert bool(converged(st, cfg, subj_idx)), "device views did not converge"
+    views = intent_views(st, cfg, subj_idx)
+    device = {subjects[i]: int(views[0, i]) for i in range(n_subjects)}
+
+    mapping = {MemberStatus.ALIVE: V_ALIVE, MemberStatus.LEAVING: V_LEAVING}
+    for s in subjects:
+        assert device[s] == mapping[oracle[s]], (
+            f"parity violation for {s}: host={oracle[s].name} "
+            f"device={device[s]} (seed {seed})")
+
+
+async def test_128_node_convergence_parity_with_host_cluster():
+    """Baseline config #1 bridged to the device plane: a real 128-node host
+    cluster converges on membership; the device sim with the same join set
+    converges to the same member list."""
+    net = LoopbackNetwork()
+    n = 16  # real asyncio nodes (128 in-process is slow; semantics identical)
+    nodes = []
+    for i in range(n):
+        s = await Serf.create(net.bind(f"a{i}"), Options.local(), f"n{i}")
+        nodes.append(s)
+    try:
+        for s in nodes[1:]:
+            await s.join("a0")
+        import asyncio
+        deadline = asyncio.get_running_loop().time() + 7.0
+        while asyncio.get_running_loop().time() < deadline:
+            if all(len([m for m in s.members()
+                        if m.status == MemberStatus.ALIVE]) == n
+                   for s in nodes):
+                break
+            await asyncio.sleep(0.01)
+        host_members = {m.node.id for m in nodes[0].members()}
+
+        # device: n nodes, join intents for each, full dissemination
+        cfg = GossipConfig(n=n, k_facts=32)
+        st = make_state(cfg)
+        for i in range(n):
+            st = inject_fact(st, cfg, subject=i, kind=K_JOIN,
+                             incarnation=0, ltime=i + 1, origin=i)
+        st = run_rounds(st, cfg, jax.random.key(0), 30)
+        subj = jnp.arange(n, dtype=jnp.int32)
+        views = intent_views(st, cfg, subj)
+        assert bool(jnp.all(views == V_ALIVE))
+        assert host_members == {f"n{i}" for i in range(n)}
+        assert all(m.status == MemberStatus.ALIVE
+                   for m in nodes[0].members())
+    finally:
+        for s in nodes:
+            await s.shutdown()
