@@ -46,7 +46,9 @@ extern "C" {
 //
 // Each scanned field writes 4 entries into `out`:
 //   [field_no, wire_type, value_or_offset, length]
-// - WT_VARINT (0):          value_or_offset = the value, length = 0
+// - WT_VARINT (0):          value_or_offset = the value,
+//                           length slot = post-field byte offset (the
+//                           Python binding derives new_pos from it)
 // - WT_FIXED64 (1):         value_or_offset = byte offset, length = 8
 // - WT_LENGTH_DELIMITED(2): value_or_offset = payload offset, length = n
 // - WT_FIXED32 (5):         value_or_offset = byte offset, length = 4

@@ -47,6 +47,8 @@ def load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     if _lib is not None:
         return _lib
+    if _tried:
+        return None  # build/load already failed; stay lock-free on the hot path
     if os.environ.get("SERF_TPU_NO_NATIVE") == "1":
         return None
     with _lock:
@@ -104,8 +106,9 @@ def scan_fields(buf: bytes, pos: int, end: int):
         return None
     if not isinstance(buf, bytes):
         buf = bytes(buf)  # ctypes c_char_p needs immutable bytes
+    end = min(end, len(buf))  # never hand C a length beyond the buffer
     body = buf if (pos == 0 and end == len(buf)) else buf[pos:end]
-    n = end - pos
+    n = len(body)
     max_fields = n // 2 + 1
     out = _scratch(max_fields)
     count = lib.serf_scan_fields(body, n, out, max_fields)

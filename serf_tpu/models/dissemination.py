@@ -76,6 +76,7 @@ class GossipConfig:
     k_facts: int = 64           # fact-table capacity (ring)
     fanout: int = 3             # gossip_nodes
     retransmit_mult: int = 4    # transmit budget = mult * ceil(log10(n+1))
+    use_pallas: bool = False    # fused Pallas kernels for phases 1+3
 
     @property
     def words(self) -> int:
@@ -179,12 +180,21 @@ def round_step(state: GossipState, cfg: GossipConfig,
     """
     n, k, w = cfg.n, cfg.k_facts, cfg.words
 
-    # 1. packet selection: all facts with remaining budget, from alive nodes
-    sending = (state.budgets > 0) & state.alive[:, None]
-    packets = pack_bits(sending)                              # u32[N, W]
+    use_pallas = cfg.use_pallas
+    if use_pallas:
+        from serf_tpu.ops import round_kernels
+        use_pallas = round_kernels.pallas_ok(n, k)
 
-    # 2. budget decrement: one transmit per selected fact per round
-    budgets = jnp.where(sending, state.budgets - 1, state.budgets)
+    if use_pallas:
+        alive_u8 = state.alive[:, None].astype(jnp.uint8)
+        # phases 1+2 fused: pack sending bits + decrement budgets
+        packets, budgets = round_kernels.select_packets(state.budgets, alive_u8)
+    else:
+        # 1. packet selection: facts with remaining budget, from alive nodes
+        sending = (state.budgets > 0) & state.alive[:, None]
+        packets = pack_bits(sending)                          # u32[N, W]
+        # 2. budget decrement: one transmit per selected fact per round
+        budgets = jnp.where(sending, state.budgets - 1, state.budgets)
 
     # 3. pull-exchange: each alive node samples `fanout` peers and ORs
     #    their packet words
@@ -196,15 +206,21 @@ def round_step(state: GossipState, cfg: GossipConfig,
     incoming = jax.lax.reduce(gathered, jnp.uint32(0),
                               jnp.bitwise_or, (1,))           # u32[N, W]
 
-    # 4. merge: learn facts we did not know; dead nodes learn nothing
-    alive_col = state.alive[:, None]
-    new_words = incoming & ~state.known & jnp.where(alive_col, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
-    known = state.known | new_words
-    new_mask = unpack_bits(new_words, k)                      # bool[N, K]
-
-    # 5. fresh budgets + learn stamps for newly learned facts
-    budgets = jnp.where(new_mask, jnp.uint8(cfg.transmit_limit), budgets)
-    learned_round = jnp.where(new_mask, state.round, state.learned_round)
+    if use_pallas:
+        # phases 4+5 fused: learn + fresh budgets + learn stamps
+        known, budgets, learned_round = round_kernels.merge_incoming(
+            state.known, incoming, alive_u8, budgets,
+            state.learned_round, state.round, cfg.transmit_limit)
+    else:
+        # 4. merge: learn facts we did not know; dead nodes learn nothing
+        alive_col = state.alive[:, None]
+        new_words = incoming & ~state.known & jnp.where(
+            alive_col, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+        known = state.known | new_words
+        new_mask = unpack_bits(new_words, k)                  # bool[N, K]
+        # 5. fresh budgets + learn stamps for newly learned facts
+        budgets = jnp.where(new_mask, jnp.uint8(cfg.transmit_limit), budgets)
+        learned_round = jnp.where(new_mask, state.round, state.learned_round)
 
     return state._replace(known=known, budgets=budgets,
                           learned_round=learned_round,

@@ -76,10 +76,12 @@ def composed_views(state: GossipState, cfg: GossipConfig,
                    swim_dead: jnp.ndarray) -> jnp.ndarray:
     """Compose intent views with the SWIM plane: ``swim_dead`` (bool[N, S] —
     knower i believes subject j dead) refines ALIVE->FAILED and
-    LEAVING->LEFT (reference base.rs:1375-1440)."""
+    LEAVING->LEFT; NONE stays NONE (a death notice about a member we never
+    saw join carries no serf status — reference base.rs:1375-1440 only
+    transitions known members)."""
     views = intent_views(state, cfg, subjects)
     return jnp.where(
-        swim_dead,
+        swim_dead & (views != V_NONE),
         jnp.where(views == V_LEAVING, jnp.uint8(V_LEFT), jnp.uint8(V_FAILED)),
         views)
 

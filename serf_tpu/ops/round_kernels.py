@@ -1,0 +1,179 @@
+"""Pallas TPU kernels for the gossip round's elementwise phases.
+
+The round kernel (serf_tpu/models/dissemination.py) has three phases:
+
+1. packet selection: pack ``budgets>0 & alive`` into uint32 words and
+   decrement selected budgets,
+2. pull-exchange: random gather + OR-reduce (left to XLA — its gather is
+   already bandwidth-optimal and fuses with the RNG),
+3. merge: learn new facts (bit ops over N×W), refresh budgets and learn
+   stamps (N×K).
+
+Phases 1 and 3 each touch the N×K uint8 budget plane plus the N×W word
+plane; under plain XLA they materialize several N×K intermediates (the
+sending mask, the unpacked new-fact mask).  These kernels fuse each phase
+into a single pass: one read and one write per array, everything else in
+VMEM registers.  The XLA path in ``dissemination.py`` remains the semantic
+oracle; parity is pinned by tests (interpret mode on CPU, compiled on TPU).
+
+Layout notes (pallas_guide.md): blocks are (BLOCK_N, K) uint8 / (BLOCK_N, W)
+uint32 in VMEM; scalars ride SMEM as (1, 1); iota is 2-D broadcasted_iota;
+unpacking uses a static repeat + per-lane shift, no gathers.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _block_for(n: int) -> int:
+    """Largest supported node-block size dividing N."""
+    for b in (512, 256, 128, 64, 32):
+        if n % b == 0:
+            return b
+    return 0
+
+
+def pallas_ok(n: int, k_facts: int) -> bool:
+    """Shapes the kernels support: a node block divides N, K is a multiple
+    of 32 (the word size)."""
+    return _block_for(n) > 0 and k_facts % 32 == 0
+
+
+# ---------------------------------------------------------------------------
+# phase 1: packet selection
+# ---------------------------------------------------------------------------
+
+
+def _select_kernel(budgets_ref, alive_ref, packets_ref, budgets_out_ref):
+    budgets = budgets_ref[:]                       # (B, K) u8
+    alive = alive_ref[:]                           # (B, 1) u8
+    k = budgets.shape[1]
+    w = k // 32
+    sending = (budgets > 0) & (alive > 0)          # (B, K) bool
+    bits = sending.astype(jnp.uint32)
+    weights = (jnp.uint32(1) << (
+        jax.lax.broadcasted_iota(jnp.uint32, (1, k), 1) % 32))
+    weighted = bits * weights                      # (B, K)
+    # sum each 32-lane group into one word
+    words = []
+    for wi in range(w):
+        words.append(jnp.sum(weighted[:, wi * 32:(wi + 1) * 32], axis=1,
+                             keepdims=True, dtype=jnp.uint32))
+    packets_ref[:] = jnp.concatenate(words, axis=1)
+    budgets_out_ref[:] = jnp.where(sending, budgets - 1, budgets)
+
+
+def select_packets(budgets: jnp.ndarray, alive_u8: jnp.ndarray
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(packets u32[N,W], new_budgets u8[N,K]) in one fused pass."""
+    n, k = budgets.shape
+    w = k // 32
+    BLOCK_N = _block_for(n)
+    grid = (n // BLOCK_N,)
+    return pl.pallas_call(
+        _select_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BLOCK_N, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, w), jnp.uint32),
+            jax.ShapeDtypeStruct((n, k), jnp.uint8),
+        ],
+        interpret=_interpret(),
+    )(budgets, alive_u8)
+
+
+# ---------------------------------------------------------------------------
+# phase 3: merge incoming
+# ---------------------------------------------------------------------------
+
+
+def _merge_kernel(round_ref, limit_ref, known_ref, incoming_ref, alive_ref,
+                  budgets_ref, learned_ref,
+                  known_out_ref, budgets_out_ref, learned_out_ref):
+    known = known_ref[:]                           # (B, W) u32
+    incoming = incoming_ref[:]                     # (B, W) u32
+    alive = alive_ref[:]                           # (B, 1) u8
+    budgets = budgets_ref[:]                       # (B, K) u8
+    learned = learned_ref[:]                       # (B, K) i32
+    k = budgets.shape[1]
+    alive_words = jnp.where(alive > 0, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+    new_words = incoming & ~known & alive_words    # (B, W)
+    known_out_ref[:] = known | new_words
+    # unpack: column k must read word k//32 — broadcast each single word
+    # column to 32 lanes (pltpu.repeat tiles, so repeat a 1-wide slice),
+    # concat the groups, then shift by k%32
+    w = new_words.shape[1]
+    groups = [pltpu.repeat(new_words[:, wi:wi + 1], 32, axis=1)
+              for wi in range(w)]
+    repeated = jnp.concatenate(groups, axis=1)                 # (B, K)
+    shifts = (jax.lax.broadcasted_iota(jnp.uint32, (1, k), 1) % 32)
+    new_mask = ((repeated >> shifts) & 1).astype(bool)
+    limit = limit_ref[0, 0].astype(jnp.uint8)
+    budgets_out_ref[:] = jnp.where(new_mask, limit, budgets)
+    learned_out_ref[:] = jnp.where(new_mask, round_ref[0, 0], learned)
+
+
+def merge_incoming(known: jnp.ndarray, incoming: jnp.ndarray,
+                   alive_u8: jnp.ndarray, budgets: jnp.ndarray,
+                   learned: jnp.ndarray, round_scalar, limit: int
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(known', budgets', learned') in one fused pass."""
+    n, k = budgets.shape
+    w = k // 32
+    BLOCK_N = _block_for(n)
+    grid = (n // BLOCK_N,)
+    round_arr = jnp.asarray(round_scalar, jnp.int32).reshape(1, 1)
+    limit_arr = jnp.asarray(limit, jnp.int32).reshape(1, 1)
+    return pl.pallas_call(
+        _merge_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BLOCK_N, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, w), jnp.uint32),
+            jax.ShapeDtypeStruct((n, k), jnp.uint8),
+            jax.ShapeDtypeStruct((n, k), jnp.int32),
+        ],
+        interpret=_interpret(),
+    )(round_arr, limit_arr, known, incoming, alive_u8, budgets, learned)

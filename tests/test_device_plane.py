@@ -358,3 +358,24 @@ def test_checkpoint_shape_mismatch_rejected():
         checkpoint.save(p, sa)
         with pytest.raises(ValueError):
             checkpoint.restore(p, sb)
+
+
+def test_composed_views_none_stays_none():
+    """A death notice about a never-joined member carries no serf status
+    (review finding)."""
+    from serf_tpu.models.membership import (composed_views, V_ALIVE, V_FAILED,
+                                            V_LEFT, V_LEAVING, V_NONE)
+    from serf_tpu.models.dissemination import K_JOIN, K_LEAVE
+
+    cfg = GossipConfig(n=64, k_facts=32)
+    s = make_state(cfg)
+    s = inject_fact(s, cfg, 0, K_JOIN, 0, 5, 0)    # subject 0 joined
+    s = inject_fact(s, cfg, 1, K_LEAVE, 0, 6, 0)   # subject 1 leaving
+    # subject 2: no intent at all
+    s = run_rounds(s, cfg, jax.random.key(0), 25)
+    subjects = jnp.arange(3, dtype=jnp.int32)
+    swim_dead = jnp.ones((cfg.n, 3), bool)  # everyone believes all 3 dead
+    v = composed_views(s, cfg, subjects, swim_dead)
+    assert int(v[0, 0]) == V_FAILED    # alive -> failed
+    assert int(v[0, 1]) == V_LEFT      # leaving -> left
+    assert int(v[0, 2]) == V_NONE      # never seen -> stays none

@@ -2,11 +2,12 @@
 as documented."""
 
 import asyncio
+import os
 import sys
 
 import pytest
 
-sys.path.insert(0, "/root/repo/examples")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
 
 pytestmark = pytest.mark.asyncio
 

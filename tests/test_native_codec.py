@@ -105,7 +105,6 @@ def test_native_scan_parity_fuzz():
 @needs_native
 def test_decode_message_uses_native_and_agrees():
     """End-to-end: full message decoding with native on vs off must agree."""
-    import os
     from serf_tpu.types.messages import (JoinMessage, PushPullMessage,
                                          UserEvents, UserEventMessage,
                                          encode_message, decode_message)
@@ -116,7 +115,25 @@ def test_decode_message_uses_native_and_agrees():
                         (UserEvents(2, (UserEventMessage(2, "e", b"p"),)),), 4),
     ]
     for m in msgs:
-        assert decode_message(encode_message(m)) == m
+        wire = encode_message(m)
+        with_native = decode_message(wire)
+        saved = _native._lib, _native._tried
+        _native._lib, _native._tried = None, True
+        try:
+            without_native = decode_message(wire)
+        finally:
+            _native._lib, _native._tried = saved
+        assert with_native == without_native == m
+
+
+@needs_native
+def test_oversized_end_fails_closed():
+    """end > len(buf) must never reach C with an oversized length
+    (review finding: out-of-bounds read)."""
+    buf = bytes([0x08, 0x01])
+    assert list(codec.iter_fields(buf, 0, 10)) == [(1, 0, 1, 2)]
+    with pytest.raises(codec.DecodeError):
+        list(codec.iter_fields(bytes([0x08]), 0, 10))  # truncated varint
 
 
 @needs_native
