@@ -139,6 +139,66 @@ class LoopbackNetwork:
         rng = random.Random(seed)
         self.drop_fn = (lambda s, d, b: rng.random() < p) if p > 0 else None
 
+    def drop_message_types(self, serf_types=(), swim_types=(),
+                           keyring=None) -> None:
+        """Drop packets containing the given message types — the transport
+        analog of the reference's test-only ``MessageDropper``
+        (serf-core/src/serf/delegate.rs:42-45, SURVEY.md §4).
+
+        Classification decodes the real wire format (``decode_swim``), so
+        compound packets are dropped if ANY part matches, swim USER frames
+        match both ``SwimMessageType.USER`` in ``swim_types`` and the inner
+        serf envelope (including messages nested inside RELAY) in
+        ``serf_types``.  For an encrypted cluster pass the cluster
+        ``keyring`` — without it encrypted packets cannot be classified and
+        are passed through untouched.
+        """
+        serf_set = {int(t) for t in serf_types}
+        swim_set = {int(t) for t in swim_types}
+        if not serf_set and not swim_set:
+            self.drop_fn = None
+            return
+
+        from serf_tpu import codec
+        from serf_tpu.host import messages as sm
+        from serf_tpu.host.keyring import ENCRYPTION_VERSION, KeyringError
+
+        def _serf_matches(payload: bytes) -> bool:
+            while payload:
+                if payload[0] in serf_set:
+                    return True
+                if payload[0] != 8:  # MessageType.RELAY: unwrap the nested msg
+                    return False
+                try:
+                    inner = b""
+                    for f, _w, v, _p in codec.iter_fields(payload[1:]):
+                        if f == 2:
+                            inner = codec.as_bytes(v)
+                    payload = inner
+                except codec.DecodeError:
+                    return False
+            return False
+
+        def _drop(src, dst, buf: bytes) -> bool:
+            if keyring is not None and buf and buf[0] == ENCRYPTION_VERSION:
+                try:
+                    buf = keyring.decrypt(buf)
+                except KeyringError:
+                    return False  # unclassifiable: pass through
+            try:
+                decoded = sm.decode_swim(buf)
+            except codec.DecodeError:
+                return False  # unclassifiable (e.g. encrypted, no keyring)
+            parts = decoded if isinstance(decoded, list) else [decoded]
+            for m in parts:
+                if int(m.TYPE) in swim_set:
+                    return True
+                if isinstance(m, sm.UserMsg) and _serf_matches(m.payload):
+                    return True
+            return False
+
+        self.drop_fn = _drop
+
     def _blocked(self, src, dst) -> bool:
         if self._partitions is not None:
             for g in self._partitions:

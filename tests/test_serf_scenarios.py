@@ -1,0 +1,270 @@
+"""Deeper serf scenario coverage mirroring the reference suites under
+serf/test/main/net/** (SURVEY.md §4): coalescing, reaping, snapshot
+compaction, conflict resolution, message-drop fault injection.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from serf_tpu.host import (
+    EventSubscriber,
+    LoopbackNetwork,
+    MemberEvent,
+    MemberEventType,
+    Serf,
+    SerfState,
+    UserEvent,
+)
+from serf_tpu.host.events import MemberEventCoalescer, UserEventCoalescer
+from serf_tpu.options import Options
+from serf_tpu.types.member import Member, MemberStatus, Node
+from serf_tpu.types.messages import MessageType
+from serf_tpu.types.tags import Tags
+
+pytestmark = pytest.mark.asyncio
+DEADLINE = 7.0
+
+
+async def wait_until(cond, deadline=DEADLINE, interval=0.01, msg="condition"):
+    loop = asyncio.get_running_loop()
+    end = loop.time() + deadline
+    while loop.time() < end:
+        if cond():
+            return
+        await asyncio.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# -- coalescer units (reference coalesce/member.rs, coalesce/user.rs) -------
+
+
+def test_member_event_coalescer_keeps_latest_per_node():
+    c = MemberEventCoalescer()
+    m = Member(Node("a"), Tags(), MemberStatus.ALIVE)
+    c.handle(MemberEvent(MemberEventType.JOIN, (m,)))
+    c.handle(MemberEvent(MemberEventType.FAILED, (m,)))
+    out = c.flush()
+    assert len(out) == 1 and out[0].ty == MemberEventType.FAILED
+    assert c.flush() == []  # drained
+
+
+def test_member_event_coalescer_merges_by_type():
+    c = MemberEventCoalescer()
+    a = Member(Node("a"), Tags(), MemberStatus.ALIVE)
+    b = Member(Node("b"), Tags(), MemberStatus.ALIVE)
+    c.handle(MemberEvent(MemberEventType.JOIN, (a,)))
+    c.handle(MemberEvent(MemberEventType.JOIN, (b,)))
+    out = c.flush()
+    assert len(out) == 1
+    assert {m.node.id for m in out[0].members} == {"a", "b"}
+
+
+def test_user_event_coalescer_dedups_by_ltime_name():
+    c = UserEventCoalescer()
+    e1 = UserEvent(5, "deploy", b"x", True)
+    e2 = UserEvent(5, "deploy", b"x", True)
+    e3 = UserEvent(6, "deploy", b"y", True)
+    assert c.handle(e1) and c.handle(e2) and c.handle(e3)
+    out = c.flush()
+    assert [(e.ltime, e.name) for e in out] == [(5, "deploy"), (6, "deploy")]
+    assert not c.handle(UserEvent(7, "x", b"", False))  # non-coalescable
+
+
+async def test_coalesced_member_events_flow():
+    """End-to-end: with coalesce_period set, join events arrive merged."""
+    net = LoopbackNetwork()
+    sub = EventSubscriber()
+    opts = Options.local(coalesce_period=0.1, quiescent_period=0.05)
+    s0 = await Serf.create(net.bind("c0"), opts, "c-0", subscriber=sub)
+    others = []
+    for i in range(1, 4):
+        s = await Serf.create(net.bind(f"c{i}"), Options.local(), f"c-{i}")
+        others.append(s)
+    try:
+        for s in others:
+            await s.join("c0")
+        joined = set()
+
+        async def collect():
+            while len(joined) < 4:
+                ev = await sub.next(timeout=DEADLINE)
+                if isinstance(ev, MemberEvent) and ev.ty == MemberEventType.JOIN:
+                    joined.update(m.node.id for m in ev.members)
+
+        await asyncio.wait_for(collect(), DEADLINE)
+        assert joined == {"c-0", "c-1", "c-2", "c-3"}
+    finally:
+        await s0.shutdown()
+        for s in others:
+            await s.shutdown()
+
+
+# -- reaper (reference base.rs:483-610) -------------------------------------
+
+
+async def test_reaper_erases_failed_members_and_emits_reap():
+    net = LoopbackNetwork()
+    sub = EventSubscriber()
+    opts = Options.local(reap_interval=0.1, reconnect_timeout=0.3,
+                         reconnect_interval=3600.0)
+    s0 = await Serf.create(net.bind("r0"), opts, "r-0", subscriber=sub)
+    s1 = await Serf.create(net.bind("r1"), Options.local(), "r-1")
+    try:
+        await s1.join("r0")
+        await wait_until(lambda: s0.num_members() == 2)
+        await s1.shutdown()
+        await wait_until(
+            lambda: any(m.status == MemberStatus.FAILED for m in s0.members()
+                        if m.node.id == "r-1"), msg="r-1 failed")
+        # after reconnect_timeout the reaper erases it entirely
+        await wait_until(lambda: s0.num_members() == 1, msg="r-1 reaped")
+
+        async def got_reap():
+            while True:
+                ev = await sub.next(timeout=DEADLINE)
+                if isinstance(ev, MemberEvent) and ev.ty == MemberEventType.REAP:
+                    return ev
+
+        ev = await asyncio.wait_for(got_reap(), DEADLINE)
+        assert ev.members[0].node.id == "r-1"
+    finally:
+        await s0.shutdown()
+
+
+# -- snapshot compaction (reference snapshot.rs:766-884) --------------------
+
+
+async def test_snapshot_force_compaction(tmp_path):
+    from serf_tpu.utils import metrics as metrics_mod
+
+    snap = str(tmp_path / "s.snap")
+    net = LoopbackNetwork()
+    opts = Options.local(snapshot_path=snap, snapshot_min_compact_size=512)
+    sink = metrics_mod.MetricsSink()
+    metrics_mod.set_global_sink(sink)
+    s0 = await Serf.create(net.bind("s0"), opts, "s-0")
+    s1 = await Serf.create(net.bind("s1"), Options.local(), "s-1")
+    try:
+        # push enough user events to exceed the 512-byte compaction floor
+        await s1.join("s0")
+        await wait_until(lambda: s0.num_members() == 2)
+        for i in range(400):
+            await s0.user_event(f"e{i}", b"payload", coalesce=False)
+        # compaction observably RAN (metric recorded), not just "file small"
+        await wait_until(
+            lambda: len(sink.histogram("serf.snapshot.compact", {})) > 0,
+            deadline=10.0, msg="snapshot compaction ran")
+        await wait_until(
+            lambda: os.path.exists(snap) and os.path.getsize(snap) < 4096,
+            deadline=10.0, msg="snapshot compacted below write volume")
+        # the compacted snapshot still replays the member list
+        await s0.shutdown()
+        from serf_tpu.host.snapshot import open_and_replay_snapshot
+        replay = open_and_replay_snapshot(snap)
+        assert {n.id for n in replay.alive_nodes} == {"s-0", "s-1"}
+        assert replay.last_event_clock > 100
+    finally:
+        metrics_mod.set_global_sink(metrics_mod.MetricsSink())
+        await s1.shutdown()
+        if s0.state != SerfState.SHUTDOWN:
+            await s0.shutdown()
+
+
+# -- conflict resolution (reference base.rs:1658-1780) ----------------------
+
+
+async def test_name_conflict_minority_shuts_down():
+    """Two nodes claim the same id; the majority keeps the incumbent and the
+    usurper shuts itself down."""
+    net = LoopbackNetwork()
+    nodes = []
+    for i in range(3):
+        s = await Serf.create(net.bind(f"n{i}"), Options.local(), f"node-{i}")
+        nodes.append(s)
+    for s in nodes[1:]:
+        await s.join("n0")
+    await wait_until(lambda: all(s.num_members() == 3 for s in nodes))
+    # an usurper claims node-1's id from a different address
+    usurper = await Serf.create(net.bind("evil"), Options.local(), "node-1")
+    try:
+        try:
+            await usurper.join("n0")
+        except Exception:
+            pass
+        await wait_until(
+            lambda: usurper.state == SerfState.SHUTDOWN
+            or nodes[1].state == SerfState.SHUTDOWN,
+            deadline=10.0, msg="one claimant shuts down")
+        # the incumbent (majority view) survives
+        assert nodes[1].state != SerfState.SHUTDOWN
+        assert usurper.state == SerfState.SHUTDOWN
+    finally:
+        for s in nodes:
+            await s.shutdown()
+        if usurper.state != SerfState.SHUTDOWN:
+            await usurper.shutdown()
+
+
+# -- message-type fault injection (reference MessageDropper, SURVEY.md §4) --
+
+
+async def test_drop_leave_messages_blocks_leave_dissemination():
+    net = LoopbackNetwork()
+    nodes = []
+    for i in range(3):
+        s = await Serf.create(net.bind(f"d{i}"), Options.local(), f"d-{i}")
+        nodes.append(s)
+    try:
+        for s in nodes[1:]:
+            await s.join("d0")
+        await wait_until(lambda: all(s.num_members() == 3 for s in nodes))
+        net.drop_message_types(serf_types=(MessageType.LEAVE,))
+        # graceful leave can't disseminate its intent; peers see a LEFT via
+        # the swim plane (memberlist leave) but never the serf leave intent —
+        # the node must still complete its own leave locally
+        await asyncio.wait_for(nodes[2].leave(), DEADLINE)
+        assert nodes[2].state == SerfState.LEFT
+        net.drop_message_types()  # heal
+    finally:
+        for s in nodes:
+            await s.shutdown()
+
+
+def test_dropper_classification_unit():
+    """The classifier decodes the real wire format: swim types, compound
+    parts, USER-wrapped serf envelopes, and RELAY nesting (review findings)."""
+    from serf_tpu.host import messages as sm
+    from serf_tpu.host.keyring import SecretKeyring
+    from serf_tpu.types.member import Node
+    from serf_tpu.types.messages import (QueryResponseMessage, encode_message,
+                                         encode_relay_message)
+
+    net = LoopbackNetwork()
+    ping = sm.encode_swim(sm.Ping(1, Node("a", "x"), "b"))
+    user_qr = sm.encode_swim(sm.UserMsg(
+        encode_message(QueryResponseMessage(1, 2, Node("a")))))
+    relayed = sm.encode_swim(sm.UserMsg(encode_relay_message(
+        Node("b"), encode_message(QueryResponseMessage(1, 2, Node("a"))))))
+    compound = sm.encode_compound([ping, user_qr])
+
+    # swim USER type is droppable
+    net.drop_message_types(swim_types=(sm.SwimMessageType.USER,))
+    assert net.drop_fn(0, 1, user_qr) and not net.drop_fn(0, 1, ping)
+    # serf type matches inside USER, including RELAY-nested
+    net.drop_message_types(serf_types=(MessageType.QUERY_RESPONSE,))
+    assert net.drop_fn(0, 1, user_qr)
+    assert net.drop_fn(0, 1, relayed)
+    assert not net.drop_fn(0, 1, ping)
+    # compound drops when any part matches
+    net.drop_message_types(swim_types=(sm.SwimMessageType.PING,))
+    assert net.drop_fn(0, 1, compound)
+    # encrypted: unclassifiable without keyring (pass through), classified with
+    ring = SecretKeyring(bytes(range(16)))
+    enc = ring.encrypt(ping)
+    assert not net.drop_fn(0, 1, enc)
+    net.drop_message_types(swim_types=(sm.SwimMessageType.PING,), keyring=ring)
+    assert net.drop_fn(0, 1, enc)
+    net.drop_message_types()
+    assert net.drop_fn is None
