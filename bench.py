@@ -8,12 +8,20 @@ that 10k target.
 
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Robustness: the TPU here is reached through a tunnel that can wedge (a
+killed client can leave the allocator grant stuck).  The orchestrator runs
+the measurement in a subprocess with a hard timeout; if the TPU path hangs
+it falls back to an honestly-labeled CPU measurement instead of hanging the
+driver.  Run with ``--run`` to execute the measurement directly.
 """
 
 from __future__ import annotations
 
 import functools
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -22,10 +30,17 @@ K_FACTS = 64
 ROUNDS_PER_CALL = 100
 TIMED_CALLS = 3
 TARGET_ROUNDS_PER_SEC = 10_000.0  # BASELINE.json north star (v5e-8)
+TPU_TIMEOUT_S = int(os.environ.get("SERF_TPU_BENCH_TIMEOUT", "480"))
+CPU_TIMEOUT_S = int(os.environ.get("SERF_TPU_BENCH_CPU_TIMEOUT", "900"))
 
 
 def main() -> None:
     import jax
+
+    if jax.default_backend() == "cpu":
+        # CPU fallback keeps the same cluster size but fewer rounds
+        global ROUNDS_PER_CALL, TIMED_CALLS
+        ROUNDS_PER_CALL, TIMED_CALLS = 10, 2
     import jax.numpy as jnp
 
     from serf_tpu.models.dissemination import (
@@ -74,15 +89,66 @@ def main() -> None:
                           "vs_baseline": 0.0}))
         sys.exit(1)
 
+    platform = f"{len(jax.devices())}x {jax.devices()[0].device_kind}"
+    if jax.default_backend() == "cpu":
+        platform += " (CPU FALLBACK — TPU tunnel unavailable)"
     print(json.dumps({
         "metric": f"SWIM gossip rounds/sec @ {N_NODES} simulated nodes "
                   f"(full round: dissemination + failure detection), "
-                  f"{len(jax.devices())}x {jax.devices()[0].device_kind}",
+                  f"{platform}",
         "value": round(rps, 2),
         "unit": "rounds/sec",
         "vs_baseline": round(rps / TARGET_ROUNDS_PER_SEC, 4),
     }))
 
 
+def orchestrate() -> None:
+    """Run the measurement in a subprocess with a timeout; CPU fallback if
+    the TPU tunnel is wedged."""
+    me = os.path.abspath(__file__)
+    try:
+        proc = subprocess.run([sys.executable, me, "--run"],
+                              capture_output=True, text=True,
+                              timeout=TPU_TIMEOUT_S)
+        out = _last_json_line(proc.stdout)
+        if proc.returncode == 0 and out is not None:
+            print(out)
+            return
+        sys.stderr.write(proc.stderr[-2000:] + "\n")
+    except subprocess.TimeoutExpired:
+        sys.stderr.write("TPU bench timed out (wedged tunnel?); "
+                         "falling back to CPU\n")
+    env = dict(os.environ, SERF_TPU_BENCH_CPU="1")
+    try:
+        proc = subprocess.run([sys.executable, me, "--run"],
+                              capture_output=True, text=True,
+                              timeout=CPU_TIMEOUT_S, env=env)
+        out = _last_json_line(proc.stdout)
+        if proc.returncode == 0 and out is not None:
+            print(out)
+            return
+        sys.stderr.write(proc.stderr[-2000:] + "\n")
+    except subprocess.TimeoutExpired:
+        sys.stderr.write("CPU fallback bench also timed out\n")
+    print(json.dumps({"metric": "ERROR: bench failed on TPU and CPU",
+                      "value": 0, "unit": "rounds/sec",
+                      "vs_baseline": 0.0}))
+    sys.exit(1)
+
+
+def _last_json_line(stdout: str):
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return line
+    return None
+
+
 if __name__ == "__main__":
-    main()
+    if "--run" in sys.argv:
+        if os.environ.get("SERF_TPU_BENCH_CPU") == "1":
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        main()
+    else:
+        orchestrate()

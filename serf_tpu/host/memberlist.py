@@ -110,6 +110,7 @@ class Memberlist:
         self.delegate = delegate or SwimDelegate()
         self._keyring = keyring
         self.rng = rng or random.Random()
+        opts.validate()
 
         self.local = Node(node_id, transport.local_addr)
         self._incarnation = 1
@@ -262,19 +263,79 @@ class Memberlist:
     # ------------------------------------------------------------------
 
     async def _send_packet(self, addr, buf: bytes) -> None:
-        if self._keyring is not None:
-            buf = self._keyring.encrypt(buf)
+        buf = self._encode_wire(buf)
         metrics.observe("memberlist.packet.sent", len(buf), self.opts.metric_labels)
         await self.transport.send_packet(addr, buf)
 
-    def _decrypt(self, buf: bytes) -> Optional[bytes]:
-        if self._keyring is None:
-            return buf
-        try:
-            return self._keyring.decrypt(buf)
-        except KeyringError:
-            metrics.incr("memberlist.packet.decrypt_failed", 1, self.opts.metric_labels)
-            return None
+    def _encode_wire(self, buf: bytes) -> bytes:
+        """Outbound packet pipeline: compress -> checksum -> encrypt
+        (capability parity with the reference's compression/checksum/
+        encryption transport features, SURVEY.md §2.9)."""
+        if self.opts.compression == "zlib":
+            import zlib
+            buf = b"\x01" + zlib.compress(buf, level=1)
+        elif self.opts.compression is None:
+            if self.opts.checksum is not None:
+                buf = b"\x00" + buf
+        if self.opts.checksum is not None:
+            import zlib
+            fn = zlib.crc32 if self.opts.checksum == "crc32" else zlib.adler32
+            buf = fn(buf).to_bytes(4, "big") + buf
+        if self._keyring is not None:
+            buf = self._keyring.encrypt(buf)
+        return buf
+
+    def _decode_wire(self, buf: bytes) -> Optional[bytes]:
+        """Inbound pipeline: decrypt -> verify checksum -> decompress.
+        Any failure drops the packet (UDP semantics), with a metric."""
+        if self._keyring is not None:
+            try:
+                buf = self._keyring.decrypt(buf)
+            except KeyringError:
+                metrics.incr("memberlist.packet.decrypt_failed", 1,
+                             self.opts.metric_labels)
+                return None
+        if self.opts.checksum is not None:
+            import zlib
+            if len(buf) < 5:
+                metrics.incr("memberlist.packet.checksum_failed", 1,
+                             self.opts.metric_labels)
+                return None
+            want = int.from_bytes(buf[:4], "big")
+            buf = buf[4:]
+            fn = zlib.crc32 if self.opts.checksum == "crc32" else zlib.adler32
+            if fn(buf) != want:
+                metrics.incr("memberlist.packet.checksum_failed", 1,
+                             self.opts.metric_labels)
+                return None
+        if self.opts.compression is not None or self.opts.checksum is not None:
+            if not buf:
+                return None
+            marker, buf = buf[0], buf[1:]
+            if marker == 1:
+                import zlib
+                try:
+                    buf = zlib.decompress(buf)
+                except zlib.error:
+                    metrics.incr("memberlist.packet.decompress_failed", 1,
+                                 self.opts.metric_labels)
+                    return None
+        return buf
+
+    def _wire_overhead(self) -> int:
+        """Worst-case bytes _encode_wire adds (marker + checksum + zlib
+        expansion headroom + AES-GCM version/nonce/tag) — reserved out of
+        the UDP packet budget so encoded packets stay UDP-safe."""
+        overhead = 0
+        if self.opts.compression is not None or self.opts.checksum is not None:
+            overhead += 1                       # marker byte
+        if self.opts.compression is not None:
+            overhead += 16                      # zlib worst-case expansion
+        if self.opts.checksum is not None:
+            overhead += 4
+        if self._keyring is not None:
+            overhead += 1 + 12 + 16             # version + nonce + GCM tag
+        return overhead
 
     def _queue_broadcast(self, buf: bytes, name: Optional[str] = None,
                          notify: Optional[asyncio.Event] = None) -> None:
@@ -300,7 +361,7 @@ class Memberlist:
                 src, raw = await self.transport.recv_packet()
             except ConnectionError:
                 return
-            buf = self._decrypt(raw)
+            buf = self._decode_wire(raw)
             if buf is None:
                 continue
             metrics.observe("memberlist.packet.received", len(buf), self.opts.metric_labels)
@@ -624,7 +685,7 @@ class Memberlist:
         if not candidates:
             return
         self.rng.shuffle(candidates)
-        budget = self.transport.max_packet_size
+        budget = self.transport.max_packet_size - self._wire_overhead()
         # Drain once per tick and send the same payload to all k targets —
         # one queue "transmit" fans out to gossip_nodes deliveries, matching
         # memberlist's dissemination rate.
@@ -663,7 +724,7 @@ class Memberlist:
         try:
             out = sm.PushPull(join, tuple(self._local_push_states()),
                               self.delegate.local_state(join))
-            await stream.send_frame(self._maybe_encrypt(sm.encode_swim(out)))
+            await stream.send_frame(self._encode_wire(sm.encode_swim(out)))
             reply_raw = await stream.recv_frame(self.opts.timeout)
             reply = self._decode_stream_msg(reply_raw)
             if not isinstance(reply, sm.PushPull):
@@ -688,7 +749,7 @@ class Memberlist:
             if isinstance(msg, sm.PushPull):
                 out = sm.PushPull(False, tuple(self._local_push_states()),
                                   self.delegate.local_state(msg.join))
-                await stream.send_frame(self._maybe_encrypt(sm.encode_swim(out)))
+                await stream.send_frame(self._encode_wire(sm.encode_swim(out)))
                 self._merge_remote(msg, msg.join)
             elif isinstance(msg, sm.UserMsg):
                 self.delegate.notify_message(msg.payload)
@@ -699,13 +760,10 @@ class Memberlist:
         finally:
             await stream.close()
 
-    def _maybe_encrypt(self, buf: bytes) -> bytes:
-        return self._keyring.encrypt(buf) if self._keyring is not None else buf
-
     def _decode_stream_msg(self, raw: bytes):
-        buf = self._decrypt(raw)
+        buf = self._decode_wire(raw)
         if buf is None:
-            raise KeyringError("undecryptable stream frame")
+            raise KeyringError("undecodable stream frame")
         return sm.decode_swim(buf)
 
     def _merge_remote(self, pp: sm.PushPull, join: bool) -> None:

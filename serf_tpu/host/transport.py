@@ -140,7 +140,7 @@ class LoopbackNetwork:
         self.drop_fn = (lambda s, d, b: rng.random() < p) if p > 0 else None
 
     def drop_message_types(self, serf_types=(), swim_types=(),
-                           keyring=None) -> None:
+                           keyring=None, opts=None) -> None:
         """Drop packets containing the given message types — the transport
         analog of the reference's test-only ``MessageDropper``
         (serf-core/src/serf/delegate.rs:42-45, SURVEY.md §4).
@@ -150,8 +150,9 @@ class LoopbackNetwork:
         match both ``SwimMessageType.USER`` in ``swim_types`` and the inner
         serf envelope (including messages nested inside RELAY) in
         ``serf_types``.  For an encrypted cluster pass the cluster
-        ``keyring`` — without it encrypted packets cannot be classified and
-        are passed through untouched.
+        ``keyring``; for a cluster using compression/checksum wire options
+        pass its ``MemberlistOptions`` as ``opts`` — without them such
+        packets cannot be classified and are passed through untouched.
         """
         serf_set = {int(t) for t in serf_types}
         swim_set = {int(t) for t in swim_types}
@@ -185,6 +186,23 @@ class LoopbackNetwork:
                     buf = keyring.decrypt(buf)
                 except KeyringError:
                     return False  # unclassifiable: pass through
+            if opts is not None and (opts.checksum is not None
+                                     or opts.compression is not None):
+                # mirror the peer decode pipeline: strip checksum, marker,
+                # decompress (classification only — no verification)
+                if opts.checksum is not None:
+                    if len(buf) < 5:
+                        return False
+                    buf = buf[4:]
+                if not buf:
+                    return False
+                marker, buf = buf[0], buf[1:]
+                if marker == 1:
+                    import zlib
+                    try:
+                        buf = zlib.decompress(buf)
+                    except zlib.error:
+                        return False
             try:
                 decoded = sm.decode_swim(buf)
             except codec.DecodeError:

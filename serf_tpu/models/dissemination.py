@@ -239,6 +239,46 @@ def run_rounds(state: GossipState, cfg: GossipConfig, key: jax.Array,
     return final
 
 
+def push_round_step(state: GossipState, cfg: GossipConfig,
+                    key: jax.Array) -> GossipState:
+    """Exact *push*-gossip round as MXU matmuls (the north star's "SWIM as a
+    GNN-style message-passing kernel", BASELINE.json).
+
+    Each node picks ``fanout`` targets and SENDS its packet; delivery is a
+    boolean-semiring matmul: unpack packets to a bit plane ``B[N, K]``,
+    build the round's adjacency ``A[N, N]`` from the sampled targets, and
+    ``incoming = (Aᵀ @ B) > 0`` — dense matmuls the MXU eats directly.
+    O(N²) per round, so this is the conformance/small-N mode (the reference
+    push semantics bit-for-bit at the round level); the pull kernel in
+    ``round_step`` is the O(N·F) scale mode.  Budget accounting is
+    identical (one decrement per selected fact per round).
+    """
+    n, k = cfg.n, cfg.k_facts
+
+    sending = (state.budgets > 0) & state.alive[:, None]      # bool[N, K]
+    budgets = jnp.where(sending, state.budgets - 1, state.budgets)
+
+    targets = jax.random.randint(key, (n, cfg.fanout), 0, n)  # i32[N, F]
+    # adjacency: A[src, dst] = 1 if src sends to dst this round
+    adj = jnp.zeros((n, n), jnp.float32)
+    adj = adj.at[jnp.arange(n)[:, None], targets].set(1.0)
+    adj = adj * state.alive[:, None].astype(jnp.float32)      # dead don't send
+
+    bits = sending.astype(jnp.float32)                        # f32[N, K]
+    counts = jnp.matmul(adj.T, bits,
+                        preferred_element_type=jnp.float32)   # MXU [N, K]
+    incoming = counts > 0.0
+
+    alive_col = state.alive[:, None]
+    new_mask = incoming & ~unpack_bits(state.known, k) & alive_col
+    known = state.known | pack_bits(new_mask)
+    budgets = jnp.where(new_mask, jnp.uint8(cfg.transmit_limit), budgets)
+    learned_round = jnp.where(new_mask, state.round, state.learned_round)
+    return state._replace(known=known, budgets=budgets,
+                          learned_round=learned_round,
+                          round=state.round + 1)
+
+
 # -- metrics -----------------------------------------------------------------
 
 def coverage(state: GossipState, cfg: GossipConfig) -> jnp.ndarray:

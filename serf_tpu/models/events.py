@@ -1,0 +1,104 @@
+"""Device->host event streaming: per-round deltas without killing throughput.
+
+The device analog of the host event pipeline (SURVEY.md §7 stage 4 and §5
+"host/device event streaming"): rather than shipping every node's state each
+round, reduce on device to compact summaries — newly-learned counts per
+fact, first-full-coverage rounds, per-fact knower counts — and only ship
+those.  A host-side ``DeviceEventStream`` diffs consecutive summaries into
+MemberEvent/UserEvent-like records.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    GossipState,
+    K_DEAD,
+    K_JOIN,
+    K_LEAVE,
+    K_SUSPECT,
+    K_USER_EVENT,
+    unpack_bits,
+)
+
+
+class RoundSummary(NamedTuple):
+    """Per-round device-side reduction (small: O(K) + scalars)."""
+
+    round: jnp.ndarray          # i32
+    knowers: jnp.ndarray        # i32[K] alive nodes knowing each fact
+    alive_count: jnp.ndarray    # i32
+    fact_subject: jnp.ndarray   # i32[K]
+    fact_kind: jnp.ndarray      # u8[K]
+    fact_valid: jnp.ndarray     # bool[K]
+
+
+def summarize(state: GossipState, cfg: GossipConfig) -> RoundSummary:
+    known = unpack_bits(state.known, cfg.k_facts)
+    alive = state.alive[:, None]
+    return RoundSummary(
+        round=state.round,
+        knowers=jnp.sum(known & alive, axis=0).astype(jnp.int32),
+        alive_count=jnp.sum(state.alive).astype(jnp.int32),
+        fact_subject=state.facts.subject,
+        fact_kind=state.facts.kind,
+        fact_valid=state.facts.valid,
+    )
+
+
+class DeviceEvent(NamedTuple):
+    """A host-consumable protocol event derived from summary diffs."""
+
+    round: int
+    kind: str          # "fact-born" | "fully-disseminated" | "retired"
+    fact_kind: int     # K_* constant
+    subject: int
+    knowers: int
+
+
+_KIND_NAMES = {K_JOIN: "join", K_LEAVE: "leave", K_SUSPECT: "suspect",
+               K_DEAD: "dead", K_USER_EVENT: "user-event"}
+
+
+class DeviceEventStream:
+    """Diff consecutive RoundSummaries into discrete events (host side)."""
+
+    def __init__(self, cfg: GossipConfig):
+        self.cfg = cfg
+        self._prev: RoundSummary | None = None
+        self._full_seen: set = set()
+
+    def push(self, summary: RoundSummary) -> List[DeviceEvent]:
+        events: List[DeviceEvent] = []
+        cur_valid = summary.fact_valid
+        knowers = summary.knowers
+        alive = int(summary.alive_count)
+        rnd = int(summary.round)
+        prev = self._prev
+        for slot in range(self.cfg.k_facts):
+            valid = bool(cur_valid[slot])
+            subject = int(summary.fact_subject[slot])
+            fkind = int(summary.fact_kind[slot])
+            key = (slot, subject, fkind)
+            was_valid = prev is not None and bool(prev.fact_valid[slot]) and \
+                int(prev.fact_subject[slot]) == subject and \
+                int(prev.fact_kind[slot]) == fkind
+            if valid and not was_valid:
+                events.append(DeviceEvent(rnd, "fact-born", fkind, subject,
+                                          int(knowers[slot])))
+                self._full_seen.discard(key)
+            if valid and int(knowers[slot]) >= alive and key not in self._full_seen:
+                self._full_seen.add(key)
+                events.append(DeviceEvent(rnd, "fully-disseminated", fkind,
+                                          subject, int(knowers[slot])))
+        self._prev = summary
+        return events
+
+
+def kind_name(fact_kind: int) -> str:
+    return _KIND_NAMES.get(fact_kind, f"kind-{fact_kind}")

@@ -33,7 +33,15 @@ class MemberlistOptions:
     push_pull_interval: float = 30.0
     awareness_max_multiplier: int = 8        # Lifeguard local-health ceiling
     timeout: float = 10.0                    # stream (push/pull) op timeout
+    compression: Optional[str] = None        # None | "zlib" (packet payloads)
+    checksum: Optional[str] = None           # None | "crc32" | "adler32"
     metric_labels: Dict[str, str] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        if self.compression not in (None, "zlib"):
+            raise ValueError(f"unsupported compression {self.compression!r}")
+        if self.checksum not in (None, "crc32", "adler32"):
+            raise ValueError(f"unsupported checksum {self.checksum!r}")
 
     @classmethod
     def lan(cls) -> "MemberlistOptions":
@@ -96,6 +104,7 @@ class Options:
                 f"max_user_event_size {self.max_user_event_size} exceeds hard cap "
                 f"{USER_EVENT_SIZE_LIMIT}"
             )
+        self.memberlist.validate()
 
     @classmethod
     def local(cls, **kw) -> "Options":

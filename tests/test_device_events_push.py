@@ -1,0 +1,88 @@
+"""Device event streaming + exact push-gossip (MXU) mode."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    K_USER_EVENT,
+    coverage,
+    inject_fact,
+    make_state,
+    push_round_step,
+    round_step,
+)
+from serf_tpu.models.events import DeviceEventStream, RoundSummary, summarize
+
+
+def test_push_mode_disseminates_and_respects_budgets():
+    cfg = GossipConfig(n=256, k_facts=32)
+    s = inject_fact(make_state(cfg), cfg, 0, K_USER_EVENT, 0, 1, 0)
+    step = jax.jit(functools.partial(push_round_step, cfg=cfg))
+    key = jax.random.key(0)
+    for r in range(40):
+        key, k2 = jax.random.split(key)
+        s = step(s, key=k2)
+        if float(coverage(s, cfg)[0]) == 1.0:
+            break
+    assert float(coverage(s, cfg)[0]) == 1.0
+    # budgets exhaust after convergence
+    for r in range(cfg.transmit_limit + 2):
+        key, k2 = jax.random.split(key)
+        s = step(s, key=k2)
+    assert int(jnp.sum(s.budgets)) == 0
+
+
+def test_push_mode_dead_nodes_dont_send_or_learn():
+    cfg = GossipConfig(n=128, k_facts=32)
+    s = make_state(cfg)
+    s = s._replace(alive=s.alive.at[5].set(False))
+    s = inject_fact(s, cfg, 0, K_USER_EVENT, 0, 1, 5)  # origin is dead!
+    step = jax.jit(functools.partial(push_round_step, cfg=cfg))
+    key = jax.random.key(1)
+    for _ in range(30):
+        key, k2 = jax.random.split(key)
+        s = step(s, key=k2)
+    assert float(coverage(s, cfg)[0]) == 0.0  # dead origin spreads nothing
+
+
+def test_push_and_pull_reach_same_fixpoint():
+    """Different exchange directions, same converged knowledge."""
+    cfg = GossipConfig(n=256, k_facts=32)
+    base = inject_fact(make_state(cfg), cfg, 0, K_USER_EVENT, 0, 1, 0)
+    pull_step = jax.jit(functools.partial(round_step, cfg=cfg))
+    push_step = jax.jit(functools.partial(push_round_step, cfg=cfg))
+    a, b = base, base
+    key = jax.random.key(2)
+    for _ in range(50):
+        key, k1, k2 = jax.random.split(key, 3)
+        a = pull_step(a, key=k1)
+        b = push_step(b, key=k2)
+    assert float(coverage(a, cfg)[0]) == 1.0
+    assert float(coverage(b, cfg)[0]) == 1.0
+    assert bool(jnp.all(a.known == b.known))
+
+
+def test_device_event_stream():
+    cfg = GossipConfig(n=128, k_facts=32)
+    s = make_state(cfg)
+    stream = DeviceEventStream(cfg)
+    step = jax.jit(functools.partial(round_step, cfg=cfg))
+    events = stream.push(jax.device_get(summarize(s, cfg)))
+    assert events == []
+    s = inject_fact(s, cfg, 7, K_USER_EVENT, 0, 1, 0)
+    events = stream.push(jax.device_get(summarize(s, cfg)))
+    assert any(e.kind == "fact-born" and e.subject == 7 for e in events)
+    key = jax.random.key(3)
+    full = []
+    for _ in range(40):
+        key, k2 = jax.random.split(key)
+        s = step(s, key=k2)
+        full.extend(e for e in stream.push(jax.device_get(summarize(s, cfg)))
+                    if e.kind == "fully-disseminated")
+        if full:
+            break
+    assert full and full[0].subject == 7
+    assert full[0].knowers == cfg.n

@@ -233,3 +233,70 @@ async def test_health_score_degrades_when_isolated():
                          msg="isolated node's health degrades")
     finally:
         await shutdown_all(nodes)
+
+
+async def test_compressed_checksummed_cluster_converges():
+    """Wire pipeline parity: zlib compression + crc32 checksum on packets
+    and streams (reference compression/checksum transport features)."""
+    import dataclasses
+    net = LoopbackNetwork()
+    opts = dataclasses.replace(MemberlistOptions.local(),
+                               compression="zlib", checksum="crc32")
+    nodes = []
+    for i in range(3):
+        ml = Memberlist(net.bind(f"z{i}"), opts, f"z-{i}")
+        await ml.start()
+        nodes.append(ml)
+    try:
+        for ml in nodes[1:]:
+            await ml.join("z0")
+        await wait_until(lambda: all(m.num_online_members() == 3 for m in nodes),
+                         msg="compressed cluster convergence")
+    finally:
+        await shutdown_all(nodes)
+
+
+async def test_checksum_drops_corrupted_packets():
+    """A corrupted packet must be dropped by the checksum, not decoded."""
+    import dataclasses
+    from serf_tpu.utils import metrics as metrics_mod
+    sink = metrics_mod.MetricsSink()
+    metrics_mod.set_global_sink(sink)
+    net = LoopbackNetwork()
+    opts = dataclasses.replace(MemberlistOptions.local(), checksum="crc32")
+    a = Memberlist(net.bind("ck0"), opts, "ck-0")
+    b = Memberlist(net.bind("ck1"), opts, "ck-1")
+    await a.start(); await b.start()
+
+    # corrupt every 3rd packet in flight
+    count = [0]
+    orig_send = net.transports["ck0"].send_packet
+
+    async def corrupting_send(addr, buf):
+        count[0] += 1
+        if count[0] % 3 == 0 and len(buf) > 6:
+            buf = buf[:5] + bytes([buf[5] ^ 0xFF]) + buf[6:]
+        await orig_send(addr, buf)
+
+    net.transports["ck0"].send_packet = corrupting_send
+    try:
+        await b.join("ck0")
+        await wait_until(lambda: a.num_online_members() == 2
+                         and b.num_online_members() == 2)
+        await wait_until(
+            lambda: sink.counter("memberlist.packet.checksum_failed", {}) > 0,
+            msg="corrupted packets detected and dropped")
+    finally:
+        metrics_mod.set_global_sink(metrics_mod.MetricsSink())
+        await shutdown_all([a, b])
+
+
+async def test_unsupported_wire_options_rejected():
+    import dataclasses
+    net = LoopbackNetwork()
+    with pytest.raises(ValueError):
+        Memberlist(net.bind("x0"), dataclasses.replace(
+            MemberlistOptions.local(), compression="snappy"), "x-0")
+    with pytest.raises(ValueError):
+        Memberlist(net.bind("x1"), dataclasses.replace(
+            MemberlistOptions.local(), checksum="xxhash"), "x-1")

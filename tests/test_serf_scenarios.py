@@ -268,3 +268,28 @@ def test_dropper_classification_unit():
     assert net.drop_fn(0, 1, enc)
     net.drop_message_types()
     assert net.drop_fn is None
+
+
+def test_dropper_with_wire_options():
+    """The classifier must see through compression/checksum framing when
+    given the cluster options (review finding)."""
+    import dataclasses
+    from serf_tpu.host import messages as sm
+    from serf_tpu.host.memberlist import Memberlist
+    from serf_tpu.options import MemberlistOptions
+    from serf_tpu.types.member import Node
+
+    net = LoopbackNetwork()
+    opts = dataclasses.replace(MemberlistOptions.local(),
+                               compression="zlib", checksum="crc32")
+    ml = Memberlist(net.bind("wire0"), opts, "wire-0")
+    ping_plain = sm.encode_swim(sm.Ping(1, Node("a", "x"), "b"))
+    on_wire = ml._encode_wire(ping_plain)
+    assert on_wire != ping_plain
+    # without opts: unclassifiable, passes through
+    net.drop_message_types(swim_types=(sm.SwimMessageType.PING,))
+    assert not net.drop_fn(0, 1, on_wire)
+    # with opts: classified and dropped
+    net.drop_message_types(swim_types=(sm.SwimMessageType.PING,), opts=opts)
+    assert net.drop_fn(0, 1, on_wire)
+    net.drop_message_types()
