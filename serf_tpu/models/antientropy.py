@@ -44,9 +44,8 @@ def push_pull_round(state: GossipState, cfg: GossipConfig, key: jax.Array,
     known = state.known | new_words
     new_mask = unpack_bits(new_words, k)
     budgets = jnp.where(new_mask, jnp.uint8(cfg.transmit_limit), state.budgets)
-    learned_round = jnp.where(new_mask, state.round, state.learned_round)
-    return state._replace(known=known, budgets=budgets,
-                          learned_round=learned_round)
+    age = jnp.where(new_mask, jnp.uint8(0), state.age)
+    return state._replace(known=known, budgets=budgets, age=age)
 
 
 def make_partition(n: int, split: float = 0.5) -> jnp.ndarray:

@@ -9,8 +9,8 @@ This is the TPU-native re-design of serf's dissemination machinery
   slots, exactly like the reference's ``buffer[ltime % len]`` dedup cells.
 - each simulated node's state is a row: a packed bitset of which facts it
   knows (``known``: N×W uint32), per-fact remaining transmit budget
-  (``budgets``: N×K uint8 — the TransmitLimitedQueue, vectorized), and the
-  round at which each fact was learned (for suspicion timers and metrics).
+  (``budgets``: N×K uint8 — the TransmitLimitedQueue, vectorized), and a
+  saturating rounds-since-learned age (for suspicion timers).
 - a gossip round = sample ``fanout`` peers per node, gather their packed
   packet words, bitwise-OR, then a masked Lamport-style merge — pure
   elementwise math plus one gather, which is exactly what the MXU-era memory
@@ -61,7 +61,8 @@ class GossipState(NamedTuple):
     facts: FactTable
     known: jnp.ndarray          # u32[N, W]  packed known-fact bitset
     budgets: jnp.ndarray        # u8[N, K]   remaining transmits per fact
-    learned_round: jnp.ndarray  # i32[N, K]  round each fact was learned (-1)
+    age: jnp.ndarray            # u8[N, K]   rounds since learned (saturating;
+                                #            255 also = never/unknown)
     alive: jnp.ndarray          # bool[N]    ground-truth liveness
     incarnation: jnp.ndarray    # u32[N]     ground-truth own incarnation
     round: jnp.ndarray          # i32 scalar
@@ -102,7 +103,7 @@ def make_state(cfg: GossipConfig) -> GossipState:
         facts=facts,
         known=jnp.zeros((n, w), jnp.uint32),
         budgets=jnp.zeros((n, k), jnp.uint8),
-        learned_round=jnp.full((n, k), -1, jnp.int32),
+        age=jnp.full((n, k), 255, jnp.uint8),
         alive=jnp.ones((n,), bool),
         incarnation=jnp.ones((n,), jnp.uint32),
         round=jnp.asarray(0, jnp.int32),
@@ -156,11 +157,10 @@ def inject_fact(state: GossipState, cfg: GossipConfig, subject, kind,
     known = known.at[origin, word].set(known[origin, word] | bitmask)
     budgets = state.budgets.at[:, slot].set(0)
     budgets = budgets.at[origin, slot].set(cfg.transmit_limit)
-    learned = state.learned_round.at[:, slot].set(-1)
-    learned = learned.at[origin, slot].set(state.round)
+    age = state.age.at[:, slot].set(255)
+    age = age.at[origin, slot].set(0)
     return state._replace(facts=facts, known=known, budgets=budgets,
-                          learned_round=learned,
-                          next_slot=state.next_slot + 1)
+                          age=age, next_slot=state.next_slot + 1)
 
 
 # -- the gossip round kernel -------------------------------------------------
@@ -187,14 +187,17 @@ def round_step(state: GossipState, cfg: GossipConfig,
 
     if use_pallas:
         alive_u8 = state.alive[:, None].astype(jnp.uint8)
-        # phases 1+2 fused: pack sending bits + decrement budgets
-        packets, budgets = round_kernels.select_packets(state.budgets, alive_u8)
+        # phases 1+2 fused: pack sending bits + decrement budgets + age++
+        packets, budgets, aged = round_kernels.select_packets(
+            state.budgets, alive_u8, state.age)
     else:
         # 1. packet selection: facts with remaining budget, from alive nodes
         sending = (state.budgets > 0) & state.alive[:, None]
         packets = pack_bits(sending)                          # u32[N, W]
-        # 2. budget decrement: one transmit per selected fact per round
+        # 2. budget decrement: one transmit per selected fact per round;
+        #    knowledge ages one round (saturating)
         budgets = jnp.where(sending, state.budgets - 1, state.budgets)
+        aged = jnp.where(state.age < 255, state.age + 1, state.age)
 
     # 3. pull-exchange: each alive node samples `fanout` peers and ORs
     #    their packet words
@@ -207,10 +210,10 @@ def round_step(state: GossipState, cfg: GossipConfig,
                               jnp.bitwise_or, (1,))           # u32[N, W]
 
     if use_pallas:
-        # phases 4+5 fused: learn + fresh budgets + learn stamps
-        known, budgets, learned_round = round_kernels.merge_incoming(
+        # phases 4+5 fused: learn + fresh budgets + age reset
+        known, budgets, age = round_kernels.merge_incoming(
             state.known, incoming, alive_u8, budgets,
-            state.learned_round, state.round, cfg.transmit_limit)
+            aged, cfg.transmit_limit)
     else:
         # 4. merge: learn facts we did not know; dead nodes learn nothing
         alive_col = state.alive[:, None]
@@ -218,12 +221,11 @@ def round_step(state: GossipState, cfg: GossipConfig,
             alive_col, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
         known = state.known | new_words
         new_mask = unpack_bits(new_words, k)                  # bool[N, K]
-        # 5. fresh budgets + learn stamps for newly learned facts
+        # 5. fresh budgets + age reset for newly learned facts
         budgets = jnp.where(new_mask, jnp.uint8(cfg.transmit_limit), budgets)
-        learned_round = jnp.where(new_mask, state.round, state.learned_round)
+        age = jnp.where(new_mask, jnp.uint8(0), aged)
 
-    return state._replace(known=known, budgets=budgets,
-                          learned_round=learned_round,
+    return state._replace(known=known, budgets=budgets, age=age,
                           round=state.round + 1)
 
 
@@ -273,9 +275,9 @@ def push_round_step(state: GossipState, cfg: GossipConfig,
     new_mask = incoming & ~unpack_bits(state.known, k) & alive_col
     known = state.known | pack_bits(new_mask)
     budgets = jnp.where(new_mask, jnp.uint8(cfg.transmit_limit), budgets)
-    learned_round = jnp.where(new_mask, state.round, state.learned_round)
-    return state._replace(known=known, budgets=budgets,
-                          learned_round=learned_round,
+    aged = jnp.where(state.age < 255, state.age + 1, state.age)
+    age = jnp.where(new_mask, jnp.uint8(0), aged)
+    return state._replace(known=known, budgets=budgets, age=age,
                           round=state.round + 1)
 
 

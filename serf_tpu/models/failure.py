@@ -39,6 +39,14 @@ class FailureConfig:
     max_new_facts: int = 8         # injection bound per category per round
     probe_drop_rate: float = 0.0   # chance an ack is lost (fault injection)
 
+    def __post_init__(self):
+        # knowledge age is a saturating uint8; 255 is the never-known
+        # sentinel, so windows beyond 254 rounds are unrepresentable
+        if not (0 < self.suspicion_rounds <= 254):
+            raise ValueError(
+                f"suspicion_rounds must be in [1, 254] (u8 age plane), "
+                f"got {self.suspicion_rounds}")
+
 
 def _facts_about(state: GossipState, kinds, min_inc_of_subject=None):
     """bool[K]: table slots that are valid facts of one of ``kinds``."""
@@ -145,7 +153,7 @@ def declare_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
     n, k = cfg.n, cfg.k_facts
     known = unpack_bits(state.known, k)
     suspect = _facts_about(state, (K_SUSPECT,))
-    aged = (state.round - state.learned_round) >= fcfg.suspicion_rounds
+    aged = state.age >= fcfg.suspicion_rounds
     # a refutation is an alive fact about the same subject with strictly
     # higher incarnation present in the table
     refuted = jnp.zeros((k,), bool)
@@ -201,8 +209,8 @@ def believed_dead(state: GossipState, cfg: GossipConfig,
     n, k = cfg.n, cfg.k_facts
     known = unpack_bits(state.known, k)
     dead_fact = _facts_about(state, (K_DEAD,))
-    aged_suspect = _facts_about(state, (K_SUSPECT,)) & True
-    aged = (state.round - state.learned_round) >= fcfg.suspicion_rounds
+    aged_suspect = _facts_about(state, (K_SUSPECT,))
+    aged = state.age >= fcfg.suspicion_rounds
     evidence = known & (dead_fact[None, :] | (aged_suspect[None, :] & aged))
     # refutation: knower also knows an alive fact about the same subject with
     # strictly higher incarnation

@@ -6,8 +6,8 @@ The round kernel (serf_tpu/models/dissemination.py) has three phases:
    decrement selected budgets,
 2. pull-exchange: random gather + OR-reduce (left to XLA — its gather is
    already bandwidth-optimal and fuses with the RNG),
-3. merge: learn new facts (bit ops over N×W), refresh budgets and learn
-   stamps (N×K).
+3. merge: learn new facts (bit ops over N×W), refresh budgets and reset
+   knowledge ages (N×K).
 
 Phases 1 and 3 each touch the N×K uint8 budget plane plus the N×W word
 plane; under plain XLA they materialize several N×K intermediates (the
@@ -54,9 +54,11 @@ def pallas_ok(n: int, k_facts: int) -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _select_kernel(budgets_ref, alive_ref, packets_ref, budgets_out_ref):
+def _select_kernel(budgets_ref, alive_ref, age_ref,
+                   packets_ref, budgets_out_ref, age_out_ref):
     budgets = budgets_ref[:]                       # (B, K) u8
     alive = alive_ref[:]                           # (B, 1) u8
+    age = age_ref[:]                               # (B, K) u8
     k = budgets.shape[1]
     w = k // 32
     sending = (budgets > 0) & (alive > 0)          # (B, K) bool
@@ -71,11 +73,13 @@ def _select_kernel(budgets_ref, alive_ref, packets_ref, budgets_out_ref):
                              keepdims=True, dtype=jnp.uint32))
     packets_ref[:] = jnp.concatenate(words, axis=1)
     budgets_out_ref[:] = jnp.where(sending, budgets - 1, budgets)
+    age_out_ref[:] = jnp.where(age < 255, age + 1, age)  # saturating age++
 
 
-def select_packets(budgets: jnp.ndarray, alive_u8: jnp.ndarray
-                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(packets u32[N,W], new_budgets u8[N,K]) in one fused pass."""
+def select_packets(budgets: jnp.ndarray, alive_u8: jnp.ndarray,
+                   age: jnp.ndarray
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(packets u32[N,W], new_budgets u8[N,K], aged u8[N,K]) in one pass."""
     n, k = budgets.shape
     w = k // 32
     BLOCK_N = _block_for(n)
@@ -88,9 +92,13 @@ def select_packets(budgets: jnp.ndarray, alive_u8: jnp.ndarray
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((BLOCK_N, 1), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
@@ -98,9 +106,10 @@ def select_packets(budgets: jnp.ndarray, alive_u8: jnp.ndarray
         out_shape=[
             jax.ShapeDtypeStruct((n, w), jnp.uint32),
             jax.ShapeDtypeStruct((n, k), jnp.uint8),
+            jax.ShapeDtypeStruct((n, k), jnp.uint8),
         ],
         interpret=_interpret(),
-    )(budgets, alive_u8)
+    )(budgets, alive_u8, age)
 
 
 # ---------------------------------------------------------------------------
@@ -108,14 +117,14 @@ def select_packets(budgets: jnp.ndarray, alive_u8: jnp.ndarray
 # ---------------------------------------------------------------------------
 
 
-def _merge_kernel(round_ref, limit_ref, known_ref, incoming_ref, alive_ref,
-                  budgets_ref, learned_ref,
-                  known_out_ref, budgets_out_ref, learned_out_ref):
+def _merge_kernel(limit_ref, known_ref, incoming_ref, alive_ref,
+                  budgets_ref, age_ref,
+                  known_out_ref, budgets_out_ref, age_out_ref):
     known = known_ref[:]                           # (B, W) u32
     incoming = incoming_ref[:]                     # (B, W) u32
     alive = alive_ref[:]                           # (B, 1) u8
     budgets = budgets_ref[:]                       # (B, K) u8
-    learned = learned_ref[:]                       # (B, K) i32
+    age = age_ref[:]                               # (B, K) u8
     k = budgets.shape[1]
     alive_words = jnp.where(alive > 0, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
     new_words = incoming & ~known & alive_words    # (B, W)
@@ -131,25 +140,23 @@ def _merge_kernel(round_ref, limit_ref, known_ref, incoming_ref, alive_ref,
     new_mask = ((repeated >> shifts) & 1).astype(bool)
     limit = limit_ref[0, 0].astype(jnp.uint8)
     budgets_out_ref[:] = jnp.where(new_mask, limit, budgets)
-    learned_out_ref[:] = jnp.where(new_mask, round_ref[0, 0], learned)
+    age_out_ref[:] = jnp.where(new_mask, jnp.uint8(0), age)
 
 
 def merge_incoming(known: jnp.ndarray, incoming: jnp.ndarray,
                    alive_u8: jnp.ndarray, budgets: jnp.ndarray,
-                   learned: jnp.ndarray, round_scalar, limit: int
+                   age: jnp.ndarray, limit: int
                    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """(known', budgets', learned') in one fused pass."""
+    """(known', budgets', age') in one fused pass."""
     n, k = budgets.shape
     w = k // 32
     BLOCK_N = _block_for(n)
     grid = (n // BLOCK_N,)
-    round_arr = jnp.asarray(round_scalar, jnp.int32).reshape(1, 1)
     limit_arr = jnp.asarray(limit, jnp.int32).reshape(1, 1)
     return pl.pallas_call(
         _merge_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
@@ -173,7 +180,7 @@ def merge_incoming(known: jnp.ndarray, incoming: jnp.ndarray,
         out_shape=[
             jax.ShapeDtypeStruct((n, w), jnp.uint32),
             jax.ShapeDtypeStruct((n, k), jnp.uint8),
-            jax.ShapeDtypeStruct((n, k), jnp.int32),
+            jax.ShapeDtypeStruct((n, k), jnp.uint8),
         ],
         interpret=_interpret(),
-    )(round_arr, limit_arr, known, incoming, alive_u8, budgets, learned)
+    )(limit_arr, known, incoming, alive_u8, budgets, age)

@@ -379,3 +379,10 @@ def test_composed_views_none_stays_none():
     assert int(v[0, 0]) == V_FAILED    # alive -> failed
     assert int(v[0, 1]) == V_LEFT      # leaving -> left
     assert int(v[0, 2]) == V_NONE      # never seen -> stays none
+
+
+def test_failure_config_rejects_oversized_suspicion_window():
+    """The u8 age plane caps representable windows at 254 rounds."""
+    with pytest.raises(ValueError):
+        FailureConfig(suspicion_rounds=300)
+    FailureConfig(suspicion_rounds=254)  # boundary ok

@@ -23,10 +23,9 @@ def _rand_state(cfg, key):
     s = make_state(cfg)
     budgets = jax.random.randint(k1, (cfg.n, cfg.k_facts), 0, 6).astype(jnp.uint8)
     known = jax.random.bits(k2, (cfg.n, cfg.words), jnp.uint32)
-    learned = jax.random.randint(k3, (cfg.n, cfg.k_facts), -1, 10)
+    age = jax.random.randint(k3, (cfg.n, cfg.k_facts), 0, 256).astype(jnp.uint8)
     alive = jax.random.bernoulli(k4, 0.9, (cfg.n,))
-    return s._replace(budgets=budgets, known=known,
-                      learned_round=learned, alive=alive,
+    return s._replace(budgets=budgets, known=known, age=age, alive=alive,
                       round=jnp.asarray(7, jnp.int32))
 
 
@@ -37,10 +36,11 @@ def test_select_packets_matches_oracle():
     sending = (s.budgets > 0) & s.alive[:, None]
     want_packets = pack_bits(sending)
     want_budgets = jnp.where(sending, s.budgets - 1, s.budgets)
-    packets, budgets = round_kernels.select_packets(
-        s.budgets, s.alive[:, None].astype(jnp.uint8))
+    packets, budgets, aged = round_kernels.select_packets(
+        s.budgets, s.alive[:, None].astype(jnp.uint8), s.age)
     assert bool(jnp.all(packets == want_packets))
     assert bool(jnp.all(budgets == want_budgets))
+    assert bool(jnp.all(aged == jnp.where(s.age < 255, s.age + 1, s.age)))
 
 
 def test_full_round_parity_pallas_vs_xla():
