@@ -48,6 +48,28 @@ class MemberlistOptions:
         return cls()
 
     @classmethod
+    def in_process(cls, n: int) -> "MemberlistOptions":
+        """Timings for LARGE in-process clusters sharing one event loop.
+
+        The compressed ``local()`` profile collapses past ~32 co-located
+        nodes: scheduling lag makes 25 ms probe timeouts fail en masse and
+        1x-suspicion expires before refutations land, mass-killing healthy
+        nodes.  This profile keeps gossip fast but scales the failure
+        detector with cluster size: event-loop lag grows with the number of
+        co-located nodes, so probe timings stretch ~sqrt(n/64) (suspicion
+        already scales log10(n) through suspicion_mult).
+        """
+        f = max(1.0, (n / 64.0) ** 0.5)
+        return cls(
+            gossip_interval=0.02,
+            probe_interval=0.4 * f,
+            probe_timeout=0.15 * f,
+            suspicion_mult=4,
+            push_pull_interval=2.0,
+            timeout=5.0,
+        )
+
+    @classmethod
     def local(cls) -> "MemberlistOptions":
         """Compressed timings for in-process tests (reference base/tests.rs:25-39)."""
         return cls(
@@ -55,7 +77,9 @@ class MemberlistOptions:
             probe_interval=0.05,
             probe_timeout=0.025,
             suspicion_mult=1,
-            push_pull_interval=0.25,  # fast anti-entropy repair for tests
+            push_pull_interval=1.0,  # anti-entropy repair net for tests;
+                                     # hotter rates saturate big in-process
+                                     # clusters (every sync is O(N) decode)
             timeout=2.0,
         )
 
@@ -115,6 +139,18 @@ class Options:
             reconnect_interval=1.0,
             recent_intent_timeout=5.0,
             queue_check_interval=1.0,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def cluster(cls, n: int, **kw) -> "Options":
+        """Profile for large in-process clusters (see
+        MemberlistOptions.in_process)."""
+        defaults = dict(
+            memberlist=MemberlistOptions.in_process(n),
+            reap_interval=5.0,
+            reconnect_interval=5.0,
         )
         defaults.update(kw)
         return cls(**defaults)

@@ -48,6 +48,13 @@ needs_native = pytest.mark.skipif(_native.load() is None,
                                   reason="native codec unavailable (no g++?)")
 
 
+@pytest.fixture(autouse=True)
+def _always_dispatch_native(monkeypatch):
+    """The size gate (NATIVE_SCAN_MIN_BYTES) routes small bodies to Python;
+    these tests exist to exercise the native dispatch, so disable the gate."""
+    monkeypatch.setattr(codec, "NATIVE_SCAN_MIN_BYTES", 0)
+
+
 @needs_native
 def test_native_builds_and_loads():
     assert _native.load() is not None
