@@ -1,1 +1,35 @@
-"""(package)"""
+"""Device plane: the cluster simulation as HBM-resident arrays.
+
+- ``dissemination`` — fact-ring gossip (pull kernel + exact push/MXU mode)
+- ``failure`` — probe/suspect/refute/declare failure detection
+- ``antientropy`` — push/pull full sync, partition/heal
+- ``vivaldi`` — vectorized network coordinates
+- ``membership`` — serf intent views (Lamport merge semilattice)
+- ``swim`` — the composed flagship cluster round
+- ``events`` — device→host event-delta streaming
+- ``checkpoint`` — bit-exact state save/restore
+"""
+
+from serf_tpu.models.swim import (
+    ClusterConfig,
+    ClusterState,
+    cluster_round,
+    make_cluster,
+    run_cluster,
+)
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    GossipState,
+    inject_fact,
+    make_state,
+    round_step,
+    run_rounds,
+)
+from serf_tpu.models.failure import FailureConfig, run_swim, swim_round
+
+__all__ = [
+    "ClusterConfig", "ClusterState", "cluster_round", "make_cluster",
+    "run_cluster", "GossipConfig", "GossipState", "inject_fact",
+    "make_state", "round_step", "run_rounds", "FailureConfig",
+    "run_swim", "swim_round",
+]

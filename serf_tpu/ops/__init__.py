@@ -1,1 +1,2 @@
-"""(package)"""
+"""Device kernels: Pallas fused fast paths for the gossip round
+(``round_kernels``; enabled via ``GossipConfig.use_pallas``)."""

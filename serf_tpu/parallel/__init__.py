@@ -1,1 +1,6 @@
-"""(package)"""
+"""Device-mesh sharding: single-host node-axis sharding (``mesh``) and
+multi-host DCN x ICI hybrid meshes (``multihost``)."""
+
+from serf_tpu.parallel.mesh import NODE_AXIS, make_mesh, shard_state, state_shardings
+
+__all__ = ["NODE_AXIS", "make_mesh", "shard_state", "state_shardings"]

@@ -1,0 +1,85 @@
+"""Multi-host (DCN) scaling for the cluster simulation.
+
+The reference scales over real networks with NCCL-free gossip (sockets);
+the device plane scales the *simulation* over pods: hosts connect with
+``jax.distributed``, devices form a 2-D ``(dcn, ici)`` mesh, and the node
+dimension shards over both axes.  Within a host, cross-shard gossip packets
+ride ICI; across hosts, the same all-gather rides DCN.  Because the round
+kernel only ever all-gathers the small packed packet words (N×W uint32 —
+32 MB at 1M nodes), DCN bandwidth is not the bottleneck until far larger
+clusters.
+
+This module is exercised in CI only at the single-host virtual-device
+level (the environment has one chip); the multi-host entry is the standard
+``jax.distributed.initialize`` contract and is kept thin on purpose.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DCN_AXIS = "dcn"
+ICI_AXIS = "ici"
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> None:
+    """Join the jax.distributed job.
+
+    Called with no arguments, defers to ``jax.distributed.initialize()``'s
+    pod auto-detection (the natural call on a real TPU slice).  Pass
+    ``num_processes<=1`` explicitly to no-op for single-process runs.
+    """
+    if num_processes is not None and num_processes <= 1:
+        return
+    if (coordinator_address is None and num_processes is None
+            and process_id is None):
+        jax.distributed.initialize()  # TPU-pod auto-detection
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_hybrid_mesh() -> Mesh:
+    """(dcn, ici) mesh: hosts on the outer axis, local devices inner.
+
+    With one process this degenerates to ``(1, n_local_devices)``.
+    """
+    n_procs = jax.process_count()
+    local = jax.local_device_count()
+    devices = np.array(jax.devices()).reshape(n_procs, local)
+    return Mesh(devices, (DCN_AXIS, ICI_AXIS))
+
+
+def hybrid_node_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the node dimension across BOTH axes: nodes split first over
+    hosts (DCN), then over local chips (ICI)."""
+    return NamedSharding(mesh, P((DCN_AXIS, ICI_AXIS)))
+
+
+def shard_cluster_hybrid(state, mesh: Mesh):
+    """Place a ClusterState on the hybrid mesh (same rules as
+    ``serf_tpu.parallel.mesh``: per-node arrays shard, facts replicate)."""
+    from serf_tpu.parallel.mesh import NODE_AXIS, _spec_for
+
+    node_sharding = hybrid_node_sharding(mesh)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+    out = []
+    for path, leaf in flat:
+        pstr = jax.tree_util.keystr(path)
+        spec = _spec_for(pstr, leaf)
+        if spec == P(NODE_AXIS):
+            sharding = node_sharding
+        else:
+            sharding = NamedSharding(mesh, spec)
+        out.append(jax.device_put(leaf, sharding))
+    return jax.tree_util.tree_unflatten(treedef, out)

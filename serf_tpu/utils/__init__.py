@@ -1,1 +1,1 @@
-"""(package)"""
+"""Shared utilities: the metrics facade (``serf_tpu.utils.metrics``)."""

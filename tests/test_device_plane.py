@@ -386,3 +386,23 @@ def test_failure_config_rejects_oversized_suspicion_window():
     with pytest.raises(ValueError):
         FailureConfig(suspicion_rounds=300)
     FailureConfig(suspicion_rounds=254)  # boundary ok
+
+
+def test_hybrid_multihost_mesh_runs():
+    """DCN x ICI hybrid sharding: one step over the (1, n_devices) mesh on
+    this single host; multi-host is the same contract over processes."""
+    import numpy as np
+    from jax.sharding import Mesh
+    from serf_tpu.parallel import multihost
+
+    n_dev = len(jax.devices())
+    devices = np.array(jax.devices()).reshape(1, n_dev)
+    mesh = Mesh(devices, (multihost.DCN_AXIS, multihost.ICI_AXIS))
+    cfg = ClusterConfig(gossip=GossipConfig(n=128 * n_dev, k_facts=32))
+    state = make_cluster(cfg, jax.random.key(0))
+    state = state._replace(
+        gossip=inject_fact(state.gossip, cfg.gossip, 1, K_USER_EVENT, 0, 1, 0))
+    sharded = multihost.shard_cluster_hybrid(state, mesh)
+    out = jax.jit(functools.partial(cluster_round, cfg=cfg))(
+        sharded, key=jax.random.key(1))
+    assert int(out.gossip.round) == 1
