@@ -13,9 +13,8 @@ import ctypes
 import logging
 import os
 import subprocess
-import sys
 import threading
-from typing import Iterator, Optional, Tuple
+from typing import Optional
 
 log = logging.getLogger("serf_tpu.codec.native")
 

@@ -15,7 +15,7 @@ from __future__ import annotations
 import math
 import random
 import threading
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, replace
 from typing import Dict, List, Optional, Sequence
 
 from serf_tpu import codec

@@ -21,7 +21,6 @@ from serf_tpu.types.messages import (
     QueryFlag,
     QueryResponseMessage,
     encode_message,
-    encode_relay_message,
 )
 from serf_tpu.types.member import Node
 

@@ -8,7 +8,7 @@ Reference: serf-core/src/key_manager.rs:24-120 — each op broadcasts a
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Optional
 
 from serf_tpu import codec
 from serf_tpu.host.query import QueryParam

@@ -11,7 +11,7 @@ encapsulation (which is how serf-layer bytes ride in gossip packets).
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import List, Tuple
 
 from serf_tpu import codec

@@ -11,8 +11,8 @@ import asyncio
 import math
 import random
 import time
-from dataclasses import dataclass, field
-from typing import List, Optional, Sequence, Set, Tuple
+from dataclasses import dataclass
+from typing import List, Sequence, Set, Tuple
 
 from serf_tpu.types.filters import Filter
 from serf_tpu.types.member import Member, MemberStatus

@@ -20,7 +20,7 @@ import enum
 import logging
 import random
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from serf_tpu import codec
@@ -41,7 +41,6 @@ from serf_tpu.host.keyring import SecretKeyring
 from serf_tpu.host.memberlist import Memberlist, NodeState
 from serf_tpu.host.messages import SwimState
 from serf_tpu.host.query import (
-    NodeResponse,
     QueryParam,
     QueryResponse,
     default_query_timeout,
@@ -66,7 +65,6 @@ from serf_tpu.types.messages import (
     ConflictResponseMessage,
     JoinMessage,
     LeaveMessage,
-    MessageType,
     PushPullMessage,
     QueryFlag,
     QueryMessage,

@@ -29,7 +29,7 @@ a liveness mask (SURVEY.md §7 "hard parts").
 from __future__ import annotations
 
 import dataclasses
-from typing import NamedTuple, Tuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -12,7 +12,6 @@ from __future__ import annotations
 
 from typing import List, NamedTuple
 
-import jax
 import jax.numpy as jnp
 
 from serf_tpu.models.dissemination import (

@@ -21,7 +21,6 @@ import jax
 import jax.numpy as jnp
 
 from serf_tpu.models.dissemination import (
-    FactTable,
     GossipConfig,
     GossipState,
     K_ALIVE,

@@ -16,7 +16,7 @@ array over the device mesh (see ``serf_tpu.parallel``).
 from __future__ import annotations
 
 import dataclasses
-from typing import NamedTuple, Optional
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp

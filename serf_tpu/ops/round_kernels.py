@@ -23,7 +23,6 @@ unpacking uses a static repeat + per-lane shift, no gathers.
 
 from __future__ import annotations
 
-import functools
 from typing import Tuple
 
 import jax

@@ -11,7 +11,7 @@ from __future__ import annotations
 import enum
 import time as _time
 from dataclasses import dataclass, field, replace
-from typing import Optional, Tuple
+from typing import Optional
 
 from serf_tpu import codec
 from serf_tpu.types.clock import LamportTime
