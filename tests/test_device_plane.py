@@ -406,3 +406,14 @@ def test_hybrid_multihost_mesh_runs():
     out = jax.jit(functools.partial(cluster_round, cfg=cfg))(
         sharded, key=jax.random.key(1))
     assert int(out.gossip.round) == 1
+
+
+def test_10k_node_dissemination_config():
+    """Baseline config #2 at true scale: a user event over a 10k-node
+    cluster reaches full coverage within the epidemic bound."""
+    cfg = GossipConfig(n=10_000, k_facts=32)
+    s = inject_fact(make_state(cfg), cfg, 0, K_USER_EVENT, 0, 1, 0)
+    run = jax.jit(functools.partial(run_rounds, cfg=cfg),
+                  static_argnames=("num_rounds",))
+    s = run(s, key=jax.random.key(0), num_rounds=30)
+    assert float(coverage(s, cfg)[0]) == 1.0

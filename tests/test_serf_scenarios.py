@@ -293,3 +293,81 @@ def test_dropper_with_wire_options():
     net.drop_message_types(swim_types=(sm.SwimMessageType.PING,), opts=opts)
     assert net.drop_fn(0, 1, on_wire)
     net.drop_message_types()
+
+
+async def test_join_ignore_old_suppresses_event_replay():
+    """join(ignore_old=True): user events that predate the join are not
+    replayed to the newcomer (reference api.rs:318-417 event_join_ignore)."""
+    net = LoopbackNetwork()
+    created = []
+    s0 = await Serf.create(net.bind("io0"), Options.local(), "io-0")
+    created.append(s0)
+    s1 = await Serf.create(net.bind("io1"), Options.local(), "io-1")
+    created.append(s1)
+    try:
+        await s1.join("io0")
+        await wait_until(lambda: s0.num_members() == 2)
+        for i in range(3):
+            await s0.user_event(f"old-{i}", b"x", coalesce=False)
+        await wait_until(lambda: s1.event_clock.time() >= 4)
+
+        sub = EventSubscriber()
+        s2 = await Serf.create(net.bind("io2"), Options.local(), "io-2",
+                               subscriber=sub)
+        created.append(s2)
+        await s2.join("io0", ignore_old=True)
+        await wait_until(lambda: s2.num_members() == 3)
+        await asyncio.sleep(0.5)  # let any (wrong) replay arrive
+        replayed = []
+        while True:
+            ev = sub.try_next()
+            if ev is None:
+                break
+            if isinstance(ev, UserEvent) and ev.name.startswith("old-"):
+                replayed.append(ev.name)
+        assert replayed == [], f"old events replayed: {replayed}"
+        # but NEW events still flow
+        await s0.user_event("fresh", b"y", coalesce=False)
+
+        async def got_fresh():
+            while True:
+                ev = await sub.next(timeout=DEADLINE)
+                if isinstance(ev, UserEvent) and ev.name == "fresh":
+                    return True
+
+        assert await asyncio.wait_for(got_fresh(), DEADLINE)
+    finally:
+        for s in created:
+            try:
+                await s.shutdown()
+            except Exception:
+                pass
+
+
+async def test_join_without_ignore_old_replays_recent_events():
+    """Default join: the push/pull event window IS replayed to newcomers."""
+    net = LoopbackNetwork()
+    created = []
+    s0 = await Serf.create(net.bind("rp0"), Options.local(), "rp-0")
+    created.append(s0)
+    try:
+        await s0.user_event("historic", b"x", coalesce=False)
+        sub = EventSubscriber()
+        s1 = await Serf.create(net.bind("rp1"), Options.local(), "rp-1",
+                               subscriber=sub)
+        created.append(s1)
+        await s1.join("rp0")
+
+        async def got_historic():
+            while True:
+                ev = await sub.next(timeout=DEADLINE)
+                if isinstance(ev, UserEvent) and ev.name == "historic":
+                    return True
+
+        assert await asyncio.wait_for(got_historic(), DEADLINE)
+    finally:
+        for s in created:
+            try:
+                await s.shutdown()
+            except Exception:
+                pass

@@ -1,0 +1,85 @@
+"""Randomized protocol soak: a seeded storm of joins, leaves, kills,
+events, and queries against a live host cluster must never wedge the
+engine, and the survivors must converge afterwards.
+
+The randomized analog of the reference's scenario suites — operations are
+drawn from the full public API surface.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from serf_tpu.host import LoopbackNetwork, QueryParam, Serf, SerfState
+from serf_tpu.options import Options
+from serf_tpu.types.member import MemberStatus
+
+pytestmark = pytest.mark.asyncio
+
+
+@pytest.mark.parametrize("seed", [1, 2])
+async def test_randomized_soak(seed):
+    rng = random.Random(seed)
+    net = LoopbackNetwork()
+    n = 10
+    nodes = {}
+    for i in range(n):
+        nodes[i] = await Serf.create(net.bind(f"s{i}"), Options.local(),
+                                     f"soak-{i}")
+    for i in range(1, n):
+        await nodes[i].join("s0")
+    killed = set()
+    try:
+        for op in range(60):
+            choice = rng.random()
+            live = [i for i in nodes if i not in killed]
+            if not live:
+                break
+            actor = nodes[rng.choice(live)]
+            if choice < 0.15 and len(live) > 4:
+                victim = rng.choice([i for i in live if i != 0])
+                if rng.random() < 0.5:
+                    await nodes[victim].leave()
+                await nodes[victim].shutdown()
+                killed.add(victim)
+            elif choice < 0.30 and killed:
+                back = rng.choice(sorted(killed))
+                killed.discard(back)
+                nodes[back] = await Serf.create(
+                    net.bind(f"s{back}") if f"s{back}" not in net.transports
+                    else net.transports[f"s{back}"],
+                    Options.local(), f"soak-{back}")
+                await nodes[back].join(f"s{rng.choice([i for i in nodes if i not in killed and i != back])}")
+            elif choice < 0.6:
+                await actor.user_event(f"ev-{op}", bytes([op % 256]) * rng.randint(0, 50),
+                                       coalesce=False)
+            elif choice < 0.8:
+                resp = await actor.query(f"q-{op}", b"", QueryParam(timeout=0.2))
+                await resp.collect()
+            else:
+                from serf_tpu.types.tags import Tags
+                await actor.set_tags(Tags(v=str(op)))
+            if rng.random() < 0.3:
+                await asyncio.sleep(0.02)
+        # afterwards: every surviving node converges on the live membership
+        live = [i for i in nodes if i not in killed
+                and nodes[i].state == SerfState.ALIVE]
+        deadline = asyncio.get_running_loop().time() + 10.0
+        want = {f"soak-{i}" for i in live}
+        while asyncio.get_running_loop().time() < deadline:
+            views = [
+                {m.node.id for m in nodes[i].members()
+                 if m.status == MemberStatus.ALIVE} for i in live
+            ]
+            if all(v >= want for v in views):
+                break
+            await asyncio.sleep(0.05)
+        views = [{m.node.id for m in nodes[i].members()
+                  if m.status == MemberStatus.ALIVE} for i in live]
+        for v in views:
+            assert v >= want, f"seed {seed}: survivor view {v} missing {want - v}"
+    finally:
+        for i, s in nodes.items():
+            if s.state != SerfState.SHUTDOWN:
+                await s.shutdown()
