@@ -6,17 +6,26 @@ a service as a user event, every agent folds events into a local registry,
 and ``list`` answers from local state — eventually consistent by gossip.
 Queries give a consistent-read path (scatter ``list`` to all agents).
 
-Run a demo cluster in-process:
+Like the reference, agents also expose a **unix-socket RPC**: run an agent
+with real UDP/TCP networking and drive it from a client:
+
+    python examples/toyregistry.py agent /tmp/a.sock 127.0.0.1:7946 &
+    python examples/toyregistry.py agent /tmp/b.sock 127.0.0.1:7947 \
+        --join 127.0.0.1:7946 &
+    python examples/toyregistry.py client /tmp/a.sock register api 10.0.0.1:80
+    python examples/toyregistry.py client /tmp/b.sock list
+    python examples/toyregistry.py client /tmp/b.sock members
+
+Or run an in-process demo cluster:
 
     python examples/toyregistry.py demo
-
-or drive agents programmatically (see ``ToyRegistry``).
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import os
 import sys
 from typing import Dict, Optional
 
@@ -118,8 +127,97 @@ async def demo() -> None:
         await a.shutdown()
 
 
+# -- unix-socket RPC plane (the reference's clap CLI + socket, rebuilt) ------
+
+
+async def serve_agent(sock_path: str, bind: str, join: Optional[str]) -> None:
+    """Run one agent on real UDP/TCP, controllable over a unix socket with
+    line-delimited JSON: {"op": "register"|"deregister"|"list"|
+    "list-consistent"|"members"|"leave", ...}."""
+    from serf_tpu.host.net import NetTransport
+
+    host, port = bind.rsplit(":", 1)
+    transport = await NetTransport.bind((host, int(port)))
+    agent = await ToyRegistry.start(transport, Options(), f"agent@{bind}")
+    if join:
+        jh, jp = join.rsplit(":", 1)
+        await agent.serf.join((jh, int(jp)))
+
+    async def handle(reader, writer):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                try:
+                    req = json.loads(line)
+                    op = req.get("op")
+                    if op == "register":
+                        await agent.register(req["name"], req["addr"])
+                        out = {"ok": True}
+                    elif op == "deregister":
+                        await agent.deregister(req["name"])
+                        out = {"ok": True}
+                    elif op == "list":
+                        out = {"ok": True, "services": agent.list_local()}
+                    elif op == "list-consistent":
+                        out = {"ok": True,
+                               "services": await agent.list_consistent()}
+                    elif op == "members":
+                        out = {"ok": True, "members": [
+                            {"id": m.node.id, "status": m.status.name}
+                            for m in agent.serf.members()]}
+                    elif op == "leave":
+                        await agent.serf.leave()
+                        out = {"ok": True}
+                    else:
+                        out = {"ok": False, "error": f"unknown op {op!r}"}
+                except Exception as e:  # noqa: BLE001 - RPC surface
+                    out = {"ok": False, "error": str(e)}
+                writer.write((json.dumps(out) + "\n").encode())
+                await writer.drain()
+        finally:
+            writer.close()
+
+    try:
+        os.unlink(sock_path)  # stale socket from a killed agent
+    except FileNotFoundError:
+        pass
+    server = await asyncio.start_unix_server(handle, path=sock_path)
+    print(f"agent {agent.serf.local_id} up; rpc={sock_path}", flush=True)
+    async with server:
+        await server.serve_forever()
+
+
+async def client_cmd(sock_path: str, argv) -> None:
+    op = argv[0]
+    req = {"op": op}
+    if op == "register":
+        req["name"], req["addr"] = argv[1], argv[2]
+    elif op == "deregister":
+        req["name"] = argv[1]
+    reader, writer = await asyncio.open_unix_connection(sock_path)
+    writer.write((json.dumps(req) + "\n").encode())
+    await writer.drain()
+    print((await reader.readline()).decode().strip())
+    writer.close()
+
+
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] == "demo":
-        asyncio.run(demo())
-    else:
-        print(__doc__)
+    try:
+        if len(sys.argv) > 1 and sys.argv[1] == "demo":
+            asyncio.run(demo())
+        elif len(sys.argv) > 3 and sys.argv[1] == "agent":
+            join_addr = None
+            if "--join" in sys.argv:
+                idx = sys.argv.index("--join") + 1
+                if idx >= len(sys.argv):
+                    sys.exit("error: --join requires an address")
+                join_addr = sys.argv[idx]
+            asyncio.run(serve_agent(sys.argv[2], sys.argv[3], join_addr))
+        elif len(sys.argv) > 3 and sys.argv[1] == "client":
+            asyncio.run(client_cmd(sys.argv[2], sys.argv[3:]))
+        else:
+            print(__doc__)
+    except IndexError:
+        sys.exit(f"error: missing operands\n{__doc__}")

@@ -47,3 +47,54 @@ async def test_toyregistry_end_to_end():
     finally:
         for a in agents:
             await a.shutdown()
+
+
+async def test_agent_unix_socket_rpc():
+    """The toyconsul-parity socket RPC: two real-socket agents, driven
+    through their unix sockets."""
+    import json
+    import tempfile
+
+    from toyregistry import serve_agent
+
+    import socket
+
+    def free_port():
+        with socket.socket() as sk:
+            sk.bind(("127.0.0.1", 0))
+            return sk.getsockname()[1]
+
+    pa, pb = free_port(), free_port()
+    d = tempfile.mkdtemp()
+    sa, sb = f"{d}/a.sock", f"{d}/b.sock"
+    t1 = asyncio.create_task(serve_agent(sa, f"127.0.0.1:{pa}", None))
+    await asyncio.sleep(0.5)
+    t2 = asyncio.create_task(
+        serve_agent(sb, f"127.0.0.1:{pb}", f"127.0.0.1:{pa}"))
+    await asyncio.sleep(0.5)
+
+    async def rpc(sock, req):
+        reader, writer = await asyncio.open_unix_connection(sock)
+        writer.write((json.dumps(req) + "\n").encode())
+        await writer.drain()
+        out = json.loads(await reader.readline())
+        writer.close()
+        return out
+
+    try:
+        assert (await rpc(sa, {"op": "register", "name": "api",
+                               "addr": "10.0.0.1:80"}))["ok"]
+        deadline = asyncio.get_running_loop().time() + 7.0
+        while asyncio.get_running_loop().time() < deadline:
+            out = await rpc(sb, {"op": "list"})
+            if out["services"] == {"api": "10.0.0.1:80"}:
+                break
+            await asyncio.sleep(0.1)
+        assert out["services"] == {"api": "10.0.0.1:80"}
+        members = await rpc(sb, {"op": "members"})
+        assert len(members["members"]) == 2
+        bad = await rpc(sa, {"op": "nope"})
+        assert not bad["ok"]
+    finally:
+        t1.cancel()
+        t2.cancel()
