@@ -1,0 +1,21 @@
+"""Env-filtered logging bootstrap.
+
+Analog of the reference's ``SERF_TESTING_LOG`` subscriber
+(serf-core/src/lib.rs:96-114): set ``SERF_TPU_LOG=DEBUG`` (any logging
+level name) to see structured protocol decision logs.  Unknown level names
+fail loudly (logging raises ValueError) instead of silently downgrading.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+
+def setup_logging(env_var: str = "SERF_TPU_LOG") -> None:
+    level = os.environ.get(env_var)
+    if not level:
+        return
+    logging.basicConfig(
+        level=level.upper(),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")

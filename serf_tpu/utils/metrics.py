@@ -79,3 +79,4 @@ def gauge(name: str, value: float, labels: Optional[Dict[str, str]] = None) -> N
 
 def observe(name: str, value: float, labels: Optional[Dict[str, str]] = None) -> None:
     _global.observe(name, value, labels)
+

@@ -405,12 +405,16 @@ async def test_rejoin_intent_refutes_leave():
         await shutdown_all(nodes)
 
 
-async def test_net_transport_real_sockets():
-    """Conformance: a serf cluster over real UDP/TCP on 127.0.0.1
-    (reference runs its whole suite this way; we pin one end-to-end flow)."""
+@pytest.mark.parametrize("host", ["127.0.0.1", "::1"])
+async def test_net_transport_real_sockets(host):
+    """Conformance: a serf cluster over real UDP/TCP, IPv4 and IPv6
+    (the reference stamps its whole suite for both families)."""
     from serf_tpu.host.net import NetTransport
-    t0 = await NetTransport.bind(("127.0.0.1", 0))
-    t1 = await NetTransport.bind(("127.0.0.1", 0))
+    try:
+        t0 = await NetTransport.bind((host, 0))
+    except OSError:
+        pytest.skip(f"{host} unavailable")
+    t1 = await NetTransport.bind((host, 0))
     s0 = await Serf.create(t0, Options.local(), "net-0")
     s1 = await Serf.create(t1, Options.local(), "net-1")
     try:
