@@ -370,6 +370,9 @@ class Memberlist:
             except codec.DecodeError as e:
                 log.debug("dropping undecodable packet from %r: %s", src, e)
                 continue
+            except Exception:  # noqa: BLE001 - a decode bug must not kill the loop
+                log.exception("decode_swim failed on packet from %r", src)
+                continue
             for m in msg if isinstance(msg, list) else [msg]:
                 try:
                     await self._handle_message(src, m)
@@ -553,7 +556,11 @@ class Memberlist:
         if ns is None:
             return
         is_leave = d.from_node == d.node
-        if d.incarnation < ns.incarnation and not is_leave:
+        # Stale-incarnation dead/leave messages are ignored unconditionally
+        # (matching reference memberlist): a leave exemption here would let an
+        # old leave still circulating in gossip re-mark a rejoined/refuted
+        # node LEFT despite its higher incarnation, causing repeated flapping.
+        if d.incarnation < ns.incarnation:
             return
         if d.node == self.local.id:
             if not self._leaving:

@@ -320,6 +320,12 @@ _DECODERS = {
 }
 
 
+# Upper bound on total decode units (messages + nested compounds) unwound
+# from a single packet; a datagram is ≤64 KiB so a legitimate packet can
+# never approach this.
+_MAX_COMPOUND_UNITS = 4096
+
+
 def encode_swim(msg) -> bytes:
     return bytes([int(msg.TYPE)]) + msg.encode_body()
 
@@ -331,28 +337,47 @@ def encode_compound(parts: List[bytes]) -> bytes:
 
 
 def decode_swim(buf: bytes):
-    """Decode one packet; COMPOUND yields a list of messages (recursively
-    flattened).  Fails closed with DecodeError on any malformation."""
+    """Decode one packet; COMPOUND yields a list of messages (flattened).
+
+    COMPOUND nesting is unwound iteratively with an explicit work list — a
+    crafted deeply-nested datagram must not be able to exhaust the Python
+    recursion limit (that would escape the DecodeError contract and kill the
+    receive loop).  Fails closed with DecodeError on any malformation.
+    """
     if not buf:
         raise codec.DecodeError("empty swim packet")
-    try:
-        ty = SwimMessageType(buf[0])
-    except ValueError as e:
-        raise codec.DecodeError(f"unknown swim message type {buf[0]}") from e
-    body = buf[1:]
-    try:
-        if ty == SwimMessageType.COMPOUND:
-            out = []
-            for f, _w, v, _p in codec.iter_fields(body):
-                if f == 1:
-                    sub = decode_swim(codec.as_bytes(v))
-                    if isinstance(sub, list):
-                        out.extend(sub)
-                    else:
-                        out.append(sub)
-            return out
-        return _DECODERS[ty](body)
-    except codec.DecodeError:
-        raise
-    except (AttributeError, TypeError, UnicodeDecodeError, ValueError) as e:
-        raise codec.DecodeError(f"malformed {ty.name} body: {e}") from e
+
+    def _type_of(b: bytes) -> SwimMessageType:
+        if not b:
+            raise codec.DecodeError("empty swim packet")
+        try:
+            return SwimMessageType(b[0])
+        except ValueError as e:
+            raise codec.DecodeError(f"unknown swim message type {b[0]}") from e
+
+    top = _type_of(buf)
+    is_compound = top == SwimMessageType.COMPOUND
+    out = []
+    work: List[bytes] = [buf]
+    units = 0
+    while work:
+        cur = work.pop()
+        units += 1
+        if units > _MAX_COMPOUND_UNITS:
+            raise codec.DecodeError(
+                f"compound packet exceeds {_MAX_COMPOUND_UNITS} units")
+        ty = _type_of(cur)
+        body = cur[1:]
+        try:
+            if ty == SwimMessageType.COMPOUND:
+                # push in reverse so nested parts decode in wire order
+                parts = [codec.as_bytes(v)
+                         for f, _w, v, _p in codec.iter_fields(body) if f == 1]
+                work.extend(reversed(parts))
+            else:
+                out.append(_DECODERS[ty](body))
+        except codec.DecodeError:
+            raise
+        except (AttributeError, TypeError, UnicodeDecodeError, ValueError) as e:
+            raise codec.DecodeError(f"malformed {ty.name} body: {e}") from e
+    return out if is_compound else out[0]

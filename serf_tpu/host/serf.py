@@ -432,7 +432,8 @@ class Serf:
                 opts.snapshot_path, replay, s._labels,
                 clock_fn=lambda: (s.clock.time(), s.event_clock.time(),
                                   s.query_clock.time()),
-                min_compact_size=opts.snapshot_min_compact_size)
+                min_compact_size=opts.snapshot_min_compact_size,
+                rejoin_after_leave=opts.rejoin_after_leave)
             s._tasks.append(asyncio.create_task(
                 s.snapshotter.run(), name=f"serf-snapshot-{node_id}"))
 

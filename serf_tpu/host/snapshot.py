@@ -124,11 +124,13 @@ class Snapshotter:
 
     def __init__(self, path: str, replay: ReplayResult, labels=None,
                  clock_fn: Optional[Callable[[], Tuple[int, int, int]]] = None,
-                 min_compact_size: int = 128 * 1024):
+                 min_compact_size: int = 128 * 1024,
+                 rejoin_after_leave: bool = False):
         self.path = path
         self.labels = labels
         self.clock_fn = clock_fn
         self.min_compact_size = min_compact_size
+        self.rejoin_after_leave = rejoin_after_leave
         self.left_before = replay.left_before
         self._alive: Dict[str, Node] = {n.id: n for n in replay.alive_nodes}
         self._last_clocks = (replay.last_clock, replay.last_event_clock,
@@ -136,11 +138,12 @@ class Snapshotter:
         self._f = open(path, "ab")
         self._dirty = False
         self._stopped = False
+        self._leaving = False
 
     # -- event tee (called synchronously from the serf event pipeline) -----
 
     def observe(self, ev) -> None:
-        if self._stopped:
+        if self._stopped or self._leaving:
             return
         if isinstance(ev, MemberEvent):
             if ev.ty in (MemberEventType.JOIN, MemberEventType.UPDATE):
@@ -200,7 +203,10 @@ class Snapshotter:
             return
         threshold = max(self.min_compact_size,
                         2 * MEMBER_RECORD_SIZE_HINT * max(1, len(self._alive)))
-        if size <= threshold:
+        if size <= threshold or self._leaving:
+            # after leave(), a compaction would rewrite the log without the
+            # leave record and with the full alive set — a restart would then
+            # auto-rejoin a cluster the operator deliberately left
             return
         start = time.monotonic()
         tmp = self.path + ".compact"
@@ -226,8 +232,13 @@ class Snapshotter:
 
     async def leave(self) -> None:
         """Mark a deliberate leave so restart does not auto-rejoin
-        (reference snapshot.rs:562-579)."""
+        (reference snapshot.rs:562-579): append the leave record, then stop
+        recording and compacting, and drop the alive set unless the operator
+        asked to rejoin after leave."""
         self._append(R_LEAVE)
+        self._leaving = True
+        if not self.rejoin_after_leave:
+            self._alive.clear()
         self._fsync()
 
     async def shutdown(self) -> None:

@@ -26,7 +26,7 @@ from serf_tpu.models.dissemination import (
     K_ALIVE,
     K_DEAD,
     K_SUSPECT,
-    inject_fact,
+    inject_facts_batch,
     round_step,
     unpack_bits,
 )
@@ -74,30 +74,26 @@ def _bounded_inject(state: GossipState, cfg: GossipConfig, candidates,
     """Inject up to ``max_new`` facts for candidate subjects (bool[N]).
 
     Random tie-break keeps the choice unbiased; static-shape top_k keeps it
-    jit-compatible.  Non-candidates inject a no-op (slot overwritten with
-    valid=False is avoided by gating on ``any``: we gate with lax.cond-free
-    masking — an invalid injection writes subject=-1, valid=False).
+    jit-compatible.  Real candidates come out of top_k as a contiguous
+    prefix (their scores are > 0, non-candidates score 0), so the whole
+    batch lands in one masked multi-slot scatter — no per-candidate copy of
+    the cluster state.
     """
     n = cfg.n
     score = candidates.astype(jnp.float32) * (
         1.0 + jax.random.uniform(key, (n,)))
     vals, idx = jax.lax.top_k(score, max_new)
-    for i in range(max_new):
-        subject = idx[i]
-        is_real = vals[i] > 0.0
-        st2 = inject_fact(
-            state, cfg,
-            subject=jnp.where(is_real, subject, -1),
-            kind=jnp.where(is_real, jnp.uint8(kind), jnp.uint8(0)),
-            incarnation=incarnations[subject],
-            ltime=state.round.astype(jnp.uint32),
-            origin=origins[subject],
-        )
-        # only advance the ring if a real fact was written; otherwise keep
-        # the previous state entirely
-        state = jax.tree_util.tree_map(
-            lambda new, old: jnp.where(is_real, new, old), st2, state)
-    return state
+    active = vals > 0.0
+    subjects = idx.astype(jnp.int32)
+    return inject_facts_batch(
+        state, cfg,
+        subjects=subjects,
+        kind=kind,
+        incarnations=incarnations[subjects],
+        ltimes=jnp.full((max_new,), state.round.astype(jnp.uint32)),
+        origins=origins[subjects],
+        active=active,
+    )
 
 
 def probe_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,

@@ -14,6 +14,11 @@ from typing import Dict, List, Optional, Tuple
 
 LabelSet = Tuple[Tuple[str, str], ...]
 
+# Histograms keep summary stats plus a fixed-size ring of recent samples;
+# observe() is called per packet sent/received, so raw samples must never
+# accumulate unboundedly in a long-running agent.
+HISTOGRAM_RING_SIZE = 256
+
 
 def _labels(labels: Optional[Dict[str, str]]) -> LabelSet:
     if not labels:
@@ -21,12 +26,43 @@ def _labels(labels: Optional[Dict[str, str]]) -> LabelSet:
     return tuple(sorted(labels.items()))
 
 
+class HistogramSummary:
+    __slots__ = ("count", "total", "min", "max", "_ring", "_pos")
+
+    def __init__(self, ring_size: int = HISTOGRAM_RING_SIZE):
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self._ring: List[float] = [0.0] * ring_size
+        self._pos = 0
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+        self._ring[self._pos] = value
+        self._pos = (self._pos + 1) % len(self._ring)
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def recent(self) -> List[float]:
+        """Last ≤ring_size samples, oldest first."""
+        if self.count >= len(self._ring):
+            return self._ring[self._pos:] + self._ring[:self._pos]
+        return self._ring[:self._pos]
+
+
 class MetricsSink:
     def __init__(self):
         self._lock = threading.Lock()
         self.counters: Dict[Tuple[str, LabelSet], float] = defaultdict(float)
         self.gauges: Dict[Tuple[str, LabelSet], float] = {}
-        self.histograms: Dict[Tuple[str, LabelSet], List[float]] = defaultdict(list)
+        self.histograms: Dict[Tuple[str, LabelSet], HistogramSummary] = (
+            defaultdict(HistogramSummary))
 
     def incr(self, name: str, value: float = 1.0, labels: Optional[Dict[str, str]] = None) -> None:
         with self._lock:
@@ -38,7 +74,7 @@ class MetricsSink:
 
     def observe(self, name: str, value: float, labels: Optional[Dict[str, str]] = None) -> None:
         with self._lock:
-            self.histograms[(name, _labels(labels))].append(value)
+            self.histograms[(name, _labels(labels))].observe(value)
 
     # inspection helpers (tests, stats)
     def counter(self, name: str, labels: Optional[Dict[str, str]] = None) -> float:
@@ -48,7 +84,12 @@ class MetricsSink:
         return self.gauges.get((name, _labels(labels)))
 
     def histogram(self, name: str, labels: Optional[Dict[str, str]] = None) -> List[float]:
-        return self.histograms.get((name, _labels(labels)), [])
+        """Recent samples (bounded ring) for the named histogram."""
+        h = self.histograms.get((name, _labels(labels)))
+        return h.recent() if h is not None else []
+
+    def histogram_summary(self, name: str, labels: Optional[Dict[str, str]] = None) -> Optional[HistogramSummary]:
+        return self.histograms.get((name, _labels(labels)))
 
     def reset(self) -> None:
         with self._lock:

@@ -1,0 +1,170 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md).
+
+1. A crafted deeply-nested COMPOUND datagram must raise DecodeError, not
+   RecursionError (remote one-packet DoS on the receive loop).
+2. Stale-incarnation leave messages must be ignored (no re-mark of a
+   rejoined/refuted node as LEFT).
+3. Snapshotter.leave() must stop recording/compaction and clear the alive
+   set, so a restart does not auto-rejoin a deliberately-left cluster.
+4. MetricsSink.observe must keep bounded state, not append raw samples
+   forever.
+"""
+
+import asyncio
+
+import pytest
+
+from serf_tpu import codec
+from serf_tpu.host import messages as sm
+from serf_tpu.host.memberlist import Memberlist, NodeState
+from serf_tpu.host.messages import SwimState
+from serf_tpu.host.transport import LoopbackNetwork
+from serf_tpu.options import MemberlistOptions
+from serf_tpu.types.member import Node
+
+
+# ---------------------------------------------------------------------------
+# 1. COMPOUND nesting bomb
+# ---------------------------------------------------------------------------
+
+def _nested_compound(depth: int, leaf: bytes) -> bytes:
+    pkt = leaf
+    for _ in range(depth):
+        pkt = sm.encode_compound([pkt])
+    return pkt
+
+
+def test_compound_bomb_raises_decode_error_not_recursion():
+    leaf = sm.encode_swim(sm.Ping(1, Node("a", "x"), "b"))
+    # ~4k nesting levels fits in an ~8-16KB datagram and previously blew the
+    # Python recursion limit, escaping the DecodeError contract.
+    bomb = _nested_compound(5000, leaf)
+    with pytest.raises(codec.DecodeError):
+        sm.decode_swim(bomb)
+
+
+def test_compound_moderate_nesting_decodes_in_order():
+    p1 = sm.encode_swim(sm.Ping(1, Node("a", "x"), "b"))
+    p2 = sm.encode_swim(sm.Ping(2, Node("c", "y"), "d"))
+    p3 = sm.encode_swim(sm.Ping(3, Node("e", "z"), "f"))
+    pkt = sm.encode_compound([p1, sm.encode_compound([p2, p3])])
+    out = sm.decode_swim(pkt)
+    assert [m.seq for m in out] == [1, 2, 3]
+
+
+def test_compound_deep_but_legit_nesting_ok():
+    leaf = sm.encode_swim(sm.Ping(7, Node("a", "x"), "b"))
+    pkt = _nested_compound(64, leaf)
+    out = sm.decode_swim(pkt)
+    assert len(out) == 1 and out[0].seq == 7
+
+
+# ---------------------------------------------------------------------------
+# 2. stale-incarnation leave
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_stale_leave_does_not_remark_refuted_node():
+    net = LoopbackNetwork()
+    ml = Memberlist(net.bind("addr-0"), MemberlistOptions.local(), "node-0")
+    await ml.start()
+    try:
+        ml._nodes["node-1"] = NodeState(Node("node-1", "addr-1"),
+                                        incarnation=5, state=SwimState.ALIVE)
+        # an old leave (incarnation 3) still circulating in gossip
+        ml._handle_dead(sm.Dead(3, "node-1", "node-1"))
+        assert ml._nodes["node-1"].state == SwimState.ALIVE
+        # a current leave is honored
+        ml._handle_dead(sm.Dead(5, "node-1", "node-1"))
+        assert ml._nodes["node-1"].state == SwimState.LEFT
+    finally:
+        await ml.shutdown()
+
+
+@pytest.mark.asyncio
+async def test_stale_dead_from_third_party_still_ignored():
+    net = LoopbackNetwork()
+    ml = Memberlist(net.bind("addr-0"), MemberlistOptions.local(), "node-0")
+    await ml.start()
+    try:
+        ml._nodes["node-1"] = NodeState(Node("node-1", "addr-1"),
+                                        incarnation=5, state=SwimState.ALIVE)
+        ml._handle_dead(sm.Dead(4, "node-1", "node-2"))
+        assert ml._nodes["node-1"].state == SwimState.ALIVE
+    finally:
+        await ml.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 3. snapshot leave vs compaction
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_snapshot_leave_survives_compaction(tmp_path):
+    from serf_tpu.host.events import MemberEvent, MemberEventType
+    from serf_tpu.host.snapshot import (R_LEAVE, Snapshotter,
+                                        open_and_replay_snapshot)
+    from serf_tpu.types.member import Member
+
+    path = str(tmp_path / "snap.db")
+    snap = Snapshotter(path, open_and_replay_snapshot(path),
+                       min_compact_size=64)
+    members = [Member(Node(f"node-{i}", f"addr-{i}")) for i in range(8)]
+    snap.observe(MemberEvent(MemberEventType.JOIN, tuple(members)))
+    await snap.leave()
+    # post-leave observations and compactions must be suppressed
+    snap.observe(MemberEvent(MemberEventType.JOIN,
+                             (Member(Node("late", "addr-x")),)))
+    snap._maybe_compact()  # would previously rewrite the log w/o the leave
+    await snap.shutdown()
+
+    replay = open_and_replay_snapshot(path, rejoin_after_leave=False)
+    assert replay.left_before
+    assert replay.alive_nodes == []
+
+
+@pytest.mark.asyncio
+async def test_snapshot_leave_keeps_alive_set_when_rejoin_after_leave(tmp_path):
+    from serf_tpu.host.events import MemberEvent, MemberEventType
+    from serf_tpu.host.snapshot import Snapshotter, open_and_replay_snapshot
+    from serf_tpu.types.member import Member
+
+    path = str(tmp_path / "snap.db")
+    snap = Snapshotter(path, open_and_replay_snapshot(path),
+                       rejoin_after_leave=True)
+    snap.observe(MemberEvent(MemberEventType.JOIN,
+                             (Member(Node("peer", "addr-1")),)))
+    await snap.leave()
+    assert "peer" in snap._alive  # kept for rejoin
+    await snap.shutdown()
+    replay = open_and_replay_snapshot(path, rejoin_after_leave=True)
+    assert replay.left_before
+    assert [n.id for n in replay.alive_nodes] == ["peer"]
+
+
+# ---------------------------------------------------------------------------
+# 4. bounded metrics
+# ---------------------------------------------------------------------------
+
+def test_metrics_histograms_are_bounded():
+    from serf_tpu.utils.metrics import HISTOGRAM_RING_SIZE, MetricsSink
+
+    sink = MetricsSink()
+    n = HISTOGRAM_RING_SIZE * 4
+    for i in range(n):
+        sink.observe("pkt.size", float(i))
+    summ = sink.histogram_summary("pkt.size")
+    assert summ.count == n
+    assert summ.min == 0.0 and summ.max == float(n - 1)
+    assert summ.mean == pytest.approx((n - 1) / 2)
+    recent = sink.histogram("pkt.size")
+    assert len(recent) == HISTOGRAM_RING_SIZE
+    # ring holds the most recent samples, oldest first
+    assert recent[0] == float(n - HISTOGRAM_RING_SIZE)
+    assert recent[-1] == float(n - 1)
+
+
+def test_compound_with_empty_part_raises_decode_error():
+    pkt = sm.encode_compound([b""])
+    with pytest.raises(codec.DecodeError):
+        sm.decode_swim(pkt)

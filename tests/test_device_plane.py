@@ -417,3 +417,88 @@ def test_10k_node_dissemination_config():
                   static_argnames=("num_rounds",))
     s = run(s, key=jax.random.key(0), num_rounds=30)
     assert float(coverage(s, cfg)[0]) == 1.0
+
+
+def test_inject_facts_batch_matches_sequential_inject():
+    """The one-scatter batched injection must be state-identical to the
+    sequential inject_fact loop it replaced (round-1 verdict, weak #7)."""
+    from serf_tpu.models.dissemination import (FactTable, GossipState,
+                                                inject_facts_batch)
+
+    cfg = GossipConfig(n=64, k_facts=32, fanout=2)
+    rng = random.Random(7)
+
+    for trial in range(20):
+        state = make_state(cfg)
+        # pre-populate a few slots so retirement/clearing is exercised
+        for s in range(rng.randrange(0, 5)):
+            state = inject_fact(state, cfg, subject=rng.randrange(cfg.n),
+                                kind=K_USER_EVENT, incarnation=1,
+                                ltime=s, origin=rng.randrange(cfg.n))
+        state = state._replace(round=jnp.asarray(rng.randrange(50), jnp.int32))
+
+        m = 8
+        n_real = rng.randrange(0, m + 1)
+        subjects = [rng.randrange(cfg.n) for _ in range(m)]
+        origins = [rng.randrange(cfg.n) for _ in range(m)]
+        incs = [rng.randrange(1, 5) for _ in range(m)]
+        active = [i < n_real for i in range(m)]
+
+        seq = state
+        for i in range(m):
+            if active[i]:
+                seq = inject_fact(seq, cfg, subject=subjects[i], kind=K_SUSPECT,
+                                  incarnation=incs[i],
+                                  ltime=int(state.round), origin=origins[i])
+
+        batch = inject_facts_batch(
+            state, cfg,
+            subjects=jnp.asarray(subjects, jnp.int32),
+            kind=K_SUSPECT,
+            incarnations=jnp.asarray(incs, jnp.uint32),
+            ltimes=jnp.full((m,), int(state.round), jnp.uint32),
+            origins=jnp.asarray(origins, jnp.int32),
+            active=jnp.asarray(active),
+        )
+
+        for name in GossipState._fields:
+            a, b = getattr(seq, name), getattr(batch, name)
+            if name == "facts":
+                for fn in FactTable._fields:
+                    assert jnp.array_equal(getattr(a, fn), getattr(b, fn)), \
+                        f"trial {trial}: facts.{fn} mismatch"
+            else:
+                assert jnp.array_equal(a, b), f"trial {trial}: {name} mismatch"
+
+
+def test_inject_facts_batch_jaxpr_has_no_per_candidate_state_copies():
+    """The batched injection must not materialize per-candidate copies of the
+    N×K planes: the jaxpr should contain O(1) select_n ops over the budgets/
+    age planes, not O(max_new)."""
+    from serf_tpu.models.dissemination import inject_facts_batch
+
+    cfg = GossipConfig(n=256, k_facts=64)
+    state = make_state(cfg)
+    m = 8
+
+    def f(state):
+        return inject_facts_batch(
+            state, cfg,
+            subjects=jnp.arange(m, dtype=jnp.int32),
+            kind=K_SUSPECT,
+            incarnations=jnp.ones((m,), jnp.uint32),
+            ltimes=jnp.zeros((m,), jnp.uint32),
+            origins=jnp.arange(m, dtype=jnp.int32),
+            active=jnp.ones((m,), bool),
+        )
+
+    jaxpr = jax.make_jaxpr(f)(state)
+    text = str(jaxpr)
+    # count full-plane selects — jaxpr renders them as e.g.
+    # "c:u8[256,64] = select_n ...".  One each for budgets and age (plus
+    # incidental known-plane ops) is fine; one-per-candidate (8+) is the
+    # regression this guards against.
+    import re
+    full_plane = re.findall(r"\[256,64\] = select_n|\[256,2\] = select_n", text)
+    assert 1 <= len(full_plane) <= 4, \
+        f"expected 1-4 full-plane select_n ops, found {len(full_plane)}"
