@@ -8,6 +8,7 @@
 - ``swim`` — the composed flagship cluster round
 - ``events`` — device→host event-delta streaming
 - ``checkpoint`` — bit-exact state save/restore
+- ``query`` — scatter/filter/gather query engine + conflict majority vote
 """
 
 from serf_tpu.models.swim import (
@@ -26,10 +27,19 @@ from serf_tpu.models.dissemination import (
     run_rounds,
 )
 from serf_tpu.models.failure import FailureConfig, run_swim, swim_round
+from serf_tpu.models.query import (
+    QueryConfig,
+    QueryState,
+    launch_query,
+    make_queries,
+    majority_vote,
+    query_round,
+)
 
 __all__ = [
     "ClusterConfig", "ClusterState", "cluster_round", "make_cluster",
     "run_cluster", "GossipConfig", "GossipState", "inject_fact",
     "make_state", "round_step", "run_rounds", "FailureConfig",
-    "run_swim", "swim_round",
+    "run_swim", "swim_round", "QueryConfig", "QueryState", "launch_query",
+    "make_queries", "majority_vote", "query_round",
 ]

@@ -1,0 +1,138 @@
+"""Poisson churn: leave/fail/rejoin processes driving the device cluster.
+
+Baseline config #3 ("100k nodes, Poisson churn") and the richer scenario
+library the reference exercises through shutdown/restart tests
+(SURVEY.md §4; serf-core/src/serf/base/tests/serf.rs:163-258).  Per-round,
+per-node event probabilities are Poisson thinning: with per-round rate λ a
+node fires with p = 1−e^{−λ} ≈ λ for the small rates churn uses.
+
+Event kinds:
+
+- **fail**: the node crashes silently — no announcement; the SWIM failure
+  detector must notice (probe → suspect → declare).
+- **leave**: graceful — the node announces a ``K_LEAVE`` intent fact (the
+  device analog of the reference's LeaveMessage broadcast,
+  base.rs:1442-1572), participates in ONE more gossip round so the
+  announcement actually leaves the building (the reference's
+  ``leave_propagate_delay``), then goes dark.
+- **rejoin**: a dead node returns with a bumped incarnation and announces a
+  ``K_ALIVE`` fact, refuting any standing suspicion/death facts (the
+  reference's restart-on-same-address scenario).
+
+Per-round events are capped at ``max_events`` per kind (the same bounded
+injection discipline as the failure detector); sampled candidates beyond
+the cap simply don't fire that round, keeping rates honest in expectation.
+All randomness is explicit PRNG keys; the churn masks are ordinary traced
+tensors, so the whole process jits and scans.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    GossipState,
+    K_ALIVE,
+    K_LEAVE,
+    inject_facts_batch,
+    pick_bounded,
+)
+from serf_tpu.models.swim import ClusterConfig, ClusterState, cluster_round
+
+
+@dataclasses.dataclass(frozen=True)
+class ChurnConfig:
+    fail_rate: float = 0.0      # per-alive-node per-round crash probability
+    leave_rate: float = 0.0     # per-alive-node per-round graceful-leave prob
+    rejoin_rate: float = 0.0    # per-dead-node per-round rejoin probability
+    max_events: int = 8         # cap per kind per round (bounded injection)
+
+
+def churn_round(state: GossipState, cfg: GossipConfig, ccfg: ChurnConfig,
+                key: jax.Array):
+    """Sample and apply one round of churn events to the gossip substate.
+
+    Returns ``(state, pending_down)``: fails and rejoins take effect
+    immediately; graceful leavers have announced their ``K_LEAVE`` fact but
+    stay alive until the caller applies ``pending_down`` AFTER the next
+    gossip round — otherwise the dead-sender masking in ``round_step``
+    would silence the announcement before it ever leaves the origin.
+    """
+    n = cfg.n
+    k_f, k_l, k_r, k_pf, k_pl, k_pr = jax.random.split(key, 6)
+
+    want_fail = jax.random.bernoulli(k_f, ccfg.fail_rate, (n,)) & state.alive
+    want_leave = (jax.random.bernoulli(k_l, ccfg.leave_rate, (n,))
+                  & state.alive & ~want_fail)
+    want_rejoin = (jax.random.bernoulli(k_r, ccfg.rejoin_rate, (n,))
+                   & ~state.alive)
+
+    fails, _, _ = pick_bounded(want_fail, ccfg.max_events, k_pf)
+    leaves, leave_subj, leave_act = pick_bounded(
+        want_leave, ccfg.max_events, k_pl)
+    rejoins, rejoin_subj, rejoin_act = pick_bounded(
+        want_rejoin, ccfg.max_events, k_pr)
+
+    # a rejoiner returns with a bumped incarnation so its alive
+    # announcement refutes standing suspect/dead facts
+    incarnation = jnp.where(rejoins, state.incarnation + 1, state.incarnation)
+    alive = (state.alive & ~fails) | rejoins
+    state = state._replace(alive=alive, incarnation=incarnation)
+
+    ltime = state.round.astype(jnp.uint32)
+    if ccfg.leave_rate > 0:
+        state = inject_facts_batch(
+            state, cfg, subjects=leave_subj, kind=K_LEAVE,
+            incarnations=incarnation[leave_subj],
+            ltimes=jnp.full((ccfg.max_events,), ltime),
+            origins=leave_subj, active=leave_act)
+    if ccfg.rejoin_rate > 0:
+        state = inject_facts_batch(
+            state, cfg, subjects=rejoin_subj, kind=K_ALIVE,
+            incarnations=incarnation[rejoin_subj],
+            ltimes=jnp.full((ccfg.max_events,), ltime),
+            origins=rejoin_subj, active=rejoin_act)
+    return state, leaves
+
+
+class ChurnTrace(NamedTuple):
+    """Ground-truth bookkeeping carried through a churned run."""
+
+    ever_down: jnp.ndarray     # bool[N] was non-alive at any point
+    always_up: jnp.ndarray     # bool[N] alive through the whole run
+
+
+def run_cluster_churn(state: ClusterState, cfg: ClusterConfig,
+                      ccfg: ChurnConfig, key: jax.Array, num_rounds: int):
+    """lax.scan driver: churn + full protocol round, with ground-truth trace.
+
+    Returns ``(final ClusterState, ChurnTrace)`` — the trace is what churn
+    assertions need: nodes that were **always up** must never be believed
+    dead (no false deaths), nodes down at the end must be detected within
+    the suspicion window.
+    """
+    n = cfg.n
+    trace = ChurnTrace(ever_down=~state.gossip.alive,
+                       always_up=state.gossip.alive)
+
+    def body(carry, subkey):
+        st, tr = carry
+        k_churn, k_round = jax.random.split(subkey)
+        g, pending_down = churn_round(st.gossip, cfg.gossip, ccfg, k_churn)
+        st = st._replace(gossip=g)
+        st = cluster_round(st, cfg, k_round)
+        # leavers gossiped their announcement this round; now they go dark
+        g = st.gossip
+        st = st._replace(gossip=g._replace(alive=g.alive & ~pending_down))
+        tr = ChurnTrace(ever_down=tr.ever_down | ~st.gossip.alive,
+                        always_up=tr.always_up & st.gossip.alive)
+        return (st, tr), ()
+
+    keys = jax.random.split(key, num_rounds)
+    (final, trace), _ = jax.lax.scan(body, (state, trace), keys)
+    return final, trace

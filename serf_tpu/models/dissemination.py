@@ -43,6 +43,7 @@ K_ALIVE = 3       # swim alive (incarnation-ordered; refutes suspect/dead)
 K_SUSPECT = 4     # swim suspicion (starts a timer at each knower)
 K_DEAD = 5        # swim death declaration
 K_USER_EVENT = 6  # user event broadcast (subject = event id)
+K_QUERY = 7       # query scatter (subject = query slot id; models/query.py)
 
 
 class FactTable(NamedTuple):
@@ -225,6 +226,26 @@ def inject_facts_batch(state: GossipState, cfg: GossipConfig, subjects,
     return state._replace(facts=facts, known=known, budgets=budgets, age=age,
                           next_slot=state.next_slot
                           + jnp.sum(active).astype(jnp.int32))
+
+
+def pick_bounded(candidates: jnp.ndarray, max_events: int, key: jax.Array):
+    """Unbiased bounded selection: choose ≤``max_events`` of the candidate
+    nodes (bool[N]) by randomized top-k.
+
+    Returns ``(chosen bool[N], subjects i32[M], active bool[M])``; the
+    active entries are a contiguous prefix — exactly the
+    ``inject_facts_batch`` contract (real candidates score > 0, others 0,
+    and top_k sorts descending).
+    """
+    n = candidates.shape[0]
+    score = candidates.astype(jnp.float32) * (
+        1.0 + jax.random.uniform(key, (n,)))
+    vals, idx = jax.lax.top_k(score, max_events)
+    active = vals > 0.0
+    subjects = idx.astype(jnp.int32)
+    chosen = jnp.zeros((n,), bool).at[
+        jnp.where(active, subjects, n)].set(True, mode="drop")
+    return chosen, subjects, active
 
 
 # -- the gossip round kernel -------------------------------------------------

@@ -27,6 +27,7 @@ from serf_tpu.models.dissemination import (
     K_DEAD,
     K_SUSPECT,
     inject_facts_batch,
+    pick_bounded,
     round_step,
     unpack_bits,
 )
@@ -36,7 +37,8 @@ from serf_tpu.models.dissemination import (
 class FailureConfig:
     suspicion_rounds: int = 12     # suspicion timeout in gossip rounds
     max_new_facts: int = 8         # injection bound per category per round
-    probe_drop_rate: float = 0.0   # chance an ack is lost (fault injection)
+    probe_drop_rate: float = 0.0   # chance any one probe path is lost
+    indirect_probes: int = 3       # SWIM indirect-probe helpers (k)
 
     def __post_init__(self):
         # knowledge age is a saturating uint8; 255 is the never-known
@@ -79,12 +81,7 @@ def _bounded_inject(state: GossipState, cfg: GossipConfig, candidates,
     batch lands in one masked multi-slot scatter — no per-candidate copy of
     the cluster state.
     """
-    n = cfg.n
-    score = candidates.astype(jnp.float32) * (
-        1.0 + jax.random.uniform(key, (n,)))
-    vals, idx = jax.lax.top_k(score, max_new)
-    active = vals > 0.0
-    subjects = idx.astype(jnp.int32)
+    _, subjects, active = pick_bounded(candidates, max_new, key)
     return inject_facts_batch(
         state, cfg,
         subjects=subjects,
@@ -98,13 +95,29 @@ def _bounded_inject(state: GossipState, cfg: GossipConfig, candidates,
 
 def probe_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
                 key: jax.Array) -> GossipState:
-    """Probe + suspicion injection."""
+    """Probe + indirect probes + suspicion injection.
+
+    SWIM semantics: a missed direct ack falls back to ``indirect_probes``
+    helper paths (reference memberlist probe loop, SURVEY.md §2.9); only a
+    target unreachable on EVERY path is suspected.  That makes the false-
+    suspicion probability ~drop^(1+k) per probe — without it, realistic
+    packet loss at 100k nodes floods the fact ring with false suspicions
+    every round and starves real death declarations of ring residency.
+    """
     n = cfg.n
-    k_target, k_drop, k_pick = jax.random.split(key, 3)
+    k_target, k_drop, k_help, k_hdrop, k_pick = jax.random.split(key, 5)
     targets = jax.random.randint(k_target, (n,), 0, n)
     dropped = jax.random.bernoulli(k_drop, fcfg.probe_drop_rate, (n,))
     prober_ok = state.alive
-    ack = state.alive[targets] & ~dropped
+    target_up = state.alive[targets]
+    ack = target_up & ~dropped
+    if fcfg.indirect_probes > 0:
+        ki = fcfg.indirect_probes
+        helpers = jax.random.randint(k_help, (n, ki), 0, n)
+        helper_ok = state.alive[helpers]                       # bool[N, ki]
+        h_drop = jax.random.bernoulli(k_hdrop, fcfg.probe_drop_rate, (n, ki))
+        ack_indirect = target_up[:, None] & helper_ok & ~h_drop
+        ack = ack | jnp.any(ack_indirect, axis=1)
     detected = prober_ok & ~ack & (targets != jnp.arange(n))
 
     # which subjects were detected, and by whom.  The scatter must be masked:

@@ -1,0 +1,262 @@
+"""Device-plane query engine: scatter a question, gather acks + responses.
+
+The TPU-native vectorization of the serf query machinery (SURVEY.md §7
+stage 7; reference serf-core/src/serf/query.rs:388-601, base.rs:972-1154,
+1655-1780):
+
+- **Scatter**: a query is a fact of kind ``K_QUERY`` in the shared gossip
+  ring — dissemination to every node is the same transmit-limited gossip
+  that carries intents and user events (reference: query_broadcasts queue).
+- **Filters**: the reference evaluates Id-list and Tag-regex filters per
+  node (query.rs:439-521).  On device a filter is a precomputed eligibility
+  mask ``bool[N]`` — ``id_filter_mask`` / ``tag_filter_mask`` build the two
+  reference filter kinds from an id list / a tag plane.
+- **Ack/response gather**: a node that learns the query, passes the filter,
+  and is alive "sends" an ack (if requested) and a response to the origin —
+  delivery is direct plus ``relay_factor`` relayed copies through random
+  alive intermediates (reference relay_response, query.rs:523-601); a
+  message arrives if ANY path survives the drop masks.  Duplicate delivery
+  dedups by construction (boolean OR — the reference's per-source dedup
+  sets, query.rs:240-369).
+- **Timeouts**: a query closes after ``timeout_rounds``; the default is the
+  reference's ``mult × ceil(log10(N+1))`` in gossip rounds
+  (query.rs:421-427 with the gossip interval factored out).
+- **Conflict resolution**: ``majority_vote`` is the segment-sum form of
+  ``resolve_node_conflict`` (base.rs:1655-1780): bincount responder votes,
+  winner must hold a strict majority of responses.
+
+Fault injection (per-path drop masks) is an input tensor, like everywhere
+else on the device plane.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    GossipState,
+    K_QUERY,
+    inject_fact,
+    unpack_bits,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class QueryConfig:
+    """Static query-engine shapes + protocol constants."""
+
+    q_slots: int = 8           # concurrent in-flight query capacity (ring)
+    relay_factor: int = 0      # relayed response copies (reference ≤5)
+    timeout_mult: int = 16     # reference query_timeout_mult
+
+    def __post_init__(self):
+        if not (0 <= self.relay_factor <= 5):
+            raise ValueError("relay_factor must be in [0, 5] (reference cap)")
+
+
+def default_timeout_rounds(n: int, timeout_mult: int = 16) -> int:
+    """Query deadline in gossip rounds: mult × ceil(log10(N+1))."""
+    return timeout_mult * max(1, math.ceil(math.log10(n + 1)))
+
+
+class QueryState(NamedTuple):
+    """Q in-flight queries over an N-node cluster, struct-of-arrays."""
+
+    origin: jnp.ndarray      # i32[Q] originating node
+    fact_slot: jnp.ndarray   # i32[Q] gossip-ring slot carrying the query
+    ltime: jnp.ndarray       # u32[Q] query lamport time
+    deadline: jnp.ndarray    # i32[Q] round after which the query is closed
+    want_ack: jnp.ndarray    # bool[Q]
+    eligible: jnp.ndarray    # bool[Q, N] filter mask (id/tag filters applied)
+    valid: jnp.ndarray       # bool[Q]
+    attempted: jnp.ndarray   # bool[Q, N] node sent its ack/response
+    acked: jnp.ndarray       # bool[Q, N] origin received node's ack
+    responded: jnp.ndarray   # bool[Q, N] origin received node's response
+    resp_value: jnp.ndarray  # i32[Q, N] response payload seen at origin
+    next_q: jnp.ndarray      # i32 scalar ring cursor
+
+
+def make_queries(cfg: GossipConfig, qcfg: QueryConfig) -> QueryState:
+    q, n = qcfg.q_slots, cfg.n
+    return QueryState(
+        origin=jnp.zeros((q,), jnp.int32),
+        fact_slot=jnp.zeros((q,), jnp.int32),
+        ltime=jnp.zeros((q,), jnp.uint32),
+        deadline=jnp.zeros((q,), jnp.int32),
+        want_ack=jnp.zeros((q,), bool),
+        eligible=jnp.zeros((q, n), bool),
+        valid=jnp.zeros((q,), bool),
+        attempted=jnp.zeros((q, n), bool),
+        acked=jnp.zeros((q, n), bool),
+        responded=jnp.zeros((q, n), bool),
+        resp_value=jnp.zeros((q, n), jnp.int32),
+        next_q=jnp.asarray(0, jnp.int32),
+    )
+
+
+# -- filters -----------------------------------------------------------------
+
+def id_filter_mask(n: int, ids) -> jnp.ndarray:
+    """Reference Filter::Id — only the listed node ids may respond."""
+    mask = jnp.zeros((n,), bool)
+    ids = jnp.asarray(ids, jnp.int32)
+    return mask.at[ids].set(True, mode="drop")
+
+
+def tag_filter_mask(tag_plane: jnp.ndarray, tag_idx: int,
+                    value) -> jnp.ndarray:
+    """Reference Filter::Tag — nodes whose tag ``tag_idx`` equals ``value``.
+
+    ``tag_plane`` is the device tag representation: i32[N, T] of interned
+    tag values (the host's string regex filter compiles to a value set; an
+    equality mask is its device form — regex alternation = OR of masks).
+    """
+    return tag_plane[:, tag_idx] == jnp.asarray(value, tag_plane.dtype)
+
+
+def no_filter_mask(n: int) -> jnp.ndarray:
+    return jnp.ones((n,), bool)
+
+
+# -- lifecycle ---------------------------------------------------------------
+
+def launch_query(gossip: GossipState, qstate: QueryState, cfg: GossipConfig,
+                 qcfg: QueryConfig, origin, eligible: jnp.ndarray,
+                 want_ack=True, timeout_rounds: Optional[int] = None,
+                 ltime=None):
+    """Open a query: claim the next query slot, scatter a K_QUERY fact.
+
+    Returns ``(gossip', qstate', q_idx)``.  Reusing a ring slot closes the
+    old query that lived there (bounded concurrency — the device analog of
+    the reference's query dedup ring ``query_buffer_size``).
+    """
+    if timeout_rounds is None:
+        timeout_rounds = default_timeout_rounds(cfg.n, qcfg.timeout_mult)
+    qi = qstate.next_q % qcfg.q_slots
+    slot = gossip.next_slot % cfg.k_facts
+    lt = (gossip.round.astype(jnp.uint32) if ltime is None
+          else jnp.asarray(ltime, jnp.uint32))
+    gossip = inject_fact(gossip, cfg, subject=qi, kind=K_QUERY,
+                         incarnation=0, ltime=lt, origin=origin)
+    n = cfg.n
+    qstate = QueryState(
+        origin=qstate.origin.at[qi].set(jnp.asarray(origin, jnp.int32)),
+        fact_slot=qstate.fact_slot.at[qi].set(slot.astype(jnp.int32)
+                                              if hasattr(slot, "astype")
+                                              else jnp.int32(slot)),
+        ltime=qstate.ltime.at[qi].set(lt),
+        deadline=qstate.deadline.at[qi].set(
+            gossip.round + jnp.int32(timeout_rounds)),
+        want_ack=qstate.want_ack.at[qi].set(jnp.asarray(want_ack, bool)),
+        eligible=qstate.eligible.at[qi].set(eligible),
+        valid=qstate.valid.at[qi].set(True),
+        attempted=qstate.attempted.at[qi].set(jnp.zeros((n,), bool)),
+        acked=qstate.acked.at[qi].set(jnp.zeros((n,), bool)),
+        responded=qstate.responded.at[qi].set(jnp.zeros((n,), bool)),
+        resp_value=qstate.resp_value.at[qi].set(jnp.zeros((n,), jnp.int32)),
+        next_q=qstate.next_q + 1,
+    )
+    return gossip, qstate, qi
+
+
+def query_round(gossip: GossipState, qstate: QueryState, cfg: GossipConfig,
+                qcfg: QueryConfig, key: jax.Array,
+                response_value: Optional[jnp.ndarray] = None,
+                drop_direct: Optional[jnp.ndarray] = None,
+                drop_relay: Optional[jnp.ndarray] = None) -> QueryState:
+    """One gather step: new knowers of each open query send ack + response.
+
+    - ``response_value``: i32[N] per-node answer payload (the app handler's
+      return, vectorized).  Defaults to the node index.
+    - ``drop_direct``: bool[Q, N] — the responder→origin direct send is lost.
+    - ``drop_relay``: bool[Q, N, R] — relayed copy r is lost in transit.
+
+    A responder attempts exactly once (first round it knows + passes the
+    filter, reference base.rs:1002-1042's (ltime,id) dedup); a lost attempt
+    is lost for good, but any surviving relay path delivers.  Arrivals OR
+    into ``acked``/``responded`` — duplicate relay deliveries are absorbed,
+    matching the reference's per-source dedup sets.
+    """
+    q, n = qcfg.q_slots, cfg.n
+    if response_value is None:
+        response_value = jnp.arange(n, dtype=jnp.int32)
+
+    known = unpack_bits(gossip.known, cfg.k_facts)            # bool[N, K]
+    knows = known[:, qstate.fact_slot].T                      # bool[Q, N]
+    # the ring slot must still carry OUR query fact (not overwritten)
+    slot_is_ours = (gossip.facts.kind[qstate.fact_slot] == K_QUERY) \
+        & (gossip.facts.subject[qstate.fact_slot] == jnp.arange(q)) \
+        & gossip.facts.valid[qstate.fact_slot]                # bool[Q]
+    open_q = qstate.valid & slot_is_ours & (gossip.round <= qstate.deadline)
+
+    senders = (knows & qstate.eligible & gossip.alive[None, :]
+               & open_q[:, None] & ~qstate.attempted)         # bool[Q, N]
+
+    # delivery: direct path + relay_factor independent relayed copies
+    arrive = jnp.ones((q, n), bool) if drop_direct is None else ~drop_direct
+    origin_alive = gossip.alive[qstate.origin]                # bool[Q]
+    if qcfg.relay_factor > 0:
+        r = qcfg.relay_factor
+        mids = jax.random.randint(key, (q, n, r), 0, n)       # i32[Q, N, R]
+        relay_ok = gossip.alive[mids]                         # bool[Q, N, R]
+        if drop_relay is not None:
+            relay_ok = relay_ok & ~drop_relay
+        arrive = arrive | jnp.any(relay_ok, axis=-1)
+    arrive = arrive & origin_alive[:, None]
+
+    delivered = senders & arrive
+    acked = qstate.acked | (delivered & qstate.want_ack[:, None])
+    responded = qstate.responded | delivered
+    resp_value = jnp.where(delivered, response_value[None, :],
+                           qstate.resp_value)
+    return qstate._replace(attempted=qstate.attempted | senders,
+                           acked=acked, responded=responded,
+                           resp_value=resp_value)
+
+
+# -- views -------------------------------------------------------------------
+
+def num_acks(qstate: QueryState) -> jnp.ndarray:
+    """i32[Q] acks received per query (reference serf.query.acks metric)."""
+    return jnp.sum(qstate.acked, axis=1).astype(jnp.int32)
+
+
+def num_responses(qstate: QueryState) -> jnp.ndarray:
+    return jnp.sum(qstate.responded, axis=1).astype(jnp.int32)
+
+
+def responders(qstate: QueryState, qi) -> jnp.ndarray:
+    """bool[N]: nodes whose response reached the origin for query ``qi``."""
+    return qstate.responded[qi]
+
+
+# -- conflict resolution -----------------------------------------------------
+
+def majority_vote(votes: jnp.ndarray, responded: jnp.ndarray,
+                  num_candidates: int):
+    """Conflict-resolution majority vote as a segment-sum
+    (reference base.rs:1655-1780, internal_query handle_conflict).
+
+    ``votes``: i32[N] — each node's belief (e.g. interned address of the
+    conflicted id); ``responded``: bool[N] — whose response arrived.
+    Returns ``(winner, winner_count, total_responses)``; the winner stands
+    only if ``winner_count >= total//2 + 1`` (strict majority), exactly the
+    host engine's ``_resolve_node_conflict`` arithmetic.
+    """
+    weights = responded.astype(jnp.int32)
+    counts = jnp.zeros((num_candidates,), jnp.int32).at[votes].add(
+        weights, mode="drop")
+    winner = jnp.argmax(counts).astype(jnp.int32)
+    total = jnp.sum(weights)
+    return winner, counts[winner], total
+
+
+def majority_holds(winner_count, total) -> jnp.ndarray:
+    """Strict majority test: count >= total//2 + 1 (host serf.py parity)."""
+    return (total > 0) & (winner_count >= total // 2 + 1)

@@ -1,0 +1,137 @@
+"""Poisson churn at scale: baseline config #3 (100k nodes) plus unit
+semantics for the churn process itself.
+
+The scale test drives 100_000 nodes through the full flagship round
+(`cluster_round`: gossip + failure detection + anti-entropy + Vivaldi)
+under a Poisson leave/fail/rejoin process with packet loss, then asserts
+the reference failure-detector contract: every down node is detected
+within the suspicion-window bound, and **no node that stayed up is ever
+believed dead** (no false deaths) at realistic drop rates.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from serf_tpu.models.churn import ChurnConfig, churn_round, run_cluster_churn
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    K_ALIVE,
+    K_LEAVE,
+    make_state,
+)
+from serf_tpu.models.failure import FailureConfig, believed_dead, detection_complete
+from serf_tpu.models.swim import ClusterConfig, make_cluster, run_cluster
+
+
+def test_churn_round_semantics_small():
+    cfg = GossipConfig(n=64, k_facts=32)
+    ccfg = ChurnConfig(fail_rate=0.2, leave_rate=0.2, rejoin_rate=0.5,
+                       max_events=4)
+    state = make_state(cfg)._replace(
+        alive=jnp.ones((64,), bool).at[0:8].set(False))
+    out, pending = churn_round(state, cfg, ccfg, jax.random.key(0))
+
+    # caps respected: ≤4 immediate fails among previously-alive, ≤4 pending
+    # leavers (still alive until after their announcement round), ≤4 rejoins
+    newly_down = state.alive & ~out.alive
+    newly_up = ~state.alive & out.alive
+    assert int(jnp.sum(newly_down)) <= 4
+    assert int(jnp.sum(pending)) <= 4
+    assert int(jnp.sum(newly_up)) <= 4
+    # leavers are still alive (they announce before going dark) and are
+    # disjoint from the crashed
+    assert not bool(jnp.any(pending & ~out.alive))
+    # rejoiners bumped their incarnation
+    assert bool(jnp.all(jnp.where(newly_up, out.incarnation == 2, True)))
+    # leave facts announced exactly for the pending leavers
+    leave_subjects = set(
+        int(s) for s, k, v in zip(out.facts.subject, out.facts.kind,
+                                  out.facts.valid)
+        if bool(v) and int(k) == K_LEAVE)
+    assert leave_subjects == set(int(i) for i in jnp.nonzero(pending)[0])
+    # alive facts announced for every rejoiner
+    alive_subjects = set(
+        int(s) for s, k, v in zip(out.facts.subject, out.facts.kind,
+                                  out.facts.valid)
+        if bool(v) and int(k) == K_ALIVE)
+    up_ids = set(int(i) for i in jnp.nonzero(newly_up)[0])
+    assert alive_subjects == up_ids
+
+
+def test_churn_rates_zero_is_identity():
+    cfg = GossipConfig(n=32, k_facts=32)
+    state = make_state(cfg)
+    out, pending = churn_round(state, cfg, ChurnConfig(), jax.random.key(1))
+    assert bool(jnp.all(out.alive == state.alive))
+    assert bool(jnp.all(out.known == state.known))
+    assert int(out.next_slot) == int(state.next_slot)
+    assert int(jnp.sum(pending)) == 0
+
+
+def test_leave_announcement_disseminates_before_leaver_goes_dark():
+    """A graceful leaver's K_LEAVE fact must actually spread: run churn with
+    only leaves and verify the announcement reaches the cluster even though
+    the leaver is dark from the next round on."""
+    from serf_tpu.models.dissemination import coverage
+    from serf_tpu.models.swim import ClusterConfig, make_cluster
+
+    cfg = ClusterConfig(gossip=GossipConfig(n=256, k_facts=32, fanout=3),
+                        with_failure=False, with_vivaldi=False)
+    ccfg = ChurnConfig(leave_rate=0.01, max_events=2)
+    state = make_cluster(cfg, jax.random.key(0))
+    state, trace = run_cluster_churn(state, cfg, ccfg, jax.random.key(1), 3)
+    downs = int(jnp.sum(trace.ever_down))
+    assert downs > 0, "no leaves sampled; pick a different seed"
+    # let the announcements disseminate among survivors
+    state = run_cluster(state, cfg, jax.random.key(2), 30)
+    g = state.gossip
+    leave_slots = jnp.nonzero((g.facts.kind == K_LEAVE) & g.facts.valid)[0]
+    assert len(leave_slots) > 0
+    cov = coverage(g, cfg.gossip)
+    for sl in leave_slots:
+        assert float(cov[int(sl)]) == 1.0, \
+            f"leave fact in slot {int(sl)} did not disseminate"
+
+
+def test_poisson_churn_100k_detection_and_no_false_deaths():
+    """Baseline config #3 at its stated scale (run once per session: ~1 min
+    CPU).  30 churned rounds then a settle window; the detector must catch
+    every down node and never kill a node that stayed up."""
+    n = 100_000
+    cfg = ClusterConfig(
+        # k_facts=256: the fact ring must hold a live suspect/dead fact for
+        # every churned subject simultaneously — the reference sizes its
+        # dedup buffers at event_buffer_size=512 for the same reason
+        gossip=GossipConfig(n=n, k_facts=256, fanout=3),
+        failure=FailureConfig(suspicion_rounds=12, max_new_facts=8,
+                              probe_drop_rate=0.02),
+        push_pull_every=16,
+        with_vivaldi=False,   # vivaldi has its own scale test; keep this lean
+    )
+    ccfg = ChurnConfig(fail_rate=1e-5, leave_rate=1e-5, rejoin_rate=0.02,
+                       max_events=8)
+    key = jax.random.key(42)
+    state = make_cluster(cfg, key)
+
+    churn = jax.jit(functools.partial(run_cluster_churn, cfg=cfg, ccfg=ccfg,
+                                      num_rounds=30),
+                    static_argnames=())
+    state, trace = run_cluster_churn(state, cfg, ccfg,
+                                     jax.random.key(7), 30)
+    # Poisson process actually fired (expected ~2/round/kind at these rates)
+    downs = int(jnp.sum(trace.ever_down))
+    assert downs > 10, f"churn too quiet: {downs} down events"
+
+    # settle: no churn; bounded-suspicion coverage sweeps + suspicion window
+    # + declaration sweeps + full-dissemination slack
+    settle = cfg.failure.suspicion_rounds * 2 + 80
+    state = run_cluster(state, cfg, jax.random.key(8), settle)
+
+    assert bool(detection_complete(state.gossip, cfg.gossip, cfg.failure)), \
+        "down nodes not fully detected within the settle window"
+    believed = believed_dead(state.gossip, cfg.gossip, cfg.failure)
+    false_deaths = believed & trace.always_up
+    assert int(jnp.sum(false_deaths)) == 0, \
+        f"{int(jnp.sum(false_deaths))} false deaths among always-up nodes"

@@ -502,3 +502,52 @@ def test_inject_facts_batch_jaxpr_has_no_per_candidate_state_copies():
     full_plane = re.findall(r"\[256,64\] = select_n|\[256,2\] = select_n", text)
     assert 1 <= len(full_plane) <= 4, \
         f"expected 1-4 full-plane select_n ops, found {len(full_plane)}"
+
+
+def test_indirect_probes_suppress_false_suspicion():
+    """SWIM indirect probing: with k=3 helpers, 20% path loss almost never
+    suspects a healthy node (needs all 4 paths down: 0.2^4 = 0.16%), while
+    k=0 suspects constantly."""
+    from serf_tpu.models.failure import probe_round
+
+    cfg = GossipConfig(n=512, k_facts=64)
+    s = make_state(cfg)  # everyone alive: any suspicion is false
+    key = jax.random.key(21)
+
+    def count_suspects(fcfg, rounds=30):
+        st, k = s, key
+        total = 0
+        step = jax.jit(functools.partial(probe_round, cfg=cfg, fcfg=fcfg))
+        for _ in range(rounds):
+            k, k2 = jax.random.split(k)
+            st2 = step(st, key=k2)
+            total += int(st2.next_slot - st.next_slot)
+            st = st2
+        return total
+
+    with_ind = count_suspects(FailureConfig(probe_drop_rate=0.2,
+                                            indirect_probes=3))
+    without = count_suspects(FailureConfig(probe_drop_rate=0.2,
+                                           indirect_probes=0))
+    # k=0 control saturates the 8/round injection cap (~240 over 30 rounds);
+    # k=3 expectation is n·p^4 ≈ 0.8/round ≈ 25 — allow 2.5x slack
+    assert without >= 200, f"k=0 control too quiet: {without}"
+    assert with_ind <= 62, (with_ind, without)
+
+
+def test_indirect_probes_do_not_mask_real_deaths():
+    """A dead target never acks on any path: detection latency is unchanged
+    by indirect probing."""
+    cfg = GossipConfig(n=256, k_facts=64)
+    fcfg = FailureConfig(suspicion_rounds=8, max_new_facts=4,
+                         probe_drop_rate=0.2, indirect_probes=3)
+    s = make_state(cfg)._replace(
+        alive=jnp.ones((256,), bool).at[42].set(False))
+    step = jax.jit(functools.partial(swim_round, cfg=cfg, fcfg=fcfg))
+    key = jax.random.key(22)
+    for r in range(120):
+        key, k2 = jax.random.split(key)
+        s = step(s, key=k2)
+        if bool(detection_complete(s, cfg, fcfg)):
+            break
+    assert bool(detection_complete(s, cfg, fcfg))

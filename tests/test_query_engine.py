@@ -1,0 +1,275 @@
+"""Device-plane query engine: scatter/filter/gather/relay/timeout, and
+host-vs-device parity (the SURVEY.md §7 stage-7 component).
+
+The host Serf query engine is the oracle: for the same membership, filters,
+and loss-free network, the device plane must deliver responses from exactly
+the same responder set; the conflict majority vote must reproduce the host
+engine's ``responses//2 + 1`` arithmetic.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    K_QUERY,
+    K_USER_EVENT,
+    inject_fact,
+    make_state,
+    round_step,
+)
+from serf_tpu.models.query import (
+    QueryConfig,
+    default_timeout_rounds,
+    id_filter_mask,
+    launch_query,
+    majority_holds,
+    majority_vote,
+    make_queries,
+    no_filter_mask,
+    num_acks,
+    num_responses,
+    query_round,
+    tag_filter_mask,
+)
+
+
+def _drive(gossip, qstate, cfg, qcfg, key, rounds, **kw):
+    step = jax.jit(functools.partial(round_step, cfg=cfg))
+    for _ in range(rounds):
+        key, k1, k2 = jax.random.split(key, 3)
+        gossip = step(gossip, key=k1)
+        qstate = query_round(gossip, qstate, cfg, qcfg, k2, **kw)
+    return gossip, qstate
+
+
+def test_query_gathers_all_alive_responses():
+    cfg = GossipConfig(n=256, k_facts=32)
+    qcfg = QueryConfig(q_slots=4)
+    g = make_state(cfg)
+    qs = make_queries(cfg, qcfg)
+    g, qs, qi = launch_query(g, qs, cfg, qcfg, origin=0,
+                             eligible=no_filter_mask(cfg.n))
+    g, qs = _drive(g, qs, cfg, qcfg, jax.random.key(0), 30)
+    assert int(num_responses(qs)[int(qi)]) == cfg.n
+    assert int(num_acks(qs)[int(qi)]) == cfg.n
+    # responses carry the per-node payload (default: node index)
+    assert bool(jnp.all(qs.resp_value[int(qi)] == jnp.arange(cfg.n)))
+
+
+def test_id_filter_limits_responders():
+    cfg = GossipConfig(n=128, k_facts=32)
+    qcfg = QueryConfig(q_slots=4)
+    g = make_state(cfg)
+    qs = make_queries(cfg, qcfg)
+    ids = [3, 17, 99]
+    g, qs, qi = launch_query(g, qs, cfg, qcfg, origin=0,
+                             eligible=id_filter_mask(cfg.n, ids))
+    g, qs = _drive(g, qs, cfg, qcfg, jax.random.key(1), 30)
+    got = set(int(i) for i in jnp.nonzero(qs.responded[int(qi)])[0])
+    assert got == set(ids)
+
+
+def test_tag_filter_limits_responders():
+    cfg = GossipConfig(n=64, k_facts=32)
+    qcfg = QueryConfig(q_slots=4)
+    # tag plane: tag 0 = role (0=web, 1=db)
+    tag_plane = jnp.zeros((cfg.n, 2), jnp.int32).at[10:20, 0].set(1)
+    g = make_state(cfg)
+    qs = make_queries(cfg, qcfg)
+    g, qs, qi = launch_query(g, qs, cfg, qcfg, origin=0,
+                             eligible=tag_filter_mask(tag_plane, 0, 1))
+    g, qs = _drive(g, qs, cfg, qcfg, jax.random.key(2), 30)
+    got = set(int(i) for i in jnp.nonzero(qs.responded[int(qi)])[0])
+    assert got == set(range(10, 20))
+
+
+def test_dead_nodes_do_not_respond_and_dead_origin_gets_nothing():
+    cfg = GossipConfig(n=64, k_facts=32)
+    qcfg = QueryConfig(q_slots=2)
+    g = make_state(cfg)._replace(
+        alive=jnp.ones((64,), bool).at[7].set(False))
+    qs = make_queries(cfg, qcfg)
+    g, qs, qi = launch_query(g, qs, cfg, qcfg, origin=0,
+                             eligible=no_filter_mask(cfg.n))
+    g, qs = _drive(g, qs, cfg, qcfg, jax.random.key(3), 30)
+    assert not bool(qs.responded[int(qi), 7])
+    assert int(num_responses(qs)[int(qi)]) == cfg.n - 1
+
+    # dead origin: no deliveries at all
+    g2 = make_state(cfg)._replace(
+        alive=jnp.ones((64,), bool).at[0].set(False))
+    qs2 = make_queries(cfg, qcfg)
+    g2, qs2, qi2 = launch_query(g2, qs2, cfg, qcfg, origin=0,
+                                eligible=no_filter_mask(cfg.n))
+    g2, qs2 = _drive(g2, qs2, cfg, qcfg, jax.random.key(4), 20)
+    assert int(num_responses(qs2)[int(qi2)]) == 0
+
+
+def test_timeout_closes_query():
+    cfg = GossipConfig(n=256, k_facts=32)
+    qcfg = QueryConfig(q_slots=2)
+    g = make_state(cfg)
+    qs = make_queries(cfg, qcfg)
+    # a 2-round deadline: dissemination cannot finish, late learners are
+    # shut out (reference: responses after the deadline are dropped)
+    g, qs, qi = launch_query(g, qs, cfg, qcfg, origin=0,
+                             eligible=no_filter_mask(cfg.n),
+                             timeout_rounds=2)
+    g, qs = _drive(g, qs, cfg, qcfg, jax.random.key(5), 30)
+    assert 0 < int(num_responses(qs)[int(qi)]) < cfg.n
+
+
+def test_direct_drops_lose_responses_relay_recovers_them():
+    cfg = GossipConfig(n=128, k_facts=32)
+    g0 = make_state(cfg)
+
+    # all direct sends dropped, no relay: origin only ever hears itself
+    # (self-delivery is local, but the drop mask covers it too — so zero)
+    qcfg = QueryConfig(q_slots=2, relay_factor=0)
+    qs = make_queries(cfg, qcfg)
+    g, qs, qi = launch_query(g0, qs, cfg, qcfg, origin=0,
+                             eligible=no_filter_mask(cfg.n))
+    drop = jnp.ones((qcfg.q_slots, cfg.n), bool)
+    g, qs = _drive(g, qs, cfg, qcfg, jax.random.key(6), 25,
+                   drop_direct=drop)
+    assert int(num_responses(qs)[int(qi)]) == 0
+
+    # same loss, relay_factor=3: relayed copies deliver everything
+    qcfg_r = QueryConfig(q_slots=2, relay_factor=3)
+    qs2 = make_queries(cfg, qcfg_r)
+    g2, qs2, qi2 = launch_query(g0, qs2, cfg, qcfg_r, origin=0,
+                                eligible=no_filter_mask(cfg.n))
+    g2, qs2 = _drive(g2, qs2, cfg, qcfg_r, jax.random.key(7), 25,
+                     drop_direct=drop)
+    assert int(num_responses(qs2)[int(qi2)]) == cfg.n
+
+
+def test_attempt_is_once_lost_stays_lost_without_relay():
+    """A responder sends exactly once; if that send is dropped the response
+    never arrives (reference: no retry), even when the drop mask later
+    clears."""
+    cfg = GossipConfig(n=64, k_facts=32)
+    qcfg = QueryConfig(q_slots=2, relay_factor=0)
+    g = make_state(cfg)
+    qs = make_queries(cfg, qcfg)
+    g, qs, qi = launch_query(g, qs, cfg, qcfg, origin=0,
+                             eligible=no_filter_mask(cfg.n))
+    drop = jnp.ones((qcfg.q_slots, cfg.n), bool)
+    # first 30 rounds: everything drops (all nodes learn + attempt)
+    g, qs = _drive(g, qs, cfg, qcfg, jax.random.key(8), 30, drop_direct=drop)
+    lost = int(jnp.sum(qs.attempted[int(qi)]))
+    assert lost == cfg.n
+    # drops clear, but attempts are spent
+    g, qs = _drive(g, qs, cfg, qcfg, jax.random.key(9), 10)
+    assert int(num_responses(qs)[int(qi)]) == 0
+
+
+def test_ring_overwrite_closes_query():
+    cfg = GossipConfig(n=64, k_facts=32)
+    qcfg = QueryConfig(q_slots=2)
+    g = make_state(cfg)
+    qs = make_queries(cfg, qcfg)
+    g, qs, qi = launch_query(g, qs, cfg, qcfg, origin=0,
+                             eligible=no_filter_mask(cfg.n))
+    # overwrite the whole gossip ring with user events before any gather
+    for i in range(cfg.k_facts):
+        g = inject_fact(g, cfg, 100 + i, K_USER_EVENT, 0, 2 + i, 0)
+    g, qs = _drive(g, qs, cfg, qcfg, jax.random.key(10), 20)
+    assert int(num_responses(qs)[int(qi)]) == 0
+
+
+def test_no_ack_when_not_requested():
+    cfg = GossipConfig(n=64, k_facts=32)
+    qcfg = QueryConfig(q_slots=2)
+    g = make_state(cfg)
+    qs = make_queries(cfg, qcfg)
+    g, qs, qi = launch_query(g, qs, cfg, qcfg, origin=0,
+                             eligible=no_filter_mask(cfg.n), want_ack=False)
+    g, qs = _drive(g, qs, cfg, qcfg, jax.random.key(11), 30)
+    assert int(num_acks(qs)[int(qi)]) == 0
+    assert int(num_responses(qs)[int(qi)]) == cfg.n
+
+
+def test_majority_vote_segment_sum():
+    n = 101
+    votes = jnp.asarray([0] * 60 + [1] * 41, jnp.int32)
+    responded = jnp.ones((n,), bool)
+    w, c, t = majority_vote(votes, responded, num_candidates=4)
+    assert (int(w), int(c), int(t)) == (0, 60, 101)
+    assert bool(majority_holds(c, t))
+    # only the minority responds: no majority for 0
+    responded = jnp.asarray([False] * 45 + [True] * 56)
+    w, c, t = majority_vote(votes, responded, num_candidates=4)
+    assert (int(w), int(c), int(t)) == (1, 41, 56)
+    assert not bool(majority_holds(jnp.int32(15), jnp.int32(56)))
+    # host arithmetic parity: majority = responses // 2 + 1
+    for total, count in [(5, 3), (5, 2), (4, 2), (4, 3), (1, 1), (0, 0)]:
+        host_ok = total > 0 and count >= total // 2 + 1
+        assert bool(majority_holds(jnp.int32(count), jnp.int32(total))) == host_ok
+
+
+@pytest.mark.asyncio
+async def test_host_vs_device_query_parity():
+    """Same membership + id filter, loss-free: the device responder set must
+    equal the host engine's (style of tests/test_parity.py)."""
+    from serf_tpu.host import LoopbackNetwork, QueryParam, Serf
+    from serf_tpu.host.events import EventSubscriber, QueryEvent
+    from serf_tpu.options import Options
+    from serf_tpu.types.filters import IdFilter
+    from serf_tpu.types.member import MemberStatus
+
+    import asyncio
+
+    n = 5
+    filter_ids = [1, 3, 4]
+
+    # -- host oracle
+    net = LoopbackNetwork()
+    subs = [EventSubscriber() for _ in range(n)]
+    nodes = [await Serf.create(net.bind(f"a{i}"), Options.local(), f"n{i}",
+                               subscriber=subs[i]) for i in range(n)]
+    try:
+        for s in nodes[1:]:
+            await s.join("a0")
+        for _ in range(400):
+            if all(len([m for m in s.members()
+                        if m.status == MemberStatus.ALIVE]) == n
+                   for s in nodes):
+                break
+            await asyncio.sleep(0.02)
+
+        async def responder(i):
+            while True:
+                ev = await subs[i].next()
+                if isinstance(ev, QueryEvent) and ev.name == "who":
+                    await ev.respond(f"n{i}".encode())
+        tasks = [asyncio.create_task(responder(i)) for i in range(1, n)]
+        resp = await nodes[0].query(
+            "who", b"", QueryParam(
+                timeout=1.5,
+                filters=(IdFilter(tuple(f"n{i}" for i in filter_ids)),)))
+        results = await resp.collect()
+        host_responders = {r.from_id for r in results}
+        for t in tasks:
+            t.cancel()
+    finally:
+        for s in nodes:
+            await s.shutdown()
+
+    # -- device plane, same scenario (origin 0 not in the filter list)
+    cfg = GossipConfig(n=n, k_facts=32, fanout=2)
+    qcfg = QueryConfig(q_slots=2)
+    g = make_state(cfg)
+    qs = make_queries(cfg, qcfg)
+    g, qs, qi = launch_query(g, qs, cfg, qcfg, origin=0,
+                             eligible=id_filter_mask(n, filter_ids))
+    g, qs = _drive(g, qs, cfg, qcfg, jax.random.key(12), 30)
+    device_responders = {f"n{int(i)}"
+                         for i in jnp.nonzero(qs.responded[int(qi)])[0]}
+
+    assert device_responders == host_responders == \
+        {f"n{i}" for i in filter_ids}
