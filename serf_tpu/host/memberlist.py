@@ -30,6 +30,7 @@ from serf_tpu.host.delegate import SwimDelegate
 from serf_tpu.host.keyring import KeyringError, SecretKeyring
 from serf_tpu.host.messages import SwimState
 from serf_tpu.host.transport import Transport
+from serf_tpu.host import wire
 from serf_tpu.options import MemberlistOptions
 from serf_tpu.types.member import Node
 from serf_tpu.utils import metrics
@@ -225,7 +226,10 @@ class Memberlist:
 
     async def join(self, addr) -> None:
         """Push/pull state sync with a seed node (reference join path,
-        SURVEY.md §3.2)."""
+        SURVEY.md §3.2).  The target goes through the transport's resolver
+        first, so joins accept unresolved names (reference
+        MaybeResolvedAddress)."""
+        addr = await self.transport.resolve(addr)
         await self._push_pull_with(addr, join=True)
 
     async def join_many(self, addrs: Sequence) -> Tuple[int, List[Exception]]:
@@ -270,17 +274,9 @@ class Memberlist:
     def _encode_wire(self, buf: bytes) -> bytes:
         """Outbound packet pipeline: compress -> checksum -> encrypt
         (capability parity with the reference's compression/checksum/
-        encryption transport features, SURVEY.md §2.9)."""
-        if self.opts.compression == "zlib":
-            import zlib
-            buf = b"\x01" + zlib.compress(buf, level=1)
-        elif self.opts.compression is None:
-            if self.opts.checksum is not None:
-                buf = b"\x00" + buf
-        if self.opts.checksum is not None:
-            import zlib
-            fn = zlib.crc32 if self.opts.checksum == "crc32" else zlib.adler32
-            buf = fn(buf).to_bytes(4, "big") + buf
+        encryption transport features, SURVEY.md §2.9; algorithm
+        registries in ``host/wire.py``)."""
+        buf = wire.encode_wire(buf, self.opts.compression, self.opts.checksum)
         if self._keyring is not None:
             buf = self._keyring.encrypt(buf)
         return buf
@@ -295,44 +291,20 @@ class Memberlist:
                 metrics.incr("memberlist.packet.decrypt_failed", 1,
                              self.opts.metric_labels)
                 return None
-        if self.opts.checksum is not None:
-            import zlib
-            if len(buf) < 5:
-                metrics.incr("memberlist.packet.checksum_failed", 1,
-                             self.opts.metric_labels)
-                return None
-            want = int.from_bytes(buf[:4], "big")
-            buf = buf[4:]
-            fn = zlib.crc32 if self.opts.checksum == "crc32" else zlib.adler32
-            if fn(buf) != want:
-                metrics.incr("memberlist.packet.checksum_failed", 1,
-                             self.opts.metric_labels)
-                return None
-        if self.opts.compression is not None or self.opts.checksum is not None:
-            if not buf:
-                return None
-            marker, buf = buf[0], buf[1:]
-            if marker == 1:
-                import zlib
-                try:
-                    buf = zlib.decompress(buf)
-                except zlib.error:
-                    metrics.incr("memberlist.packet.decompress_failed", 1,
-                                 self.opts.metric_labels)
-                    return None
-        return buf
+        try:
+            return wire.decode_wire(buf, self.opts.compression,
+                                    self.opts.checksum)
+        except wire.WireError as e:
+            metrics.incr(f"memberlist.packet.{e.stage}_failed", 1,
+                         self.opts.metric_labels)
+            return None
 
     def _wire_overhead(self) -> int:
-        """Worst-case bytes _encode_wire adds (marker + checksum + zlib
-        expansion headroom + AES-GCM version/nonce/tag) — reserved out of
-        the UDP packet budget so encoded packets stay UDP-safe."""
-        overhead = 0
-        if self.opts.compression is not None or self.opts.checksum is not None:
-            overhead += 1                       # marker byte
-        if self.opts.compression is not None:
-            overhead += 16                      # zlib worst-case expansion
-        if self.opts.checksum is not None:
-            overhead += 4
+        """Worst-case bytes _encode_wire adds (marker + checksum + expansion
+        headroom + AES-GCM version/nonce/tag) — reserved out of the UDP
+        packet budget so encoded packets stay UDP-safe."""
+        overhead = wire.wire_overhead(self.opts.compression,
+                                      self.opts.checksum)
         if self._keyring is not None:
             overhead += 1 + 12 + 16             # version + nonce + GCM tag
         return overhead

@@ -63,6 +63,12 @@ class Transport:
     async def accept(self) -> Stream:  # pragma: no cover - abstract
         raise NotImplementedError
 
+    async def resolve(self, addr):
+        """Resolve a join target to a transport address — the reference's
+        ``Transport::Resolver`` seam (serf-core/src/serf.rs:133-137).
+        Default: identity (pre-resolved addresses pass through)."""
+        return addr
+
     async def shutdown(self) -> None:  # pragma: no cover - abstract
         raise NotImplementedError
 

@@ -1,0 +1,186 @@
+"""Packet wire pipeline: compress → checksum → encrypt, with pluggable
+algorithm registries.
+
+Capability parity with the reference's transport features
+(serf-core/src/types.rs:10-48; SURVEY.md §2.9): the reference feature-gates
+checksums {crc32, xxhash, murmur3} and compressions {snappy, zstd, lz4,
+brotli}.  Here the checksum registry carries the reference's exact variants
+(xxhash32 and murmur3 are hand-rolled below — small, well-specified, and
+dependency-free) plus adler32; the compression registry is zlib-only
+because the environment forbids new dependencies (documented deviation in
+PARITY.md) — registering another algorithm is one dict entry.
+
+Wire layout (outermost first):  [AES-GCM]([checksum4](marker1 + payload))
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Callable, Dict, Optional, Tuple
+
+
+# ---------------------------------------------------------------------------
+# checksums (reference: crc32 / xxhash / murmur3; plus adler32)
+# ---------------------------------------------------------------------------
+
+_M = 0xFFFFFFFF
+
+
+def xxhash32(data: bytes, seed: int = 0) -> int:
+    """XXH32 (the reference's xxhash feature), from the public spec."""
+    p1, p2, p3, p4, p5 = (2654435761, 2246822519, 3266489917,
+                          668265263, 374761393)
+
+    def rotl(x: int, r: int) -> int:
+        return ((x << r) | (x >> (32 - r))) & _M
+
+    n = len(data)
+    idx = 0
+    if n >= 16:
+        v1 = (seed + p1 + p2) & _M
+        v2 = (seed + p2) & _M
+        v3 = seed & _M
+        v4 = (seed - p1) & _M
+        while idx <= n - 16:
+            for ref in range(4):
+                (lane,) = struct.unpack_from("<I", data, idx)
+                if ref == 0:
+                    v1 = (rotl((v1 + lane * p2) & _M, 13) * p1) & _M
+                elif ref == 1:
+                    v2 = (rotl((v2 + lane * p2) & _M, 13) * p1) & _M
+                elif ref == 2:
+                    v3 = (rotl((v3 + lane * p2) & _M, 13) * p1) & _M
+                else:
+                    v4 = (rotl((v4 + lane * p2) & _M, 13) * p1) & _M
+                idx += 4
+        h = (rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18)) & _M
+    else:
+        h = (seed + p5) & _M
+    h = (h + n) & _M
+    while idx <= n - 4:
+        (lane,) = struct.unpack_from("<I", data, idx)
+        h = (rotl((h + lane * p3) & _M, 17) * p4) & _M
+        idx += 4
+    while idx < n:
+        h = (rotl((h + data[idx] * p5) & _M, 11) * p1) & _M
+        idx += 1
+    h ^= h >> 15
+    h = (h * p2) & _M
+    h ^= h >> 13
+    h = (h * p3) & _M
+    h ^= h >> 16
+    return h
+
+
+def murmur3_32(data: bytes, seed: int = 0) -> int:
+    """MurmurHash3 x86_32 (the reference's murmur3 feature)."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & _M
+    n = len(data)
+    rounds = n // 4
+    for i in range(rounds):
+        (k,) = struct.unpack_from("<I", data, i * 4)
+        k = (k * c1) & _M
+        k = ((k << 15) | (k >> 17)) & _M
+        k = (k * c2) & _M
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & _M
+        h = (h * 5 + 0xE6546B64) & _M
+    k = 0
+    tail = data[rounds * 4:]
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * c1) & _M
+        k = ((k << 15) | (k >> 17)) & _M
+        k = (k * c2) & _M
+        h ^= k
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _M
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _M
+    h ^= h >> 16
+    return h
+
+
+CHECKSUMS: Dict[str, Callable[[bytes], int]] = {
+    "crc32": lambda b: zlib.crc32(b) & _M,
+    "adler32": lambda b: zlib.adler32(b) & _M,
+    "xxhash32": xxhash32,
+    "murmur3": murmur3_32,
+}
+
+# marker byte → (compress, decompress); marker 0 = uncompressed
+COMPRESSIONS: Dict[str, Tuple[int, Callable[[bytes], bytes],
+                              Callable[[bytes], bytes]]] = {
+    "zlib": (1, lambda b: zlib.compress(b, level=1), zlib.decompress),
+}
+_DECOMPRESS_BY_MARKER = {m: d for (m, _c, d) in COMPRESSIONS.values()}
+
+
+class WireError(Exception):
+    """Inbound pipeline failure (drop the packet, UDP semantics).
+
+    ``stage`` names the layer that failed — "checksum" (bad or truncated
+    checksum frame) or "decompress" (bad marker/payload) — so callers
+    can emit the right metric."""
+
+    def __init__(self, stage: str):
+        super().__init__(stage)
+        self.stage = stage  # "checksum" | "decompress"
+
+
+def encode_wire(buf: bytes, compression: Optional[str],
+                checksum: Optional[str]) -> bytes:
+    """compress → checksum (encryption is the keyring's layer, above)."""
+    if compression is not None:
+        marker, comp, _ = COMPRESSIONS[compression]
+        buf = bytes([marker]) + comp(buf)
+    elif checksum is not None:
+        buf = b"\x00" + buf
+    if checksum is not None:
+        buf = CHECKSUMS[checksum](buf).to_bytes(4, "big") + buf
+    return buf
+
+
+def decode_wire(buf: bytes, compression: Optional[str],
+                checksum: Optional[str]) -> bytes:
+    """verify checksum → decompress; raises WireError on any failure."""
+    if checksum is not None:
+        if len(buf) < 5:
+            raise WireError("checksum")
+        want = int.from_bytes(buf[:4], "big")
+        buf = buf[4:]
+        if CHECKSUMS[checksum](buf) != want:
+            raise WireError("checksum")
+    if compression is not None or checksum is not None:
+        if not buf:
+            raise WireError("decompress")
+        marker, buf = buf[0], buf[1:]
+        if marker != 0:
+            dec = _DECOMPRESS_BY_MARKER.get(marker)
+            if dec is None:
+                raise WireError("decompress")
+            try:
+                buf = dec(buf)
+            except Exception as e:  # noqa: BLE001 - any codec failure = drop
+                raise WireError("decompress") from e
+    return buf
+
+
+def wire_overhead(compression: Optional[str], checksum: Optional[str]) -> int:
+    """Worst-case bytes encode_wire adds (marker + checksum + compressor
+    expansion headroom)."""
+    overhead = 0
+    if compression is not None or checksum is not None:
+        overhead += 1
+    if checksum is not None:
+        overhead += 4
+    if compression is not None:
+        overhead += 16  # zlib worst-case expansion headroom on small packets
+    return overhead

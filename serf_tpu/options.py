@@ -34,13 +34,14 @@ class MemberlistOptions:
     awareness_max_multiplier: int = 8        # Lifeguard local-health ceiling
     timeout: float = 10.0                    # stream (push/pull) op timeout
     compression: Optional[str] = None        # None | "zlib" (packet payloads)
-    checksum: Optional[str] = None           # None | "crc32" | "adler32"
+    checksum: Optional[str] = None           # None | crc32/adler32/xxhash32/murmur3
     metric_labels: Dict[str, str] = field(default_factory=dict)
 
     def validate(self) -> None:
-        if self.compression not in (None, "zlib"):
+        from serf_tpu.host.wire import CHECKSUMS, COMPRESSIONS
+        if self.compression is not None and self.compression not in COMPRESSIONS:
             raise ValueError(f"unsupported compression {self.compression!r}")
-        if self.checksum not in (None, "crc32", "adler32"):
+        if self.checksum is not None and self.checksum not in CHECKSUMS:
             raise ValueError(f"unsupported checksum {self.checksum!r}")
 
     @classmethod

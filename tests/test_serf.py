@@ -427,3 +427,122 @@ async def test_net_transport_real_sockets(host):
     finally:
         await s0.shutdown()
         await s1.shutdown()
+
+
+def _self_signed_cert(tmp_path, hostname="localhost"):
+    """Generate a self-signed cert+key PEM pair (tests only)."""
+    import datetime
+    import ipaddress as ipa
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, hostname)])
+    san = x509.SubjectAlternativeName([
+        x509.DNSName(hostname),
+        x509.IPAddress(ipa.ip_address("127.0.0.1")),
+        x509.IPAddress(ipa.ip_address("::1")),
+    ])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=1))
+            .add_extension(san, critical=False)
+            .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                           critical=True)
+            .sign(key, hashes.SHA256()))
+    cert_pem = tmp_path / "cert.pem"
+    key_pem = tmp_path / "key.pem"
+    cert_pem.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_pem.write_bytes(key.private_bytes(
+        serialization.Encoding.PEM, serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption()))
+    return str(cert_pem), str(key_pem)
+
+
+@pytest.mark.parametrize("host", ["127.0.0.1", "::1"])
+@pytest.mark.parametrize("stream", ["tcp", "tls"])
+async def test_net_transport_stream_variants(host, stream, tmp_path):
+    """Conformance over real sockets for both stream planes: plain TCP and
+    TLS-wrapped (the reference's NetTransport/TLS feature split), IPv4+IPv6."""
+    from serf_tpu.host.net import NetTransport, TlsNetTransport, make_tls_contexts
+
+    if stream == "tls":
+        # one shared cluster cert (the single-cert self-signed deployment)
+        cert, key = _self_signed_cert(tmp_path)
+
+    async def bind(addr):
+        if stream == "tcp":
+            return await NetTransport.bind(addr)
+        server_ctx, client_ctx = make_tls_contexts(cert, key)
+        return await TlsNetTransport.bind(addr, server_ctx=server_ctx,
+                                          client_ctx=client_ctx)
+
+    try:
+        t0 = await bind((host, 0))
+    except OSError:
+        pytest.skip(f"{host} unavailable")
+    t1 = await bind((host, 0))
+    s0 = await Serf.create(t0, Options.local(), f"{stream}-0")
+    s1 = await Serf.create(t1, Options.local(), f"{stream}-1")
+    try:
+        await s1.join(t0.local_addr)
+        await wait_until(lambda: s0.num_members() == 2 and s1.num_members() == 2,
+                         msg=f"2-node convergence over {stream}")
+        await s0.user_event("hello", stream.encode(), coalesce=False)
+        await wait_until(lambda: s1.event_clock.time() >= 2,
+                         msg=f"user event over {stream}")
+    finally:
+        await s0.shutdown()
+        await s1.shutdown()
+
+
+async def test_join_resolves_dns_names():
+    """The resolver seam: joins accept a hostname:port string and resolve it
+    through the transport (reference Transport::Resolver)."""
+    from serf_tpu.host.net import NetTransport
+
+    t0 = await NetTransport.bind(("127.0.0.1", 0))
+    t1 = await NetTransport.bind(("127.0.0.1", 0))
+    s0 = await Serf.create(t0, Options.local(), "dns-0")
+    s1 = await Serf.create(t1, Options.local(), "dns-1")
+    try:
+        port = t0.local_addr[1]
+        await s1.join(f"localhost:{port}")
+        await wait_until(lambda: s0.num_members() == 2 and s1.num_members() == 2,
+                         msg="2-node convergence after DNS-resolved join")
+        # unresolvable names fail loudly, not silently
+        with pytest.raises(ConnectionError):
+            await s1.memberlist.transport.resolve("no.such.host.invalid:1")
+    finally:
+        await s0.shutdown()
+        await s1.shutdown()
+
+
+async def test_resolver_address_forms():
+    """resolve() handles bare IPv6 literals, bracketed IPv6:port, host:port,
+    numeric pass-through, and malformed targets."""
+    from serf_tpu.host.net import NetTransport
+
+    t = await NetTransport.bind(("127.0.0.1", 0))
+    try:
+        assert await t.resolve(("127.0.0.1", 80)) == ("127.0.0.1", 80)
+        assert await t.resolve("127.0.0.1:80") == ("127.0.0.1", 80)
+        # bare IPv6 literal: NOT split at the last colon
+        assert await t.resolve("::1") == "::1"
+        assert await t.resolve("fe80::1") == "fe80::1"
+        # bracketed IPv6 with port
+        assert await t.resolve("[::1]:8080") == ("::1", 8080)
+        with pytest.raises(ConnectionError):
+            await t.resolve("host:notaport")
+        # family constrained to the bound socket (IPv4 here)
+        host, port = await t.resolve(f"localhost:9")
+        assert host == "127.0.0.1" and port == 9
+    finally:
+        await t.shutdown()
