@@ -1,13 +1,17 @@
-"""Benchmark: 1M-node SWIM cluster simulation throughput on TPU.
+"""Benchmark: 1M-node serf/SWIM cluster simulation throughput on TPU.
 
-Headline metric (BASELINE.md north star): gossip rounds/sec simulating a
-1,000,000-node SWIM cluster — full protocol rounds (dissemination with
-transmit-limited budgets + probe/suspect/refute/declare failure detection) —
-target >= 10,000 rounds/sec on a v5e-8.  ``vs_baseline`` is measured against
-that 10k target.
+Headline metric (BASELINE.md north star): FULL protocol rounds/sec
+simulating a 1,000,000-node cluster with the flagship ``cluster_round`` —
+gossip dissemination with transmit-limited budgets + probe/indirect-probe/
+suspect/refute/declare failure detection + periodic push/pull anti-entropy
++ Vivaldi coordinate co-training — target >= 10,000 rounds/sec on a v5e-8.
+``vs_baseline`` is measured against that 10k target.
 
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Secondary measurements (run_swim without anti-entropy/vivaldi, and the
+Pallas-kernel A/B on TPU) go to stderr and ``BENCH_DETAIL.json``.
 
 Robustness: the TPU here is reached through a tunnel that can wedge (a
 killed client can leave the allocator grant stuck).  The orchestrator runs
@@ -18,6 +22,7 @@ driver.  Run with ``--run`` to execute the measurement directly.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import json
 import os
@@ -25,7 +30,7 @@ import subprocess
 import sys
 import time
 
-N_NODES = 1_000_000
+N_NODES = int(os.environ.get("SERF_TPU_BENCH_N", 1_000_000))
 K_FACTS = 64
 ROUNDS_PER_CALL = 100
 TIMED_CALLS = 3
@@ -34,13 +39,28 @@ TPU_TIMEOUT_S = int(os.environ.get("SERF_TPU_BENCH_TIMEOUT", "480"))
 CPU_TIMEOUT_S = int(os.environ.get("SERF_TPU_BENCH_CPU_TIMEOUT", "900"))
 
 
+def _time_rounds(jitted, state, key, rounds_per_call, timed_calls):
+    import jax
+
+    key, k = jax.random.split(key)
+    state = jax.block_until_ready(
+        jitted(state, key=k, num_rounds=rounds_per_call))  # compile+warm
+    t0 = time.perf_counter()
+    for _ in range(timed_calls):
+        key, k = jax.random.split(key)
+        state = jitted(state, key=k, num_rounds=rounds_per_call)
+    state = jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    return state, (rounds_per_call * timed_calls) / dt
+
+
 def main() -> None:
     import jax
 
-    if jax.default_backend() == "cpu":
-        # CPU fallback keeps the same cluster size but fewer rounds
-        global ROUNDS_PER_CALL, TIMED_CALLS
-        ROUNDS_PER_CALL, TIMED_CALLS = 10, 2
+    on_cpu = jax.default_backend() == "cpu"
+    rounds_per_call = 10 if on_cpu else ROUNDS_PER_CALL
+    timed_calls = 2 if on_cpu else TIMED_CALLS
+
     import jax.numpy as jnp
 
     from serf_tpu.models.dissemination import (
@@ -48,57 +68,91 @@ def main() -> None:
         K_USER_EVENT,
         coverage,
         inject_fact,
-        make_state,
     )
     from serf_tpu.models.failure import FailureConfig, run_swim
+    from serf_tpu.models.swim import ClusterConfig, make_cluster, run_cluster
 
-    cfg = GossipConfig(n=N_NODES, k_facts=K_FACTS)
+    detail = {}
+    gcfg = GossipConfig(n=N_NODES, k_facts=K_FACTS)
     fcfg = FailureConfig(suspicion_rounds=12, max_new_facts=8)
+    cfg = ClusterConfig(gossip=gcfg, failure=fcfg, push_pull_every=16,
+                        with_failure=True, with_vivaldi=True)
 
-    key = jax.random.key(0)
-    state = make_state(cfg)
-    # realistic work: live dissemination + a churn event to detect
-    for i in range(8):
-        state = inject_fact(state, cfg, subject=i * 1000, kind=K_USER_EVENT,
-                            incarnation=0, ltime=i + 1, origin=i * 1000)
-    dead = jnp.arange(0, N_NODES, N_NODES // 100)[:64]  # 64 dead nodes
-    state = state._replace(alive=state.alive.at[dead].set(False))
+    def seeded_state(c):
+        key = jax.random.key(0)
+        st = make_cluster(c, key)
+        g = st.gossip
+        # realistic work: live dissemination + churn events to detect
+        spacing = max(1, N_NODES // 8)
+        for i in range(8):
+            g = inject_fact(g, c.gossip, subject=(i * spacing) % N_NODES,
+                            kind=K_USER_EVENT, incarnation=0, ltime=i + 1,
+                            origin=(i * spacing) % N_NODES)
+        n_dead = min(64, N_NODES // 100)   # keep tiny smoke-test Ns sane
+        if n_dead:
+            dead = jnp.arange(n_dead) * (N_NODES // n_dead)
+            g = g._replace(alive=g.alive.at[dead].set(False))
+        return st._replace(gossip=g)
 
-    run = jax.jit(functools.partial(run_swim, cfg=cfg, fcfg=fcfg),
-                  static_argnames=("num_rounds",), donate_argnums=(0,))
-
-    # warmup / compile
-    key, k = jax.random.split(key)
-    state = jax.block_until_ready(run(state, key=k, num_rounds=ROUNDS_PER_CALL))
-
-    t0 = time.perf_counter()
-    for _ in range(TIMED_CALLS):
-        key, k = jax.random.split(key)
-        state = run(state, key=k, num_rounds=ROUNDS_PER_CALL)
-    state = jax.block_until_ready(state)
-    dt = time.perf_counter() - t0
-
-    rounds = ROUNDS_PER_CALL * TIMED_CALLS
-    rps = rounds / dt
+    # --- headline: the flagship cluster round (all subsystems on) ---------
+    state = seeded_state(cfg)
+    run_flag = jax.jit(functools.partial(run_cluster, cfg=cfg),
+                       static_argnames=("num_rounds",), donate_argnums=(0,))
+    state, flagship_rps = _time_rounds(run_flag, state, jax.random.key(1),
+                                       rounds_per_call, timed_calls)
+    detail["cluster_round_rps"] = round(flagship_rps, 2)
 
     # sanity: the simulation made protocol progress (facts spread)
-    cov = float(coverage(state, cfg)[0])
+    cov = float(coverage(state.gossip, cfg.gossip)[0])
     if not (0.0 < cov <= 1.0):
         print(json.dumps({"metric": "ERROR: no protocol progress",
                           "value": 0, "unit": "rounds/sec",
                           "vs_baseline": 0.0}))
         sys.exit(1)
 
+    # --- secondary: swim-only (dissemination + failure detection) ---------
+    swim_state = seeded_state(cfg).gossip
+    run_sw = jax.jit(functools.partial(run_swim, cfg=gcfg, fcfg=fcfg),
+                     static_argnames=("num_rounds",), donate_argnums=(0,))
+    _, swim_rps = _time_rounds(run_sw, swim_state, jax.random.key(2),
+                               rounds_per_call, timed_calls)
+    detail["run_swim_rps"] = round(swim_rps, 2)
+
+    # --- secondary: Pallas fused-kernel A/B (TPU only; compiled, not
+    #     interpret mode) ---------------------------------------------------
+    if not on_cpu:
+        try:
+            gcfg_p = dataclasses.replace(gcfg, use_pallas=True)
+            pal_state = seeded_state(
+                dataclasses.replace(cfg, gossip=gcfg_p)).gossip
+            run_pal = jax.jit(
+                functools.partial(run_swim, cfg=gcfg_p, fcfg=fcfg),
+                static_argnames=("num_rounds",), donate_argnums=(0,))
+            _, pal_rps = _time_rounds(run_pal, pal_state, jax.random.key(2),
+                                      rounds_per_call, timed_calls)
+            detail["run_swim_pallas_rps"] = round(pal_rps, 2)
+        except Exception as e:  # noqa: BLE001 - A/B is best-effort detail
+            detail["run_swim_pallas_error"] = repr(e)[:300]
+
     platform = f"{len(jax.devices())}x {jax.devices()[0].device_kind}"
-    if jax.default_backend() == "cpu":
+    if on_cpu:
         platform += " (CPU FALLBACK — TPU tunnel unavailable)"
+    detail["platform"] = platform
+    sys.stderr.write(json.dumps(detail) + "\n")
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_DETAIL.json"), "w") as f:
+            json.dump(detail, f, indent=1)
+    except OSError:
+        pass
+
     print(json.dumps({
-        "metric": f"SWIM gossip rounds/sec @ {N_NODES} simulated nodes "
-                  f"(full round: dissemination + failure detection), "
+        "metric": f"full serf cluster rounds/sec @ {N_NODES} simulated nodes "
+                  f"(gossip + failure detection + anti-entropy + vivaldi), "
                   f"{platform}",
-        "value": round(rps, 2),
+        "value": round(flagship_rps, 2),
         "unit": "rounds/sec",
-        "vs_baseline": round(rps / TARGET_ROUNDS_PER_SEC, 4),
+        "vs_baseline": round(flagship_rps / TARGET_ROUNDS_PER_SEC, 4),
     }))
 
 
@@ -110,11 +164,11 @@ def orchestrate() -> None:
         proc = subprocess.run([sys.executable, me, "--run"],
                               capture_output=True, text=True,
                               timeout=TPU_TIMEOUT_S)
+        sys.stderr.write(proc.stderr[-2000:] + "\n")
         out = _last_json_line(proc.stdout)
         if proc.returncode == 0 and out is not None:
             print(out)
             return
-        sys.stderr.write(proc.stderr[-2000:] + "\n")
     except subprocess.TimeoutExpired:
         sys.stderr.write("TPU bench timed out (wedged tunnel?); "
                          "falling back to CPU\n")
@@ -123,11 +177,11 @@ def orchestrate() -> None:
         proc = subprocess.run([sys.executable, me, "--run"],
                               capture_output=True, text=True,
                               timeout=CPU_TIMEOUT_S, env=env)
+        sys.stderr.write(proc.stderr[-2000:] + "\n")
         out = _last_json_line(proc.stdout)
         if proc.returncode == 0 and out is not None:
             print(out)
             return
-        sys.stderr.write(proc.stderr[-2000:] + "\n")
     except subprocess.TimeoutExpired:
         sys.stderr.write("CPU fallback bench also timed out\n")
     print(json.dumps({"metric": "ERROR: bench failed on TPU and CPU",

@@ -1,0 +1,316 @@
+#!/usr/bin/env python
+"""Standing fuzz harness over the wire codec — the analog of the reference's
+libfuzzer target (fuzz/fuzz_targets/messages.rs:12-16, fuzzy::Message).
+
+Three loops, seeded and time-/case-boxed:
+
+1. **round-trip**: arbitrary messages of every envelope type (incl. RELAY
+   nesting and swim COMPOUND wrapping) must satisfy
+   ``decode(encode(m)) == m``.
+2. **mutation**: truncations / bit-flips / splices of valid encodings must
+   either decode to *something* or raise ``DecodeError`` — never any other
+   exception (the fail-closed contract).
+3. **garbage**: raw random buffers, same contract; also fed through the
+   swim-packet decoder and the native C++ field scanner (differential vs
+   the pure-Python scanner when the native lib is available).
+
+Run standalone (CI artifact)::
+
+    python fuzz/fuzz_messages.py --seconds 60 --seed 0
+    python fuzz/fuzz_messages.py --cases 1000000
+
+Prints one JSON summary line; exit code 0 iff no contract violations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from serf_tpu import codec
+from serf_tpu.host import messages as sm
+from serf_tpu.types.filters import IdFilter, TagFilter
+from serf_tpu.types.member import Member, MemberStatus, Node
+from serf_tpu.types.messages import (
+    ConflictResponseMessage,
+    JoinMessage,
+    KeyRequestMessage,
+    KeyResponseMessage,
+    LeaveMessage,
+    PushPullMessage,
+    QueryFlag,
+    QueryMessage,
+    QueryResponseMessage,
+    RelayMessage,
+    UserEventMessage,
+    UserEvents,
+    decode_message,
+    encode_message,
+    encode_relay_message,
+)
+from serf_tpu.types.tags import Tags
+
+
+def _arb_str(rng: random.Random, max_len: int = 24) -> str:
+    n = rng.randrange(max_len)
+    return "".join(chr(rng.choice((rng.randrange(32, 127),
+                                   rng.randrange(0x80, 0x2FF))))
+                   for _ in range(n))
+
+
+def _arb_bytes(rng: random.Random, max_len: int = 64) -> bytes:
+    return rng.randbytes(rng.randrange(max_len))
+
+
+def _arb_node(rng: random.Random) -> Node:
+    addr = rng.choice([
+        None,
+        rng.randrange(1 << 16),
+        (_arb_str(rng, 12).replace(":", "_"), rng.randrange(1 << 16)),
+        _arb_str(rng, 12).replace(":", "_") or "x",
+    ])
+    return Node(_arb_str(rng), addr)
+
+
+def _arb_ltime(rng: random.Random) -> int:
+    return rng.choice([0, 1, rng.randrange(1 << 16), rng.randrange(1 << 63)])
+
+
+def _arb_member(rng: random.Random) -> Member:
+    tags = Tags({_arb_str(rng, 8): _arb_str(rng, 8)
+                 for _ in range(rng.randrange(3))})
+    return Member(_arb_node(rng), tags,
+                  MemberStatus(rng.randrange(5)))
+
+
+def _arb_filter(rng: random.Random):
+    if rng.random() < 0.5:
+        return IdFilter(tuple(_arb_str(rng) for _ in range(rng.randrange(4))))
+    # keep expr a literal so construction cannot fail
+    return TagFilter(_arb_str(rng, 8), "literal" + _arb_str(rng, 4)
+                     .replace("\\", "").replace("[", "").replace("(", "")
+                     .replace("*", "").replace("+", "").replace("?", "")
+                     .replace("{", "").replace("|", "").replace(")", "")
+                     .replace("]", "").replace("^", "").replace("$", ""))
+
+
+def _arb_user_events(rng: random.Random) -> UserEvents:
+    return UserEvents(_arb_ltime(rng), tuple(
+        UserEventMessage(_arb_ltime(rng), _arb_str(rng), _arb_bytes(rng),
+                         rng.random() < 0.5)
+        for _ in range(rng.randrange(3))))
+
+
+def arbitrary_message(rng: random.Random, depth: int = 0):
+    """The fuzzy::Message analog: any envelope type, relay-nested up to 3."""
+    kinds = ["join", "leave", "user", "pushpull", "query", "query_resp",
+             "conflict", "key_req", "key_resp"]
+    if depth < 3:
+        kinds.append("relay")
+    k = rng.choice(kinds)
+    if k == "join":
+        return JoinMessage(_arb_ltime(rng), _arb_str(rng))
+    if k == "leave":
+        return LeaveMessage(_arb_ltime(rng), _arb_str(rng),
+                            rng.random() < 0.5)
+    if k == "user":
+        return UserEventMessage(_arb_ltime(rng), _arb_str(rng),
+                                _arb_bytes(rng), rng.random() < 0.5)
+    if k == "pushpull":
+        return PushPullMessage(
+            _arb_ltime(rng),
+            {_arb_str(rng): _arb_ltime(rng) for _ in range(rng.randrange(4))},
+            tuple(_arb_str(rng) for _ in range(rng.randrange(3))),
+            _arb_ltime(rng),
+            tuple(_arb_user_events(rng) for _ in range(rng.randrange(3))),
+            _arb_ltime(rng))
+    if k == "query":
+        return QueryMessage(
+            _arb_ltime(rng), rng.randrange(1 << 32), _arb_node(rng),
+            tuple(_arb_filter(rng) for _ in range(rng.randrange(3))),
+            QueryFlag(rng.randrange(4)), rng.randrange(6),
+            rng.randrange(1 << 40), _arb_str(rng), _arb_bytes(rng))
+    if k == "query_resp":
+        return QueryResponseMessage(_arb_ltime(rng), rng.randrange(1 << 32),
+                                    _arb_node(rng), QueryFlag(rng.randrange(4)),
+                                    _arb_bytes(rng))
+    if k == "conflict":
+        return ConflictResponseMessage(_arb_member(rng))
+    if k == "key_req":
+        return KeyRequestMessage(_arb_bytes(rng, 33))
+    if k == "key_resp":
+        return KeyResponseMessage(rng.random() < 0.5, _arb_str(rng),
+                                  tuple(_arb_bytes(rng, 33)
+                                        for _ in range(rng.randrange(3))),
+                                  _arb_bytes(rng, 33))
+    # relay: nest an encoded inner message
+    inner = arbitrary_message(rng, depth + 1)
+    return RelayMessage(_arb_node(rng), encode_message(inner)
+                        if not isinstance(inner, RelayMessage)
+                        else encode_relay_message(inner.node, inner.payload))
+
+
+def encode_any(msg) -> bytes:
+    if isinstance(msg, RelayMessage):
+        return encode_relay_message(msg.node, msg.payload)
+    return encode_message(msg)
+
+
+def _mutate(rng: random.Random, raw: bytes) -> bytes:
+    choice = rng.random()
+    b = bytearray(raw)
+    if choice < 0.35 and b:                       # truncate
+        return bytes(b[:rng.randrange(len(b))])
+    if choice < 0.7 and b:                        # bit flips
+        for _ in range(rng.randrange(1, 4)):
+            i = rng.randrange(len(b))
+            b[i] ^= 1 << rng.randrange(8)
+        return bytes(b)
+    if choice < 0.9 and b:                        # splice random chunk
+        i = rng.randrange(len(b))
+        return bytes(b[:i]) + rng.randbytes(rng.randrange(8)) + bytes(b[i:])
+    return rng.randbytes(rng.randrange(96))       # replace wholesale
+
+
+def _python_scan(buf: bytes):
+    """Independent pure-Python field scan (the differential oracle — kept
+    deliberately separate from the dispatching ``codec.iter_fields``)."""
+    out = []
+    pos, end = 0, len(buf)
+    while pos < end:
+        key, pos = codec.decode_varint(buf, pos)
+        field, wt = codec.split_tag(key)
+        if wt == codec.WT_VARINT:
+            value, pos = codec.decode_varint(buf, pos)
+        elif wt == codec.WT_FIXED64:
+            if pos + 8 > end:
+                raise codec.DecodeError("truncated fixed64")
+            value, pos = buf[pos:pos + 8], pos + 8
+        elif wt == codec.WT_LENGTH_DELIMITED:
+            ln, pos = codec.decode_varint(buf, pos)
+            if pos + ln > end:
+                raise codec.DecodeError("truncated length-delimited field")
+            value, pos = buf[pos:pos + ln], pos + ln
+        elif wt == codec.WT_FIXED32:
+            if pos + 4 > end:
+                raise codec.DecodeError("truncated fixed32")
+            value, pos = buf[pos:pos + 4], pos + 4
+        else:
+            raise codec.DecodeError(f"unknown wire type {wt}")
+        out.append((field, wt, bytes(value) if isinstance(value, (bytes, bytearray)) else value, pos))
+    return out
+
+
+def _native_scanner():
+    try:
+        from serf_tpu.codec import _native
+        if _native.load() is not None:
+            return _native
+    except Exception:  # noqa: BLE001 - native lib strictly optional here
+        pass
+    return None
+
+
+def run(seed: int, seconds: float | None, cases: int | None) -> dict:
+    rng = random.Random(seed)
+    native = _native_scanner()
+    stats = {"round_trips": 0, "mutations": 0, "garbage": 0,
+             "decode_errors": 0, "violations": 0, "native_diffs": 0}
+    deadline = time.monotonic() + seconds if seconds else None
+    examples = []
+
+    def check_decode(buf: bytes, where: str) -> None:
+        try:
+            decode_message(buf)
+        except codec.DecodeError:
+            stats["decode_errors"] += 1
+        except Exception as e:  # noqa: BLE001 - the contract under test
+            stats["violations"] += 1
+            if len(examples) < 5:
+                examples.append({"where": where, "err": repr(e),
+                                 "buf": buf[:64].hex()})
+        # swim packet layer (COMPOUND/USER framing shares the contract)
+        try:
+            sm.decode_swim(buf)
+        except codec.DecodeError:
+            pass
+        except Exception as e:  # noqa: BLE001
+            stats["violations"] += 1
+            if len(examples) < 5:
+                examples.append({"where": where + "/swim", "err": repr(e),
+                                 "buf": buf[:64].hex()})
+        if native is not None:
+            body = buf[1:]
+            scanned = native.scan_fields(body, 0, len(body))
+            try:
+                py = _python_scan(body)
+            except codec.DecodeError:
+                py = None
+            if scanned is not None:
+                got = (None if scanned == -1 else
+                       [(f, w, bytes(v) if isinstance(v, (bytes, bytearray, memoryview)) else v, p)
+                        for f, w, v, p in scanned])
+                if got != py:
+                    stats["native_diffs"] += 1
+                    if len(examples) < 5:
+                        examples.append({"where": where + "/native",
+                                         "buf": body[:64].hex()})
+
+    i = 0
+    while True:
+        if deadline is not None and time.monotonic() >= deadline:
+            break
+        if cases is not None and i >= cases:
+            break
+        i += 1
+        msg = arbitrary_message(rng)
+        raw = encode_any(msg)
+        back = decode_message(raw)
+        if back != msg:
+            stats["violations"] += 1
+            if len(examples) < 5:
+                examples.append({"where": "round-trip",
+                                 "msg": repr(msg)[:200],
+                                 "back": repr(back)[:200]})
+        stats["round_trips"] += 1
+
+        # wrap through the swim USER framing + COMPOUND, like real packets
+        pkt = sm.encode_compound([sm.encode_swim(sm.UserMsg(raw))])
+        out = sm.decode_swim(pkt)
+        if not (len(out) == 1 and out[0].payload == raw):
+            stats["violations"] += 1
+
+        for _ in range(4):
+            check_decode(_mutate(rng, raw), "mutation")
+            stats["mutations"] += 1
+        check_decode(rng.randbytes(rng.randrange(96)), "garbage")
+        stats["garbage"] += 1
+
+    stats["cases"] = i
+    stats["seed"] = seed
+    stats["examples"] = examples
+    stats["ok"] = stats["violations"] == 0 and stats["native_diffs"] == 0
+    return stats
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=None)
+    ap.add_argument("--cases", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    if args.seconds is None and args.cases is None:
+        args.seconds = 30.0
+    stats = run(args.seed, args.seconds, args.cases)
+    print(json.dumps(stats))
+    return 0 if stats["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

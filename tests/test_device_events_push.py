@@ -86,3 +86,40 @@ def test_device_event_stream():
             break
     assert full and full[0].subject == 7
     assert full[0].knowers == cfg.n
+
+
+def test_device_event_stream_emits_retired_on_ring_overwrite():
+    cfg = GossipConfig(n=64, k_facts=32)
+    s = make_state(cfg)
+    stream = DeviceEventStream(cfg)
+    s = inject_fact(s, cfg, 7, K_USER_EVENT, 0, 1, 0)
+    stream.push(summarize(s, cfg))
+    # wrap the ring: k_facts more injections overwrite slot 0
+    for i in range(cfg.k_facts):
+        s = inject_fact(s, cfg, 100 + i, K_USER_EVENT, 0, 2 + i, 0)
+    events = stream.push(summarize(s, cfg))
+    assert any(e.kind == "retired" and e.subject == 7 for e in events)
+    # the new occupants of the ring are born
+    assert sum(e.kind == "fact-born" for e in events) == cfg.k_facts
+
+
+def test_device_event_stream_single_transfer_per_push():
+    """push() must not issue per-slot device syncs: after one device_get the
+    diff is pure numpy.  Guard by counting jax.device_get calls."""
+    import numpy as np
+    from unittest import mock
+
+    cfg = GossipConfig(n=64, k_facts=32)
+    s = inject_fact(make_state(cfg), cfg, 3, K_USER_EVENT, 0, 1, 0)
+    stream = DeviceEventStream(cfg)
+    summary = summarize(s, cfg)
+    real = jax.device_get
+    calls = []
+
+    def counting(x):
+        calls.append(1)
+        return real(x)
+
+    with mock.patch.object(jax, "device_get", counting):
+        stream.push(summary)
+    assert len(calls) == 1
