@@ -110,6 +110,21 @@ def main() -> None:
                           "vs_baseline": 0.0}))
         sys.exit(1)
 
+    # print + flush the headline BEFORE the secondary benches: if a
+    # secondary hangs/crashes, the orchestrator can still salvage the
+    # already-valid headline from the subprocess's captured stdout
+    platform = f"{len(jax.devices())}x {jax.devices()[0].device_kind}"
+    if on_cpu:
+        platform += " (CPU FALLBACK — TPU tunnel unavailable)"
+    print(json.dumps({
+        "metric": f"full serf cluster rounds/sec @ {N_NODES} simulated nodes "
+                  f"(gossip + failure detection + anti-entropy + vivaldi), "
+                  f"{platform}",
+        "value": round(flagship_rps, 2),
+        "unit": "rounds/sec",
+        "vs_baseline": round(flagship_rps / TARGET_ROUNDS_PER_SEC, 4),
+    }), flush=True)
+
     # --- secondary: swim-only (dissemination + failure detection) ---------
     swim_state = seeded_state(cfg).gossip
     run_sw = jax.jit(functools.partial(run_swim, cfg=gcfg, fcfg=fcfg),
@@ -134,9 +149,6 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 - A/B is best-effort detail
             detail["run_swim_pallas_error"] = repr(e)[:300]
 
-    platform = f"{len(jax.devices())}x {jax.devices()[0].device_kind}"
-    if on_cpu:
-        platform += " (CPU FALLBACK — TPU tunnel unavailable)"
     detail["platform"] = platform
     sys.stderr.write(json.dumps(detail) + "\n")
     try:
@@ -145,15 +157,6 @@ def main() -> None:
             json.dump(detail, f, indent=1)
     except OSError:
         pass
-
-    print(json.dumps({
-        "metric": f"full serf cluster rounds/sec @ {N_NODES} simulated nodes "
-                  f"(gossip + failure detection + anti-entropy + vivaldi), "
-                  f"{platform}",
-        "value": round(flagship_rps, 2),
-        "unit": "rounds/sec",
-        "vs_baseline": round(flagship_rps / TARGET_ROUNDS_PER_SEC, 4),
-    }))
 
 
 def orchestrate() -> None:
@@ -166,10 +169,22 @@ def orchestrate() -> None:
                               timeout=TPU_TIMEOUT_S)
         sys.stderr.write(proc.stderr[-2000:] + "\n")
         out = _last_json_line(proc.stdout)
-        if proc.returncode == 0 and out is not None:
+        if out is not None and (proc.returncode == 0
+                                or "ERROR" not in out):
             print(out)
             return
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # the headline is printed+flushed before the secondary benches, so
+        # a hang in a secondary still leaves a salvageable headline
+        partial = e.stdout
+        if isinstance(partial, bytes):
+            partial = partial.decode(errors="replace")
+        out = _last_json_line(partial or "")
+        if out is not None and "ERROR" not in out:
+            sys.stderr.write("TPU bench timed out after the headline; "
+                             "keeping the measured headline\n")
+            print(out)
+            return
         sys.stderr.write("TPU bench timed out (wedged tunnel?); "
                          "falling back to CPU\n")
     env = dict(os.environ, SERF_TPU_BENCH_CPU="1")

@@ -134,3 +134,88 @@ long serf_varint_decode(const unsigned char* buf, long len, uint64_t* value) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Wire checksums (host/wire.py registry hot path).
+//
+// xxhash32 and murmur3_x86_32 per their public specs — the Python
+// implementations in serf_tpu/host/wire.py are the semantic oracles
+// (validated against published vectors); these native versions are the
+// per-packet fast path.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+inline uint32_t rotl32(uint32_t x, int r) {
+    return (x << r) | (x >> (32 - r));
+}
+
+inline uint32_t read_le32(const unsigned char* p) {
+    return static_cast<uint32_t>(p[0]) |
+           (static_cast<uint32_t>(p[1]) << 8) |
+           (static_cast<uint32_t>(p[2]) << 16) |
+           (static_cast<uint32_t>(p[3]) << 24);
+}
+
+}  // namespace
+
+extern "C" {
+
+uint32_t serf_xxhash32(const unsigned char* data, long n, uint32_t seed) {
+    const uint32_t P1 = 2654435761U, P2 = 2246822519U, P3 = 3266489917U,
+                   P4 = 668265263U, P5 = 374761393U;
+    long idx = 0;
+    uint32_t h;
+    if (n >= 16) {
+        uint32_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed,
+                 v4 = seed - P1;
+        while (idx <= n - 16) {
+            v1 = rotl32(v1 + read_le32(data + idx) * P2, 13) * P1; idx += 4;
+            v2 = rotl32(v2 + read_le32(data + idx) * P2, 13) * P1; idx += 4;
+            v3 = rotl32(v3 + read_le32(data + idx) * P2, 13) * P1; idx += 4;
+            v4 = rotl32(v4 + read_le32(data + idx) * P2, 13) * P1; idx += 4;
+        }
+        h = rotl32(v1, 1) + rotl32(v2, 7) + rotl32(v3, 12) + rotl32(v4, 18);
+    } else {
+        h = seed + P5;
+    }
+    h += static_cast<uint32_t>(n);
+    while (idx <= n - 4) {
+        h = rotl32(h + read_le32(data + idx) * P3, 17) * P4;
+        idx += 4;
+    }
+    while (idx < n) {
+        h = rotl32(h + data[idx] * P5, 11) * P1;
+        ++idx;
+    }
+    h ^= h >> 15; h *= P2;
+    h ^= h >> 13; h *= P3;
+    h ^= h >> 16;
+    return h;
+}
+
+uint32_t serf_murmur3_32(const unsigned char* data, long n, uint32_t seed) {
+    const uint32_t C1 = 0xCC9E2D51U, C2 = 0x1B873593U;
+    uint32_t h = seed;
+    const long rounds = n / 4;
+    for (long i = 0; i < rounds; ++i) {
+        uint32_t k = read_le32(data + i * 4);
+        k *= C1; k = rotl32(k, 15); k *= C2;
+        h ^= k; h = rotl32(h, 13); h = h * 5 + 0xE6546B64U;
+    }
+    const unsigned char* tail = data + rounds * 4;
+    uint32_t k = 0;
+    switch (n & 3) {
+        case 3: k ^= static_cast<uint32_t>(tail[2]) << 16; [[fallthrough]];
+        case 2: k ^= static_cast<uint32_t>(tail[1]) << 8;  [[fallthrough]];
+        case 1: k ^= tail[0];
+                k *= C1; k = rotl32(k, 15); k *= C2; h ^= k;
+    }
+    h ^= static_cast<uint32_t>(n);
+    h ^= h >> 16; h *= 0x85EBCA6BU;
+    h ^= h >> 13; h *= 0xC2B2AE35U;
+    h ^= h >> 16;
+    return h;
+}
+
+}  // extern "C"

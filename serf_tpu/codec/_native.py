@@ -74,6 +74,12 @@ def load() -> Optional[ctypes.CDLL]:
         lib.serf_varint_decode.restype = ctypes.c_long
         lib.serf_varint_decode.argtypes = [
             ctypes.c_char_p, ctypes.c_long, ctypes.POINTER(ctypes.c_uint64)]
+        for name in ("serf_xxhash32", "serf_murmur3_32"):
+            fn = getattr(lib, name, None)
+            if fn is not None:
+                fn.restype = ctypes.c_uint32
+                fn.argtypes = [ctypes.c_char_p, ctypes.c_long,
+                               ctypes.c_uint32]
         _lib = lib
         return _lib
 
@@ -128,3 +134,18 @@ def scan_fields(buf: bytes, pos: int, end: int):
             new_pos = pos + int(voff) + int(length)
         result.append((int(field), int(wt), value, new_pos))
     return result
+
+
+def checksum_fn(name: str):
+    """Native checksum implementation (``xxhash32`` / ``murmur3``) or None.
+
+    A freshly-rebuilt library always has these; ``getattr`` guards a stale
+    prebuilt .so from before they existed."""
+    lib = load()
+    if lib is None:
+        return None
+    sym = {"xxhash32": "serf_xxhash32", "murmur3": "serf_murmur3_32"}.get(name)
+    fn = getattr(lib, sym, None) if sym else None
+    if fn is None:
+        return None
+    return lambda data, seed=0: fn(bytes(data), len(data), seed)

@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Tuple
 
 from serf_tpu.types.clock import LamportTime
 from serf_tpu.types.member import Member
+from serf_tpu.utils import metrics
 from serf_tpu.types.messages import (
     QueryFlag,
     QueryResponseMessage,
@@ -98,6 +99,11 @@ class EventSubscriber:
 
     def __init__(self, maxsize: int = 4096):
         self._q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
+        #: events discarded by drop-oldest overflow.  Deliberate deviation
+        #: from the reference's backpressuring bounded channel: a slow
+        #: consumer must not wedge the protocol; the counter (plus the
+        #: serf.subscriber.dropped metric) makes the loss observable.
+        self.dropped = 0
 
     def _push(self, ev) -> None:
         while True:
@@ -107,6 +113,8 @@ class EventSubscriber:
             except asyncio.QueueFull:
                 try:
                     self._q.get_nowait()  # drop oldest
+                    self.dropped += 1
+                    metrics.incr("serf.subscriber.dropped", 1)
                     log.warning("event subscriber overflow: dropping oldest event")
                 except asyncio.QueueEmpty:
                     pass

@@ -108,11 +108,35 @@ def murmur3_32(data: bytes, seed: int = 0) -> int:
     return h
 
 
+def _native_or(name: str, py_fn: Callable[[bytes], int]):
+    """Prefer the native C++ implementation (native/codec.cpp) of a
+    checksum; the Python spec implementation above stays the oracle
+    (differential-pinned in tests/test_wire.py).
+
+    Resolution is deferred to the first call: ``_native.load()`` may build
+    the shared library with g++, and that must not happen at import time
+    of the host stack."""
+    impl: list = []
+
+    def dispatch(data: bytes) -> int:
+        if not impl:
+            fn = None
+            try:
+                from serf_tpu.codec import _native
+                fn = _native.checksum_fn(name)
+            except Exception:  # noqa: BLE001 - native strictly optional
+                fn = None
+            impl.append(fn or py_fn)
+        return impl[0](data)
+
+    return dispatch
+
+
 CHECKSUMS: Dict[str, Callable[[bytes], int]] = {
     "crc32": lambda b: zlib.crc32(b) & _M,
     "adler32": lambda b: zlib.adler32(b) & _M,
-    "xxhash32": xxhash32,
-    "murmur3": murmur3_32,
+    "xxhash32": _native_or("xxhash32", xxhash32),
+    "murmur3": _native_or("murmur3", murmur3_32),
 }
 
 # marker byte → (compress, decompress); marker 0 = uncompressed

@@ -177,10 +177,14 @@ def declare_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
     subject_expired = jnp.zeros((n,), bool).at[subj].max(jnp.any(expired, axis=0))
     already_dead = _subject_covered(state, cfg, (K_DEAD,))
     candidates = subject_expired & ~already_dead
-    # declarer: lowest-id knower with the expired suspicion
-    any_expired_fact = jnp.any(expired, axis=1)              # bool[N] knowers
-    declarer = jnp.argmax(any_expired_fact).astype(jnp.int32)
-    declarers = jnp.full((n,), declarer, jnp.int32)
+    # declarer PER SUBJECT: the lowest-id knower whose suspicion of that
+    # subject expired (argmax of bool = first True).  A single global
+    # declarer would skew per-node fairness accounting.
+    fact_has_expired = jnp.any(expired, axis=0)              # bool[K]
+    declarer_of_fact = jnp.argmax(expired, axis=0).astype(jnp.int32)  # [K]
+    declarers_p1 = jnp.zeros((n,), jnp.int32).at[subj].max(
+        jnp.where(fact_has_expired, declarer_of_fact + 1, 0))
+    declarers = jnp.maximum(declarers_p1 - 1, 0)
     return _bounded_inject(state, cfg, candidates, K_DEAD,
                            state.incarnation, declarers,
                            fcfg.max_new_facts, key)

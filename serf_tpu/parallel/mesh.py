@@ -33,29 +33,55 @@ def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     return Mesh(np.array(devices), (NODE_AXIS,))
 
 
-def _spec_for(path: str, arr) -> P:
-    """Per-node arrays shard on their first (N) axis; facts and scalars are
-    replicated."""
+# QueryState per-node planes are [Q, N]: the node axis is SECOND
+_QUERY_QN_FIELDS = frozenset(
+    {"eligible", "attempted", "acked", "responded", "resp_value"})
+# QueryState Q-major vectors are replicated (Q is small and global).
+# "ltime"/"valid" only reach these checks for QueryState — the fact-table
+# fields of the same name are caught by the "facts" ancestor check first.
+_QUERY_Q_FIELDS = frozenset(
+    {"origin", "fact_slot", "deadline", "want_ack", "ltime", "valid"})
+
+
+def _path_names(path) -> list:
+    """Exact attribute/key names along a tree path (no substring traps)."""
+    names = []
+    for entry in path:
+        name = getattr(entry, "name", None) or getattr(entry, "key", None)
+        if name is not None:
+            names.append(str(name))
+    return names
+
+
+def _spec_for(path, arr) -> P:
+    """Per-node arrays shard on their first (N) axis; facts, scalars, and
+    query-slot metadata are replicated; query [Q, N] planes shard on their
+    second axis."""
     if arr.ndim == 0:
         return P()
+    names = _path_names(path)
+    leaf = names[-1] if names else ""
     # fact-table arrays are K-major and replicated; everything under
     # 'gossip.facts' or with a non-N leading dim stays replicated
-    if "facts" in path:
+    if "facts" in names:
         return P()
-    if "adj_index" in path:
+    if leaf == "adj_index":
+        return P()
+    if leaf in _QUERY_QN_FIELDS:
+        return P(None, NODE_AXIS)
+    if leaf in _QUERY_Q_FIELDS:
         return P()
     return P(NODE_AXIS)
 
 
-def state_shardings(state: ClusterState, mesh: Mesh):
-    """A pytree of NamedShardings matching ``state``."""
+def state_shardings(state, mesh: Mesh):
+    """A pytree of NamedShardings matching ``state`` (works for
+    ClusterState, GossipState, QueryState, or any composite of them)."""
     flat, treedef = jax.tree_util.tree_flatten_with_path(state)
-    specs = []
-    for path, leaf in flat:
-        pstr = jax.tree_util.keystr(path)
-        specs.append(NamedSharding(mesh, _spec_for(pstr, leaf)))
+    specs = [NamedSharding(mesh, _spec_for(path, leaf))
+             for path, leaf in flat]
     return jax.tree_util.tree_unflatten(treedef, specs)
 
 
-def shard_state(state: ClusterState, mesh: Mesh) -> ClusterState:
+def shard_state(state, mesh: Mesh):
     return jax.device_put(state, state_shardings(state, mesh))

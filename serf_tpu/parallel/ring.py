@@ -1,0 +1,128 @@
+"""Ring-pipelined gossip exchange: ppermute block rotation over ICI.
+
+The default multi-chip round (`models/dissemination.round_step` under a
+node-sharded mesh) lets GSPMD turn ``packets[srcs]`` into an **all-gather**
+of the packed packet plane — simple, but it materializes the full N×W
+uint32 packet array on every chip (32 MB at 1M nodes) and puts one big
+collective on the critical path.
+
+This module is the ring-attention-style alternative (SURVEY.md §5's
+"where ring-attention-style SPMD decomposition would go"): under
+``shard_map``, each device keeps only its N/D-sized packet block and the
+blocks rotate around the ring with ``lax.ppermute``, one hop per step.
+At hop h device d holds the block of shard (d − h) mod D; each node
+resolves the sampled sources that live in the visiting block.  After D
+hops every source has been resolved — **bit-identical to the all-gather
+round** (same sampled sources, same merge), with peak memory N/D×W per
+chip and D point-to-point neighbor transfers riding the ICI ring instead
+of one global collective.
+
+Use when the packet plane dominates HBM or the all-gather dominates the
+round; the parity test pins bit-equality against ``round_step``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    GossipState,
+    pack_bits,
+    unpack_bits,
+)
+from serf_tpu.parallel.mesh import NODE_AXIS
+
+
+def _ring_gather(packets_local: jnp.ndarray, srcs_local: jnp.ndarray,
+                 n_local: int, n_devices: int) -> jnp.ndarray:
+    """Inside shard_map: resolve global source indices by rotating packet
+    blocks around the ring.
+
+    packets_local: u32[Nl, W] — this shard's packet block
+    srcs_local:    i32[Nl, F] — global source ids sampled by local nodes
+    returns:       u32[Nl, W] — bitwise-OR of the packets of all sources
+    """
+    me = jax.lax.axis_index(NODE_AXIS)
+    perm = [(d, (d + 1) % n_devices) for d in range(n_devices)]
+
+    def resolve(visiting, h, acc):
+        visiting_shard = (me - h) % n_devices
+        mask = (srcs_local // n_local) == visiting_shard      # bool[Nl, F]
+        idx = srcs_local % n_local                            # i32[Nl, F]
+        got = visiting[idx]                                   # u32[Nl, F, W]
+        got = jnp.where(mask[:, :, None], got, jnp.uint32(0))
+        return acc | jax.lax.reduce(got, jnp.uint32(0),
+                                    jnp.bitwise_or, (1,))     # u32[Nl, W]
+
+    def hop(carry, h):
+        visiting, acc = carry
+        acc = resolve(visiting, h, acc)
+        # rotate: my block moves to the next device; I receive the previous
+        visiting = jax.lax.ppermute(visiting, NODE_AXIS, perm)
+        return (visiting, acc), ()
+
+    acc0 = jnp.zeros_like(packets_local)
+    if n_devices == 1:
+        return resolve(packets_local, 0, acc0)
+    # D-1 rotations suffice: the last visiting block is resolved in place
+    # (a final rotation would ship a block nobody reads)
+    (visiting, acc), _ = jax.lax.scan(hop, (packets_local, acc0),
+                                      jnp.arange(n_devices - 1))
+    return resolve(visiting, n_devices - 1, acc)
+
+
+def round_step_ring(state: GossipState, cfg: GossipConfig, key: jax.Array,
+                    mesh, group=None) -> GossipState:
+    """One gossip round with the ring-pipelined exchange.
+
+    Bit-identical to ``round_step(state, cfg, key, group)`` for the same
+    inputs (same RNG stream → same sampled sources, same Lamport merge);
+    only the collective schedule differs.  Requires ``cfg.n`` divisible by
+    the mesh size.
+    """
+    n, k, w = cfg.n, cfg.k_facts, cfg.words
+    n_devices = mesh.shape[NODE_AXIS]
+    if n % n_devices != 0:
+        raise ValueError(f"n={n} not divisible by mesh size {n_devices}")
+    n_local = n // n_devices
+
+    # phases 1+2 exactly as round_step (elementwise; GSPMD shards freely)
+    sending = (state.budgets > 0) & state.alive[:, None]
+    packets = pack_bits(sending)                              # u32[N, W]
+    budgets = jnp.where(sending, state.budgets - 1, state.budgets)
+    aged = jnp.where(state.age < 255, state.age + 1, state.age)
+
+    srcs = jax.random.randint(key, (n, cfg.fanout), 0, n)     # i32[N, F]
+    if group is not None:
+        # Partition mask, evaluated on the sampler side so the ring kernel
+        # stays a pure gather: disallowed cross-group samples are
+        # substituted with SELF.  Parity-safe: a node's sending bits are
+        # always a subset of its known bits (budgets only exist for known
+        # facts), so OR-ing its own packets into `incoming` changes no
+        # merge outcome — exactly like round_step's zeroing.
+        allowed = group[srcs] == group[:, None]               # bool[N, F]
+        srcs = jnp.where(allowed, srcs, jnp.arange(n)[:, None])
+    exchange = shard_map(
+        functools.partial(_ring_gather, n_local=n_local,
+                          n_devices=n_devices),
+        mesh=mesh,
+        in_specs=(P(NODE_AXIS, None), P(NODE_AXIS, None)),
+        out_specs=P(NODE_AXIS, None),
+    )
+    incoming = exchange(packets, srcs)
+
+    alive_col = state.alive[:, None]
+    new_words = incoming & ~state.known & jnp.where(
+        alive_col, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+    known = state.known | new_words
+    new_mask = unpack_bits(new_words, k)
+    budgets = jnp.where(new_mask, jnp.uint8(cfg.transmit_limit), budgets)
+    age = jnp.where(new_mask, jnp.uint8(0), aged)
+    return state._replace(known=known, budgets=budgets, age=age,
+                          round=state.round + 1)

@@ -551,3 +551,81 @@ def test_indirect_probes_do_not_mask_real_deaths():
         if bool(detection_complete(s, cfg, fcfg)):
             break
     assert bool(detection_complete(s, cfg, fcfg))
+
+
+def test_declare_round_attributes_declarer_per_subject():
+    """Each dead declaration's origin must be a knower whose suspicion of
+    THAT subject expired, not one global declarer (round-1 verdict weak #9)."""
+    from serf_tpu.models.failure import declare_round
+
+    cfg = GossipConfig(n=64, k_facts=32)
+    fcfg = FailureConfig(suspicion_rounds=4, max_new_facts=4)
+    s = make_state(cfg)
+    # two suspicions about different subjects, known at different knowers
+    s = inject_fact(s, cfg, subject=10, kind=K_SUSPECT, incarnation=1,
+                    ltime=1, origin=20)
+    s = inject_fact(s, cfg, subject=11, kind=K_SUSPECT, incarnation=1,
+                    ltime=1, origin=30)
+    # age both past the suspicion window at their origins only
+    s = s._replace(age=s.age.at[20, 0].set(10).at[30, 1].set(10),
+                   alive=s.alive.at[10].set(False).at[11].set(False))
+    out = declare_round(s, cfg, fcfg, jax.random.key(0))
+    dead_slots = jnp.nonzero((out.facts.kind == K_DEAD) & out.facts.valid)[0]
+    origin_of = {}
+    known = unpack_bits(out.known, cfg.k_facts)
+    for sl in dead_slots:
+        sl = int(sl)
+        subject = int(out.facts.subject[sl])
+        knowers = jnp.nonzero(known[:, sl])[0]
+        assert len(knowers) == 1
+        origin_of[subject] = int(knowers[0])
+    assert origin_of == {10: 20, 11: 30}
+
+
+def test_sharded_query_churn_parity_8_devices():
+    """Query gather + churn composed with the flagship round, sharded over
+    8 devices, must be bit-identical to the single-device run."""
+    from serf_tpu.models.churn import ChurnConfig, churn_round
+    from serf_tpu.models.query import (QueryConfig, launch_query,
+                                       make_queries, no_filter_mask,
+                                       query_round)
+
+    cfg = ClusterConfig(gossip=GossipConfig(n=1024, k_facts=32),
+                        push_pull_every=10)
+    ccfg = ChurnConfig(fail_rate=1e-3, leave_rate=1e-3, rejoin_rate=0.05,
+                       max_events=4)
+    qcfg = QueryConfig(q_slots=2, relay_factor=2)
+    state = make_cluster(cfg, jax.random.key(0))
+    g, qs, _ = launch_query(state.gossip, make_queries(cfg.gossip, qcfg),
+                            cfg.gossip, qcfg, origin=0,
+                            eligible=no_filter_mask(cfg.n))
+    state = state._replace(gossip=g)
+
+    def steps(st, qs, key, num_rounds):
+        def body(carry, subkey):
+            st, qs = carry
+            k_c, k_r, k_q = jax.random.split(subkey, 3)
+            g, pending = churn_round(st.gossip, cfg.gossip, ccfg, k_c)
+            st = st._replace(gossip=g)
+            st = cluster_round(st, cfg, k_r)
+            qs = query_round(st.gossip, qs, cfg.gossip, qcfg, k_q)
+            g2 = st.gossip
+            st = st._replace(gossip=g2._replace(alive=g2.alive & ~pending))
+            return (st, qs), ()
+        (st, qs), _ = jax.lax.scan(body, (st, qs),
+                                   jax.random.split(key, num_rounds))
+        return st, qs
+
+    mesh = make_mesh(8)
+    out_sh = (state_shardings(state, mesh), state_shardings(
+        make_queries(cfg.gossip, qcfg), mesh))
+    run8 = jax.jit(steps, static_argnames=("num_rounds",),
+                   out_shardings=out_sh)
+    run1 = jax.jit(steps, static_argnames=("num_rounds",))
+    s8, q8 = run8(shard_state(state, mesh), shard_state(qs, mesh),
+                  jax.random.key(2), num_rounds=25)
+    s1, q1 = run1(state, qs, jax.random.key(2), num_rounds=25)
+    assert bool(jnp.all(s1.gossip.known == s8.gossip.known))
+    assert bool(jnp.all(s1.gossip.alive == s8.gossip.alive))
+    assert bool(jnp.all(q1.responded == q8.responded))
+    assert bool(jnp.all(q1.resp_value == q8.resp_value))

@@ -371,3 +371,25 @@ async def test_join_without_ignore_old_replays_recent_events():
                 await s.shutdown()
             except Exception:
                 pass
+
+
+def test_subscriber_overflow_counted():
+    """Drop-oldest overflow is a documented deviation from the reference's
+    backpressuring channel; the loss must be observable (round-1 verdict)."""
+    import asyncio
+
+    from serf_tpu.host.events import EventSubscriber
+    from serf_tpu.utils import metrics
+
+    async def main():
+        sub = EventSubscriber(maxsize=4)
+        before = metrics.global_sink().counter("serf.subscriber.dropped")
+        for i in range(10):
+            sub._push(i)
+        assert sub.dropped == 6
+        assert metrics.global_sink().counter("serf.subscriber.dropped") - before == 6
+        # newest events survive
+        got = [sub.try_next() for _ in range(4)]
+        assert got == [6, 7, 8, 9]
+
+    asyncio.run(main())
