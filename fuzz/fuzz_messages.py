@@ -262,6 +262,38 @@ def run(seed: int, seconds: float | None, cases: int | None) -> dict:
                         examples.append({"where": where + "/native",
                                          "buf": body[:64].hex()})
 
+    try:
+        from serf_tpu.codec import _native
+        lz4 = _native.lz4_fns()
+    except Exception:  # noqa: BLE001 - native strictly optional
+        lz4 = None
+
+    def check_lz4(buf: bytes) -> None:
+        """The native LZ4 decoder parses untrusted packets: it must reject
+        or produce exactly the requested size — never crash or over-read."""
+        if lz4 is None:
+            return
+        comp, decomp = lz4
+        try:
+            decomp(buf, 64)   # wrapper raises unless exactly 64 decoded
+        except ValueError:
+            stats["decode_errors"] += 1
+        except Exception as e:  # noqa: BLE001 - contract under test
+            stats["violations"] += 1
+            if len(examples) < 5:
+                examples.append({"where": "lz4", "err": repr(e),
+                                 "buf": buf[:64].hex()})
+        # round-trip on the same buffer as plaintext
+        try:
+            enc = comp(buf)
+            if decomp(enc, len(buf)) != buf:
+                raise AssertionError("lz4 round-trip mismatch")
+        except Exception as e:  # noqa: BLE001 - contract under test
+            stats["violations"] += 1
+            if len(examples) < 5:
+                examples.append({"where": "lz4-roundtrip", "err": repr(e),
+                                 "buf": buf[:64].hex()})
+
     i = 0
     while True:
         if deadline is not None and time.monotonic() >= deadline:
@@ -271,6 +303,7 @@ def run(seed: int, seconds: float | None, cases: int | None) -> dict:
         i += 1
         msg = arbitrary_message(rng)
         raw = encode_any(msg)
+        check_lz4(_mutate(rng, raw))
         back = decode_message(raw)
         if back != msg:
             stats["violations"] += 1

@@ -219,3 +219,143 @@ uint32_t serf_murmur3_32(const unsigned char* data, long n, uint32_t seed) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// LZ4 block format codec (host/wire.py "lz4" compression variant).
+//
+// Implemented from the public LZ4 block format description: sequences of
+// [token][literal-len ext][literals][2B LE offset][match-len ext], last
+// sequence literals-only.  The decoder is fully bounds-checked (every read
+// and write validated) — it parses untrusted packets.  The encoder is a
+// greedy hash-table matcher; correctness is what matters here, ratio is
+// secondary to zlib (tests pin round-trip identity and decoder robustness).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr long LZ4_MIN_MATCH = 4;
+constexpr long LZ4_LAST_LITERALS = 5;   // spec: last 5 bytes are literals
+constexpr long LZ4_MFLIMIT = 12;        // spec: no match closer than 12B to end
+constexpr int LZ4_HASH_LOG = 13;
+
+inline uint32_t lz4_hash(uint32_t v) {
+    return (v * 2654435761U) >> (32 - LZ4_HASH_LOG);
+}
+
+inline uint32_t read32(const unsigned char* p) {
+    return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+           (static_cast<uint32_t>(p[2]) << 16) |
+           (static_cast<uint32_t>(p[3]) << 24);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Compress src[0..n) into dst (capacity cap).  Returns compressed size,
+// or -1 if dst is too small.  Worst case needs n + n/255 + 16 bytes.
+long serf_lz4_compress(const unsigned char* src, long n,
+                       unsigned char* dst, long cap) {
+    long table[1 << LZ4_HASH_LOG];
+    for (long i = 0; i < (1 << LZ4_HASH_LOG); ++i) table[i] = -1;
+
+    long ip = 0, op = 0, anchor = 0;
+    const long mflimit = n - LZ4_MFLIMIT;
+
+    auto emit = [&](long lit_len, long match_off, long match_len) -> bool {
+        long need = 1 + lit_len / 255 + 1 + lit_len +
+                    (match_len ? 2 + (match_len - LZ4_MIN_MATCH) / 255 + 1 : 0);
+        if (op + need > cap) return false;
+        long ml_code = match_len ? match_len - LZ4_MIN_MATCH : 0;
+        unsigned char token =
+            static_cast<unsigned char>((lit_len >= 15 ? 15 : lit_len) << 4);
+        if (match_len) token |= (ml_code >= 15 ? 15 : ml_code);
+        dst[op++] = token;
+        if (lit_len >= 15) {
+            long rest = lit_len - 15;
+            while (rest >= 255) { dst[op++] = 255; rest -= 255; }
+            dst[op++] = static_cast<unsigned char>(rest);
+        }
+        for (long i = 0; i < lit_len; ++i) dst[op++] = src[anchor + i];
+        if (match_len) {
+            dst[op++] = static_cast<unsigned char>(match_off & 0xFF);
+            dst[op++] = static_cast<unsigned char>((match_off >> 8) & 0xFF);
+            if (ml_code >= 15) {
+                long rest = ml_code - 15;
+                while (rest >= 255) { dst[op++] = 255; rest -= 255; }
+                dst[op++] = static_cast<unsigned char>(rest);
+            }
+        }
+        return true;
+    };
+
+    if (n >= LZ4_MFLIMIT) {
+        while (ip < mflimit) {
+            uint32_t h = lz4_hash(read32(src + ip));
+            long cand = table[h];
+            table[h] = ip;
+            if (cand >= 0 && ip - cand <= 0xFFFF &&
+                read32(src + cand) == read32(src + ip)) {
+                // extend the match (stop LZ4_LAST_LITERALS from the end)
+                long ml = LZ4_MIN_MATCH;
+                long limit = n - LZ4_LAST_LITERALS;
+                while (ip + ml < limit && src[cand + ml] == src[ip + ml]) ++ml;
+                if (!emit(ip - anchor, ip - cand, ml)) return -1;
+                ip += ml;
+                anchor = ip;
+            } else {
+                ++ip;
+            }
+        }
+    }
+    // final literals
+    if (!emit(n - anchor, 0, 0)) return -1;
+    return op;
+}
+
+// Decompress src[0..n) into dst (capacity cap).  Returns decompressed
+// size, or -1 on ANY malformation (truncated sequence, offset beyond
+// output start, output overflow).
+long serf_lz4_decompress(const unsigned char* src, long n,
+                         unsigned char* dst, long cap) {
+    long ip = 0, op = 0;
+    while (ip < n) {
+        unsigned char token = src[ip++];
+        // literal length
+        long lit = token >> 4;
+        if (lit == 15) {
+            unsigned char b;
+            do {
+                if (ip >= n) return -1;
+                b = src[ip++];
+                lit += b;
+            } while (b == 255);
+        }
+        if (ip + lit > n || op + lit > cap) return -1;
+        for (long i = 0; i < lit; ++i) dst[op++] = src[ip++];
+        if (ip == n) break;  // last sequence: literals only
+        // match
+        if (ip + 2 > n) return -1;
+        long off = src[ip] | (static_cast<long>(src[ip + 1]) << 8);
+        ip += 2;
+        if (off == 0 || off > op) return -1;
+        long ml = (token & 0x0F);
+        if (ml == 15) {
+            unsigned char b;
+            do {
+                if (ip >= n) return -1;
+                b = src[ip++];
+                ml += b;
+            } while (b == 255);
+        }
+        ml += LZ4_MIN_MATCH;
+        if (op + ml > cap) return -1;
+        for (long i = 0; i < ml; ++i) {  // byte-wise: overlapping matches
+            dst[op] = dst[op - off];
+            ++op;
+        }
+    }
+    return op;
+}
+
+}  // extern "C"

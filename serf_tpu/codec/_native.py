@@ -149,3 +149,40 @@ def checksum_fn(name: str):
     if fn is None:
         return None
     return lambda data, seed=0: fn(bytes(data), len(data), seed)
+
+
+def lz4_fns():
+    """Native LZ4 block (compress, decompress) or None.
+
+    compress(data) -> bytes; decompress(data, out_size) -> bytes (exact
+    declared size required; raises ValueError on malformed input)."""
+    lib = load()
+    if lib is None or not hasattr(lib, "serf_lz4_compress"):
+        return None
+    lib.serf_lz4_compress.restype = ctypes.c_long
+    lib.serf_lz4_compress.argtypes = [
+        ctypes.c_char_p, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long]
+    lib.serf_lz4_decompress.restype = ctypes.c_long
+    lib.serf_lz4_decompress.argtypes = [
+        ctypes.c_char_p, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long]
+
+    def compress(data: bytes) -> bytes:
+        data = bytes(data)
+        cap = len(data) + len(data) // 255 + 16
+        out = (ctypes.c_ubyte * cap)()
+        got = lib.serf_lz4_compress(data, len(data), out, cap)
+        if got < 0:
+            raise ValueError("lz4 compression buffer overflow")
+        return bytes(out[:got])
+
+    def decompress(data: bytes, out_size: int) -> bytes:
+        data = bytes(data)
+        out = (ctypes.c_ubyte * max(out_size, 1))()
+        got = lib.serf_lz4_decompress(data, len(data), out, out_size)
+        if got != out_size:
+            raise ValueError("malformed lz4 block")
+        return bytes(out[:got])
+
+    return compress, decompress

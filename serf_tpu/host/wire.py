@@ -6,9 +6,10 @@ Capability parity with the reference's transport features
 checksums {crc32, xxhash, murmur3} and compressions {snappy, zstd, lz4,
 brotli}.  Here the checksum registry carries the reference's exact variants
 (xxhash32 and murmur3 are hand-rolled below — small, well-specified, and
-dependency-free) plus adler32; the compression registry is zlib-only
-because the environment forbids new dependencies (documented deviation in
-PARITY.md) — registering another algorithm is one dict entry.
+dependency-free) plus adler32; the compression registry carries zlib and
+the hand-rolled native LZ4 block codec (snappy/zstd/brotli stay documented
+deviations in PARITY.md — the environment forbids new dependencies).
+Registering another algorithm is one dict entry.
 
 Wire layout (outermost first):  [AES-GCM]([checksum4](marker1 + payload))
 """
@@ -139,10 +140,51 @@ CHECKSUMS: Dict[str, Callable[[bytes], int]] = {
     "murmur3": _native_or("murmur3", murmur3_32),
 }
 
+# lz4 payloads carry varint(raw_len) + block: the LZ4 block format does
+# not encode its own output size.  Output size is sanity-capped well above
+# the largest stream frame.
+_LZ4_MAX_RAW = 64 * 1024 * 1024
+
+
+_lz4_cache: list = []
+
+
+def _lz4_native():
+    if not _lz4_cache:
+        from serf_tpu.codec import _native
+        fns = _native.lz4_fns()
+        if fns is None:
+            raise RuntimeError(
+                "lz4 compression requires the native codec library "
+                "(native/codec.cpp could not be built/loaded)")
+        _lz4_cache.append(fns)
+    return _lz4_cache[0]
+
+
+def _lz4_compress(data: bytes) -> bytes:
+    from serf_tpu import codec as _codec
+    comp, _ = _lz4_native()
+    return _codec.encode_varint(len(data)) + comp(data)
+
+
+def _lz4_decompress(payload: bytes) -> bytes:
+    from serf_tpu import codec as _codec
+    _, decomp = _lz4_native()
+    raw_len, pos = _codec.decode_varint(payload)
+    # bound the declared size by the format's maximum expansion (~255x)
+    # BEFORE allocating — a tiny crafted packet must not force a huge
+    # alloc+memset (memory amplification)
+    if raw_len > _LZ4_MAX_RAW or raw_len > len(payload) * 255 + 64:
+        raise ValueError(f"lz4 declared size {raw_len} implausible "
+                         f"for a {len(payload)}-byte payload")
+    return decomp(payload[pos:], raw_len)
+
+
 # marker byte → (compress, decompress); marker 0 = uncompressed
 COMPRESSIONS: Dict[str, Tuple[int, Callable[[bytes], bytes],
                               Callable[[bytes], bytes]]] = {
     "zlib": (1, lambda b: zlib.compress(b, level=1), zlib.decompress),
+    "lz4": (2, _lz4_compress, _lz4_decompress),
 }
 _DECOMPRESS_BY_MARKER = {m: d for (m, _c, d) in COMPRESSIONS.values()}
 
@@ -197,6 +239,12 @@ def decode_wire(buf: bytes, compression: Optional[str],
     return buf
 
 
+# worst-case expansion headroom per compressor on packet-sized payloads
+# (zlib: header+adler; lz4: varint size prefix + token overhead n/255+16,
+# ~27B at the 1400B UDP budget)
+_COMPRESSION_OVERHEAD = {"zlib": 16, "lz4": 32}
+
+
 def wire_overhead(compression: Optional[str], checksum: Optional[str]) -> int:
     """Worst-case bytes encode_wire adds (marker + checksum + compressor
     expansion headroom)."""
@@ -206,5 +254,5 @@ def wire_overhead(compression: Optional[str], checksum: Optional[str]) -> int:
     if checksum is not None:
         overhead += 4
     if compression is not None:
-        overhead += 16  # zlib worst-case expansion headroom on small packets
+        overhead += _COMPRESSION_OVERHEAD.get(compression, 64)
     return overhead
