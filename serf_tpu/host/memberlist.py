@@ -215,6 +215,14 @@ class Memberlist:
     def num_online_members(self) -> int:
         return sum(1 for n in self._nodes.values() if n.state == SwimState.ALIVE)
 
+    def advertise_node(self) -> Node:
+        """The (id, address) this node announces to peers (reference
+        memberlist ``advertise_node``)."""
+        return self.local
+
+    def advertise_address(self):
+        return self.transport.local_addr
+
     def health_score(self) -> int:
         return self._awareness.score
 

@@ -20,6 +20,8 @@ import struct
 import zlib
 from typing import Callable, Dict, Optional, Tuple
 
+from serf_tpu.codec import decode_varint, encode_varint
+
 
 # ---------------------------------------------------------------------------
 # checksums (reference: crc32 / xxhash / murmur3; plus adler32)
@@ -162,15 +164,13 @@ def _lz4_native():
 
 
 def _lz4_compress(data: bytes) -> bytes:
-    from serf_tpu import codec as _codec
     comp, _ = _lz4_native()
-    return _codec.encode_varint(len(data)) + comp(data)
+    return encode_varint(len(data)) + comp(data)
 
 
 def _lz4_decompress(payload: bytes) -> bytes:
-    from serf_tpu import codec as _codec
     _, decomp = _lz4_native()
-    raw_len, pos = _codec.decode_varint(payload)
+    raw_len, pos = decode_varint(payload)
     # bound the declared size by the format's maximum expansion (~255x)
     # BEFORE allocating — a tiny crafted packet must not force a huge
     # alloc+memset (memory amplification)

@@ -300,3 +300,20 @@ async def test_unsupported_wire_options_rejected():
     with pytest.raises(ValueError):
         Memberlist(net.bind("x1"), dataclasses.replace(
             MemberlistOptions.local(), checksum="xxhash"), "x-1")
+
+
+async def test_advertise_node_and_address():
+    """Reference memberlist object-API surface (SURVEY.md §2.9): the
+    advertised identity is the bound local node + transport address."""
+    net = LoopbackNetwork()
+    nodes = await make_cluster(net, 2)
+    try:
+        await nodes[1].join(nodes[0].transport.local_addr)
+        ml = nodes[0]
+        adv = ml.advertise_node()
+        assert adv.id == ml.local_id() and adv.addr == ml.advertise_address()
+        # what peers actually recorded matches what we advertise
+        peer_view = {n.id: n.addr for n in nodes[1].members()}
+        assert peer_view[adv.id] == adv.addr
+    finally:
+        await shutdown_all(nodes)

@@ -393,3 +393,37 @@ def test_subscriber_overflow_counted():
         assert got == [6, 7, 8, 9]
 
     asyncio.run(main())
+
+
+async def test_leave_intent_avoids_infinite_rebroadcast():
+    """The consul#8179 guard: a leave intent about an already-leaving/left
+    member updates the time but must NOT be rebroadcast (the reference pins
+    this with events_leave_avoid_infinite_rebroadcast)."""
+    from serf_tpu.host import LoopbackNetwork, Serf
+    from serf_tpu.host.memberlist import NodeState
+    from serf_tpu.options import Options
+    from serf_tpu.types.member import Node
+    from serf_tpu.types.messages import LeaveMessage
+
+    net = LoopbackNetwork()
+    s = await Serf.create(net.bind("g"), Options.local(), "guard-node")
+    try:
+        s._handle_node_join(NodeState(Node("peer", "p")))
+        # first leave intent: rebroadcast
+        assert s._handle_node_leave_intent(LeaveMessage(10, "peer")) is True
+        # re-delivery with a newer ltime while LEAVING: no rebroadcast
+        assert s._handle_node_leave_intent(LeaveMessage(11, "peer")) is False
+        assert s._members["peer"].status_time == 11  # time still advances
+        # stale ltime: ignored outright
+        assert s._handle_node_leave_intent(LeaveMessage(5, "peer")) is False
+        # failed -> left transition rebroadcasts once, then suppresses
+        s._handle_node_join(NodeState(Node("f", "f")))
+        from serf_tpu.types.member import MemberStatus
+        ms = s._members["f"]
+        ms.member = ms.member.with_status(MemberStatus.FAILED)
+        s._failed.append(ms)
+        assert s._handle_node_leave_intent(LeaveMessage(20, "f")) is True
+        assert s._members["f"].member.status == MemberStatus.LEFT
+        assert s._handle_node_leave_intent(LeaveMessage(21, "f")) is False
+    finally:
+        await s.shutdown()

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""One-shot TPU evidence session: everything we need from ONE device claim.
+
+The axon tunnel allows one client at a time and wedges if a client dies
+mid-claim, so when the TPU is reachable we must capture all hardware
+evidence in a single, foreground, never-killed process:
+
+1. **Compiled Pallas parity** — run the fused round kernels with
+   ``interpret=False`` on the real chip and assert bit-equality against the
+   XLA path (round-1 verdict: interpret-mode-only Pallas is unverified).
+2. **Flagship bench** — full `cluster_round` @1M (the BENCH headline).
+3. **swim-only bench** + **Pallas A/B** @1M.
+
+Writes ``TPU_PROOF.json`` at the repo root and prints a summary; exits 0
+only if every stage ran (parity failures exit 1 with the failing stage
+recorded).  Run in the foreground: ``python tools/tpu_proof.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "TPU_PROOF.json")
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    proof = {"stages": {}}
+
+    def record(stage, **kv):
+        proof["stages"][stage] = kv
+        with open(OUT, "w") as f:
+            json.dump(proof, f, indent=1)
+        print(f"[{stage}] {kv}", flush=True)
+
+    devs = jax.devices()
+    proof["platform"] = f"{len(devs)}x {devs[0].device_kind}"
+    proof["backend"] = jax.default_backend()
+    if jax.default_backend() == "cpu":
+        print("ERROR: no TPU backend — refusing to fake TPU evidence",
+              flush=True)
+        record("platform_check", ok=False, backend="cpu")
+        return 1
+    record("platform_check", ok=True, platform=proof["platform"])
+
+    from serf_tpu.models.dissemination import (
+        GossipConfig,
+        K_USER_EVENT,
+        coverage,
+        inject_fact,
+        make_state,
+        round_step,
+    )
+    from serf_tpu.models.failure import FailureConfig, run_swim
+    from serf_tpu.models.swim import ClusterConfig, make_cluster, run_cluster
+    from serf_tpu.ops import round_kernels
+
+    # -- stage 1: compiled Pallas parity (modest n: compile fast, assert
+    #    bit-equality over several rounds) ---------------------------------
+    n_par = 8192
+    cfg_x = GossipConfig(n=n_par, k_facts=64, use_pallas=False)
+    cfg_p = GossipConfig(n=n_par, k_facts=64, use_pallas=True)
+    st = inject_fact(make_state(cfg_x), cfg_x, 3, K_USER_EVENT, 0, 1, 0)
+    step_x = jax.jit(functools.partial(round_step, cfg=cfg_x))
+    step_p = jax.jit(functools.partial(round_step, cfg=cfg_p))
+    a = b = st
+    key = jax.random.key(0)
+    t0 = time.perf_counter()
+    equal = True
+    for _ in range(20):
+        key, k2 = jax.random.split(key)
+        a = step_x(a, key=k2)
+        b = step_p(b, key=k2)
+    jax.block_until_ready((a, b))
+    for name in ("known", "budgets", "age"):
+        if not bool(jnp.all(getattr(a, name) == getattr(b, name))):
+            equal = False
+            record("pallas_parity", ok=False, mismatch=name)
+    if equal:
+        record("pallas_parity", ok=True, n=n_par, rounds=20,
+               interpret=False, seconds=round(time.perf_counter() - t0, 1))
+    else:
+        return 1
+
+    # -- timing helper ------------------------------------------------------
+    def timed(jitted, state, rounds_per_call=100, calls=3):
+        key = jax.random.key(1)
+        key, k = jax.random.split(key)
+        state = jax.block_until_ready(
+            jitted(state, key=k, num_rounds=rounds_per_call))
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            key, k = jax.random.split(key)
+            state = jitted(state, key=k, num_rounds=rounds_per_call)
+        jax.block_until_ready(state)
+        return state, rounds_per_call * calls / (time.perf_counter() - t0)
+
+    n = 1_000_000
+    gcfg = GossipConfig(n=n, k_facts=64)
+    fcfg = FailureConfig(suspicion_rounds=12, max_new_facts=8)
+    ccfg = ClusterConfig(gossip=gcfg, failure=fcfg, push_pull_every=16)
+
+    def seeded():
+        st = make_cluster(ccfg, jax.random.key(0))
+        g = st.gossip
+        for i in range(8):
+            g = inject_fact(g, gcfg, subject=i * 125_000, kind=K_USER_EVENT,
+                            incarnation=0, ltime=i + 1, origin=i * 125_000)
+        dead = jnp.arange(64) * (n // 64)
+        g = g._replace(alive=g.alive.at[dead].set(False))
+        return st._replace(gossip=g)
+
+    # -- stage 2: flagship --------------------------------------------------
+    st = seeded()
+    run_flag = jax.jit(functools.partial(run_cluster, cfg=ccfg),
+                       static_argnames=("num_rounds",), donate_argnums=(0,))
+    st, rps = timed(run_flag, st)
+    cov = float(coverage(st.gossip, gcfg)[0])
+    record("flagship_1m", rps=round(rps, 1), coverage0=cov,
+           vs_10k_target=round(rps / 10_000.0, 2))
+
+    # -- stage 3: swim-only + Pallas A/B ------------------------------------
+    run_sw = jax.jit(functools.partial(run_swim, cfg=gcfg, fcfg=fcfg),
+                     static_argnames=("num_rounds",), donate_argnums=(0,))
+    _, sw_rps = timed(run_sw, seeded().gossip)
+    record("swim_1m", rps=round(sw_rps, 1))
+
+    gcfg_p = dataclasses.replace(gcfg, use_pallas=True)
+    run_pl = jax.jit(functools.partial(run_swim, cfg=gcfg_p, fcfg=fcfg),
+                     static_argnames=("num_rounds",), donate_argnums=(0,))
+    _, pl_rps = timed(run_pl, seeded().gossip)
+    record("swim_1m_pallas", rps=round(pl_rps, 1),
+           speedup_vs_xla=round(pl_rps / sw_rps, 3))
+
+    proof["ok"] = True
+    with open(OUT, "w") as f:
+        json.dump(proof, f, indent=1)
+    print("TPU proof complete:", json.dumps(proof["stages"]), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
