@@ -16,6 +16,14 @@ with real UDP/TCP networking and drive it from a client:
     python examples/toyregistry.py client /tmp/b.sock list
     python examples/toyregistry.py client /tmp/b.sock members
 
+``--join`` accepts hostnames (``node1.example:7946`` — resolved through the
+transport's DNS seam).  ``--tls CERT KEY`` runs the stream plane (push/pull
+state sync) over TLS; all agents of a cluster share one cert in the
+self-signed deployment:
+
+    python examples/toyregistry.py agent /tmp/a.sock 127.0.0.1:7946 \
+        --tls cluster.pem cluster.key &
+
 Or run an in-process demo cluster:
 
     python examples/toyregistry.py demo
@@ -130,18 +138,26 @@ async def demo() -> None:
 # -- unix-socket RPC plane (the reference's clap CLI + socket, rebuilt) ------
 
 
-async def serve_agent(sock_path: str, bind: str, join: Optional[str]) -> None:
-    """Run one agent on real UDP/TCP, controllable over a unix socket with
-    line-delimited JSON: {"op": "register"|"deregister"|"list"|
-    "list-consistent"|"members"|"leave", ...}."""
-    from serf_tpu.host.net import NetTransport
+async def serve_agent(sock_path: str, bind: str, join: Optional[str],
+                      tls: Optional[tuple] = None) -> None:
+    """Run one agent on real UDP/TCP (or TLS streams with ``--tls CERT
+    KEY``), controllable over a unix socket with line-delimited JSON:
+    {"op": "register"|"deregister"|"list"|"list-consistent"|"members"|
+    "leave", ...}.  ``--join`` accepts hostnames (resolved through the
+    transport's DNS seam)."""
+    from serf_tpu.host.net import NetTransport, TlsNetTransport, make_tls_contexts
 
     host, port = bind.rsplit(":", 1)
-    transport = await NetTransport.bind((host, int(port)))
+    if tls:
+        server_ctx, client_ctx = make_tls_contexts(*tls)
+        transport = await TlsNetTransport.bind(
+            (host, int(port)), server_ctx=server_ctx, client_ctx=client_ctx)
+    else:
+        transport = await NetTransport.bind((host, int(port)))
     agent = await ToyRegistry.start(transport, Options(), f"agent@{bind}")
     if join:
-        jh, jp = join.rsplit(":", 1)
-        await agent.serf.join((jh, int(jp)))
+        # raw string: the transport resolver handles host:port / DNS / IPv6
+        await agent.serf.join(join)
 
     async def handle(reader, writer):
         try:
@@ -214,7 +230,13 @@ if __name__ == "__main__":
                 if idx >= len(sys.argv):
                     sys.exit("error: --join requires an address")
                 join_addr = sys.argv[idx]
-            asyncio.run(serve_agent(sys.argv[2], sys.argv[3], join_addr))
+            tls = None
+            if "--tls" in sys.argv:
+                idx = sys.argv.index("--tls")
+                if idx + 2 >= len(sys.argv):
+                    sys.exit("error: --tls requires CERT and KEY paths")
+                tls = (sys.argv[idx + 1], sys.argv[idx + 2])
+            asyncio.run(serve_agent(sys.argv[2], sys.argv[3], join_addr, tls))
         elif len(sys.argv) > 3 and sys.argv[1] == "client":
             asyncio.run(client_cmd(sys.argv[2], sys.argv[3:]))
         else:

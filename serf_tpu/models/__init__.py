@@ -9,6 +9,8 @@
 - ``events`` — device→host event-delta streaming
 - ``checkpoint`` — bit-exact state save/restore
 - ``query`` — scatter/filter/gather query engine + conflict majority vote
+- ``churn`` — Poisson leave/fail/rejoin processes with ground-truth traces
+- ``views`` — operator stats snapshot + string-tags→tag-plane bridge
 """
 
 from serf_tpu.models.swim import (
@@ -27,6 +29,7 @@ from serf_tpu.models.dissemination import (
     run_rounds,
 )
 from serf_tpu.models.failure import FailureConfig, run_swim, swim_round
+from serf_tpu.models.churn import ChurnConfig, churn_round, run_cluster_churn
 from serf_tpu.models.query import (
     QueryConfig,
     QueryState,
@@ -35,11 +38,14 @@ from serf_tpu.models.query import (
     majority_vote,
     query_round,
 )
+from serf_tpu.models.views import ClusterStats, TagInterner, cluster_stats
 
 __all__ = [
     "ClusterConfig", "ClusterState", "cluster_round", "make_cluster",
     "run_cluster", "GossipConfig", "GossipState", "inject_fact",
     "make_state", "round_step", "run_rounds", "FailureConfig",
     "run_swim", "swim_round", "QueryConfig", "QueryState", "launch_query",
-    "make_queries", "majority_vote", "query_round",
+    "make_queries", "majority_vote", "query_round", "ChurnConfig",
+    "churn_round", "run_cluster_churn", "ClusterStats", "TagInterner",
+    "cluster_stats",
 ]

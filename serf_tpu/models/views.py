@@ -1,0 +1,145 @@
+"""Operator views of the device-plane cluster: the Stats analog and the
+host-tags → device-tag-plane bridge.
+
+- ``cluster_stats`` mirrors the reference's ``Serf::stats()`` snapshot
+  (serf-core/src/serf/api.rs:586-602) as one jit-able device reduction:
+  member counts by believed status, queue depth (facts with live transmit
+  budget), and the protocol clock maxima.
+- ``TagInterner`` turns host-plane string tags (``types/tags.py``) into the
+  i32 tag plane the device query engine filters on (``models/query.py``
+  ``tag_filter_mask``): regex/equality filters over interned values.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Sequence
+
+import jax.numpy as jnp
+
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    GossipState,
+    K_DEAD,
+    K_JOIN,
+    K_LEAVE,
+    K_QUERY,
+    K_SUSPECT,
+    K_USER_EVENT,
+)
+
+
+class ClusterStats(NamedTuple):
+    """Device-side operator snapshot; every field is a 0-d device scalar
+    (one ``jax.device_get(stats)`` ships the whole thing)."""
+
+    members: jnp.ndarray          # i32 alive nodes (ground truth)
+    failed: jnp.ndarray           # i32 dead nodes
+    suspected: jnp.ndarray        # i32 subjects with a live suspicion fact
+    declared_dead: jnp.ndarray    # i32 subjects with a live dead fact
+    leaving: jnp.ndarray          # i32 subjects with a live leave intent
+    queue_depth: jnp.ndarray      # i32 facts still holding transmit budget
+    intent_facts: jnp.ndarray     # i32 live join/leave intent facts
+    event_facts: jnp.ndarray      # i32 live user-event facts
+    query_facts: jnp.ndarray      # i32 live query facts
+    max_ltime: jnp.ndarray        # u32 highest fact lamport time
+    round: jnp.ndarray            # i32 protocol round (the Epoch)
+
+
+def _count_kind(state: GossipState, kind: int) -> jnp.ndarray:
+    return jnp.sum((state.facts.kind == kind)
+                   & state.facts.valid).astype(jnp.int32)
+
+
+def _subjects_with_kind(state: GossipState, n: int, kind: int) -> jnp.ndarray:
+    mask = (state.facts.kind == kind) & state.facts.valid
+    subj = jnp.clip(state.facts.subject, 0)
+    hit = jnp.zeros((n,), bool).at[subj].max(mask)
+    return jnp.sum(hit).astype(jnp.int32)
+
+
+def cluster_stats(state: GossipState, cfg: GossipConfig) -> ClusterStats:
+    """One reduction pass; call under jit and ``device_get`` the result."""
+    n = cfg.n
+    return ClusterStats(
+        members=jnp.sum(state.alive).astype(jnp.int32),
+        failed=jnp.sum(~state.alive).astype(jnp.int32),
+        suspected=_subjects_with_kind(state, n, K_SUSPECT),
+        declared_dead=_subjects_with_kind(state, n, K_DEAD),
+        leaving=_subjects_with_kind(state, n, K_LEAVE),
+        queue_depth=jnp.sum(jnp.any(state.budgets > 0, axis=0)
+                            & state.facts.valid).astype(jnp.int32),
+        intent_facts=_count_kind(state, K_JOIN) + _count_kind(state, K_LEAVE),
+        event_facts=_count_kind(state, K_USER_EVENT),
+        query_facts=_count_kind(state, K_QUERY),
+        max_ltime=jnp.max(jnp.where(state.facts.valid, state.facts.ltime,
+                                    jnp.uint32(0))),
+        round=state.round,
+    )
+
+
+class TagInterner:
+    """Host-side bridge from string tags to the device tag plane.
+
+    The reference filters responders with ``Filter::Tag(tag, regex)``
+    (serf-core/src/types/filter.rs); the device plane filters with integer
+    equality masks over an i32[N, T] plane (``tag_filter_mask``).  The
+    interner fixes the tag-key columns and interns values; a regex filter
+    compiles to the set of interned values it matches — an OR of equality
+    masks.
+
+    0 is reserved for "tag absent".
+    """
+
+    ABSENT = 0
+
+    def __init__(self, keys: Sequence[str]):
+        self.keys: List[str] = list(keys)
+        self._key_idx: Dict[str, int] = {k: i for i, k in enumerate(self.keys)}
+        self._values: Dict[str, int] = {}
+
+    @property
+    def num_keys(self) -> int:
+        return len(self.keys)
+
+    def intern(self, value: str) -> int:
+        vid = self._values.get(value)
+        if vid is None:
+            vid = len(self._values) + 1   # 0 = absent
+            self._values[value] = vid
+        return vid
+
+    def plane(self, node_tags: Sequence[Optional[Dict[str, str]]]) -> jnp.ndarray:
+        """i32[N, T] tag plane from per-node tag mappings (None = no tags)."""
+        import numpy as np
+
+        n = len(node_tags)
+        out = np.zeros((n, self.num_keys), np.int32)
+        for i, tags in enumerate(node_tags):
+            if not tags:
+                continue
+            for k, v in tags.items():
+                col = self._key_idx.get(k)
+                if col is not None:
+                    out[i, col] = self.intern(v)
+        return jnp.asarray(out)
+
+    def filter_values(self, key: str, pattern: str) -> List[int]:
+        """Interned values matching a reference-style tag regex — the set a
+        ``TagFilter(key, pattern)`` would accept (regex alternation becomes
+        an OR of equality masks on device)."""
+        import re
+
+        rx = re.compile(pattern)
+        return [vid for v, vid in self._values.items() if rx.search(v)]
+
+    def filter_mask(self, tag_plane: jnp.ndarray, key: str,
+                    pattern: str) -> jnp.ndarray:
+        """bool[N] eligibility mask for a (key, regex) tag filter: one
+        membership test over the matched value set."""
+        col = self._key_idx.get(key)
+        if col is None:
+            return jnp.zeros((tag_plane.shape[0],), bool)
+        vals = self.filter_values(key, pattern)
+        if not vals:
+            return jnp.zeros((tag_plane.shape[0],), bool)
+        return jnp.isin(tag_plane[:, col], jnp.asarray(vals, jnp.int32))

@@ -98,3 +98,55 @@ async def test_agent_unix_socket_rpc():
     finally:
         t1.cancel()
         t2.cancel()
+
+
+async def test_agent_rpc_over_tls():
+    """The agent CLI's --tls path: two TLS-stream agents sharing a cluster
+    cert converge and replicate a registration."""
+    import json
+    import socket
+    import tempfile
+
+    from toyregistry import serve_agent
+
+    from test_serf import _self_signed_cert
+
+    def free_port():
+        with socket.socket() as sk:
+            sk.bind(("127.0.0.1", 0))
+            return sk.getsockname()[1]
+
+    d = tempfile.mkdtemp()
+    import pathlib
+    cert, key = _self_signed_cert(pathlib.Path(d))
+    pa, pb = free_port(), free_port()
+    sa, sb = f"{d}/a.sock", f"{d}/b.sock"
+    t1 = asyncio.create_task(
+        serve_agent(sa, f"127.0.0.1:{pa}", None, (cert, key)))
+    await asyncio.sleep(0.5)
+    t2 = asyncio.create_task(
+        serve_agent(sb, f"127.0.0.1:{pb}", f"127.0.0.1:{pa}", (cert, key)))
+    await asyncio.sleep(0.5)
+
+    async def rpc(sock, req):
+        reader, writer = await asyncio.open_unix_connection(sock)
+        writer.write((json.dumps(req) + "\n").encode())
+        await writer.drain()
+        out = json.loads(await reader.readline())
+        writer.close()
+        return out
+
+    try:
+        assert (await rpc(sa, {"op": "register", "name": "db",
+                               "addr": "10.0.0.9:5432"}))["ok"]
+        deadline = asyncio.get_running_loop().time() + 7.0
+        out = {"services": None}
+        while asyncio.get_running_loop().time() < deadline:
+            out = await rpc(sb, {"op": "list"})
+            if out["services"] == {"db": "10.0.0.9:5432"}:
+                break
+            await asyncio.sleep(0.1)
+        assert out["services"] == {"db": "10.0.0.9:5432"}
+    finally:
+        t1.cancel()
+        t2.cancel()

@@ -1,0 +1,119 @@
+"""Device-plane operator views: cluster_stats vs ground truth, and the
+string-tags → tag-plane bridge driving the query engine (host TagFilter
+parity)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    K_JOIN,
+    K_LEAVE,
+    K_SUSPECT,
+    K_USER_EVENT,
+    inject_fact,
+    make_state,
+    round_step,
+)
+from serf_tpu.models.query import (
+    QueryConfig,
+    launch_query,
+    make_queries,
+    query_round,
+)
+from serf_tpu.models.views import ClusterStats, TagInterner, cluster_stats
+
+
+def test_cluster_stats_counts_match_ground_truth():
+    cfg = GossipConfig(n=128, k_facts=32)
+    s = make_state(cfg)._replace(
+        alive=jnp.ones((128,), bool).at[5].set(False).at[9].set(False))
+    s = inject_fact(s, cfg, 7, K_SUSPECT, 1, 3, 0)
+    s = inject_fact(s, cfg, 8, K_SUSPECT, 1, 4, 0)
+    s = inject_fact(s, cfg, 7, K_SUSPECT, 2, 5, 1)   # same subject twice
+    s = inject_fact(s, cfg, 20, K_JOIN, 0, 6, 2)
+    s = inject_fact(s, cfg, 21, K_LEAVE, 0, 7, 3)
+    s = inject_fact(s, cfg, 1, K_USER_EVENT, 0, 8, 4)
+
+    st = jax.jit(functools.partial(cluster_stats, cfg=cfg))(s)
+    st = ClusterStats(*(int(x) for x in jax.device_get(st)))
+    assert st.members == 126 and st.failed == 2
+    assert st.suspected == 2           # subjects 7 and 8 (dedup by subject)
+    assert st.leaving == 1
+    assert st.intent_facts == 2
+    assert st.event_facts == 1
+    assert st.query_facts == 0
+    assert st.queue_depth == 6         # every live fact still has budget
+    assert st.max_ltime == 8
+    assert st.round == 0
+
+
+def test_cluster_stats_queue_drains():
+    cfg = GossipConfig(n=64, k_facts=32)
+    s = inject_fact(make_state(cfg), cfg, 0, K_USER_EVENT, 0, 1, 0)
+    step = jax.jit(functools.partial(round_step, cfg=cfg))
+    key = jax.random.key(0)
+    for _ in range(200):
+        key, k2 = jax.random.split(key)
+        s = step(s, key=k2)
+    st = cluster_stats(s, cfg)
+    assert int(st.queue_depth) == 0    # budgets exhausted after convergence
+    assert int(st.event_facts) == 1    # the fact itself is still resident
+
+
+def test_tag_interner_plane_and_regex_filter():
+    interner = TagInterner(["role", "dc"])
+    tags = [{"role": "web", "dc": "us-1"},
+            {"role": "db", "dc": "us-1"},
+            {"role": "web-canary"},
+            None,
+            {"dc": "eu-2"}]
+    plane = interner.plane(tags)
+    assert plane.shape == (5, 2)
+    assert int(plane[3, 0]) == TagInterner.ABSENT
+
+    # reference-style regex filter: role ~ "^web"
+    mask = interner.filter_mask(plane, "role", r"^web")
+    assert [bool(x) for x in mask] == [True, False, True, False, False]
+    # exact match
+    mask = interner.filter_mask(plane, "role", r"^db$")
+    assert [bool(x) for x in mask] == [False, True, False, False, False]
+    # unknown key: nobody matches
+    assert not bool(jnp.any(interner.filter_mask(plane, "zone", ".*")))
+
+
+def test_tag_interner_drives_device_query_like_host_tagfilter():
+    """End-to-end: regex tag filter -> interned mask -> device query; the
+    responder set equals what the host TagFilter would accept."""
+    from serf_tpu.types.filters import TagFilter
+    from serf_tpu.types.tags import Tags
+
+    n = 64
+    interner = TagInterner(["role"])
+    node_tags = [{"role": "web"} if i % 3 == 0 else
+                 {"role": "db"} if i % 3 == 1 else None
+                 for i in range(n)]
+    plane = interner.plane(node_tags)
+
+    cfg = GossipConfig(n=n, k_facts=32)
+    qcfg = QueryConfig(q_slots=2)
+    g, qs = make_state(cfg), make_queries(cfg, qcfg)
+    g, qs, qi = launch_query(g, qs, cfg, qcfg, origin=0,
+                             eligible=interner.filter_mask(plane, "role",
+                                                           r"^(web|db)$"))
+    step = jax.jit(functools.partial(round_step, cfg=cfg))
+    key = jax.random.key(1)
+    for _ in range(30):
+        key, k1, k2 = jax.random.split(key, 3)
+        g = step(g, key=k1)
+        qs = query_round(g, qs, cfg, qcfg, k2)
+
+    device_responders = {int(i) for i in jnp.nonzero(qs.responded[int(qi)])[0]}
+    host_filter = TagFilter("role", r"^(web|db)$")
+    host_responders = {
+        i for i in range(n)
+        if host_filter.matches(f"node-{i}",
+                               Tags(node_tags[i]) if node_tags[i] else None)}
+    assert device_responders == host_responders
