@@ -39,14 +39,31 @@ class FailureConfig:
     max_new_facts: int = 8         # injection bound per category per round
     probe_drop_rate: float = 0.0   # chance any one probe path is lost
     indirect_probes: int = 3       # SWIM indirect-probe helpers (k)
+    #: "random": every node samples a uniform target each round (coverage in
+    #: expectation).  "round_robin": the vectorized analog of memberlist's
+    #: shuffled probe list — each round all nodes probe at one pseudo-random
+    #: rotation offset, so every node is probed by EXACTLY one prober per
+    #: round (deterministic coverage, no N×N schedule state).
+    probe_schedule: str = "random"
 
     def __post_init__(self):
+        if self.probe_schedule not in ("random", "round_robin"):
+            raise ValueError(
+                f"unknown probe_schedule {self.probe_schedule!r}")
         # knowledge age is a saturating uint8; 255 is the never-known
         # sentinel, so windows beyond 254 rounds are unrepresentable
         if not (0 < self.suspicion_rounds <= 254):
             raise ValueError(
                 f"suspicion_rounds must be in [1, 254] (u8 age plane), "
                 f"got {self.suspicion_rounds}")
+
+
+def rotation_offset(round_, n: int) -> jnp.ndarray:
+    """Round-robin probe rotation: a pseudo-random offset in [1, n-1]
+    (uint32 arithmetic; Knuth multiplicative constant is odd, so offsets
+    sweep the distance space as rounds advance)."""
+    return jnp.uint32(1) + (jnp.asarray(round_, jnp.uint32)
+                            * jnp.uint32(2654435761)) % jnp.uint32(max(1, n - 1))
 
 
 def _facts_about(state: GossipState, kinds, min_inc_of_subject=None):
@@ -106,7 +123,14 @@ def probe_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
     """
     n = cfg.n
     k_target, k_drop, k_help, k_hdrop, k_pick = jax.random.split(key, 5)
-    targets = jax.random.randint(k_target, (n,), 0, n)
+    if fcfg.probe_schedule == "round_robin":
+        # one pseudo-random nonzero rotation per round: node i probes
+        # (i + offset) % n, so every node is probed exactly once
+        offset = rotation_offset(state.round, n)
+        targets = ((jnp.arange(n, dtype=jnp.uint32) + offset)
+                   % jnp.uint32(n)).astype(jnp.int32)
+    else:
+        targets = jax.random.randint(k_target, (n,), 0, n)
     dropped = jax.random.bernoulli(k_drop, fcfg.probe_drop_rate, (n,))
     prober_ok = state.alive
     target_up = state.alive[targets]

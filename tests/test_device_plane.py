@@ -38,6 +38,8 @@ from serf_tpu.models.failure import (
     FailureConfig,
     believed_dead,
     detection_complete,
+    probe_round,
+    rotation_offset,
     run_swim,
     swim_round,
 )
@@ -629,3 +631,41 @@ def test_sharded_query_churn_parity_8_devices():
     assert bool(jnp.all(s1.gossip.alive == s8.gossip.alive))
     assert bool(jnp.all(q1.responded == q8.responded))
     assert bool(jnp.all(q1.resp_value == q8.resp_value))
+
+
+def test_round_robin_probe_schedule_detects_deterministically():
+    """Round-robin probing (memberlist's shuffled probe-list analog): every
+    node is probed exactly once per round, so a death is under suspicion
+    within the first round and the detection deadline is deterministic."""
+    cfg = GossipConfig(n=512, k_facts=64)
+    fcfg = FailureConfig(suspicion_rounds=8, max_new_facts=4,
+                         probe_schedule="round_robin")
+    s = make_state(cfg)._replace(
+        alive=jnp.ones((512,), bool).at[99].set(False))
+    # exactly one suspicion fact after a single probe round, every time
+    out = probe_round(s, cfg, fcfg, jax.random.key(0))
+    assert int(out.next_slot) == 1
+    assert int(out.facts.subject[0]) == 99
+
+    # full detection inside the deterministic budget
+    step = jax.jit(functools.partial(swim_round, cfg=cfg, fcfg=fcfg))
+    key = jax.random.key(1)
+    budget = 1 + fcfg.suspicion_rounds + 1 + 30  # probe+age+declare+gossip
+    for _ in range(budget):
+        key, k2 = jax.random.split(key)
+        s = step(s, key=k2)
+    assert bool(detection_complete(s, cfg, fcfg))
+
+
+def test_round_robin_offsets_cover_all_peers():
+    """The rotation offsets visit (nearly) all distances over n rounds —
+    no node pair goes unprobed indefinitely."""
+    n = 64
+    offsets = {int(rotation_offset(r, n)) for r in range(n * 4)}
+    assert min(offsets) >= 1 and max(offsets) <= n - 1
+    assert len(offsets) >= (n - 1) * 3 // 4  # wide coverage of distances
+
+
+def test_probe_schedule_validation():
+    with pytest.raises(ValueError):
+        FailureConfig(probe_schedule="nope")
