@@ -96,25 +96,16 @@ async def test_cluster_converges_with_new_checksums(checksum):
             await ml.shutdown()
 
 
-def test_native_checksums_match_python_oracle():
-    """Differential: the C++ xxhash32/murmur3 must agree with the Python
-    spec implementations on random inputs of every tail length."""
-    import random
-
+def test_native_checksums_bound_and_dispatched():
+    """The native implementations load and the registry dispatches to them
+    (the value differential lives in tests/test_property.py)."""
     from serf_tpu.codec import _native
 
     if _native.load() is None:
         pytest.skip("native lib unavailable")
-    rng = random.Random(11)
     for name, py in (("xxhash32", xxhash32), ("murmur3", murmur3_32)):
         nat = _native.checksum_fn(name)
         assert nat is not None, f"native {name} missing after rebuild"
-        for trial in range(500):
-            data = rng.randbytes(rng.randrange(0, 100))
-            seed = rng.choice([0, 1, 0xFFFFFFFF, rng.randrange(1 << 32)])
-            assert nat(data, seed) == py(data, seed), \
-                (name, seed, data.hex())
-        # the registry picked the native path
         assert CHECKSUMS[name](b"probe") == py(b"probe")
 
 
