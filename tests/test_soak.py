@@ -62,10 +62,13 @@ async def test_randomized_soak(seed):
                 await actor.set_tags(Tags(v=str(op)))
             if rng.random() < 0.3:
                 await asyncio.sleep(0.02)
-        # afterwards: every surviving node converges on the live membership
+        # afterwards: every surviving node converges on the live membership.
+        # Generous deadline: this is a liveness soak, not a latency bar
+        # (the 7 s convergence budget lives in the scenario suites), and a
+        # loaded CI machine must not flake it.
         live = [i for i in nodes if i not in killed
                 and nodes[i].state == SerfState.ALIVE]
-        deadline = asyncio.get_running_loop().time() + 10.0
+        deadline = asyncio.get_running_loop().time() + 25.0
         want = {f"soak-{i}" for i in live}
         while asyncio.get_running_loop().time() < deadline:
             views = [
