@@ -546,3 +546,100 @@ async def test_resolver_address_forms():
         assert host == "127.0.0.1" and port == 9
     finally:
         await t.shutdown()
+
+
+async def test_key_manager_cluster_rotation():
+    """Cluster-wide keyring orchestration (reference key_manager.rs):
+    install a new key everywhere, rotate the primary, remove the old key,
+    and keep gossiping through every stage."""
+    from serf_tpu.host.keyring import SecretKeyring
+
+    k1 = bytes(range(16))
+    k2 = bytes(range(16, 32))
+    net = LoopbackNetwork()
+    nodes = []
+    for i in range(3):
+        s = await Serf.create(net.bind(f"k{i}"), Options.local(), f"node-{i}",
+                              keyring=SecretKeyring(k1))
+        nodes.append(s)
+    try:
+        for s in nodes[1:]:
+            await s.join("k0")
+        await wait_until(lambda: all(len(alive_members(s)) == 3 for s in nodes),
+                         msg="3-node encrypted convergence")
+        km = nodes[0].key_manager()
+        assert km is not None
+
+        out = await km.install_key(k2)
+        assert out.num_resp == 3 and out.num_err == 0, out.messages
+        await wait_until(
+            lambda: all(k2 in s.memberlist.keyring().keys() for s in nodes),
+            msg="k2 installed everywhere")
+
+        out = await km.use_key(k2)
+        assert out.num_resp == 3 and out.num_err == 0, out.messages
+        await wait_until(
+            lambda: all(s.memberlist.keyring().primary_key() == k2 for s in nodes),
+            msg="k2 primary everywhere")
+
+        out = await km.remove_key(k1)
+        assert out.num_resp == 3 and out.num_err == 0, out.messages
+        await wait_until(
+            lambda: all(k1 not in s.memberlist.keyring().keys() for s in nodes),
+            msg="k1 removed everywhere")
+
+        # list aggregates per-node views: k2 is the unanimous primary
+        out = await km.list_keys()
+        assert out.num_resp == 3 and out.primary_keys == {k2: 3}
+        assert out.keys == {k2: 3}
+
+        # the cluster still works over the rotated key
+        await nodes[1].user_event("rotated", b"ok", coalesce=False)
+        await wait_until(lambda: all(s.event_clock.time() >= 2 for s in nodes),
+                         msg="user event after rotation")
+        # removing the active primary must fail loudly, not brick the cluster
+        out = await km.remove_key(k2)
+        assert out.num_err == 3
+    finally:
+        await shutdown_all(nodes)
+
+
+async def test_corrupted_ping_payloads_rejected():
+    """The reference's ping_versioning/ping_dimension corruption tests:
+    bad ack payloads (wrong version, wrong dimensionality, garbage) must be
+    rejected with the serf.coordinate.rejected metric — never crash the
+    ping plane or poison the coordinate."""
+    from serf_tpu.host.coordinate import Coordinate
+    from serf_tpu.host.memberlist import NodeState
+    from serf_tpu.host.serf import PING_VERSION
+    from serf_tpu.types.member import Node
+    from serf_tpu.utils import metrics
+
+    net = LoopbackNetwork()
+    s = await Serf.create(net.bind("ping"), Options.local(), "ping-node")
+    try:
+        dg = s.memberlist.delegate
+        ns = NodeState(Node("peer", "x"))
+        before = s.coord_client.get_coordinate()
+        rejected0 = metrics.global_sink().counter("serf.coordinate.rejected", s._labels)
+
+        good = Coordinate(portion=(0.01,) * 8, error=1.5,
+                          adjustment=0.0, height=1e-5).encode()
+        dg.notify_ping_complete(ns, 0.05, bytes([PING_VERSION + 1]) + good)
+        dg.notify_ping_complete(ns, 0.05, bytes([PING_VERSION]) + b"\xff\x01garbage")
+        # wrong dimensionality: a 2-d coordinate against the 8-d client
+        bad_dim = Coordinate(portion=(1.0, 2.0))
+        dg.notify_ping_complete(ns, 0.05, bytes([PING_VERSION]) + bad_dim.encode())
+        dg.notify_ping_complete(ns, 0.0, bytes([PING_VERSION]) + good)  # zero rtt
+        dg.notify_ping_complete(ns, 0.05, b"")                          # empty
+
+        rejected = metrics.global_sink().counter("serf.coordinate.rejected", s._labels)
+        assert rejected - rejected0 == 3   # version + garbage + dimension
+        assert s.coord_client.get_coordinate() == before  # nothing applied
+        assert "peer" not in s._coord_cache
+
+        # a good payload still works after all the abuse
+        dg.notify_ping_complete(ns, 0.05, bytes([PING_VERSION]) + good)
+        assert "peer" in s._coord_cache
+    finally:
+        await s.shutdown()
