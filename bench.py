@@ -133,6 +133,14 @@ def main() -> None:
                                rounds_per_call, timed_calls)
     detail["run_swim_rps"] = round(swim_rps, 2)
 
+    # --- secondary: round-robin probe schedule A/B -------------------------
+    fcfg_rr = dataclasses.replace(fcfg, probe_schedule="round_robin")
+    run_rr = jax.jit(functools.partial(run_swim, cfg=gcfg, fcfg=fcfg_rr),
+                     static_argnames=("num_rounds",), donate_argnums=(0,))
+    _, rr_rps = _time_rounds(run_rr, seeded_state(cfg).gossip,
+                             jax.random.key(2), rounds_per_call, timed_calls)
+    detail["run_swim_round_robin_rps"] = round(rr_rps, 2)
+
     # --- secondary: Pallas fused-kernel A/B (TPU only; compiled, not
     #     interpret mode) ---------------------------------------------------
     if not on_cpu:

@@ -669,3 +669,47 @@ def test_round_robin_offsets_cover_all_peers():
 def test_probe_schedule_validation():
     with pytest.raises(ValueError):
         FailureConfig(probe_schedule="nope")
+
+
+def test_checkpoint_resume_mid_query_bit_exact():
+    """Checkpoint the composed (cluster, queries) state mid-gather and
+    resume: the continuation must be bit-identical to the unbroken run."""
+    import tempfile
+
+    from serf_tpu.models import checkpoint
+    from serf_tpu.models.query import (QueryConfig, launch_query,
+                                       make_queries, no_filter_mask,
+                                       query_round)
+
+    cfg = ClusterConfig(gossip=GossipConfig(n=256, k_facts=32),
+                        push_pull_every=8)
+    qcfg = QueryConfig(q_slots=2, relay_factor=1)
+    state = make_cluster(cfg, jax.random.key(0))
+    g, qs, qi = launch_query(state.gossip, make_queries(cfg.gossip, qcfg),
+                             cfg.gossip, qcfg, origin=0,
+                             eligible=no_filter_mask(cfg.n))
+    state = state._replace(gossip=g)
+
+    def advance(st, qs, key, rounds):
+        for _ in range(rounds):
+            key, k1, k2 = jax.random.split(key, 3)
+            st = cluster_round(st, cfg, k1)
+            qs = query_round(st.gossip, qs, cfg.gossip, qcfg, k2)
+        return st, qs
+
+    # run 5 rounds, checkpoint mid-query, run 5 more
+    st_a, qs_a = advance(state, qs, jax.random.key(7), 5)
+    with tempfile.TemporaryDirectory() as d:
+        checkpoint.save(f"{d}/mid.npz", (st_a, qs_a))
+        st_a, qs_a = advance(st_a, qs_a, jax.random.key(8), 5)
+
+        # restore and continue with the same keys
+        st_b, qs_b = checkpoint.restore(
+            f"{d}/mid.npz", (make_cluster(cfg, jax.random.key(0)),
+                             make_queries(cfg.gossip, qcfg)))
+    st_b, qs_b = advance(st_b, qs_b, jax.random.key(8), 5)
+
+    assert bool(jnp.all(st_a.gossip.known == st_b.gossip.known))
+    assert bool(jnp.all(qs_a.responded == qs_b.responded))
+    assert bool(jnp.all(qs_a.resp_value == qs_b.resp_value))
+    assert int(qs_a.next_q) == int(qs_b.next_q)

@@ -142,6 +142,13 @@ def main() -> int:
     record("swim_1m_pallas", rps=round(pl_rps, 1),
            speedup_vs_xla=round(pl_rps / sw_rps, 3))
 
+    fcfg_rr = dataclasses.replace(fcfg, probe_schedule="round_robin")
+    run_rr = jax.jit(functools.partial(run_swim, cfg=gcfg, fcfg=fcfg_rr),
+                     static_argnames=("num_rounds",), donate_argnums=(0,))
+    _, rr_rps = timed(run_rr, seeded().gossip)
+    record("swim_1m_round_robin", rps=round(rr_rps, 1),
+           speedup_vs_random=round(rr_rps / sw_rps, 3))
+
     proof["ok"] = True
     with open(OUT, "w") as f:
         json.dump(proof, f, indent=1)
