@@ -206,6 +206,10 @@ class Memberlist:
     def local_state(self) -> Optional[NodeState]:
         return self._nodes.get(self.local.id)
 
+    def node_state(self, node_id: str) -> Optional[NodeState]:
+        """This node's SWIM-level record of ``node_id`` (None if unknown)."""
+        return self._nodes.get(node_id)
+
     def members(self) -> List[NodeState]:
         return list(self._nodes.values())
 

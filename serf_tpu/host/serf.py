@@ -621,7 +621,8 @@ class Serf:
     async def _broadcast_join(self, ltime: LamportTime) -> None:
         """(reference base.rs:364-397)"""
         msg = JoinMessage(ltime, self.local_id)
-        self._handle_node_join_intent(msg, rebroadcast=False)
+        self._handle_node_join_intent(msg, rebroadcast=False,
+                                      self_origin=True)
         self._queue(self.intent_broadcasts, encode_message(msg))
 
     async def leave(self) -> None:
@@ -876,7 +877,8 @@ class Serf:
         self._emit(MemberEvent(MemberEventType.UPDATE, (ms.member,)))
 
     def _handle_node_join_intent(self, msg: JoinMessage,
-                                 rebroadcast: bool = True) -> bool:
+                                 rebroadcast: bool = True,
+                                 self_origin: bool = False) -> bool:
         """(reference base.rs:1338-1373); returns whether to rebroadcast."""
         self.clock.witness(msg.ltime)
         ms = self._members.get(msg.id)
@@ -885,10 +887,48 @@ class Serf:
                                  msg.ltime)
         if msg.ltime <= ms.status_time:
             return False
+        if (not self_origin and msg.id == self.local_id
+                and self.state == SerfState.ALIVE):
+            # The network carries a newer story about us than we ever told:
+            # we rejoined through a stale partner, so our join broadcast
+            # used a clock that never witnessed our old leave, and some
+            # peers may hold LEAVING/LEFT at an ltime our intents cannot
+            # beat.  Re-assert aliveness with a beating ltime (the witness
+            # above already advanced the clock past msg.ltime).  Gated on
+            # ``self_origin`` so our own local apply in _broadcast_join
+            # cannot re-trigger it (that would be an intent-amplification
+            # loop).  Robustness addition beyond the reference, which only
+            # self-refutes leave intents (base.rs:1468-1480) and relies on
+            # snapshot clock continuity to avoid this corner.
+            log.warning("re-asserting aliveness over a newer join intent "
+                        "about ourselves (ltime %d > %d)",
+                        msg.ltime, ms.status_time)
+            ms.status_time = msg.ltime
+            self._spawn(self._broadcast_join(self.clock.increment()),
+                        "serf-reassert-join")
+            return False
         ms.status_time = msg.ltime
         if ms.member.status == MemberStatus.LEAVING:
             # join intent refutes an in-flight leave
             ms.member = ms.member.with_status(MemberStatus.ALIVE)
+        elif ms.member.status == MemberStatus.LEFT:
+            # A join intent strictly newer than the leave can only mean the
+            # node rejoined: join intents originate from the subject, whose
+            # own clock guarantees its leave ltime exceeded all its earlier
+            # joins.  Reviving here (deviation: the reference keeps LEFT
+            # and relies on the memberlist notify_join) keeps serf status
+            # Lamport-monotone and — critically — stops this node from
+            # exporting the member in push/pull ``left_members`` stamped
+            # with the NEW ltime, which would poison freshly-joined peers
+            # with an unbeatable LEAVING state (found by soak seed 7).
+            # FAILED members are NOT revived: for crashes, the failure
+            # detector's judgment wins (as in the reference).
+            ms.member = ms.member.with_status(MemberStatus.ALIVE)
+            self._left = [m for m in self._left if m.id != msg.id]
+            # no JOIN event here: the memberlist notify_join that follows a
+            # real rejoin emits the single canonical JOIN; if the rejoiner
+            # died before its aliveness reached us, the reaper's zombie
+            # sweep (below) demotes this entry back to FAILED
         return True
 
     def _handle_node_leave_intent(self, msg: LeaveMessage,
@@ -1078,6 +1118,7 @@ class Serf:
     # ------------------------------------------------------------------
 
     async def _reaper(self) -> None:
+        zombie_since: Dict[str, float] = {}
         while not self._shutdown_event.is_set():
             await asyncio.sleep(self.opts.reap_interval)
             try:
@@ -1086,8 +1127,58 @@ class Serf:
                            use_reconnect_override=True)
                 self._reap(self._left, now, self.opts.tombstone_timeout)
                 reap_intents(self._recent_intents, now, self.opts.recent_intent_timeout)
+                self._sweep_zombies(zombie_since, now)
             except Exception:  # noqa: BLE001
                 log.exception("reaper tick failed")
+
+    def _zombie_grace(self) -> float:
+        """How long a serf-ALIVE member may lack memberlist backing before
+        demotion.  Generous: a slow SWIM refutation after a rejoin can
+        legitimately leave the gap open for several anti-entropy cycles; a
+        true zombie stays unbacked forever, so patience costs nothing."""
+        return max(2 * self.opts.reap_interval,
+                   10 * self.opts.memberlist.push_pull_interval)
+
+    def _sweep_zombies(self, zombie_since: Dict[str, float],
+                       now: float) -> None:
+        """Demote serf-ALIVE/LEAVING members with no live memberlist backing.
+
+        The intent-path LEFT revival (see _handle_node_join_intent) can
+        leave a member serf-ALIVE when the rejoiner died before its SWIM
+        aliveness reached us: the memberlist never probes it, so no
+        notify_leave will ever fire and the entry would otherwise dodge the
+        reaper forever.  LEAVING is covered too — an unbacked revived
+        member that then absorbs a newer leave intent has no notify_leave
+        to complete its LEAVING→LEFT transition either.  A member
+        continuously unbacked past the grace window goes to FAILED,
+        restoring the normal reap/reconnect path."""
+        grace = self._zombie_grace()
+        current: set = set()
+        for node_id, ms in self._members.items():
+            if node_id == self.local_id:
+                continue
+            if ms.member.status not in (MemberStatus.ALIVE,
+                                        MemberStatus.LEAVING):
+                continue
+            ns = self.memberlist.node_state(node_id)
+            if ns is not None and ns.state in (SwimState.ALIVE,
+                                               SwimState.SUSPECT):
+                continue
+            current.add(node_id)
+            first = zombie_since.setdefault(node_id, now)
+            if now - first >= grace:
+                log.warning("demoting zombie member %s (serf %s, no "
+                            "memberlist backing for %.1fs)", node_id,
+                            ms.member.status.name, now - first)
+                ms.member = ms.member.with_status(MemberStatus.FAILED)
+                ms.leave_time = time.monotonic()
+                self._failed.append(ms)
+                self._emit(MemberEvent(MemberEventType.FAILED, (ms.member,)))
+                metrics.incr("serf.member.failed", 1, self._labels)
+        # forget healed or departed entries so the timer restarts fresh
+        for node_id in list(zombie_since):
+            if node_id not in current:
+                zombie_since.pop(node_id, None)
 
     def _reap(self, lst: List[MemberState], now: float, timeout: float,
               use_reconnect_override: bool = False) -> None:

@@ -643,3 +643,150 @@ async def test_corrupted_ping_payloads_rejected():
         assert "peer" in s._coord_cache
     finally:
         await s.shutdown()
+
+
+async def test_rejoin_via_stale_partner_converges():
+    """The stale-partner rejoin corner (found by soak seeds 7/8): A leaves
+    at ltime L; C restarts knowing A only as a left-members entry; A then
+    rejoins THROUGH C, so A's clock never witnesses L and its join
+    broadcast cannot beat stale LEAVING/LEFT states.  The re-assertion
+    path (a newer join intent about ourselves triggers a fresh broadcast
+    at a beating ltime) must converge every view to ALIVE."""
+    net = LoopbackNetwork()
+    a = await Serf.create(net.bind("a"), Options.local(), "A")
+    b = await Serf.create(net.bind("b"), Options.local(), "B")
+    c = await Serf.create(net.bind("c"), Options.local(), "C")
+    for s in (b, c):
+        await s.join("a")
+    await wait_until(lambda: all(len(alive_members(s)) == 3 for s in (a, b, c)),
+                     msg="initial convergence")
+    # C crashes, A leaves gracefully (only B knows the leave intent)
+    await c.shutdown()
+    await a.leave()
+    await a.shutdown()
+    await wait_until(lambda: b._members["A"].member.status == MemberStatus.LEFT,
+                     msg="B sees A LEFT")
+
+    # C restarts fresh, learns of A only via B's left_members
+    c2 = await Serf.create(net.bind("c"), Options.local(), "C")
+    await c2.join("b")
+    await asyncio.sleep(0.3)
+
+    # A restarts fresh and rejoins through the STALE partner C
+    a2 = await Serf.create(net.bind("a"), Options.local(), "A")
+    await a2.join("c")
+
+    def all_alive():
+        for s in (a2, b, c2):
+            ms = s._members.get("A")
+            if ms is None or ms.member.status != MemberStatus.ALIVE:
+                return False
+        return True
+
+    await wait_until(all_alive, deadline=15.0,
+                     msg="every view shows A ALIVE after stale-partner rejoin")
+
+
+async def test_join_intent_revives_left_not_failed():
+    """A join intent strictly newer than a graceful leave revives the LEFT
+    member (it can only mean a rejoin — the leaver's own clock put the
+    leave above all its earlier joins); a FAILED member is NOT revived by
+    intents (the failure detector's judgment wins).  Found by soak seed 7:
+    without the revival, the node keeps exporting the member in push/pull
+    left_members stamped with the NEW ltime, poisoning freshly-joined
+    peers with an unbeatable LEAVING state."""
+    from serf_tpu.host.memberlist import NodeState
+    from serf_tpu.types.member import Node
+    from serf_tpu.types.messages import JoinMessage, LeaveMessage
+
+    net = LoopbackNetwork()
+    s = await Serf.create(net.bind("r"), Options.local(), "rev-node")
+    try:
+        # LEFT member at ltime 13
+        s._handle_node_join(NodeState(Node("peer", "p")))
+        s._handle_node_leave_intent(LeaveMessage(13, "peer"))
+        from serf_tpu.host.memberlist import SwimState
+        ns = NodeState(Node("peer", "p"))
+        ns.state = SwimState.LEFT
+        s._handle_node_leave(ns)
+        assert s._members["peer"].member.status == MemberStatus.LEFT
+        assert any(m.id == "peer" for m in s._left)
+        # newer join intent: revive + drop from the left list
+        assert s._handle_node_join_intent(JoinMessage(21, "peer")) is True
+        assert s._members["peer"].member.status == MemberStatus.ALIVE
+        assert s._members["peer"].status_time == 21
+        assert not any(m.id == "peer" for m in s._left)
+
+        # FAILED member: a newer join intent updates the ltime only
+        s._handle_node_join(NodeState(Node("crashy", "c")))
+        ns2 = NodeState(Node("crashy", "c"))
+        ns2.state = SwimState.DEAD
+        s._handle_node_leave(ns2)
+        assert s._members["crashy"].member.status == MemberStatus.FAILED
+        s._handle_node_join_intent(JoinMessage(30, "crashy"))
+        assert s._members["crashy"].member.status == MemberStatus.FAILED
+    finally:
+        await s.shutdown()
+
+
+async def test_zombie_revival_demoted_by_reaper():
+    """If a LEFT member is revived by a newer join intent but the rejoiner
+    died before its memberlist aliveness arrived, the reaper's zombie sweep
+    demotes it back to FAILED (two unbacked sweeps of grace), restoring the
+    reap/reconnect path."""
+    import dataclasses
+
+    from serf_tpu.host.memberlist import NodeState, SwimState
+    from serf_tpu.options import MemberlistOptions
+    from serf_tpu.types.member import Node
+    from serf_tpu.types.messages import JoinMessage, LeaveMessage
+
+    net = LoopbackNetwork()
+    # compress reap + push/pull so the REAL reaper loop demotes within the
+    # test budget (grace = max(2*reap, 10*push_pull) = 0.2 s)
+    opts = dataclasses.replace(
+        Options.local(), reap_interval=0.05,
+        memberlist=dataclasses.replace(MemberlistOptions.local(),
+                                       push_pull_interval=0.02))
+    s = await Serf.create(net.bind("z"), opts, "z-node")
+    try:
+        s._handle_node_join(NodeState(Node("ghost", "g")))
+        s._handle_node_leave_intent(LeaveMessage(13, "ghost"))
+        ns = NodeState(Node("ghost", "g"))
+        ns.state = SwimState.LEFT
+        s._handle_node_leave(ns)
+        # memberlist still records ghost as LEFT; the newer join intent
+        # revives the serf entry with no live backing
+        s.memberlist._nodes["ghost"] = ns
+        s._handle_node_join_intent(JoinMessage(21, "ghost"))
+        assert s._members["ghost"].member.status == MemberStatus.ALIVE
+
+        # a backed member must never be demoted (control)
+        s._handle_node_join(NodeState(Node("ok", "o")))
+        s.memberlist._nodes["ok"] = NodeState(Node("ok", "o"),
+                                              state=SwimState.ALIVE)
+
+        # the REAL reaper loop demotes the unbacked ghost past the grace
+        await wait_until(
+            lambda: s._members["ghost"].member.status == MemberStatus.FAILED,
+            deadline=5.0, msg="zombie demoted by the reaper loop")
+        assert any(m.id == "ghost" for m in s._failed)
+        assert s._members["ok"].member.status == MemberStatus.ALIVE
+
+        # an unbacked LEAVING member (newer leave intent on a revived
+        # ghost) is demoted too — LEAVING->LEFT needs a notify_leave that
+        # can never fire without backing
+        s._handle_node_join(NodeState(Node("ghost2", "g2")))
+        s._handle_node_leave_intent(LeaveMessage(5, "ghost2"))
+        ns3 = NodeState(Node("ghost2", "g2"))
+        ns3.state = SwimState.LEFT
+        s._handle_node_leave(ns3)
+        s.memberlist._nodes["ghost2"] = ns3
+        s._handle_node_join_intent(JoinMessage(9, "ghost2"))   # revive
+        s._handle_node_leave_intent(LeaveMessage(11, "ghost2"))  # LEAVING
+        assert s._members["ghost2"].member.status == MemberStatus.LEAVING
+        await wait_until(
+            lambda: s._members["ghost2"].member.status == MemberStatus.FAILED,
+            deadline=5.0, msg="unbacked LEAVING demoted")
+    finally:
+        await s.shutdown()

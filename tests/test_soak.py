@@ -18,7 +18,7 @@ from serf_tpu.types.member import MemberStatus
 pytestmark = pytest.mark.asyncio
 
 
-@pytest.mark.parametrize("seed", [1, 2])
+@pytest.mark.parametrize("seed", [1, 2, 7, 8])
 async def test_randomized_soak(seed):
     rng = random.Random(seed)
     net = LoopbackNetwork()
