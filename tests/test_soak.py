@@ -86,3 +86,83 @@ async def test_randomized_soak(seed):
         for i, s in nodes.items():
             if s.state != SerfState.SHUTDOWN:
                 await s.shutdown()
+
+
+@pytest.mark.parametrize("seed", [402, 403])
+async def test_partition_churn_storm(seed):
+    """Churn storm with a mid-run bisection and heal.  Rejoins retry until
+    they land (agent behavior — a node whose only join attempt failed
+    during the partition is not a member and cannot be expected in views;
+    the reference's reconnector likewise only re-dials FAILED members)."""
+    rng = random.Random(seed)
+    net = LoopbackNetwork()
+    n = 8
+    nodes = {i: await Serf.create(net.bind(f"s{i}"), Options.local(),
+                                  f"storm-{i}") for i in range(n)}
+    for i in range(1, n):
+        await nodes[i].join("s0")
+    killed = set()
+    pending_join = {}
+    try:
+        for op in range(50):
+            live = [i for i in nodes if i not in killed]
+            r = rng.random()
+            if op == 15:
+                net.partition(set(f"s{i}" for i in range(4)),
+                              set(f"s{i}" for i in range(4, n)))
+            if op == 35:
+                net.heal()
+            # agent-like retry of any join that failed earlier
+            for b in list(pending_join):
+                try:
+                    await nodes[b].join(pending_join[b])
+                    del pending_join[b]
+                except ConnectionError:
+                    pass
+            if r < 0.25 and len(live) > 4:
+                v = rng.choice([i for i in live if i != 0])
+                if rng.random() < 0.6:
+                    await nodes[v].leave()
+                await nodes[v].shutdown()
+                killed.add(v)
+                pending_join.pop(v, None)
+            elif r < 0.5 and killed:
+                b = rng.choice(sorted(killed))
+                killed.discard(b)
+                nodes[b] = await Serf.create(
+                    net.bind(f"s{b}") if f"s{b}" not in net.transports
+                    else net.transports[f"s{b}"],
+                    Options.local(), f"storm-{b}")
+                tgt = f"s{rng.choice([i for i in nodes if i not in killed and i != b])}"
+                try:
+                    await nodes[b].join(tgt)
+                except ConnectionError:
+                    pending_join[b] = tgt   # partitioned: retry later
+            if rng.random() < 0.3:
+                await asyncio.sleep(0.02)
+        net.heal()
+        for b in list(pending_join):   # final retry round
+            try:
+                await nodes[b].join(pending_join[b])
+                del pending_join[b]
+            except ConnectionError:
+                pass
+        live = [i for i in nodes if i not in killed
+                and nodes[i].state == SerfState.ALIVE
+                and i not in pending_join]
+        want = {f"storm-{i}" for i in live}
+        deadline = asyncio.get_running_loop().time() + 30.0
+        while asyncio.get_running_loop().time() < deadline:
+            views = [{m.node.id for m in nodes[i].members()
+                      if m.status == MemberStatus.ALIVE} for i in live]
+            if all(v >= want for v in views):
+                break
+            await asyncio.sleep(0.1)
+        views = [{m.node.id for m in nodes[i].members()
+                  if m.status == MemberStatus.ALIVE} for i in live]
+        for v in views:
+            assert v >= want, f"seed {seed}: view {v} missing {want - v}"
+    finally:
+        for s in nodes.values():
+            if s.state != SerfState.SHUTDOWN:
+                await s.shutdown()
