@@ -18,6 +18,30 @@ from serf_tpu.types.member import MemberStatus
 pytestmark = pytest.mark.asyncio
 
 
+def _rebind(net, addr):
+    """Reuse a still-registered loopback transport or bind the address anew
+    (a restarted agent on the same address)."""
+    return net.bind(addr) if addr not in net.transports else net.transports[addr]
+
+
+async def _assert_converges(nodes, live, want, deadline_s, label):
+    """Every live node's ALIVE view must cover ``want`` within the deadline.
+    Generous deadlines: these are liveness soaks, not latency bars (the 7 s
+    convergence budget lives in the scenario suites), and a loaded CI
+    machine must not flake them."""
+    deadline = asyncio.get_running_loop().time() + deadline_s
+    while asyncio.get_running_loop().time() < deadline:
+        views = [{m.node.id for m in nodes[i].members()
+                  if m.status == MemberStatus.ALIVE} for i in live]
+        if all(v >= want for v in views):
+            return
+        await asyncio.sleep(0.05)
+    views = [{m.node.id for m in nodes[i].members()
+              if m.status == MemberStatus.ALIVE} for i in live]
+    for v in views:
+        assert v >= want, f"{label}: survivor view {v} missing {want - v}"
+
+
 @pytest.mark.parametrize("seed", [1, 2, 7, 8])
 async def test_randomized_soak(seed):
     rng = random.Random(seed)
@@ -47,9 +71,7 @@ async def test_randomized_soak(seed):
                 back = rng.choice(sorted(killed))
                 killed.discard(back)
                 nodes[back] = await Serf.create(
-                    net.bind(f"s{back}") if f"s{back}" not in net.transports
-                    else net.transports[f"s{back}"],
-                    Options.local(), f"soak-{back}")
+                    _rebind(net, f"s{back}"), Options.local(), f"soak-{back}")
                 await nodes[back].join(f"s{rng.choice([i for i in nodes if i not in killed and i != back])}")
             elif choice < 0.6:
                 await actor.user_event(f"ev-{op}", bytes([op % 256]) * rng.randint(0, 50),
@@ -62,26 +84,10 @@ async def test_randomized_soak(seed):
                 await actor.set_tags(Tags(v=str(op)))
             if rng.random() < 0.3:
                 await asyncio.sleep(0.02)
-        # afterwards: every surviving node converges on the live membership.
-        # Generous deadline: this is a liveness soak, not a latency bar
-        # (the 7 s convergence budget lives in the scenario suites), and a
-        # loaded CI machine must not flake it.
         live = [i for i in nodes if i not in killed
                 and nodes[i].state == SerfState.ALIVE]
-        deadline = asyncio.get_running_loop().time() + 25.0
-        want = {f"soak-{i}" for i in live}
-        while asyncio.get_running_loop().time() < deadline:
-            views = [
-                {m.node.id for m in nodes[i].members()
-                 if m.status == MemberStatus.ALIVE} for i in live
-            ]
-            if all(v >= want for v in views):
-                break
-            await asyncio.sleep(0.05)
-        views = [{m.node.id for m in nodes[i].members()
-                  if m.status == MemberStatus.ALIVE} for i in live]
-        for v in views:
-            assert v >= want, f"seed {seed}: survivor view {v} missing {want - v}"
+        await _assert_converges(nodes, live, {f"soak-{i}" for i in live},
+                                25.0, f"seed {seed}")
     finally:
         for i, s in nodes.items():
             if s.state != SerfState.SHUTDOWN:
@@ -130,9 +136,7 @@ async def test_partition_churn_storm(seed):
                 b = rng.choice(sorted(killed))
                 killed.discard(b)
                 nodes[b] = await Serf.create(
-                    net.bind(f"s{b}") if f"s{b}" not in net.transports
-                    else net.transports[f"s{b}"],
-                    Options.local(), f"storm-{b}")
+                    _rebind(net, f"s{b}"), Options.local(), f"storm-{b}")
                 tgt = f"s{rng.choice([i for i in nodes if i not in killed and i != b])}"
                 try:
                     await nodes[b].join(tgt)
@@ -150,18 +154,83 @@ async def test_partition_churn_storm(seed):
         live = [i for i in nodes if i not in killed
                 and nodes[i].state == SerfState.ALIVE
                 and i not in pending_join]
-        want = {f"storm-{i}" for i in live}
-        deadline = asyncio.get_running_loop().time() + 30.0
-        while asyncio.get_running_loop().time() < deadline:
-            views = [{m.node.id for m in nodes[i].members()
-                      if m.status == MemberStatus.ALIVE} for i in live]
-            if all(v >= want for v in views):
-                break
-            await asyncio.sleep(0.1)
-        views = [{m.node.id for m in nodes[i].members()
-                  if m.status == MemberStatus.ALIVE} for i in live]
-        for v in views:
-            assert v >= want, f"seed {seed}: view {v} missing {want - v}"
+        await _assert_converges(nodes, live, {f"storm-{i}" for i in live},
+                                30.0, f"seed {seed}")
+    finally:
+        for s in nodes.values():
+            if s.state != SerfState.SHUTDOWN:
+                await s.shutdown()
+
+
+async def test_encrypted_rotation_storm():
+    """Churn storm on an encrypted+compressed+checksummed wire with a
+    cluster-wide key rotation mid-run.  Rejoiners boot with the full
+    persisted keyring (per serf rotation guidance, a node missing a key
+    cannot decrypt replies encrypted with the new primary — verified
+    separately as correct fail-loudly behavior)."""
+    import dataclasses
+
+    from serf_tpu.host.keyring import SecretKeyring
+    from serf_tpu.options import MemberlistOptions
+
+    rng = random.Random(22)
+    net = LoopbackNetwork()
+    k1, k2 = bytes(range(16)), bytes(range(16, 32))
+    ml = dataclasses.replace(MemberlistOptions.local(), compression="zlib",
+                             checksum="xxhash32")
+    opts = dataclasses.replace(Options.local(), memberlist=ml)
+    nodes = {i: await Serf.create(net.bind(f"e{i}"), opts, f"enc-{i}",
+                                  keyring=SecretKeyring(k1))
+             for i in range(6)}
+    for i in range(1, 6):
+        await nodes[i].join("e0")
+    killed = set()
+    rotated = False
+    try:
+        for op in range(40):
+            live = [i for i in nodes if i not in killed]
+            r = rng.random()
+            if op == 20 and not rotated:
+                km = nodes[live[0]].key_manager()
+                out = await km.install_key(k2)
+                # every live node must have answered, or a missed install
+                # would surface 25 s later as an opaque convergence failure
+                assert out.num_err == 0 and out.num_resp >= len(live), out
+                out = await km.use_key(k2)
+                assert out.num_err == 0 and out.num_resp >= len(live), out
+                rotated = True
+            if r < 0.2 and len(live) > 3:
+                v = rng.choice([i for i in live if i != 0])
+                if rng.random() < 0.5:
+                    await nodes[v].leave()
+                await nodes[v].shutdown()
+                killed.add(v)
+            elif r < 0.4 and killed:
+                b = rng.choice(sorted(killed))
+                killed.discard(b)
+                # post-rotation rejoiners get the rotated keyring the way
+                # a real operator redistributes it (a node killed BEFORE
+                # the rotation never saved k2; booting it with only k1
+                # fails loudly by design — covered separately)
+                ring = (SecretKeyring(k2, keys=[k1]) if rotated
+                        else SecretKeyring(k1))
+                nodes[b] = await Serf.create(_rebind(net, f"e{b}"), opts,
+                                             f"enc-{b}", keyring=ring)
+                await nodes[b].join(
+                    f"e{rng.choice([i for i in nodes if i not in killed and i != b])}")
+            elif r < 0.7:
+                await nodes[rng.choice(live)].user_event(
+                    f"e{op}", b"x" * 40, coalesce=False)
+            if rng.random() < 0.3:
+                await asyncio.sleep(0.02)
+        assert rotated
+        live = [i for i in nodes if i not in killed
+                and nodes[i].state == SerfState.ALIVE]
+        await _assert_converges(nodes, live, {f"enc-{i}" for i in live},
+                                25.0, "encrypted storm")
+        # every survivor runs on the rotated primary
+        for i in live:
+            assert nodes[i].memberlist.keyring().primary_key() == k2
     finally:
         for s in nodes.values():
             if s.state != SerfState.SHUTDOWN:
