@@ -19,9 +19,11 @@ pytestmark = pytest.mark.asyncio
 
 
 def _rebind(net, addr):
-    """Reuse a still-registered loopback transport or bind the address anew
-    (a restarted agent on the same address)."""
-    return net.bind(addr) if addr not in net.transports else net.transports[addr]
+    """Bind the address anew for a restarted agent.  shutdown() always
+    releases the loopback address, so a live registration here would mean
+    two Serf instances racing on one packet queue — fail loudly."""
+    assert addr not in net.transports, f"{addr} still owned by a live node"
+    return net.bind(addr)
 
 
 async def _assert_converges(nodes, live, want, deadline_s, label):

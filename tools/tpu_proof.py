@@ -11,9 +11,11 @@ evidence in a single, foreground, never-killed process:
 2. **Flagship bench** — full `cluster_round` @1M (the BENCH headline).
 3. **swim-only bench** + **Pallas A/B** @1M.
 
-Writes ``TPU_PROOF.json`` at the repo root and prints a summary; exits 0
-only if every stage ran (parity failures exit 1 with the failing stage
-recorded).  Run in the foreground: ``python tools/tpu_proof.py``.
+Writes ``TPU_PROOF.json`` at the repo root and prints a summary.  A
+Pallas compile/parity failure does NOT abort the session (the bench
+stages are the headline evidence) but is recorded per-stage, flips the
+top-level ``ok`` to false, and the script exits 1.  Run in the
+foreground: ``python tools/tpu_proof.py``.
 """
 
 from __future__ import annotations
@@ -66,31 +68,39 @@ def main() -> int:
     from serf_tpu.ops import round_kernels
 
     # -- stage 1: compiled Pallas parity (modest n: compile fast, assert
-    #    bit-equality over several rounds) ---------------------------------
-    n_par = 8192
-    cfg_x = GossipConfig(n=n_par, k_facts=64, use_pallas=False)
-    cfg_p = GossipConfig(n=n_par, k_facts=64, use_pallas=True)
-    st = inject_fact(make_state(cfg_x), cfg_x, 3, K_USER_EVENT, 0, 1, 0)
-    step_x = jax.jit(functools.partial(round_step, cfg=cfg_x))
-    step_p = jax.jit(functools.partial(round_step, cfg=cfg_p))
-    a = b = st
-    key = jax.random.key(0)
-    t0 = time.perf_counter()
-    equal = True
-    for _ in range(20):
-        key, k2 = jax.random.split(key)
-        a = step_x(a, key=k2)
-        b = step_p(b, key=k2)
-    jax.block_until_ready((a, b))
-    for name in ("known", "budgets", "age"):
-        if not bool(jnp.all(getattr(a, name) == getattr(b, name))):
-            equal = False
-            record("pallas_parity", ok=False, mismatch=name)
-    if equal:
-        record("pallas_parity", ok=True, n=n_par, rounds=20,
-               interpret=False, seconds=round(time.perf_counter() - t0, 1))
-    else:
-        return 1
+    #    bit-equality over several rounds).  A Mosaic compile failure (the
+    #    kernels use sub-128 lane dims, legal-but-risky layouts) must NOT
+    #    abort the session — the bench stages are the headline evidence.
+    pallas_failed = False
+    try:
+        n_par = 8192
+        cfg_x = GossipConfig(n=n_par, k_facts=64, use_pallas=False)
+        cfg_p = GossipConfig(n=n_par, k_facts=64, use_pallas=True)
+        st = inject_fact(make_state(cfg_x), cfg_x, 3, K_USER_EVENT, 0, 1, 0)
+        step_x = jax.jit(functools.partial(round_step, cfg=cfg_x))
+        step_p = jax.jit(functools.partial(round_step, cfg=cfg_p))
+        a = b = st
+        key = jax.random.key(0)
+        t0 = time.perf_counter()
+        equal = True
+        for _ in range(20):
+            key, k2 = jax.random.split(key)
+            a = step_x(a, key=k2)
+            b = step_p(b, key=k2)
+        jax.block_until_ready((a, b))
+        for name in ("known", "budgets", "age"):
+            if not bool(jnp.all(getattr(a, name) == getattr(b, name))):
+                equal = False
+                record("pallas_parity", ok=False, mismatch=name)
+        if equal:
+            record("pallas_parity", ok=True, n=n_par, rounds=20,
+                   interpret=False,
+                   seconds=round(time.perf_counter() - t0, 1))
+        else:
+            pallas_failed = True
+    except Exception as e:  # noqa: BLE001 - keep capturing evidence
+        pallas_failed = True
+        record("pallas_parity", ok=False, error=repr(e)[:500])
 
     # -- timing helper ------------------------------------------------------
     def timed(jitted, state, rounds_per_call=100, calls=3):
@@ -135,12 +145,21 @@ def main() -> int:
     _, sw_rps = timed(run_sw, seeded().gossip)
     record("swim_1m", rps=round(sw_rps, 1))
 
-    gcfg_p = dataclasses.replace(gcfg, use_pallas=True)
-    run_pl = jax.jit(functools.partial(run_swim, cfg=gcfg_p, fcfg=fcfg),
-                     static_argnames=("num_rounds",), donate_argnums=(0,))
-    _, pl_rps = timed(run_pl, seeded().gossip)
-    record("swim_1m_pallas", rps=round(pl_rps, 1),
-           speedup_vs_xla=round(pl_rps / sw_rps, 3))
+    if not pallas_failed:
+        try:
+            gcfg_p = dataclasses.replace(gcfg, use_pallas=True)
+            run_pl = jax.jit(
+                functools.partial(run_swim, cfg=gcfg_p, fcfg=fcfg),
+                static_argnames=("num_rounds",), donate_argnums=(0,))
+            _, pl_rps = timed(run_pl, seeded().gossip)
+            record("swim_1m_pallas", rps=round(pl_rps, 1),
+                   speedup_vs_xla=round(pl_rps / sw_rps, 3))
+        except Exception as e:  # noqa: BLE001 - keep capturing evidence
+            pallas_failed = True
+            record("swim_1m_pallas", ok=False, error=repr(e)[:500])
+    else:
+        record("swim_1m_pallas", skipped=True,
+               reason="pallas_parity stage failed")
 
     fcfg_rr = dataclasses.replace(fcfg, probe_schedule="round_robin")
     run_rr = jax.jit(functools.partial(run_swim, cfg=gcfg, fcfg=fcfg_rr),
@@ -149,11 +168,11 @@ def main() -> int:
     record("swim_1m_round_robin", rps=round(rr_rps, 1),
            speedup_vs_random=round(rr_rps / sw_rps, 3))
 
-    proof["ok"] = True
+    proof["ok"] = not pallas_failed
     with open(OUT, "w") as f:
         json.dump(proof, f, indent=1)
     print("TPU proof complete:", json.dumps(proof["stages"]), flush=True)
-    return 0
+    return 0 if proof["ok"] else 1
 
 
 if __name__ == "__main__":
