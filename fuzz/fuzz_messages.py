@@ -265,8 +265,10 @@ def run(seed: int, seconds: float | None, cases: int | None) -> dict:
     try:
         from serf_tpu.codec import _native
         lz4 = _native.lz4_fns()
+        snappy = _native.snappy_fns()
     except Exception:  # noqa: BLE001 - native strictly optional
         lz4 = None
+        snappy = None
 
     def check_lz4(buf: bytes) -> None:
         """The native LZ4 decoder parses untrusted packets: it must reject
@@ -294,6 +296,30 @@ def run(seed: int, seconds: float | None, cases: int | None) -> dict:
                 examples.append({"where": "lz4-roundtrip", "err": repr(e),
                                  "buf": buf[:64].hex()})
 
+    def check_snappy(buf: bytes) -> None:
+        """Same contract as check_lz4 for the native snappy decoder."""
+        if snappy is None:
+            return
+        comp, decomp = snappy
+        try:
+            decomp(buf, 64)
+        except ValueError:
+            stats["decode_errors"] += 1
+        except Exception as e:  # noqa: BLE001 - contract under test
+            stats["violations"] += 1
+            if len(examples) < 5:
+                examples.append({"where": "snappy", "err": repr(e),
+                                 "buf": buf[:64].hex()})
+        try:
+            enc = comp(buf)
+            if decomp(enc, len(buf)) != buf:
+                raise AssertionError("snappy round-trip mismatch")
+        except Exception as e:  # noqa: BLE001 - contract under test
+            stats["violations"] += 1
+            if len(examples) < 5:
+                examples.append({"where": "snappy-roundtrip", "err": repr(e),
+                                 "buf": buf[:64].hex()})
+
     i = 0
     while True:
         if deadline is not None and time.monotonic() >= deadline:
@@ -304,6 +330,7 @@ def run(seed: int, seconds: float | None, cases: int | None) -> dict:
         msg = arbitrary_message(rng)
         raw = encode_any(msg)
         check_lz4(_mutate(rng, raw))
+        check_snappy(_mutate(rng, raw))
         back = decode_message(raw)
         if back != msg:
             stats["violations"] += 1

@@ -359,3 +359,188 @@ long serf_lz4_decompress(const unsigned char* src, long n,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Snappy block format codec (host/wire.py "snappy" compression variant).
+//
+// Implemented from the public snappy format description: a varint preamble
+// with the uncompressed length, then elements tagged by the low 2 bits —
+// 00 literal (6-bit length, or 60..63 = 1..4 extra LE length bytes),
+// 01 copy with 1-byte offset (len 4..11, 11-bit offset),
+// 10 copy with 2-byte LE offset (len 1..64),
+// 11 copy with 4-byte LE offset (len 1..64).
+// Same stance as the LZ4 codec above: the decoder is fully bounds-checked
+// (it parses untrusted packets); the encoder is a greedy hash matcher.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr int SNAPPY_HASH_LOG = 13;
+
+inline uint32_t snappy_hash(uint32_t v) {
+    return (v * 2654435761U) >> (32 - SNAPPY_HASH_LOG);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Compress src[0..n) into dst (capacity cap), preamble included.  Returns
+// compressed size, or -1 if dst is too small.  Worst case needs
+// n + n/60 + 8 bytes (literal tags + preamble).
+long serf_snappy_compress(const unsigned char* src, long n,
+                          unsigned char* dst, long cap) {
+    long op = 0;
+    // preamble: varint uncompressed length
+    {
+        uint64_t v = (uint64_t)n;
+        do {
+            if (op >= cap) return -1;
+            unsigned char b = v & 0x7F;
+            v >>= 7;
+            dst[op++] = v ? (b | 0x80) : b;
+        } while (v);
+    }
+
+    auto emit_literal = [&](long from, long len) -> bool {
+        if (len == 0) return true;  // one element: literals go to 2^32
+        long l = len - 1;
+        long need = len + (l < 60 ? 1 : (l < 256 ? 2 : (l < 65536 ? 3 : 5)));
+        if (op + need > cap) return false;
+        if (l < 60) {
+            dst[op++] = (unsigned char)(l << 2);
+        } else if (l < 256) {
+            dst[op++] = 60 << 2;
+            dst[op++] = (unsigned char)l;
+        } else if (l < 65536) {
+            dst[op++] = 61 << 2;
+            dst[op++] = (unsigned char)(l & 0xFF);
+            dst[op++] = (unsigned char)(l >> 8);
+        } else {
+            dst[op++] = 63 << 2;
+            dst[op++] = (unsigned char)(l & 0xFF);
+            dst[op++] = (unsigned char)((l >> 8) & 0xFF);
+            dst[op++] = (unsigned char)((l >> 16) & 0xFF);
+            dst[op++] = (unsigned char)((l >> 24) & 0xFF);
+        }
+        for (long i = 0; i < len; ++i) dst[op++] = src[from + i];
+        return true;
+    };
+
+    auto emit_copy = [&](long off, long len) -> bool {
+        while (len > 0) {
+            long chunk = len > 64 ? (len - 4 >= 64 ? 64 : 60) : len;
+            len -= chunk;
+            if (off < 2048 && chunk >= 4 && chunk <= 11) {
+                if (op + 2 > cap) return false;
+                dst[op++] = (unsigned char)(1 | ((chunk - 4) << 2) |
+                                            ((off >> 8) << 5));
+                dst[op++] = (unsigned char)(off & 0xFF);
+            } else if (off < 65536) {
+                if (op + 3 > cap) return false;
+                dst[op++] = (unsigned char)(2 | ((chunk - 1) << 2));
+                dst[op++] = (unsigned char)(off & 0xFF);
+                dst[op++] = (unsigned char)(off >> 8);
+            } else {
+                if (op + 5 > cap) return false;
+                dst[op++] = (unsigned char)(3 | ((chunk - 1) << 2));
+                dst[op++] = (unsigned char)(off & 0xFF);
+                dst[op++] = (unsigned char)((off >> 8) & 0xFF);
+                dst[op++] = (unsigned char)((off >> 16) & 0xFF);
+                dst[op++] = (unsigned char)((off >> 24) & 0xFF);
+            }
+        }
+        return true;
+    };
+
+    long table[1 << SNAPPY_HASH_LOG];
+    for (long i = 0; i < (1 << SNAPPY_HASH_LOG); ++i) table[i] = -1;
+
+    long ip = 0, anchor = 0;
+    while (ip + 4 <= n) {
+        uint32_t h = snappy_hash(read32(src + ip));
+        long cand = table[h];
+        table[h] = ip;
+        if (cand >= 0 && read32(src + cand) == read32(src + ip)) {
+            long ml = 4;
+            while (ip + ml < n && src[cand + ml] == src[ip + ml]) ++ml;
+            if (!emit_literal(anchor, ip - anchor)) return -1;
+            if (!emit_copy(ip - cand, ml)) return -1;
+            ip += ml;
+            anchor = ip;
+        } else {
+            ++ip;
+        }
+    }
+    if (!emit_literal(anchor, n - anchor)) return -1;
+    return op;
+}
+
+// Decompress src[0..n) into dst (capacity cap).  Parses the preamble and
+// requires the declared length to equal the actual output exactly.
+// Returns decompressed size, or -1 on ANY malformation (bad preamble,
+// declared > cap, truncated element, offset beyond output start, output
+// overflow, trailing garbage, length mismatch).
+long serf_snappy_decompress(const unsigned char* src, long n,
+                            unsigned char* dst, long cap) {
+    uint64_t declared;
+    long ip = varint(src, n, &declared);
+    if (ip == 0 || declared > (uint64_t)cap) return -1;
+    long op = 0;
+    while (ip < n) {
+        unsigned char tag = src[ip++];
+        switch (tag & 3) {
+            case 0: {  // literal
+                long len = (tag >> 2) + 1;
+                if (len > 60) {
+                    long extra = len - 60;  // 1..4 length bytes
+                    if (ip + extra > n) return -1;
+                    len = 0;
+                    for (long i = 0; i < extra; ++i)
+                        len |= (long)src[ip + i] << (8 * i);
+                    len += 1;
+                    ip += extra;
+                    if (len < 0) return -1;  // 4-byte length overflowed long?
+                }
+                if (ip + len > n || op + len > (long)declared) return -1;
+                for (long i = 0; i < len; ++i) dst[op++] = src[ip++];
+                break;
+            }
+            case 1: {  // copy, 1-byte offset
+                if (ip + 1 > n) return -1;
+                long len = ((tag >> 2) & 7) + 4;
+                long off = ((long)(tag >> 5) << 8) | src[ip++];
+                if (off == 0 || off > op || op + len > (long)declared)
+                    return -1;
+                for (long i = 0; i < len; ++i) { dst[op] = dst[op - off]; ++op; }
+                break;
+            }
+            case 2: {  // copy, 2-byte offset
+                if (ip + 2 > n) return -1;
+                long len = (tag >> 2) + 1;
+                long off = (long)src[ip] | ((long)src[ip + 1] << 8);
+                ip += 2;
+                if (off == 0 || off > op || op + len > (long)declared)
+                    return -1;
+                for (long i = 0; i < len; ++i) { dst[op] = dst[op - off]; ++op; }
+                break;
+            }
+            default: {  // copy, 4-byte offset
+                if (ip + 4 > n) return -1;
+                long len = (tag >> 2) + 1;
+                long off = (long)src[ip] | ((long)src[ip + 1] << 8) |
+                           ((long)src[ip + 2] << 16) |
+                           ((long)src[ip + 3] << 24);
+                ip += 4;
+                if (off == 0 || off > op || op + len > (long)declared)
+                    return -1;
+                for (long i = 0; i < len; ++i) { dst[op] = dst[op - off]; ++op; }
+                break;
+            }
+        }
+    }
+    if (op != (long)declared) return -1;
+    return op;
+}
+
+}  // extern "C"

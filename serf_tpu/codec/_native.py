@@ -186,3 +186,42 @@ def lz4_fns():
         return bytes(out[:got])
 
     return compress, decompress
+
+
+def snappy_fns():
+    """Native snappy block (compress, decompress) or None.
+
+    compress(data) -> bytes (varint preamble included, per the snappy
+    format); decompress(data, max_size) -> bytes (the block's declared
+    length must match the decoded output and fit max_size; raises
+    ValueError on malformed input)."""
+    lib = load()
+    if lib is None or not hasattr(lib, "serf_snappy_compress"):
+        return None
+    lib.serf_snappy_compress.restype = ctypes.c_long
+    lib.serf_snappy_compress.argtypes = [
+        ctypes.c_char_p, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long]
+    lib.serf_snappy_decompress.restype = ctypes.c_long
+    lib.serf_snappy_decompress.argtypes = [
+        ctypes.c_char_p, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long]
+
+    def compress(data: bytes) -> bytes:
+        data = bytes(data)
+        cap = len(data) + len(data) // 60 + 16
+        out = (ctypes.c_ubyte * cap)()
+        got = lib.serf_snappy_compress(data, len(data), out, cap)
+        if got < 0:
+            raise ValueError("snappy compression buffer overflow")
+        return bytes(out[:got])
+
+    def decompress(data: bytes, max_size: int) -> bytes:
+        data = bytes(data)
+        out = (ctypes.c_ubyte * max(max_size, 1))()
+        got = lib.serf_snappy_decompress(data, len(data), out, max_size)
+        if got < 0:
+            raise ValueError("malformed snappy block")
+        return bytes(out[:got])
+
+    return compress, decompress

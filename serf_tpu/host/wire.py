@@ -6,9 +6,10 @@ Capability parity with the reference's transport features
 checksums {crc32, xxhash, murmur3} and compressions {snappy, zstd, lz4,
 brotli}.  Here the checksum registry carries the reference's exact variants
 (xxhash32 and murmur3 are hand-rolled below — small, well-specified, and
-dependency-free) plus adler32; the compression registry carries zlib and
-the hand-rolled native LZ4 block codec (snappy/zstd/brotli stay documented
-deviations in PARITY.md — the environment forbids new dependencies).
+dependency-free) plus adler32; the compression registry carries zlib, the
+hand-rolled native LZ4 and snappy block codecs (native/codec.cpp), and
+zstd via the baked-in ``zstandard`` module (brotli stays the one documented
+deviation in PARITY.md — the environment forbids new dependencies).
 Registering another algorithm is one dict entry.
 
 Wire layout (outermost first):  [AES-GCM]([checksum4](marker1 + payload))
@@ -148,28 +149,33 @@ CHECKSUMS: Dict[str, Callable[[bytes], int]] = {
 _LZ4_MAX_RAW = 64 * 1024 * 1024
 
 
-_lz4_cache: list = []
+_native_fns_cache: Dict[str, tuple] = {}
 
 
-def _lz4_native():
-    if not _lz4_cache:
+def _native_fns(name: str):
+    """Lazy (compress, decompress) from the native codec library; raises
+    RuntimeError if native/codec.cpp could not be built/loaded.  Deferred
+    to first use — loading may run g++, which must not happen at import
+    time of the host stack."""
+    fns = _native_fns_cache.get(name)
+    if fns is None:
         from serf_tpu.codec import _native
-        fns = _native.lz4_fns()
+        fns = getattr(_native, f"{name}_fns")()
         if fns is None:
             raise RuntimeError(
-                "lz4 compression requires the native codec library "
+                f"{name} compression requires the native codec library "
                 "(native/codec.cpp could not be built/loaded)")
-        _lz4_cache.append(fns)
-    return _lz4_cache[0]
+        _native_fns_cache[name] = fns
+    return fns
 
 
 def _lz4_compress(data: bytes) -> bytes:
-    comp, _ = _lz4_native()
+    comp, _ = _native_fns("lz4")
     return encode_varint(len(data)) + comp(data)
 
 
 def _lz4_decompress(payload: bytes) -> bytes:
-    _, decomp = _lz4_native()
+    _, decomp = _native_fns("lz4")
     raw_len, pos = decode_varint(payload)
     # bound the declared size by the format's maximum expansion (~255x)
     # BEFORE allocating — a tiny crafted packet must not force a huge
@@ -180,13 +186,76 @@ def _lz4_decompress(payload: bytes) -> bytes:
     return decomp(payload[pos:], raw_len)
 
 
+def _snappy_compress(data: bytes) -> bytes:
+    comp, _ = _native_fns("snappy")
+    return comp(data)
+
+
+def _snappy_decompress(payload: bytes) -> bytes:
+    _, decomp = _native_fns("snappy")
+    # the snappy preamble declares the raw size; apply the same
+    # amplification guard as lz4 before the native decoder allocates
+    raw_len, _pos = decode_varint(payload)
+    if raw_len > _LZ4_MAX_RAW or raw_len > len(payload) * 255 + 64:
+        raise ValueError(f"snappy declared size {raw_len} implausible "
+                         f"for a {len(payload)}-byte payload")
+    return decomp(payload, raw_len)
+
+
+# zstd rides the baked-in ``zstandard`` module (no new dependency); absent
+# from the registry when unavailable so Options validation reports it.
+# Contexts are reused across packets (context setup dominates small
+# payloads; the asyncio host plane is single-threaded, so this is safe).
+try:
+    import zstandard as _zstandard
+    _zstd_c = _zstandard.ZstdCompressor(level=1)
+    _zstd_d = _zstandard.ZstdDecompressor()
+except ImportError:  # pragma: no cover - present in this image
+    _zstandard = None
+
+
+def _zstd_compress(data: bytes) -> bytes:
+    return _zstd_c.compress(data)
+
+
+def _zstd_decompress(payload: bytes) -> bytes:
+    # the frame header declares the content size (ZstdCompressor writes
+    # it); bound it with the same payload-proportional amplification guard
+    # as lz4/snappy before the decompressor allocates — a ~2 KB RLE frame
+    # can otherwise declare (and force allocation of) tens of MB
+    params = _zstandard.get_frame_parameters(payload)
+    cap = min(_LZ4_MAX_RAW, len(payload) * 255 + 64)
+    if params.content_size > cap:
+        raise ValueError(f"zstd declared size {params.content_size} "
+                         f"implausible for a {len(payload)}-byte payload")
+    return _zstd_d.decompress(payload, max_output_size=cap)
+
+
 # marker byte → (compress, decompress); marker 0 = uncompressed
 COMPRESSIONS: Dict[str, Tuple[int, Callable[[bytes], bytes],
                               Callable[[bytes], bytes]]] = {
     "zlib": (1, lambda b: zlib.compress(b, level=1), zlib.decompress),
     "lz4": (2, _lz4_compress, _lz4_decompress),
+    "snappy": (3, _snappy_compress, _snappy_decompress),
 }
+if _zstandard is not None:
+    COMPRESSIONS["zstd"] = (4, _zstd_compress, _zstd_decompress)
 _DECOMPRESS_BY_MARKER = {m: d for (m, _c, d) in COMPRESSIONS.values()}
+
+
+def compression_available(name: str) -> bool:
+    """Whether a registered variant can actually run here.  The native
+    variants (lz4/snappy) need the C++ library; probing may build it once.
+    Options validation uses this so an unusable variant fails at
+    construction, not on the first packet send."""
+    if name not in COMPRESSIONS:
+        return False
+    if name in ("lz4", "snappy"):
+        try:
+            _native_fns(name)
+        except RuntimeError:
+            return False
+    return True
 
 
 class WireError(Exception):
@@ -241,8 +310,9 @@ def decode_wire(buf: bytes, compression: Optional[str],
 
 # worst-case expansion headroom per compressor on packet-sized payloads
 # (zlib: header+adler; lz4: varint size prefix + token overhead n/255+16,
-# ~27B at the 1400B UDP budget)
-_COMPRESSION_OVERHEAD = {"zlib": 16, "lz4": 32}
+# ~27B at the 1400B UDP budget; snappy: preamble + literal tags n/60;
+# zstd: frame header + block headers)
+_COMPRESSION_OVERHEAD = {"zlib": 16, "lz4": 32, "snappy": 48, "zstd": 64}
 
 
 def wire_overhead(compression: Optional[str], checksum: Optional[str]) -> int:

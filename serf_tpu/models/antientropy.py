@@ -20,6 +20,8 @@ import jax.numpy as jnp
 from serf_tpu.models.dissemination import (
     GossipConfig,
     GossipState,
+    rolled_rows,
+    sample_offsets,
     unpack_bits,
 )
 
@@ -34,11 +36,20 @@ def push_pull_round(state: GossipState, cfg: GossipConfig, key: jax.Array,
     push/pull status_ltimes map.
     """
     n, k = cfg.n, cfg.k_facts
-    partners = jax.random.randint(key, (n,), 0, n)
-    partner_known = state.known[partners]                     # u32[N, W]
-    ok = state.alive & state.alive[partners]
-    if group is not None:
-        ok = ok & (group == group[partners])
+    if cfg.peer_sampling == "rotation":
+        # one random rotation pairs everyone: partner reads are contiguous
+        # rolls, no 1M-row gather (see GossipConfig.peer_sampling)
+        off = sample_offsets(key, 1, n)[0]
+        partner_known = rolled_rows(state.known, off)         # u32[N, W]
+        ok = state.alive & rolled_rows(state.alive, off)
+        if group is not None:
+            ok = ok & (group == rolled_rows(group, off))
+    else:
+        partners = jax.random.randint(key, (n,), 0, n)
+        partner_known = state.known[partners]                 # u32[N, W]
+        ok = state.alive & state.alive[partners]
+        if group is not None:
+            ok = ok & (group == group[partners])
     incoming = jnp.where(ok[:, None], partner_known, jnp.uint32(0))
     new_words = incoming & ~state.known
     known = state.known | new_words

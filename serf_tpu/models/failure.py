@@ -28,7 +28,9 @@ from serf_tpu.models.dissemination import (
     K_SUSPECT,
     inject_facts_batch,
     pick_bounded,
+    rolled_rows,
     round_step,
+    sample_offsets,
     unpack_bits,
 )
 
@@ -123,35 +125,59 @@ def probe_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
     """
     n = cfg.n
     k_target, k_drop, k_help, k_hdrop, k_pick = jax.random.split(key, 5)
-    if fcfg.probe_schedule == "round_robin":
-        # one pseudo-random nonzero rotation per round: node i probes
-        # (i + offset) % n, so every node is probed exactly once
-        offset = rotation_offset(state.round, n)
-        targets = ((jnp.arange(n, dtype=jnp.uint32) + offset)
-                   % jnp.uint32(n)).astype(jnp.int32)
-    else:
-        targets = jax.random.randint(k_target, (n,), 0, n)
     dropped = jax.random.bernoulli(k_drop, fcfg.probe_drop_rate, (n,))
     prober_ok = state.alive
-    target_up = state.alive[targets]
-    ack = target_up & ~dropped
-    if fcfg.indirect_probes > 0:
-        ki = fcfg.indirect_probes
-        helpers = jax.random.randint(k_help, (n, ki), 0, n)
-        helper_ok = state.alive[helpers]                       # bool[N, ki]
-        h_drop = jax.random.bernoulli(k_hdrop, fcfg.probe_drop_rate, (n, ki))
-        ack_indirect = target_up[:, None] & helper_ok & ~h_drop
-        ack = ack | jnp.any(ack_indirect, axis=1)
-    detected = prober_ok & ~ack & (targets != jnp.arange(n))
+    if fcfg.probe_schedule == "round_robin":
+        # one pseudo-random nonzero rotation per round: node i probes
+        # (i + offset) % n, so every node is probed exactly once — AND the
+        # rotation is invertible, so target liveness is a contiguous roll
+        # and "who probed me" is analytic: no 1M-row gather or scatter
+        # (each of those lowers to a serial loop on TPU, ~10 ms apiece)
+        offset = rotation_offset(state.round, n).astype(jnp.int32)
+        target_up = rolled_rows(state.alive, offset)
+        ack = target_up & ~dropped
+        if fcfg.indirect_probes > 0:
+            # helpers are per-round random rotations too (the reference
+            # samples k random helpers; a fresh random cyclic matching per
+            # path keeps the drop paths independent where it matters)
+            h_offs = sample_offsets(k_help, fcfg.indirect_probes, n)
+            h_drop = jax.random.bernoulli(
+                k_hdrop, fcfg.probe_drop_rate, (n, fcfg.indirect_probes))
+            for h in range(fcfg.indirect_probes):
+                helper_ok = rolled_rows(state.alive, h_offs[h])
+                ack = ack | (target_up & helper_ok & ~h_drop[:, h])
+        # offset ∈ [1, n-1] means never self-probe — except n == 1, where
+        # every rotation is the identity and the lone node must not be
+        # able to suspect itself
+        detected = prober_ok & ~ack & (n > 1)
+        # invert the rotation: subject j's prober is (j - offset) % n
+        subject_detected = rolled_rows(detected, n - offset)
+        detector_of = (jnp.arange(n, dtype=jnp.int32) + (n - offset)) % n
+    else:
+        targets = jax.random.randint(k_target, (n,), 0, n)
+        target_up = state.alive[targets]
+        ack = target_up & ~dropped
+        if fcfg.indirect_probes > 0:
+            ki = fcfg.indirect_probes
+            helpers = jax.random.randint(k_help, (n, ki), 0, n)
+            helper_ok = state.alive[helpers]                   # bool[N, ki]
+            h_drop = jax.random.bernoulli(
+                k_hdrop, fcfg.probe_drop_rate, (n, ki))
+            ack_indirect = target_up[:, None] & helper_ok & ~h_drop
+            ack = ack | jnp.any(ack_indirect, axis=1)
+        detected = prober_ok & ~ack & (targets != jnp.arange(n))
 
-    # which subjects were detected, and by whom.  The scatter must be masked:
-    # writing a default for non-detecting probers would hand subject 0 a
-    # bogus (possibly dead) detector whose packets never flow.  scatter-max
-    # of detector+1 (0 = none) composes correctly under duplicate targets.
-    subject_detected = jnp.zeros((n,), bool).at[targets].max(detected)
-    det_writes = jnp.where(detected, jnp.arange(n, dtype=jnp.int32) + 1, 0)
-    detector_plus1 = jnp.zeros((n,), jnp.int32).at[targets].max(det_writes)
-    detector_of = jnp.maximum(detector_plus1 - 1, 0)
+        # which subjects were detected, and by whom.  The scatter must be
+        # masked: writing a default for non-detecting probers would hand
+        # subject 0 a bogus (possibly dead) detector whose packets never
+        # flow.  scatter-max of detector+1 (0 = none) composes correctly
+        # under duplicate targets.
+        subject_detected = jnp.zeros((n,), bool).at[targets].max(detected)
+        det_writes = jnp.where(detected,
+                               jnp.arange(n, dtype=jnp.int32) + 1, 0)
+        detector_plus1 = jnp.zeros((n,), jnp.int32).at[targets].max(
+            det_writes)
+        detector_of = jnp.maximum(detector_plus1 - 1, 0)
 
     already = _subject_covered(state, cfg, (K_SUSPECT, K_DEAD))
     candidates = subject_detected & ~already

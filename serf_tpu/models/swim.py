@@ -26,7 +26,9 @@ from serf_tpu.models.dissemination import (
     GossipConfig,
     GossipState,
     make_state,
+    rolled_rows,
     round_step,
+    sample_offsets,
 )
 from serf_tpu.models.failure import (
     FailureConfig,
@@ -38,6 +40,7 @@ from serf_tpu.models.vivaldi import (
     VivaldiConfig,
     VivaldiState,
     ground_truth_rtt,
+    ground_truth_rtt_rolled,
     make_vivaldi,
     vivaldi_update,
 )
@@ -95,13 +98,25 @@ def cluster_round(state: ClusterState, cfg: ClusterConfig,
     viv = state.vivaldi
     if cfg.with_vivaldi:
         n = cfg.n
-        peers = jax.random.randint(k_peer, (n,), 0, n)
-        same_group = state.group == state.group[peers]
-        reachable = g.alive & g.alive[peers] & same_group \
-            & (peers != jnp.arange(n))
-        rtt = ground_truth_rtt(state.positions, jnp.arange(n), peers)
-        viv = vivaldi_update(viv, cfg.vivaldi, peers, rtt, k_viv,
-                             active=reachable)
+        if cfg.gossip.peer_sampling == "rotation":
+            # one rotation pairs every node with a pseudo-random RTT
+            # partner; every peer read (liveness, group, hidden position,
+            # coordinate state) is a contiguous roll, no 1M-row gather
+            voff = sample_offsets(k_peer, 1, n)[0]
+            peers = (jnp.arange(n, dtype=jnp.int32) + voff) % n
+            same_group = state.group == rolled_rows(state.group, voff)
+            reachable = g.alive & rolled_rows(g.alive, voff) & same_group
+            rtt = ground_truth_rtt_rolled(state.positions, voff)
+            viv = vivaldi_update(viv, cfg.vivaldi, peers, rtt, k_viv,
+                                 active=reachable, peer_roll=voff)
+        else:
+            peers = jax.random.randint(k_peer, (n,), 0, n)
+            same_group = state.group == state.group[peers]
+            reachable = g.alive & g.alive[peers] & same_group \
+                & (peers != jnp.arange(n))
+            rtt = ground_truth_rtt(state.positions, jnp.arange(n), peers)
+            viv = vivaldi_update(viv, cfg.vivaldi, peers, rtt, k_viv,
+                                 active=reachable)
     return ClusterState(g, viv, state.positions, state.group)
 
 

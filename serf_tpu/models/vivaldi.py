@@ -84,13 +84,21 @@ def _unit_vectors(diff: jnp.ndarray, key: jax.Array):
 
 def vivaldi_update(state: VivaldiState, cfg: VivaldiConfig,
                    peer: jnp.ndarray, rtt: jnp.ndarray,
-                   key: jax.Array, active=None) -> VivaldiState:
+                   key: jax.Array, active=None,
+                   peer_roll=None) -> VivaldiState:
     """One observation per node: node i measured ``rtt[i]`` against
     ``peer[i]``.  Nodes with ``active[i]=False`` keep their state.
 
     Faithful vectorization of CoordinateClient.update (host plane), which is
     itself the reference's update path (coordinate.rs:727-762 + gravity
     699-705): vivaldi force -> adjustment window -> gravity.
+
+    ``peer_roll``: when the caller sampled peers as one rotation
+    (``peer[i] = (i + peer_roll) % n``, GossipConfig.peer_sampling
+    "rotation"), pass the offset so peer state is read with contiguous
+    rolls instead of 1M-row gathers (serial-loop scatter/gather cost on
+    TPU).  ``peer`` must match the rotation; it is still used for
+    coincidence checks.
     """
     n = state.vec.shape[0]
     if active is None:
@@ -98,14 +106,22 @@ def vivaldi_update(state: VivaldiState, cfg: VivaldiConfig,
     k_force, k_grav = jax.random.split(key)
     rtt = jnp.maximum(rtt, ZERO_THRESHOLD)
 
-    p_vec = state.vec[peer]
-    p_h = state.height[peer]
-    p_err = state.error[peer]
+    if peer_roll is None:
+        p_vec = state.vec[peer]
+        p_h = state.height[peer]
+        p_err = state.error[peer]
+        p_adj = state.adjustment[peer]
+    else:
+        from serf_tpu.models.dissemination import rolled_rows
+        p_vec = rolled_rows(state.vec, peer_roll)
+        p_h = rolled_rows(state.height, peer_roll)
+        p_err = rolled_rows(state.error, peer_roll)
+        p_adj = rolled_rows(state.adjustment, peer_roll)
 
     # -- vivaldi spring relaxation (adjustment-inclusive distance, matching
     # the host oracle / reference distance_to semantics)
     raw = _raw_distance(state.vec, state.height, p_vec, p_h)
-    adjusted = raw + state.adjustment + state.adjustment[peer]
+    adjusted = raw + state.adjustment + p_adj
     dist = jnp.where(adjusted > 0.0, adjusted, raw)
     wrongness = jnp.abs(dist - rtt) / rtt
     total_err = jnp.maximum(state.error + p_err, ZERO_THRESHOLD)
@@ -182,6 +198,15 @@ def ground_truth_rtt(positions: jnp.ndarray, i, j,
     plus a base propagation delay (the '1M-node latency graph' of baseline
     config #5)."""
     return base + jnp.linalg.norm(positions[i] - positions[j], axis=-1)
+
+
+def ground_truth_rtt_rolled(positions: jnp.ndarray, shift,
+                            base: float = 0.005) -> jnp.ndarray:
+    """``ground_truth_rtt(positions, i, (i+shift)%n)`` for all i, with the
+    peer read as a contiguous roll (rotation peer sampling)."""
+    from serf_tpu.models.dissemination import rolled_rows
+    return base + jnp.linalg.norm(
+        positions - rolled_rows(positions, shift), axis=-1)
 
 
 def mean_relative_error(state: VivaldiState, cfg: VivaldiConfig,

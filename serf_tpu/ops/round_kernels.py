@@ -61,16 +61,20 @@ def _select_kernel(budgets_ref, alive_ref, age_ref,
     k = budgets.shape[1]
     w = k // 32
     sending = (budgets > 0) & (alive > 0)          # (B, K) bool
-    bits = sending.astype(jnp.uint32)
-    weights = (jnp.uint32(1) << (
-        jax.lax.broadcasted_iota(jnp.uint32, (1, k), 1) % 32))
+    # Mosaic has no unsigned reductions; sum in int32 and bitcast.  Each
+    # weight 1<<j appears at most once per word, so the signed sum is any
+    # 32-bit pattern reinterpreted — always representable, never overflows.
+    bits = sending.astype(jnp.int32)
+    weights = (jnp.int32(1) << (
+        jax.lax.broadcasted_iota(jnp.int32, (1, k), 1) % 32))
     weighted = bits * weights                      # (B, K)
     # sum each 32-lane group into one word
     words = []
     for wi in range(w):
         words.append(jnp.sum(weighted[:, wi * 32:(wi + 1) * 32], axis=1,
-                             keepdims=True, dtype=jnp.uint32))
-    packets_ref[:] = jnp.concatenate(words, axis=1)
+                             keepdims=True, dtype=jnp.int32))
+    packets_ref[:] = jax.lax.bitcast_convert_type(
+        jnp.concatenate(words, axis=1), jnp.uint32)
     budgets_out_ref[:] = jnp.where(sending, budgets - 1, budgets)
     age_out_ref[:] = jnp.where(age < 255, age + 1, age)  # saturating age++
 

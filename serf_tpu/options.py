@@ -33,13 +33,14 @@ class MemberlistOptions:
     push_pull_interval: float = 30.0
     awareness_max_multiplier: int = 8        # Lifeguard local-health ceiling
     timeout: float = 10.0                    # stream (push/pull) op timeout
-    compression: Optional[str] = None        # None | "zlib" (packet payloads)
+    compression: Optional[str] = None        # None | zlib/lz4/snappy/zstd
     checksum: Optional[str] = None           # None | crc32/adler32/xxhash32/murmur3
     metric_labels: Dict[str, str] = field(default_factory=dict)
 
     def validate(self) -> None:
-        from serf_tpu.host.wire import CHECKSUMS, COMPRESSIONS
-        if self.compression is not None and self.compression not in COMPRESSIONS:
+        from serf_tpu.host.wire import CHECKSUMS, compression_available
+        if self.compression is not None and not compression_available(
+                self.compression):
             raise ValueError(f"unsupported compression {self.compression!r}")
         if self.checksum is not None and self.checksum not in CHECKSUMS:
             raise ValueError(f"unsupported checksum {self.checksum!r}")

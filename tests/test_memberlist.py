@@ -296,7 +296,7 @@ async def test_unsupported_wire_options_rejected():
     net = LoopbackNetwork()
     with pytest.raises(ValueError):
         Memberlist(net.bind("x0"), dataclasses.replace(
-            MemberlistOptions.local(), compression="snappy"), "x-0")
+            MemberlistOptions.local(), compression="brotli"), "x-0")
     with pytest.raises(ValueError):
         Memberlist(net.bind("x1"), dataclasses.replace(
             MemberlistOptions.local(), checksum="xxhash"), "x-1")

@@ -10,7 +10,12 @@ from hypothesis import given, settings, strategies as st
 
 from serf_tpu import codec
 from serf_tpu.host import messages as sm
-from serf_tpu.host.wire import CHECKSUMS, decode_wire, encode_wire
+from serf_tpu.host.wire import (
+    CHECKSUMS,
+    COMPRESSIONS,
+    decode_wire,
+    encode_wire,
+)
 from serf_tpu.types.member import Node
 from serf_tpu.types.messages import (
     JoinMessage,
@@ -79,9 +84,17 @@ def _lz4_available() -> bool:
     return _native.lz4_fns() is not None
 
 
+def _snappy_available() -> bool:
+    from serf_tpu.codec import _native
+    return _native.snappy_fns() is not None
+
+
 # resolve availability once: a skip inside a @given body would skip the
 # WHOLE test and silently drop the zlib/checksum coverage with it
-_COMPRESSIONS = [None, "zlib"] + (["lz4"] if _lz4_available() else [])
+_COMPRESSIONS = ([None, "zlib"]
+                 + (["lz4"] if _lz4_available() else [])
+                 + (["snappy"] if _snappy_available() else [])
+                 + (["zstd"] if "zstd" in COMPRESSIONS else []))
 
 
 @settings(max_examples=150, deadline=None)
@@ -99,6 +112,17 @@ def test_lz4_round_trips_arbitrary_buffers(data):
     from serf_tpu.codec import _native
 
     comp, decomp = _native.lz4_fns()
+    assert decomp(comp(data), len(data)) == data
+
+
+@pytest.mark.skipif(not _snappy_available(),
+                    reason="native snappy unavailable")
+@settings(max_examples=200, deadline=None)
+@given(st.binary(max_size=300))
+def test_snappy_round_trips_arbitrary_buffers(data):
+    from serf_tpu.codec import _native
+
+    comp, decomp = _native.snappy_fns()
     assert decomp(comp(data), len(data)) == data
 
 

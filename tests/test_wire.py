@@ -210,3 +210,196 @@ def test_lz4_rejects_implausible_declared_size():
     # a plausible declaration still round-trips
     from serf_tpu.host.wire import _lz4_compress
     assert _lz4_decompress(_lz4_compress(b"x" * 300)) == b"x" * 300
+
+
+def _snappy_available():
+    from serf_tpu.codec import _native
+    return _native.snappy_fns() is not None
+
+
+@pytest.mark.skipif(not _snappy_available(), reason="native snappy unavailable")
+class TestSnappy:
+    def test_spec_vectors_decode(self):
+        """Hand-assembled blocks per the public snappy format description:
+        every element kind (literal short/extended, copy-1/2/4, overlapping
+        RLE copy) decodes to its spec-defined expansion."""
+        from serf_tpu.codec import _native
+
+        _, decomp = _native.snappy_fns()
+        # short literal: varint(5) + tag((5-1)<<2) + "hello"
+        assert decomp(bytes([5, (5 - 1) << 2]) + b"hello", 5) == b"hello"
+        # copy with 2-byte offset: "abcd" then len-4 off-4 copy
+        blk = (bytes([8, (4 - 1) << 2]) + b"abcd"
+               + bytes([2 | ((4 - 1) << 2), 4, 0]))
+        assert decomp(blk, 8) == b"abcdabcd"
+        # copy with 1-byte offset (tag carries len-4 and offset high bits)
+        blk = (bytes([8, (4 - 1) << 2]) + b"abcd"
+               + bytes([1 | ((4 - 4) << 2) | ((4 >> 8) << 5), 4]))
+        assert decomp(blk, 8) == b"abcdabcd"
+        # copy with 4-byte offset
+        blk = (bytes([8, (4 - 1) << 2]) + b"abcd"
+               + bytes([3 | ((4 - 1) << 2), 4, 0, 0, 0]))
+        assert decomp(blk, 8) == b"abcdabcd"
+        # overlapping copy = RLE: one "a" then off-1 len-7 copy
+        blk = bytes([8, 0]) + b"a" + bytes([2 | ((7 - 1) << 2), 1, 0])
+        assert decomp(blk, 8) == b"a" * 8
+        # extended literal length (60 => one extra LE length byte)
+        data = bytes(range(100))
+        blk = bytes([100, 60 << 2, 99]) + data
+        assert decomp(blk, 100) == data
+
+    def test_round_trip_identity(self):
+        import random
+
+        from serf_tpu.codec import _native
+
+        comp, decomp = _native.snappy_fns()
+        rng = random.Random(7)
+        cases = [b"", b"a", b"abcd" * 1000, bytes(range(256)) * 8,
+                 rng.randbytes(10_000)]
+        for data in cases:
+            enc = comp(data)
+            assert decomp(enc, len(data)) == data
+        assert len(comp(b"abcd" * 1000)) < 200   # ratio sanity on repetitive
+        rnd = rng.randbytes(5000)
+        assert len(comp(rnd)) <= len(rnd) + len(rnd) // 60 + 16
+
+    def test_decoder_rejects_malformed(self):
+        import random
+
+        from serf_tpu.codec import _native
+
+        comp, decomp = _native.snappy_fns()
+        good = comp(b"hello world, hello world, hello world")
+        rng = random.Random(8)
+        rejected = 0
+        for _ in range(3000):
+            b = bytearray(good)
+            op = rng.random()
+            if op < 0.4 and b:
+                b = b[:rng.randrange(len(b))]
+            elif op < 0.8 and b:
+                b[rng.randrange(len(b))] ^= 1 << rng.randrange(8)
+            else:
+                b = bytearray(rng.randbytes(rng.randrange(60)))
+            try:
+                decomp(bytes(b), 37)  # raises unless exactly 37 decoded
+            except ValueError:
+                rejected += 1
+        assert rejected > 1000
+
+    def test_wire_pipeline_with_snappy(self):
+        payload = b"gossip state " * 50
+        for checksum in (None, "crc32", "murmur3"):
+            enc = encode_wire(payload, "snappy", checksum)
+            assert decode_wire(enc, "snappy", checksum) == payload
+            assert len(enc) < len(payload) // 2  # it actually compressed
+
+    @pytest.mark.asyncio
+    async def test_cluster_converges_over_snappy(self):
+        import dataclasses
+
+        from serf_tpu.host.memberlist import Memberlist
+        from serf_tpu.host.transport import LoopbackNetwork
+        from serf_tpu.options import MemberlistOptions
+
+        net = LoopbackNetwork()
+        opts = dataclasses.replace(MemberlistOptions.local(),
+                                   compression="snappy", checksum="murmur3")
+        nodes = []
+        for i in range(3):
+            ml = Memberlist(net.bind(f"sn{i}"), opts, f"node-{i}")
+            await ml.start()
+            nodes.append(ml)
+        try:
+            for ml in nodes[1:]:
+                await ml.join(nodes[0].transport.local_addr)
+            deadline = asyncio.get_running_loop().time() + 7.0
+            while asyncio.get_running_loop().time() < deadline:
+                if all(m.num_online_members() == 3 for m in nodes):
+                    break
+                await asyncio.sleep(0.01)
+            assert all(m.num_online_members() == 3 for m in nodes)
+        finally:
+            for ml in nodes:
+                await ml.shutdown()
+
+
+@pytest.mark.skipif(not _snappy_available(),
+                    reason="native snappy unavailable")
+def test_snappy_rejects_implausible_declared_size():
+    """The preamble-declared size is bounded before allocation, same
+    amplification guard as lz4."""
+    from serf_tpu import codec as c
+    from serf_tpu.host.wire import _snappy_compress, _snappy_decompress
+
+    tiny = c.encode_varint(64 * 1024 * 1024) + b"\x00"
+    with pytest.raises(ValueError, match="implausible"):
+        _snappy_decompress(tiny)
+    assert _snappy_decompress(_snappy_compress(b"x" * 300)) == b"x" * 300
+
+
+@pytest.mark.skipif("zstd" not in COMPRESSIONS,
+                    reason="zstandard module unavailable")
+class TestZstd:
+    def test_wire_pipeline_with_zstd(self):
+        payload = b"gossip state " * 50
+        for checksum in (None, "crc32", "xxhash32"):
+            enc = encode_wire(payload, "zstd", checksum)
+            assert decode_wire(enc, "zstd", checksum) == payload
+            assert len(enc) < len(payload) // 2
+
+    def test_corruption_dropped(self):
+        enc = bytearray(encode_wire(b"y" * 200, "zstd", None))
+        enc[-3] ^= 0x20
+        with pytest.raises(WireError):
+            decode_wire(bytes(enc), "zstd", None)
+
+    def test_rejects_implausible_content_size(self):
+        """A frame declaring > the 64 MiB cap is rejected before the
+        decompressor allocates."""
+        import zstandard
+
+        from serf_tpu.host.wire import _zstd_decompress
+
+        big = zstandard.ZstdCompressor(level=1).compress(
+            b"\x00" * (65 * 1024 * 1024))
+        assert len(big) < 1024 * 1024  # RLE frame: tiny payload, huge claim
+        with pytest.raises(ValueError, match="implausible"):
+            _zstd_decompress(big)
+        # under the 64 MiB absolute cap but still ~30,000x the payload:
+        # the payload-proportional bound (matching lz4/snappy) must reject
+        mid = zstandard.ZstdCompressor(level=1).compress(
+            b"\x00" * (63 * 1024 * 1024))
+        assert len(mid) * 255 + 64 < 63 * 1024 * 1024
+        with pytest.raises(ValueError, match="implausible"):
+            _zstd_decompress(mid)
+
+    @pytest.mark.asyncio
+    async def test_cluster_converges_over_zstd(self):
+        import dataclasses
+
+        from serf_tpu.host.memberlist import Memberlist
+        from serf_tpu.host.transport import LoopbackNetwork
+        from serf_tpu.options import MemberlistOptions
+
+        net = LoopbackNetwork()
+        opts = dataclasses.replace(MemberlistOptions.local(),
+                                   compression="zstd", checksum="crc32")
+        nodes = []
+        for i in range(3):
+            ml = Memberlist(net.bind(f"zs{i}"), opts, f"node-{i}")
+            await ml.start()
+            nodes.append(ml)
+        try:
+            for ml in nodes[1:]:
+                await ml.join(nodes[0].transport.local_addr)
+            deadline = asyncio.get_running_loop().time() + 7.0
+            while asyncio.get_running_loop().time() < deadline:
+                if all(m.num_online_members() == 3 for m in nodes):
+                    break
+                await asyncio.sleep(0.01)
+            assert all(m.num_online_members() == 3 for m in nodes)
+        finally:
+            for ml in nodes:
+                await ml.shutdown()

@@ -103,16 +103,26 @@ def main() -> int:
         record("pallas_parity", ok=False, error=repr(e)[:500])
 
     # -- timing helper ------------------------------------------------------
+    # Completion barrier = host transfer of the round counter, NOT
+    # block_until_ready: on the axon tunnel block_until_ready can report
+    # donated-buffer outputs ready while execution is still in flight
+    # (observed 0.0 ms "completions" of 100-round 1M-node scans).  A
+    # device→host read cannot finish before the producing program.
+    import numpy as np
+
+    def _round_of(state):
+        return (state.gossip if hasattr(state, "gossip") else state).round
+
     def timed(jitted, state, rounds_per_call=100, calls=3):
         key = jax.random.key(1)
         key, k = jax.random.split(key)
-        state = jax.block_until_ready(
-            jitted(state, key=k, num_rounds=rounds_per_call))
+        state = jitted(state, key=k, num_rounds=rounds_per_call)
+        int(np.asarray(_round_of(state)))
         t0 = time.perf_counter()
         for _ in range(calls):
             key, k = jax.random.split(key)
             state = jitted(state, key=k, num_rounds=rounds_per_call)
-        jax.block_until_ready(state)
+            int(np.asarray(_round_of(state)))
         return state, rounds_per_call * calls / (time.perf_counter() - t0)
 
     n = 1_000_000
