@@ -43,6 +43,8 @@ from serf_tpu.models.dissemination import (
     GossipState,
     K_QUERY,
     inject_fact,
+    rolled_rows,
+    sample_offsets,
     unpack_bits,
 )
 
@@ -203,11 +205,27 @@ def query_round(gossip: GossipState, qstate: QueryState, cfg: GossipConfig,
     origin_alive = gossip.alive[qstate.origin]                # bool[Q]
     if qcfg.relay_factor > 0:
         r = qcfg.relay_factor
-        mids = jax.random.randint(key, (q, n, r), 0, n)       # i32[Q, N, R]
-        relay_ok = gossip.alive[mids]                         # bool[Q, N, R]
-        if drop_relay is not None:
-            relay_ok = relay_ok & ~drop_relay
-        arrive = arrive | jnp.any(relay_ok, axis=-1)
+        if cfg.peer_sampling == "rotation":
+            # one random rotation per (query, relay path): relay liveness
+            # is a contiguous roll, no Q×N×R random gather (serial-loop
+            # cost on TPU; see GossipConfig.peer_sampling)
+            offs = sample_offsets(key, q * r, n).reshape(q, r)
+            rows = []
+            for qi in range(q):
+                any_ok = jnp.zeros((n,), bool)
+                for ri in range(r):
+                    ok = rolled_rows(gossip.alive, offs[qi, ri])
+                    if drop_relay is not None:
+                        ok = ok & ~drop_relay[qi, :, ri]
+                    any_ok = any_ok | ok
+                rows.append(any_ok)
+            arrive = arrive | jnp.stack(rows)
+        else:
+            mids = jax.random.randint(key, (q, n, r), 0, n)   # i32[Q, N, R]
+            relay_ok = gossip.alive[mids]                     # bool[Q, N, R]
+            if drop_relay is not None:
+                relay_ok = relay_ok & ~drop_relay
+            arrive = arrive | jnp.any(relay_ok, axis=-1)
     arrive = arrive & origin_alive[:, None]
 
     delivered = senders & arrive

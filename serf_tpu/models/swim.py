@@ -103,11 +103,10 @@ def cluster_round(state: ClusterState, cfg: ClusterConfig,
             # partner; every peer read (liveness, group, hidden position,
             # coordinate state) is a contiguous roll, no 1M-row gather
             voff = sample_offsets(k_peer, 1, n)[0]
-            peers = (jnp.arange(n, dtype=jnp.int32) + voff) % n
             same_group = state.group == rolled_rows(state.group, voff)
             reachable = g.alive & rolled_rows(g.alive, voff) & same_group
             rtt = ground_truth_rtt_rolled(state.positions, voff)
-            viv = vivaldi_update(viv, cfg.vivaldi, peers, rtt, k_viv,
+            viv = vivaldi_update(viv, cfg.vivaldi, None, rtt, k_viv,
                                  active=reachable, peer_roll=voff)
         else:
             peers = jax.random.randint(k_peer, (n,), 0, n)

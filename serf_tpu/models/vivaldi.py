@@ -97,8 +97,7 @@ def vivaldi_update(state: VivaldiState, cfg: VivaldiConfig,
     (``peer[i] = (i + peer_roll) % n``, GossipConfig.peer_sampling
     "rotation"), pass the offset so peer state is read with contiguous
     rolls instead of 1M-row gathers (serial-loop scatter/gather cost on
-    TPU).  ``peer`` must match the rotation; it is still used for
-    coincidence checks.
+    TPU).  In that mode ``peer`` is unused and may be None.
     """
     n = state.vec.shape[0]
     if active is None:

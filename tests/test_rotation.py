@@ -166,3 +166,59 @@ def test_rotation_probe_inverse_matches_scatter_formula():
     sel = np.asarray(subject_roll)
     assert np.array_equal(np.asarray(det_scatter)[sel],
                           np.asarray(det_roll_raw)[sel])
+
+
+def test_rotation_query_gathers_all_responses():
+    from serf_tpu.models.query import (
+        QueryConfig,
+        launch_query,
+        make_queries,
+        no_filter_mask,
+        num_responses,
+        query_round,
+    )
+
+    cfg = GossipConfig(n=512, k_facts=32, peer_sampling="rotation")
+    qcfg = QueryConfig(q_slots=2, relay_factor=2)
+    st = make_state(cfg)
+    g, qstate, qi = launch_query(st, make_queries(cfg, qcfg), cfg, qcfg,
+                                 origin=3, eligible=no_filter_mask(cfg.n))
+    key = jax.random.key(6)
+    from serf_tpu.models.dissemination import round_step
+    for _ in range(30):
+        key, k1, k2 = jax.random.split(key, 3)
+        g = round_step(g, cfg, k1)
+        qstate = query_round(g, qstate, cfg, qcfg, k2)
+    assert int(num_responses(qstate)[qi]) == cfg.n  # everyone responded
+
+
+def test_rotation_sharded_parity_8_devices():
+    """Rotation mode must be bit-identical sharded vs unsharded: the
+    rolls (concat + dynamic-slice across the sharded node axis) may not
+    change results under GSPMD."""
+    import functools
+
+    from serf_tpu.models.swim import run_cluster
+    from serf_tpu.parallel.mesh import make_mesh, shard_state, state_shardings
+
+    cfg = ClusterConfig(
+        gossip=GossipConfig(n=1024, k_facts=32, peer_sampling="rotation"),
+        failure=FailureConfig(probe_schedule="round_robin"),
+        push_pull_every=8)
+    state = make_cluster(cfg, jax.random.key(0))
+    state = state._replace(
+        gossip=inject_fact(state.gossip, cfg.gossip, 3, K_USER_EVENT,
+                           0, 5, 0))
+    mesh = make_mesh(8)
+    sharded = shard_state(state, mesh)
+    out_sh = state_shardings(state, mesh)
+    run8 = jax.jit(functools.partial(run_cluster, cfg=cfg),
+                   static_argnames=("num_rounds",), out_shardings=out_sh)
+    run1 = jax.jit(functools.partial(run_cluster, cfg=cfg),
+                   static_argnames=("num_rounds",))
+    s8 = run8(sharded, key=jax.random.key(2), num_rounds=30)
+    s1 = run1(state, key=jax.random.key(2), num_rounds=30)
+    assert bool(jnp.all(s1.gossip.known == s8.gossip.known))
+    assert bool(jnp.all(s1.gossip.budgets == s8.gossip.budgets))
+    assert bool(jnp.all(s1.gossip.age == s8.gossip.age))
+    assert bool(jnp.allclose(s1.vivaldi.vec, s8.vivaldi.vec, atol=1e-6))

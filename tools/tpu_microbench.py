@@ -1,0 +1,111 @@
+"""Micro-benchmark the irregular ops at 1M: random gather vs rolled slice,
+scatter-max, top_k.  Establishes the per-op cost table driving the
+rotation-sampling redesign."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+print("platform:", jax.devices()[0].device_kind, flush=True)
+
+n = 1_000_000
+
+
+def timed(tag, fn, *args, reps=10):
+    try:
+        out = fn(*args)
+        jax.block_until_ready(out)
+        # host sync on a scalar derived from the output
+        leaf = jax.tree.leaves(out)[0]
+        float(jnp.sum(leaf.astype(jnp.float32)[:1]))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        leaf = jax.tree.leaves(out)[0]
+        float(jnp.sum(leaf.astype(jnp.float32)[:1]))
+        ms = 1000 * (time.perf_counter() - t0) / reps
+        print(f"{tag}: {ms:.2f} ms", flush=True)
+    except Exception as e:
+        print(f"{tag} ERROR: {repr(e)[:300]}", flush=True)
+
+
+key = jax.random.key(0)
+packets = jax.random.randint(key, (n, 2), 0, 2**31 - 1).astype(jnp.uint32)
+vec8 = jax.random.uniform(key, (n, 8), jnp.float32)
+srcs = jax.random.randint(key, (n, 3), 0, n)
+peer = jax.random.randint(key, (n,), 0, n)
+bools = jax.random.bernoulli(key, 0.5, (n,))
+score = jax.random.uniform(key, (n,), jnp.float32)
+targets = jax.random.randint(key, (n,), 0, n)
+
+
+@jax.jit
+def gather_rows_w2(p, s):
+    return p[s]                     # u32[N,3,2] random gather
+
+
+@jax.jit
+def gather_rows_f8(v, s):
+    return v[s]                     # f32[N,8] random gather
+
+
+@jax.jit
+def gather_bool(b, s):
+    return b[s]
+
+
+@jax.jit
+def rolled(x, shift):
+    return jax.lax.dynamic_slice_in_dim(
+        jnp.concatenate([x, x], axis=0), shift, n, axis=0)
+
+
+@jax.jit
+def roll3(p, offs):
+    acc = jnp.zeros_like(p)
+    for f in range(3):
+        acc = acc | rolled(p, offs[f])
+    return acc
+
+
+@jax.jit
+def scatter_max(b, t):
+    return jnp.zeros((n,), bool).at[t].max(b)
+
+
+@jax.jit
+def scatter_max_i32(t, w):
+    return jnp.zeros((n,), jnp.int32).at[t].max(w)
+
+
+@jax.jit
+def topk8(s):
+    return jax.lax.top_k(s, 8)
+
+
+@jax.jit
+def unpack_refute_like(known):
+    bits = (known[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)) & jnp.uint32(1)
+    m = bits.reshape(n, 64).astype(bool)
+    return jnp.any(m, axis=1)
+
+
+offs = jax.random.randint(key, (3,), 1, n)
+timed("gather_1M_rows_u32x2_fanout3", gather_rows_w2, packets, srcs)
+timed("gather_1M_rows_f32x8", gather_rows_f8, vec8, peer)
+timed("gather_1M_bool", gather_bool, bools, peer)
+timed("rolled_u32x2", rolled, packets, offs[0])
+timed("rolled_f32x8", rolled, vec8, offs[0])
+timed("roll3_or_u32x2", roll3, packets, offs)
+timed("scatter_max_1M_bool", scatter_max, bools, targets)
+timed("scatter_max_1M_i32", scatter_max_i32, targets,
+      jnp.arange(n, dtype=jnp.int32))
+timed("top_k8_1M", topk8, score)
+timed("unpack64_any_1M", unpack_refute_like, packets)
+
+print("microbench complete", flush=True)

@@ -102,28 +102,14 @@ def main() -> int:
         pallas_failed = True
         record("pallas_parity", ok=False, error=repr(e)[:500])
 
-    # -- timing helper ------------------------------------------------------
-    # Completion barrier = host transfer of the round counter, NOT
-    # block_until_ready: on the axon tunnel block_until_ready can report
-    # donated-buffer outputs ready while execution is still in flight
-    # (observed 0.0 ms "completions" of 100-round 1M-node scans).  A
-    # device→host read cannot finish before the producing program.
-    import numpy as np
-
-    def _round_of(state):
-        return (state.gossip if hasattr(state, "gossip") else state).round
+    # -- timing helper: bench.py's host-transfer barrier (one shared
+    # implementation — see _time_rounds there for why block_until_ready
+    # is NOT a trustworthy completion barrier on this tunnel) ------------
+    from bench import _time_rounds
 
     def timed(jitted, state, rounds_per_call=100, calls=3):
-        key = jax.random.key(1)
-        key, k = jax.random.split(key)
-        state = jitted(state, key=k, num_rounds=rounds_per_call)
-        int(np.asarray(_round_of(state)))
-        t0 = time.perf_counter()
-        for _ in range(calls):
-            key, k = jax.random.split(key)
-            state = jitted(state, key=k, num_rounds=rounds_per_call)
-            int(np.asarray(_round_of(state)))
-        return state, rounds_per_call * calls / (time.perf_counter() - t0)
+        return _time_rounds(jitted, state, jax.random.key(1),
+                            rounds_per_call, calls)
 
     n = 1_000_000
     gcfg = GossipConfig(n=n, k_facts=64)
@@ -136,7 +122,9 @@ def main() -> int:
         for i in range(8):
             g = inject_fact(g, gcfg, subject=i * 125_000, kind=K_USER_EVENT,
                             incarnation=0, ltime=i + 1, origin=i * 125_000)
-        dead = jnp.arange(64) * (n // 64)
+        # dead ids offset by 1 so no fact origin dies (a dead origin
+        # can't gossip its fact — coverage would sit at 0 by design)
+        dead = jnp.arange(64) * (n // 64) + 1
         g = g._replace(alive=g.alive.at[dead].set(False))
         return st._replace(gossip=g)
 
