@@ -265,21 +265,54 @@ def inject_facts_batch(state: GossipState, cfg: GossipConfig, subjects,
                           + jnp.sum(active).astype(jnp.int32))
 
 
+#: below this, a flat top_k over all n scores is cheap; above it, top_k's
+#: full sort dominates the round (measured 1.9 ms per call at 1M — three
+#: calls per swim round) and the two-level groupwise pick wins (0.7 ms)
+_PICK_FLAT_MAX = 1 << 16
+#: number of strided groups for the two-level pick (top_k runs over this
+#: many group maxima)
+_PICK_GROUPS = 4096
+
+
 def pick_bounded(candidates: jnp.ndarray, max_events: int, key: jax.Array):
-    """Unbiased bounded selection: choose ≤``max_events`` of the candidate
-    nodes (bool[N]) by randomized top-k.
+    """Bounded selection: choose ≤``max_events`` of the candidate nodes
+    (bool[N]) by randomized scoring.
 
     Returns ``(chosen bool[N], subjects i32[M], active bool[M])``; the
     active entries are a contiguous prefix — exactly the
     ``inject_facts_batch`` contract (real candidates score > 0, others 0,
-    and top_k sorts descending).
+    and selection sorts descending).
+
+    Small n: one flat randomized top_k (unbiased).  Large n: two-level —
+    index space is split into ``_PICK_GROUPS`` *strided* groups (group g =
+    indices ≡ g mod G), each group elects its max-score candidate in one
+    elementwise pass, and top_k runs over only the G group maxima.  At most
+    one winner per group per round is a selection bias, but candidates
+    co-resident in a strided group must collide modulo G: realistic
+    clustered candidate sets (contiguous id ranges — a range partition, a
+    rack failure) spread across groups, and un-picked candidates simply
+    remain candidates for the next round (the max_events bound already
+    defers extras).  This removes the full 1M-element sort that made the
+    flat top_k the single most expensive op in the swim round.
     """
     n = candidates.shape[0]
     score = candidates.astype(jnp.float32) * (
         1.0 + jax.random.uniform(key, (n,)))
-    vals, idx = jax.lax.top_k(score, max_events)
-    active = vals > 0.0
-    subjects = idx.astype(jnp.int32)
+    if n <= _PICK_FLAT_MAX:
+        vals, idx = jax.lax.top_k(score, max_events)
+        active = vals > 0.0
+        subjects = idx.astype(jnp.int32)
+    else:
+        g = _PICK_GROUPS
+        rows = (n + g - 1) // g
+        padded = score if rows * g == n else jnp.pad(score,
+                                                     (0, rows * g - n))
+        s2 = padded.reshape(rows, g)        # column j = indices ≡ j mod g
+        col_max = jnp.max(s2, axis=0)                          # f32[G]
+        col_arg = jnp.argmax(s2, axis=0).astype(jnp.int32)     # i32[G]
+        vals, cols = jax.lax.top_k(col_max, max_events)
+        active = vals > 0.0
+        subjects = col_arg[cols] * g + cols.astype(jnp.int32)
     chosen = jnp.zeros((n,), bool).at[
         jnp.where(active, subjects, n)].set(True, mode="drop")
     return chosen, subjects, active

@@ -733,3 +733,73 @@ def test_vivaldi_cotrained_with_gossip_at_100k():
     err1 = float(mean_relative_error(state.vivaldi, cfg.vivaldi,
                                      state.positions, jax.random.key(3)))
     assert err1 < err0 * 0.5, f"error did not halve at 100k: {err0} -> {err1}"
+
+
+# -- bounded selection (pick_bounded) ----------------------------------------
+
+def test_pick_bounded_flat_small_n():
+    from serf_tpu.models.dissemination import pick_bounded
+
+    n = 512
+    cand = jnp.zeros((n,), bool).at[jnp.asarray([7, 100, 511])].set(True)
+    chosen, subjects, active = pick_bounded(cand, 8, jax.random.key(0))
+    assert int(active.sum()) == 3
+    assert sorted(int(s) for s, a in zip(subjects, active) if a) == [7, 100, 511]
+    # prefix-active contract (inject_facts_batch requirement)
+    na = int(active.sum())
+    assert bool(jnp.all(active[:na])) and not bool(jnp.any(active[na:]))
+
+
+def test_pick_bounded_grouped_large_n_exact_when_sparse():
+    """The two-level strided path (n > _PICK_FLAT_MAX) finds candidates that
+    all live in distinct strided groups — including a contiguous id run,
+    which by construction spreads across groups."""
+    from serf_tpu.models.dissemination import _PICK_FLAT_MAX, pick_bounded
+
+    n = _PICK_FLAT_MAX + 1337          # forces the grouped path
+    ids = [0, 1, 2, 3, n - 1]          # contiguous run + the last (padded row)
+    cand = jnp.zeros((n,), bool).at[jnp.asarray(ids)].set(True)
+    chosen, subjects, active = pick_bounded(cand, 8, jax.random.key(1))
+    assert int(active.sum()) == len(ids)
+    assert sorted(int(s) for s, a in zip(subjects, active) if a) == ids
+    na = int(active.sum())
+    assert bool(jnp.all(active[:na])) and not bool(jnp.any(active[na:]))
+    assert int(chosen.sum()) == len(ids)
+    assert all(bool(chosen[i]) for i in ids)
+
+
+def test_pick_bounded_grouped_bounded_and_valid_under_collisions():
+    """Candidates colliding modulo the group count can defer extras to later
+    rounds (documented bias) but picks stay valid, distinct, and bounded."""
+    from serf_tpu.models.dissemination import (
+        _PICK_FLAT_MAX,
+        _PICK_GROUPS,
+        pick_bounded,
+    )
+
+    n = _PICK_FLAT_MAX * 2
+    g = _PICK_GROUPS
+    # 6 candidates in ONE strided group, 2 in another
+    ids = [5, 5 + g, 5 + 2 * g, 5 + 3 * g, 5 + 4 * g, 5 + 5 * g, 9, 9 + g]
+    cand = jnp.zeros((n,), bool).at[jnp.asarray(ids)].set(True)
+    chosen, subjects, active = pick_bounded(cand, 4, jax.random.key(2))
+    picked = [int(s) for s, a in zip(subjects, active) if a]
+    assert 2 <= len(picked) <= 4          # ≥ one per distinct group, ≤ bound
+    assert len(set(picked)) == len(picked)
+    assert all(p in ids for p in picked)
+    # group-5's winner and group-9's winner must both be present
+    assert any(p % g == 5 for p in picked)
+    assert any(p % g == 9 for p in picked)
+
+
+def test_pick_bounded_grouped_none_and_all():
+    from serf_tpu.models.dissemination import _PICK_FLAT_MAX, pick_bounded
+
+    n = _PICK_FLAT_MAX + 1
+    none = jnp.zeros((n,), bool)
+    chosen, subjects, active = pick_bounded(none, 8, jax.random.key(3))
+    assert not bool(jnp.any(active)) and not bool(jnp.any(chosen))
+    every = jnp.ones((n,), bool)
+    chosen, subjects, active = pick_bounded(every, 8, jax.random.key(4))
+    assert int(active.sum()) == 8
+    assert len({int(s) for s in subjects}) == 8

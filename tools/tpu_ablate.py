@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Per-phase in-scan cost ablation for the flagship round at 1M nodes.
+
+The tunnel adds ~5-8 ms of dispatch latency per jitted CALL, so
+microbenching single ops wildly overstates in-scan costs.  This tool times
+each protocol phase the way the flagship runs it — inside a
+``lax.scan`` over many rounds, one dispatch, host-transfer-synced — and
+prints a cost table: rounds/s and ms/round for cumulative phase stacks
+plus isolated suspects (top_k selection, the age-plane rewrite).
+
+Run on the real chip in the foreground; falls back to CPU honestly.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    K_USER_EVENT,
+    inject_fact,
+    make_state,
+    pick_bounded,
+    round_step,
+)
+from serf_tpu.models.failure import (
+    FailureConfig,
+    declare_round,
+    probe_round,
+    refute_round,
+)
+from serf_tpu.models.swim import ClusterConfig, make_cluster, run_cluster
+
+N = int(os.environ.get("SERF_TPU_ABLATE_N", 1_000_000))
+ROUNDS = int(os.environ.get("SERF_TPU_ABLATE_ROUNDS", 50))
+
+gcfg = GossipConfig(n=N, k_facts=64, peer_sampling="rotation")
+fcfg = FailureConfig(suspicion_rounds=12, max_new_facts=8,
+                     probe_schedule="round_robin")
+cfg = ClusterConfig(gossip=gcfg, failure=fcfg, push_pull_every=16,
+                    with_failure=True, with_vivaldi=True)
+
+
+def seeded():
+    key = jax.random.key(0)
+    st = make_cluster(cfg, key)
+    g = st.gossip
+    for i in range(8):
+        g = inject_fact(g, gcfg, subject=(i * (N // 8)) % N,
+                        kind=K_USER_EVENT, incarnation=0, ltime=i + 1,
+                        origin=(i * (N // 8)) % N)
+    return st._replace(gossip=g)
+
+
+def scan_timer(tag, body, state_fn, rounds=ROUNDS, reps=3):
+    """Time scan(body, rounds) with a host-transfer completion barrier.
+
+    ``state_fn`` builds a fresh state per call — the jit donates its input,
+    so a shared state object would be deleted after the first timer."""
+    state = state_fn()
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run(st, key):
+        keys = jax.random.split(key, rounds)
+        final, _ = jax.lax.scan(lambda c, k: (body(c, k), ()), st, keys)
+        return final
+
+    import numpy as np
+    key = jax.random.key(1)
+    key, k = jax.random.split(key)
+    state = run(state, k)                      # compile + warm
+    leaf = jax.tree.leaves(state)[0]
+    np.asarray(jax.device_get(leaf)).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        key, k = jax.random.split(key)
+        state = run(state, k)
+    leaf = jax.tree.leaves(state)[0]
+    np.asarray(jax.device_get(leaf)).ravel()[:1]
+    dt = time.perf_counter() - t0
+    ms = 1000 * dt / (reps * rounds)
+    print(f"{tag:34s} {ms:8.3f} ms/round   {reps * rounds / dt:10.1f} rounds/s",
+          flush=True)
+    return ms
+
+
+def _anchor(s, *vals):
+    """Fold values into the carried round counter so XLA cannot dead-code-
+    eliminate the work that produced them (a constant-zero multiply would be
+    constant-folded; a data-dependent parity bit cannot be)."""
+    acc = s.round
+    for v in vals:
+        acc = acc + (jnp.sum(v.astype(jnp.int32)) & 1)
+    return s._replace(round=acc)
+
+
+def isolated_only(g0):
+    """The isolated-suspect timers (shared by full and --skip-stacks runs)."""
+    # the ORIGINAL flat top_k selection, inlined — the production
+    # pick_bounded now takes the grouped path at this N, so calling it
+    # would A/B the new path against itself
+    def pick_flat(s, k):
+        cand = s.alive & (jax.random.uniform(k, (N,)) < 0.001)
+        score = cand.astype(jnp.float32) * (
+            1.0 + jax.random.uniform(k, (N,)))
+        vals, idx = jax.lax.top_k(score, 8)
+        return _anchor(s, vals > 0.0, idx)
+    scan_timer("pick flat-top_k@1M x1", pick_flat, g0)
+
+    # the production path (two-level strided groups at this N)
+    def pick_prod(s, k):
+        cand = s.alive & (jax.random.uniform(k, (N,)) < 0.001)
+        chosen, subjects, active = pick_bounded(cand, 8, k)
+        return _anchor(s, chosen, subjects, active)
+    scan_timer("pick_bounded (production) x1", pick_prod, g0)
+
+    # the age/budget plane rewrite alone (the per-round N*K u8 traffic)
+    def age_body(s, k):
+        aged = jnp.where(s.age < 255, s.age + 1, s.age)
+        b = jnp.where(s.budgets > 0, s.budgets - 1, s.budgets)
+        return s._replace(age=aged, budgets=b, round=s.round + 1)
+    scan_timer("age+budget plane rewrite", age_body, g0)
+
+    # rolled_rows of the packet plane alone (summed so all three rolls
+    # materialize; a masked-to-zero merge would be folded away entirely)
+    def roll_body(s, k):
+        from serf_tpu.models.dissemination import rolled_rows, sample_offsets
+        offs = sample_offsets(k, 3, N)
+        x = s.known
+        acc = jnp.zeros_like(x)
+        for f in range(3):
+            acc = acc | rolled_rows(x, offs[f])
+        return _anchor(s._replace(round=s.round + 1), acc)
+    scan_timer("3x rolled_rows(known) only", roll_body, g0)
+
+
+def main():
+    print(f"platform: {jax.devices()[0].device_kind}  N={N} rounds={ROUNDS}",
+          flush=True)
+
+    g0 = lambda: seeded().gossip
+
+    if os.environ.get("SERF_TPU_ABLATE_SKIP_STACKS"):
+        isolated_only(g0)
+        print("ablation complete", flush=True)
+        return
+
+    # cumulative stacks over the gossip state
+    scan_timer("gossip only (round_step)",
+               lambda s, k: round_step(s, gcfg, k), g0)
+    scan_timer("gossip+probe",
+               lambda s, k: probe_round(
+                   round_step(s, gcfg, jax.random.fold_in(k, 0)),
+                   gcfg, fcfg, jax.random.fold_in(k, 1)), g0)
+    scan_timer("gossip+probe+refute",
+               lambda s, k: refute_round(
+                   probe_round(
+                       round_step(s, gcfg, jax.random.fold_in(k, 0)),
+                       gcfg, fcfg, jax.random.fold_in(k, 1)),
+                   gcfg, fcfg, jax.random.fold_in(k, 2)), g0)
+    scan_timer("swim (g+p+r+declare)",
+               lambda s, k: declare_round(
+                   refute_round(
+                       probe_round(
+                           round_step(s, gcfg, jax.random.fold_in(k, 0)),
+                           gcfg, fcfg, jax.random.fold_in(k, 1)),
+                       gcfg, fcfg, jax.random.fold_in(k, 2)),
+                   gcfg, fcfg, jax.random.fold_in(k, 3)), g0)
+
+    # full flagship
+    def flag_body(s, k):
+        from serf_tpu.models.swim import cluster_round
+        return cluster_round(s, cfg, k)
+    scan_timer("flagship cluster_round", flag_body, seeded)
+
+    isolated_only(g0)
+    print("ablation complete", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -100,7 +100,12 @@ def main() -> int:
             pallas_failed = True
     except Exception as e:  # noqa: BLE001 - keep capturing evidence
         pallas_failed = True
-        record("pallas_parity", ok=False, error=repr(e)[:500])
+        err = repr(e)[:500]
+        # the axon tunnel's remote compile helper crashing (HTTP 500) is an
+        # environment failure, not a kernel bug: interpret-mode parity is
+        # green and a trivial kernel compiles through the same helper
+        env_blocked = "remote_compile" in err and "HTTP 500" in err
+        record("pallas_parity", ok=False, env_blocked=env_blocked, error=err)
 
     # -- timing helper: bench.py's host-transfer barrier (one shared
     # implementation — see _time_rounds there for why block_until_ready
@@ -112,8 +117,11 @@ def main() -> int:
                             rounds_per_call, calls)
 
     n = 1_000_000
-    gcfg = GossipConfig(n=n, k_facts=64)
-    fcfg = FailureConfig(suspicion_rounds=12, max_new_facts=8)
+    # rotation sampling + round-robin probes: the at-scale mode (no 1M-row
+    # random gathers/scatters); iid is measured below as the A/B
+    gcfg = GossipConfig(n=n, k_facts=64, peer_sampling="rotation")
+    fcfg = FailureConfig(suspicion_rounds=12, max_new_facts=8,
+                         probe_schedule="round_robin")
     ccfg = ClusterConfig(gossip=gcfg, failure=fcfg, push_pull_every=16)
 
     def seeded():
@@ -159,12 +167,17 @@ def main() -> int:
         record("swim_1m_pallas", skipped=True,
                reason="pallas_parity stage failed")
 
-    fcfg_rr = dataclasses.replace(fcfg, probe_schedule="round_robin")
-    run_rr = jax.jit(functools.partial(run_swim, cfg=gcfg, fcfg=fcfg_rr),
-                     static_argnames=("num_rounds",), donate_argnums=(0,))
-    _, rr_rps = timed(run_rr, seeded().gossip)
-    record("swim_1m_round_robin", rps=round(rr_rps, 1),
-           speedup_vs_random=round(rr_rps / sw_rps, 3))
+    # iid sampling + random probes A/B: the random-gather/scatter mode the
+    # rotation redesign replaced (each 1M-row gather/scatter is a serial
+    # loop on TPU)
+    gcfg_iid = dataclasses.replace(gcfg, peer_sampling="iid")
+    fcfg_iid = dataclasses.replace(fcfg, probe_schedule="random")
+    run_iid = jax.jit(functools.partial(run_swim, cfg=gcfg_iid,
+                                        fcfg=fcfg_iid),
+                      static_argnames=("num_rounds",), donate_argnums=(0,))
+    _, iid_rps = timed(run_iid, seeded().gossip)
+    record("swim_1m_iid", rps=round(iid_rps, 1),
+           rotation_speedup=round(sw_rps / max(iid_rps, 1e-9), 3))
 
     proof["ok"] = not pallas_failed
     with open(OUT, "w") as f:
