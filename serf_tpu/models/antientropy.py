@@ -54,9 +54,9 @@ def push_pull_round(state: GossipState, cfg: GossipConfig, key: jax.Array,
     new_words = incoming & ~state.known
     known = state.known | new_words
     new_mask = unpack_bits(new_words, k)
-    budgets = jnp.where(new_mask, jnp.uint8(cfg.transmit_limit), state.budgets)
+    # age 0 = fresh transmit budget (budget ≡ transmit_limit - age)
     age = jnp.where(new_mask, jnp.uint8(0), state.age)
-    return state._replace(known=known, budgets=budgets, age=age)
+    return state._replace(known=known, age=age)
 
 
 def make_partition(n: int, split: float = 0.5) -> jnp.ndarray:

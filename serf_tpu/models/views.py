@@ -66,8 +66,9 @@ def cluster_stats(state: GossipState, cfg: GossipConfig) -> ClusterStats:
         suspected=_subjects_with_kind(state, n, K_SUSPECT),
         declared_dead=_subjects_with_kind(state, n, K_DEAD),
         leaving=_subjects_with_kind(state, n, K_LEAVE),
-        queue_depth=jnp.sum(jnp.any(state.budgets > 0, axis=0)
-                            & state.facts.valid).astype(jnp.int32),
+        queue_depth=jnp.sum(
+            jnp.any(state.age < jnp.uint8(cfg.transmit_limit), axis=0)
+            & state.facts.valid).astype(jnp.int32),
         intent_facts=_count_kind(state, K_JOIN) + _count_kind(state, K_LEAVE),
         event_facts=_count_kind(state, K_USER_EVENT),
         query_facts=_count_kind(state, K_QUERY),

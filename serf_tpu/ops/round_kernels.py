@@ -2,14 +2,15 @@
 
 The round kernel (serf_tpu/models/dissemination.py) has three phases:
 
-1. packet selection: pack ``budgets>0 & alive`` into uint32 words and
-   decrement selected budgets,
-2. pull-exchange: random gather + OR-reduce (left to XLA — its gather is
-   already bandwidth-optimal and fuses with the RNG),
-3. merge: learn new facts (bit ops over N×W), refresh budgets and reset
-   knowledge ages (N×K).
+1. packet selection: pack ``age < transmit_limit & alive`` into uint32
+   words (a fact's remaining transmit budget is derived from its knowledge
+   age — see ``GossipState``) and tick the saturating age,
+2. pull-exchange: peer read + OR-reduce (left to XLA — rolls/gathers are
+   already bandwidth-optimal and fuse with the RNG),
+3. merge: learn new facts (bit ops over N×W) and reset knowledge ages
+   (N×K) — age 0 is a fresh budget.
 
-Phases 1 and 3 each touch the N×K uint8 budget plane plus the N×W word
+Phases 1 and 3 each touch the N×K uint8 age plane plus the N×W word
 plane; under plain XLA they materialize several N×K intermediates (the
 sending mask, the unpacked new-fact mask).  These kernels fuse each phase
 into a single pass: one read and one write per array, everything else in
@@ -53,14 +54,14 @@ def pallas_ok(n: int, k_facts: int) -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _select_kernel(budgets_ref, alive_ref, age_ref,
-                   packets_ref, budgets_out_ref, age_out_ref):
-    budgets = budgets_ref[:]                       # (B, K) u8
-    alive = alive_ref[:]                           # (B, 1) u8
+def _select_kernel(limit_ref, age_ref, alive_ref,
+                   packets_ref, age_out_ref):
     age = age_ref[:]                               # (B, K) u8
-    k = budgets.shape[1]
+    alive = alive_ref[:]                           # (B, 1) u8
+    k = age.shape[1]
     w = k // 32
-    sending = (budgets > 0) & (alive > 0)          # (B, K) bool
+    limit = limit_ref[0, 0].astype(jnp.uint8)
+    sending = (age < limit) & (alive > 0)          # (B, K) bool
     # Mosaic has no unsigned reductions; sum in int32 and bitcast.  Each
     # weight 1<<j appears at most once per word, so the signed sum is any
     # 32-bit pattern reinterpreted — always representable, never overflows.
@@ -75,27 +76,25 @@ def _select_kernel(budgets_ref, alive_ref, age_ref,
                              keepdims=True, dtype=jnp.int32))
     packets_ref[:] = jax.lax.bitcast_convert_type(
         jnp.concatenate(words, axis=1), jnp.uint32)
-    budgets_out_ref[:] = jnp.where(sending, budgets - 1, budgets)
     age_out_ref[:] = jnp.where(age < 255, age + 1, age)  # saturating age++
 
 
-def select_packets(budgets: jnp.ndarray, alive_u8: jnp.ndarray,
-                   age: jnp.ndarray
-                   ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """(packets u32[N,W], new_budgets u8[N,K], aged u8[N,K]) in one pass."""
-    n, k = budgets.shape
+def select_packets(age: jnp.ndarray, alive_u8: jnp.ndarray, limit: int
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(packets u32[N,W], aged u8[N,K]) in one pass."""
+    n, k = age.shape
     w = k // 32
     BLOCK_N = _block_for(n)
     grid = (n // BLOCK_N,)
+    limit_arr = jnp.asarray(limit, jnp.int32).reshape(1, 1)
     return pl.pallas_call(
         _select_kernel,
         grid=grid,
         in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((BLOCK_N, 1), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
@@ -103,16 +102,13 @@ def select_packets(budgets: jnp.ndarray, alive_u8: jnp.ndarray,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((n, w), jnp.uint32),
             jax.ShapeDtypeStruct((n, k), jnp.uint8),
-            jax.ShapeDtypeStruct((n, k), jnp.uint8),
         ],
         interpret=_interpret(),
-    )(budgets, alive_u8, age)
+    )(limit_arr, age, alive_u8)
 
 
 # ---------------------------------------------------------------------------
@@ -120,15 +116,13 @@ def select_packets(budgets: jnp.ndarray, alive_u8: jnp.ndarray,
 # ---------------------------------------------------------------------------
 
 
-def _merge_kernel(limit_ref, known_ref, incoming_ref, alive_ref,
-                  budgets_ref, age_ref,
-                  known_out_ref, budgets_out_ref, age_out_ref):
+def _merge_kernel(known_ref, incoming_ref, alive_ref, age_ref,
+                  known_out_ref, age_out_ref):
     known = known_ref[:]                           # (B, W) u32
     incoming = incoming_ref[:]                     # (B, W) u32
     alive = alive_ref[:]                           # (B, 1) u8
-    budgets = budgets_ref[:]                       # (B, K) u8
     age = age_ref[:]                               # (B, K) u8
-    k = budgets.shape[1]
+    k = age.shape[1]
     alive_words = jnp.where(alive > 0, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
     new_words = incoming & ~known & alive_words    # (B, W)
     known_out_ref[:] = known | new_words
@@ -141,33 +135,26 @@ def _merge_kernel(limit_ref, known_ref, incoming_ref, alive_ref,
     repeated = jnp.concatenate(groups, axis=1)                 # (B, K)
     shifts = (jax.lax.broadcasted_iota(jnp.uint32, (1, k), 1) % 32)
     new_mask = ((repeated >> shifts) & 1).astype(bool)
-    limit = limit_ref[0, 0].astype(jnp.uint8)
-    budgets_out_ref[:] = jnp.where(new_mask, limit, budgets)
     age_out_ref[:] = jnp.where(new_mask, jnp.uint8(0), age)
 
 
 def merge_incoming(known: jnp.ndarray, incoming: jnp.ndarray,
-                   alive_u8: jnp.ndarray, budgets: jnp.ndarray,
-                   age: jnp.ndarray, limit: int
-                   ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """(known', budgets', age') in one fused pass."""
-    n, k = budgets.shape
+                   alive_u8: jnp.ndarray, age: jnp.ndarray
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(known', age') in one fused pass (age 0 = fresh transmit budget)."""
+    n, k = age.shape
     w = k // 32
     BLOCK_N = _block_for(n)
     grid = (n // BLOCK_N,)
-    limit_arr = jnp.asarray(limit, jnp.int32).reshape(1, 1)
     return pl.pallas_call(
         _merge_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((BLOCK_N, 1), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
@@ -177,13 +164,10 @@ def merge_incoming(known: jnp.ndarray, incoming: jnp.ndarray,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((n, w), jnp.uint32),
             jax.ShapeDtypeStruct((n, k), jnp.uint8),
-            jax.ShapeDtypeStruct((n, k), jnp.uint8),
         ],
         interpret=_interpret(),
-    )(limit_arr, known, incoming, alive_u8, budgets, age)
+    )(known, incoming, alive_u8, age)

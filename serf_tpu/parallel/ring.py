@@ -34,6 +34,7 @@ from serf_tpu.models.dissemination import (
     GossipConfig,
     GossipState,
     pack_bits,
+    sending_mask,
     unpack_bits,
 )
 from serf_tpu.parallel.mesh import NODE_AXIS
@@ -93,9 +94,8 @@ def round_step_ring(state: GossipState, cfg: GossipConfig, key: jax.Array,
     n_local = n // n_devices
 
     # phases 1+2 exactly as round_step (elementwise; GSPMD shards freely)
-    sending = (state.budgets > 0) & state.alive[:, None]
+    sending = sending_mask(state, cfg)
     packets = pack_bits(sending)                              # u32[N, W]
-    budgets = jnp.where(sending, state.budgets - 1, state.budgets)
     aged = jnp.where(state.age < 255, state.age + 1, state.age)
 
     srcs = jax.random.randint(key, (n, cfg.fanout), 0, n)     # i32[N, F]
@@ -122,7 +122,6 @@ def round_step_ring(state: GossipState, cfg: GossipConfig, key: jax.Array,
         alive_col, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
     known = state.known | new_words
     new_mask = unpack_bits(new_words, k)
-    budgets = jnp.where(new_mask, jnp.uint8(cfg.transmit_limit), budgets)
     age = jnp.where(new_mask, jnp.uint8(0), aged)
-    return state._replace(known=known, budgets=budgets, age=age,
+    return state._replace(known=known, age=age,
                           round=state.round + 1)

@@ -32,7 +32,8 @@ def test_push_mode_disseminates_and_respects_budgets():
     for r in range(cfg.transmit_limit + 2):
         key, k2 = jax.random.split(key)
         s = step(s, key=k2)
-    assert int(jnp.sum(s.budgets)) == 0
+    from serf_tpu.models.dissemination import budgets_of
+    assert int(jnp.sum(budgets_of(s, cfg))) == 0
 
 
 def test_push_mode_dead_nodes_dont_send_or_learn():

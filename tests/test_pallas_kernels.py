@@ -19,13 +19,12 @@ from serf_tpu.ops import round_kernels
 
 
 def _rand_state(cfg, key):
-    k1, k2, k3, k4 = jax.random.split(key, 4)
+    k2, k3, k4 = jax.random.split(key, 3)
     s = make_state(cfg)
-    budgets = jax.random.randint(k1, (cfg.n, cfg.k_facts), 0, 6).astype(jnp.uint8)
     known = jax.random.bits(k2, (cfg.n, cfg.words), jnp.uint32)
     age = jax.random.randint(k3, (cfg.n, cfg.k_facts), 0, 256).astype(jnp.uint8)
     alive = jax.random.bernoulli(k4, 0.9, (cfg.n,))
-    return s._replace(budgets=budgets, known=known, age=age, alive=alive,
+    return s._replace(known=known, age=age, alive=alive,
                       round=jnp.asarray(7, jnp.int32))
 
 
@@ -33,13 +32,12 @@ def test_select_packets_matches_oracle():
     cfg = GossipConfig(n=512, k_facts=64, use_pallas=True)
     s = _rand_state(cfg, jax.random.key(0))
     from serf_tpu.models.dissemination import pack_bits
-    sending = (s.budgets > 0) & s.alive[:, None]
+    limit = cfg.transmit_limit
+    sending = (s.age < jnp.uint8(limit)) & s.alive[:, None]
     want_packets = pack_bits(sending)
-    want_budgets = jnp.where(sending, s.budgets - 1, s.budgets)
-    packets, budgets, aged = round_kernels.select_packets(
-        s.budgets, s.alive[:, None].astype(jnp.uint8), s.age)
+    packets, aged = round_kernels.select_packets(
+        s.age, s.alive[:, None].astype(jnp.uint8), limit)
     assert bool(jnp.all(packets == want_packets))
-    assert bool(jnp.all(budgets == want_budgets))
     assert bool(jnp.all(aged == jnp.where(s.age < 255, s.age + 1, s.age)))
 
 

@@ -122,12 +122,13 @@ def isolated_only(g0):
         return _anchor(s, chosen, subjects, active)
     scan_timer("pick_bounded (production) x1", pick_prod, g0)
 
-    # the age/budget plane rewrite alone (the per-round N*K u8 traffic)
+    # the age plane rewrite alone (the per-round N*K u8 traffic; the
+    # stored budget plane this used to co-time was deleted — budgets are
+    # now derived from age, see GossipState)
     def age_body(s, k):
         aged = jnp.where(s.age < 255, s.age + 1, s.age)
-        b = jnp.where(s.budgets > 0, s.budgets - 1, s.budgets)
-        return s._replace(age=aged, budgets=b, round=s.round + 1)
-    scan_timer("age+budget plane rewrite", age_body, g0)
+        return s._replace(age=aged, round=s.round + 1)
+    scan_timer("age plane rewrite", age_body, g0)
 
     # rolled_rows of the packet plane alone (summed so all three rolls
     # materialize; a masked-to-zero merge would be folded away entirely)
