@@ -373,8 +373,9 @@ def round_step(state: GossipState, cfg: GossipConfig,
 
     if use_pallas:
         alive_u8 = state.alive[:, None].astype(jnp.uint8)
-        # phases 1+2 fused: pack sending bits + age++
-        packets, aged = round_kernels.select_packets(
+        # phase 1: pack sending bits (read-only over the age plane; the
+        # saturating age++ is folded into the merge kernel's single write)
+        packets = round_kernels.select_packets(
             state.age, alive_u8, cfg.transmit_limit)
     else:
         # 1. packet selection: facts with remaining transmit budget
@@ -382,9 +383,6 @@ def round_step(state: GossipState, cfg: GossipConfig,
         #    alive nodes
         sending = sending_mask(state, cfg)
         packets = pack_bits(sending)                          # u32[N, W]
-        # 2. knowledge ages one round (saturating) — this IS the budget
-        #    decrement
-        aged = jnp.where(state.age < 255, state.age + 1, state.age)
 
     # 3. pull-exchange: each alive node samples `fanout` peers and ORs
     #    their packet words
@@ -411,9 +409,10 @@ def round_step(state: GossipState, cfg: GossipConfig,
                                   jnp.bitwise_or, (1,))        # u32[N, W]
 
     if use_pallas:
-        # phases 4+5 fused: learn + age reset (fresh budget ≡ age 0)
+        # phases 4+5 fused: learn + saturating age++ + age reset (fresh
+        # budget ≡ age 0) — the round's ONLY write over the age plane
         known, age = round_kernels.merge_incoming(
-            state.known, incoming, alive_u8, aged)
+            state.known, incoming, alive_u8, state.age)
     else:
         # 4. merge: learn facts we did not know; dead nodes learn nothing
         alive_col = state.alive[:, None]
@@ -421,7 +420,12 @@ def round_step(state: GossipState, cfg: GossipConfig,
             alive_col, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
         known = state.known | new_words
         new_mask = unpack_bits(new_words, k)                  # bool[N, K]
-        # 5. age reset for newly learned facts (= fresh transmit budget)
+        # 5. one write over the age plane: saturating age++ (the budget
+        #    decrement) folded with the age-0 reset for newly learned
+        #    facts (the fresh budget).  Selection above read the
+        #    PRE-increment age, so this is semantically the original
+        #    two-pass (tick, then reset) sequence in a single pass.
+        aged = jnp.where(state.age < 255, state.age + 1, state.age)
         age = jnp.where(new_mask, jnp.uint8(0), aged)
 
     return state._replace(known=known, age=age,

@@ -24,6 +24,7 @@ from serf_tpu.models.dissemination import (
     K_LEAVE,
     K_QUERY,
     K_SUSPECT,
+    budgets_of,
     K_USER_EVENT,
 )
 
@@ -67,7 +68,7 @@ def cluster_stats(state: GossipState, cfg: GossipConfig) -> ClusterStats:
         declared_dead=_subjects_with_kind(state, n, K_DEAD),
         leaving=_subjects_with_kind(state, n, K_LEAVE),
         queue_depth=jnp.sum(
-            jnp.any(state.age < jnp.uint8(cfg.transmit_limit), axis=0)
+            jnp.any(budgets_of(state, cfg) > 0, axis=0)
             & state.facts.valid).astype(jnp.int32),
         intent_facts=_count_kind(state, K_JOIN) + _count_kind(state, K_LEAVE),
         event_facts=_count_kind(state, K_USER_EVENT),

@@ -44,6 +44,11 @@ class VivaldiState(NamedTuple):
     error: jnp.ndarray        # f32[N]
     adjustment: jnp.ndarray   # f32[N]
     adj_samples: jnp.ndarray  # f32[N, window] rolling rtt-dist samples
+    adj_sum: jnp.ndarray      # f32[N] running sum of adj_samples rows —
+                              # updated incrementally (one column read, not
+                              # an 80 MB full-window reduce per round at
+                              # 1M); re-summed exactly at each ring wrap so
+                              # f32 drift is bounded to `window` updates
     adj_index: jnp.ndarray    # i32 scalar ring cursor
 
 
@@ -54,6 +59,7 @@ def make_vivaldi(n: int, cfg: VivaldiConfig) -> VivaldiState:
         error=jnp.full((n,), cfg.error_max, jnp.float32),
         adjustment=jnp.zeros((n,), jnp.float32),
         adj_samples=jnp.zeros((n, cfg.adjustment_window), jnp.float32),
+        adj_sum=jnp.zeros((n,), jnp.float32),
         adj_index=jnp.asarray(0, jnp.int32),
     )
 
@@ -138,15 +144,22 @@ def vivaldi_update(state: VivaldiState, cfg: VivaldiConfig,
                     + state.height),
         state.height)
 
-    # -- adjustment term (recomputed against the post-force position)
+    # -- adjustment term (recomputed against the post-force position).
+    # Only ONE window column changes per round, so the rolling window is
+    # maintained with a column read + column write + running-sum update
+    # instead of a full-plane select and reduce (the f32[N, 20] plane is
+    # 80 MB at 1M nodes — reading and rewriting it every round was the
+    # single biggest HBM consumer in the vivaldi phase).
     dist2 = _raw_distance(vec, height, p_vec, p_h)
     sample = rtt - dist2
     idx = state.adj_index % cfg.adjustment_window
-    adj_samples = jnp.where(
-        active[:, None],
-        state.adj_samples.at[:, idx].set(sample),
-        state.adj_samples)
-    adjustment = jnp.sum(adj_samples, axis=1) / (2.0 * cfg.adjustment_window)
+    old_col = jax.lax.dynamic_slice_in_dim(state.adj_samples, idx, 1,
+                                           axis=1)[:, 0]
+    new_col = jnp.where(active, sample, old_col)
+    adj_samples = jax.lax.dynamic_update_slice_in_dim(
+        state.adj_samples, new_col[:, None], idx, axis=1)
+    adj_sum = state.adj_sum - old_col + new_col
+    adjustment = adj_sum / (2.0 * cfg.adjustment_window)
 
     # -- gravity toward the origin (adjustment-inclusive from the origin's
     # viewpoint: origin adjustment is 0, ours applies)
@@ -165,6 +178,7 @@ def vivaldi_update(state: VivaldiState, cfg: VivaldiConfig,
 
     # -- NaN/Inf safety: reset invalid rows (reference validity check)
     cand = VivaldiState(g_vec, g_height, error, adjustment, adj_samples,
+                        adj_sum,
                         (state.adj_index + 1) % cfg.adjustment_window)
     bad = ~(jnp.all(jnp.isfinite(cand.vec), axis=-1)
             & jnp.isfinite(cand.height) & jnp.isfinite(cand.error)
@@ -181,12 +195,36 @@ def vivaldi_update(state: VivaldiState, cfg: VivaldiConfig,
         return jnp.where(bmask & (active if new.ndim == 1 else active[:, None]),
                          fresh_arr, out)
 
+    # adj_samples needs no act-select (inactive rows already kept their
+    # old column above); the bad-row wipe is a full-plane pass, so it
+    # rides a lax.cond and costs nothing on the (overwhelmingly common)
+    # all-finite round
+    reset = bad & active
+    adj_samples_f = jax.lax.cond(
+        jnp.any(reset),
+        lambda s: jnp.where(reset[:, None], 0.0, s),
+        lambda s: s,
+        cand.adj_samples)
+
+    # exact re-sum once per window wrap — AFTER the active/bad routing, so
+    # it corrects ALL rows (inactive rows' unchanged samples and reset
+    # rows' zeros sum exactly too): incremental f32 drift in the carried
+    # adj_sum is bounded to one window of updates.  Rides a lax.cond so
+    # the full-plane reduce costs 1/window of the rounds.
+    adj_sum_f = pick(cand.adj_sum, state.adj_sum, fresh.adj_sum)
+    adj_sum_f = jax.lax.cond(
+        idx == cfg.adjustment_window - 1,
+        lambda s: jnp.sum(s, axis=1),
+        lambda s: adj_sum_f,
+        adj_samples_f)
+
     return VivaldiState(
         vec=pick(cand.vec, state.vec, fresh.vec),
         height=pick(cand.height, state.height, fresh.height),
         error=pick(cand.error, state.error, fresh.error),
         adjustment=pick(cand.adjustment, state.adjustment, fresh.adjustment),
-        adj_samples=pick(cand.adj_samples, state.adj_samples, fresh.adj_samples),
+        adj_samples=adj_samples_f,
+        adj_sum=adj_sum_f,
         adj_index=cand.adj_index,
     )
 

@@ -54,8 +54,7 @@ def pallas_ok(n: int, k_facts: int) -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _select_kernel(limit_ref, age_ref, alive_ref,
-                   packets_ref, age_out_ref):
+def _select_kernel(limit_ref, age_ref, alive_ref, packets_ref):
     age = age_ref[:]                               # (B, K) u8
     alive = alive_ref[:]                           # (B, 1) u8
     k = age.shape[1]
@@ -76,12 +75,12 @@ def _select_kernel(limit_ref, age_ref, alive_ref,
                              keepdims=True, dtype=jnp.int32))
     packets_ref[:] = jax.lax.bitcast_convert_type(
         jnp.concatenate(words, axis=1), jnp.uint32)
-    age_out_ref[:] = jnp.where(age < 255, age + 1, age)  # saturating age++
 
 
 def select_packets(age: jnp.ndarray, alive_u8: jnp.ndarray, limit: int
-                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(packets u32[N,W], aged u8[N,K]) in one pass."""
+                   ) -> jnp.ndarray:
+    """packets u32[N,W]: one read-only pass over the age plane (the
+    saturating age++ lives in the merge kernel's single write)."""
     n, k = age.shape
     w = k // 32
     BLOCK_N = _block_for(n)
@@ -97,16 +96,9 @@ def select_packets(age: jnp.ndarray, alive_u8: jnp.ndarray, limit: int
             pl.BlockSpec((BLOCK_N, 1), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=[
-            pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((n, w), jnp.uint32),
-            jax.ShapeDtypeStruct((n, k), jnp.uint8),
-        ],
+        out_specs=pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, w), jnp.uint32),
         interpret=_interpret(),
     )(limit_arr, age, alive_u8)
 
@@ -135,13 +127,16 @@ def _merge_kernel(known_ref, incoming_ref, alive_ref, age_ref,
     repeated = jnp.concatenate(groups, axis=1)                 # (B, K)
     shifts = (jax.lax.broadcasted_iota(jnp.uint32, (1, k), 1) % 32)
     new_mask = ((repeated >> shifts) & 1).astype(bool)
-    age_out_ref[:] = jnp.where(new_mask, jnp.uint8(0), age)
+    aged = jnp.where(age < 255, age + 1, age)      # saturating age++
+    age_out_ref[:] = jnp.where(new_mask, jnp.uint8(0), aged)
 
 
 def merge_incoming(known: jnp.ndarray, incoming: jnp.ndarray,
                    alive_u8: jnp.ndarray, age: jnp.ndarray
                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(known', age') in one fused pass (age 0 = fresh transmit budget)."""
+    """(known', age') in one fused pass: learn + saturating age++ + age-0
+    reset for newly learned facts (age 0 = fresh transmit budget).  Takes
+    the PRE-increment age (selection's view)."""
     n, k = age.shape
     w = k // 32
     BLOCK_N = _block_for(n)
