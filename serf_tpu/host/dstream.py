@@ -1,0 +1,428 @@
+"""Datagram-stream transport: reliable framed streams over UDP.
+
+The reference wires THREE stream transports — TCP, TLS-over-TCP, and QUIC
+(quinn) (serf/Cargo.toml:24-56, README.md:114-131).  QUIC's role there is
+"encrypted reliable streams without TCP": the push/pull anti-entropy and
+large sends ride UDP.  No QUIC implementation exists in this image and a
+from-scratch RFC 9000 stack is out of scope, so this module fills the same
+architectural slot with an honest, minimal protocol:
+
+- one UDP socket carries BOTH planes, demultiplexed by a 1-byte type
+  prefix: gossip packets (type 0) and stream segments (type 1);
+- streams are connection-oriented (8-byte random connection id, SYN /
+  SYN-ACK handshake), segment-sequenced ARQ with a fixed in-flight
+  window, out-of-order receive buffer, cumulative ACKs, and exponential
+  retransmit backoff;
+- optional AES-GCM encryption of every segment (header included) through
+  the cluster ``SecretKeyring`` — the keyring that already encrypts
+  gossip packets also covers the stream plane, mirroring QUIC's
+  always-encrypted stance without a TLS handshake;
+- frames (the `Stream` contract) are 4-byte length-prefixed byte strings
+  chunked into ≤``MSS``-byte segments.
+
+What this is NOT (documented deviation, PARITY.md): QUIC's congestion
+control, path migration, 0-RTT, or wire format.  It is a LAN-profile ARQ
+sized for serf's push/pull exchanges, conformance-tested alongside
+tcp/tls through the same cluster scenarios.
+
+Both endpoints of a cluster must run the same transport (exactly as a
+quinn-only reference cluster cannot interoperate with plain TCP nodes).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import struct
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+import logging
+
+from serf_tpu.host.net import _resolve_address
+from serf_tpu.host.transport import Stream, Transport
+
+log = logging.getLogger("serf_tpu.dstream")
+
+MSS = 1200              # max segment payload (UDP-safe with header room)
+WINDOW = 64             # max in-flight segments per connection
+RTO_MIN = 0.15          # initial retransmit timeout (s)
+RTO_MAX = 2.0           # backoff cap (s)
+MAX_RETRIES = 30        # per-oldest-segment retransmit budget
+MAX_OOO = 4 * WINDOW    # out-of-order buffer bound (segments)
+HANDSHAKE_TIMEOUT = 5.0
+MAX_FRAME = 32 * 1024 * 1024
+
+T_PACKET = 0            # wire type: app gossip packet
+T_SEGMENT = 1           # wire type: stream segment
+
+K_SYN = 1
+K_SYN_ACK = 2
+K_DATA = 3
+K_ACK = 4
+K_FIN = 5
+K_RST = 6
+
+_HDR = struct.Struct(">8sBI")   # cid, kind, seq
+_AAD = b"serf-tpu-dstream-v1"
+
+
+def _norm(addr) -> Tuple[str, int]:
+    # (host, port): IPv6 sockets report 4-tuple sources; connection keys
+    # and reply targets use the 2-tuple form everywhere
+    return (addr[0], addr[1])
+
+
+class _Conn:
+    """One reliable segment-sequenced connection (both directions)."""
+
+    def __init__(self, transport: "DatagramStreamTransport", peer, cid: bytes):
+        self.t = transport
+        self.peer = _norm(peer)
+        self.cid = cid
+        # sender state
+        self.snd_next = 0                      # next seq to assign
+        self.snd_una = 0                       # oldest unacked seq
+        self.inflight: Dict[int, bytes] = {}   # seq -> encoded wire segment
+        self.retries = 0
+        self.rto = RTO_MIN
+        self.retx_handle: Optional[asyncio.TimerHandle] = None
+        self.window_free = asyncio.Event()
+        self.window_free.set()
+        # receiver state
+        self.rcv_next = 0
+        self.ooo: Dict[int, Tuple[int, bytes]] = {}   # seq -> (kind, payload)
+        self.rbuf = bytearray()
+        self.frames: asyncio.Queue = asyncio.Queue()
+        # lifecycle
+        self.established = asyncio.Event()
+        self.closed = False
+        self.error: Optional[str] = None
+
+    # -- sending ------------------------------------------------------------
+
+    def _send_segment(self, kind: int, seq: int, payload: bytes = b"",
+                      track: bool = True) -> None:
+        wire = self.t._encode_segment(self.cid, kind, seq, payload)
+        if track:
+            self.inflight[seq] = wire
+            self._arm_retx()
+        self.t._sendto(wire, self.peer)
+
+    def _arm_retx(self) -> None:
+        if self.retx_handle is None and self.inflight and not self.closed:
+            loop = asyncio.get_running_loop()
+            self.retx_handle = loop.call_later(self.rto, self._on_retx)
+
+    def _on_retx(self) -> None:
+        self.retx_handle = None
+        if self.closed or not self.inflight:
+            return
+        self.retries += 1
+        if self.retries > MAX_RETRIES:
+            self._fail(f"retransmit budget exhausted to {self.peer}")
+            return
+        self.rto = min(self.rto * 2.0, RTO_MAX)
+        for seq in sorted(self.inflight):
+            self.t._sendto(self.inflight[seq], self.peer)
+        self._arm_retx()
+
+    async def send_bytes(self, data: bytes) -> None:
+        """Chunk into sequenced DATA segments, respecting the window."""
+        view = memoryview(data)
+        off = 0
+        while off < len(view) or (len(view) == 0 and off == 0):
+            await self._wait_window()
+            if self.error:
+                raise ConnectionError(self.error)
+            if self.closed:
+                raise ConnectionError("stream closed")
+            chunk = bytes(view[off:off + MSS])
+            seq = self.snd_next
+            self.snd_next += 1
+            self._send_segment(K_DATA, seq, chunk)
+            off += MSS
+            if len(view) == 0:
+                break
+        self._update_window()
+
+    async def _wait_window(self) -> None:
+        while self.snd_next - self.snd_una >= WINDOW and not self.error \
+                and not self.closed:
+            self.window_free.clear()
+            await self.window_free.wait()
+
+    def _update_window(self) -> None:
+        if self.snd_next - self.snd_una < WINDOW:
+            self.window_free.set()
+
+    # -- receiving (sync, called from the datagram callback) ----------------
+
+    def on_segment(self, kind: int, seq: int, payload: bytes) -> None:
+        if self.closed:
+            if kind != K_ACK:
+                self._send_segment(K_RST, 0, track=False)
+            return
+        if kind == K_SYN_ACK:
+            self.established.set()
+            # our SYN occupied no sequence number; just stop resending it
+            self.inflight.pop(-1, None)
+            if not self.inflight and self.retx_handle is not None:
+                self.retx_handle.cancel()
+                self.retx_handle = None
+            self.retries = 0
+            return
+        if kind == K_ACK:
+            if seq > self.snd_una:
+                self.snd_una = seq
+                for s in [s for s in self.inflight if s < seq]:
+                    del self.inflight[s]
+                self.retries = 0
+                self.rto = RTO_MIN
+                if self.retx_handle is not None:
+                    self.retx_handle.cancel()
+                    self.retx_handle = None
+                self._arm_retx()
+                self._update_window()
+            return
+        if kind == K_RST:
+            self._fail(f"connection reset by {self.peer}")
+            return
+        if kind in (K_DATA, K_FIN):
+            if seq < self.rcv_next:
+                pass                           # duplicate; re-ack below
+            elif seq == self.rcv_next:
+                self._deliver(kind, payload)
+                self.rcv_next += 1
+                while self.rcv_next in self.ooo:
+                    k2, p2 = self.ooo.pop(self.rcv_next)
+                    self._deliver(k2, p2)
+                    self.rcv_next += 1
+            elif len(self.ooo) < MAX_OOO:
+                self.ooo[seq] = (kind, payload)
+            self._send_segment(K_ACK, self.rcv_next, track=False)
+
+    def _deliver(self, kind: int, payload: bytes) -> None:
+        if kind == K_FIN:
+            self.frames.put_nowait(None)
+            return
+        self.rbuf += payload
+        while len(self.rbuf) >= 4:
+            (ln,) = struct.unpack(">I", self.rbuf[:4])
+            if ln > MAX_FRAME:
+                self._fail(f"frame of {ln} bytes exceeds limit")
+                return
+            if len(self.rbuf) < 4 + ln:
+                break
+            frame = bytes(self.rbuf[4:4 + ln])
+            del self.rbuf[:4 + ln]
+            self.frames.put_nowait(frame)
+
+    def _fail(self, msg: str) -> None:
+        if self.error is None:
+            self.error = msg
+        self.frames.put_nowait(None)
+        self.window_free.set()
+        self.established.set()
+        self._teardown()
+
+    def _teardown(self) -> None:
+        self.closed = True
+        self.inflight.clear()
+        if self.retx_handle is not None:
+            self.retx_handle.cancel()
+            self.retx_handle = None
+        self.t._conns.pop((self.peer, self.cid), None)
+
+
+class DgramStream(Stream):
+    """`Stream` adapter over a `_Conn`."""
+
+    def __init__(self, conn: _Conn):
+        self._c = conn
+
+    async def send_frame(self, buf: bytes) -> None:
+        if self._c.error:
+            raise ConnectionError(self._c.error)
+        await self._c.send_bytes(struct.pack(">I", len(buf)) + buf)
+
+    async def recv_frame(self, timeout: Optional[float] = None) -> bytes:
+        try:
+            if timeout is None:
+                item = await self._c.frames.get()
+            else:
+                item = await asyncio.wait_for(self._c.frames.get(), timeout)
+        except asyncio.TimeoutError:
+            raise TimeoutError("stream recv timeout") from None
+        if item is None:
+            if self._c.error:
+                raise ConnectionError(self._c.error)
+            raise ConnectionError("stream closed by peer")
+        return item
+
+    async def close(self) -> None:
+        c = self._c
+        if c.closed or c.error:
+            c._teardown()
+            return
+        try:
+            await c._wait_window()
+            seq = c.snd_next
+            c.snd_next += 1
+            c._send_segment(K_FIN, seq)
+        except ConnectionError:
+            pass
+        # linger briefly so the FIN (and its retransmits) can land, then
+        # tear down regardless — the peer's FIN handling is idempotent
+        loop = asyncio.get_running_loop()
+        loop.call_later(RTO_MAX, c._teardown)
+
+
+class _DgramProtocol(asyncio.DatagramProtocol):
+    def __init__(self, transport: "DatagramStreamTransport"):
+        self._t = transport
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        self._t._on_datagram(data, addr)
+
+
+class DatagramStreamTransport(Transport):
+    """UDP-only transport: gossip packets and reliable streams on one
+    socket.  ``keyring``: optional ``SecretKeyring`` — when set, stream
+    segments are AES-GCM encrypted and authenticated end-to-end."""
+
+    def __init__(self, keyring=None):
+        self._addr = None
+        self._packets: asyncio.Queue = asyncio.Queue()
+        self._accepts: asyncio.Queue = asyncio.Queue()
+        self._conns: Dict[Tuple[tuple, bytes], _Conn] = {}
+        self._udp = None
+        self._shut = False
+        self._keyring = keyring
+
+    @classmethod
+    async def bind(cls, addr: Tuple[str, int], *, keyring=None
+                   ) -> "DatagramStreamTransport":
+        t = cls(keyring=keyring)
+        loop = asyncio.get_running_loop()
+        t._udp, _ = await loop.create_datagram_endpoint(
+            lambda: _DgramProtocol(t), local_addr=addr)
+        sock = t._udp.get_extra_info("socket")
+        t._addr = sock.getsockname()[:2]
+        return t
+
+    # -- wire ---------------------------------------------------------------
+
+    @property
+    def max_packet_size(self) -> int:
+        return MSS  # 1-byte demux prefix eats into the UDP budget
+
+    def _encode_segment(self, cid: bytes, kind: int, seq: int,
+                        payload: bytes = b"") -> bytes:
+        body = _HDR.pack(cid, kind, seq) + payload
+        if self._keyring is not None:
+            body = self._keyring.encrypt(body, aad=_AAD)
+        return bytes([T_SEGMENT]) + body
+
+    def _sendto(self, wire: bytes, addr) -> None:
+        if not self._shut and self._udp is not None:
+            self._udp.sendto(wire, addr)
+
+    def _on_datagram(self, data: bytes, addr) -> None:
+        if not data:
+            return
+        t, body = data[0], data[1:]
+        addr = _norm(addr)
+        if t == T_PACKET:
+            self._packets.put_nowait((addr, body))
+            return
+        if t != T_SEGMENT:
+            return
+        if self._keyring is not None:
+            try:
+                body = self._keyring.decrypt(body, aad=_AAD)
+            except Exception:
+                log.debug("dropping undecryptable segment from %r", addr)
+                return
+        if len(body) < _HDR.size:
+            return
+        cid, kind, seq = _HDR.unpack_from(body)
+        payload = body[_HDR.size:]
+        key = (addr, cid)
+        conn = self._conns.get(key)
+        if conn is None:
+            if kind == K_SYN and not self._shut:
+                conn = _Conn(self, addr, cid)
+                conn.established.set()
+                self._conns[key] = conn
+                self._accepts.put_nowait((addr, DgramStream(conn)))
+            elif kind in (K_DATA, K_FIN):
+                # stale connection: tell the peer to give up
+                self._sendto(self._encode_segment(cid, K_RST, 0), addr)
+                return
+            else:
+                return
+        if kind == K_SYN:
+            # duplicate SYN (our SYN_ACK was lost): re-ack, don't re-accept
+            self._sendto(self._encode_segment(cid, K_SYN_ACK, 0), addr)
+            return
+        conn.on_segment(kind, seq, payload)
+
+    # -- Transport contract -------------------------------------------------
+
+    @property
+    def local_addr(self):
+        return self._addr
+
+    async def resolve(self, addr):
+        return await _resolve_address(addr, self._addr)
+
+    async def send_packet(self, addr, buf: bytes) -> None:
+        if self._shut:
+            raise ConnectionError("transport shut down")
+        self._udp.sendto(bytes([T_PACKET]) + buf, _norm(addr))
+
+    async def recv_packet(self):
+        item = await self._packets.get()
+        if item is None:
+            raise ConnectionError("transport shut down")
+        return item
+
+    async def dial(self, addr, timeout: Optional[float] = None) -> Stream:
+        if self._shut:
+            raise ConnectionError("transport shut down")
+        addr = _norm(addr)
+        cid = os.urandom(8)
+        conn = _Conn(self, addr, cid)
+        self._conns[(addr, cid)] = conn
+        # SYN rides the retransmit machinery under pseudo-seq -1 (it
+        # occupies no data sequence number)
+        conn.inflight[-1] = self._encode_segment(cid, K_SYN, 0)
+        self._sendto(conn.inflight[-1], addr)
+        conn._arm_retx()
+        try:
+            await asyncio.wait_for(conn.established.wait(),
+                                   timeout or HANDSHAKE_TIMEOUT)
+        except asyncio.TimeoutError:
+            conn._teardown()
+            raise TimeoutError(f"dial {addr!r} timed out") from None
+        if conn.error:
+            raise ConnectionError(conn.error)
+        return DgramStream(conn)
+
+    async def accept(self):
+        item = await self._accepts.get()
+        if item is None:
+            raise ConnectionError("transport shut down")
+        return item
+
+    async def shutdown(self) -> None:
+        if self._shut:
+            return
+        self._shut = True
+        for conn in list(self._conns.values()):
+            conn._teardown()
+        if self._udp is not None:
+            self._udp.close()
+        self._packets.put_nowait(None)
+        self._accepts.put_nowait(None)

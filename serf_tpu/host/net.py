@@ -25,6 +25,52 @@ from serf_tpu.host.transport import Stream, Transport
 MAX_FRAME = 32 * 1024 * 1024  # sanity bound on a single stream frame
 
 
+async def _resolve_address(addr, bound_addr):
+    """Shared resolver (the reference's ``Transport::Resolver`` seam):
+    ``"host:port"`` strings and hostname tuples resolve through the event
+    loop; numeric literals pass through; resolution is constrained to the
+    bound socket's address family."""
+    if isinstance(addr, str) and ":" in addr:
+        try:
+            # a bare IPv6 literal is an address, not host:port
+            ipaddress.ip_address(addr)
+        except ValueError:
+            host, _, port = addr.rpartition(":")
+            try:
+                addr = (host.strip("[]"), int(port))
+            except ValueError as e:
+                raise ConnectionError(
+                    f"malformed host:port target {addr!r}") from e
+    if not (isinstance(addr, tuple) and len(addr) == 2):
+        return addr
+    host, port = addr
+    try:
+        # numeric literals skip the resolver entirely
+        ipaddress.ip_address(host)
+        return (host, port)
+    except ValueError:
+        pass
+    # constrain to the bound socket's family: a dual-stack hostname must
+    # not resolve to an address our AF_INET/AF_INET6 socket cannot reach
+    family = 0
+    if bound_addr is not None:
+        try:
+            bound_ip = ipaddress.ip_address(bound_addr[0])
+            family = (socket.AF_INET6 if bound_ip.version == 6
+                      else socket.AF_INET)
+        except ValueError:
+            pass
+    loop = asyncio.get_running_loop()
+    try:
+        infos = await loop.getaddrinfo(host, port, family=family,
+                                       type=socket.SOCK_DGRAM)
+    except socket.gaierror as e:
+        raise ConnectionError(f"cannot resolve {host!r}: {e}") from e
+    if not infos:
+        raise ConnectionError(f"cannot resolve {host!r}")
+    return infos[0][4][:2]
+
+
 class TcpStream(Stream):
     def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         self._r = reader
@@ -109,45 +155,7 @@ class NetTransport(Transport):
         through untouched.  IPv6 literals with ports use brackets
         (``[::1]:7946``); an unbracketed all-colons string is treated as a
         bare IPv6 address, not host:port."""
-        if isinstance(addr, str) and ":" in addr:
-            try:
-                # a bare IPv6 literal is an address, not host:port
-                ipaddress.ip_address(addr)
-            except ValueError:
-                host, _, port = addr.rpartition(":")
-                try:
-                    addr = (host.strip("[]"), int(port))
-                except ValueError as e:
-                    raise ConnectionError(
-                        f"malformed host:port target {addr!r}") from e
-        if not (isinstance(addr, tuple) and len(addr) == 2):
-            return addr
-        host, port = addr
-        try:
-            # numeric literals skip the resolver entirely
-            ipaddress.ip_address(host)
-            return (host, port)
-        except ValueError:
-            pass
-        # constrain to the bound socket's family: a dual-stack hostname must
-        # not resolve to an address our AF_INET/AF_INET6 socket cannot reach
-        family = 0
-        if self._addr is not None:
-            try:
-                bound_ip = ipaddress.ip_address(self._addr[0])
-                family = (socket.AF_INET6 if bound_ip.version == 6
-                          else socket.AF_INET)
-            except ValueError:
-                pass
-        loop = asyncio.get_running_loop()
-        try:
-            infos = await loop.getaddrinfo(host, port, family=family,
-                                           type=socket.SOCK_DGRAM)
-        except socket.gaierror as e:
-            raise ConnectionError(f"cannot resolve {host!r}: {e}") from e
-        if not infos:
-            raise ConnectionError(f"cannot resolve {host!r}")
-        return infos[0][4][:2]
+        return await _resolve_address(addr, self._addr)
 
     @property
     def local_addr(self):

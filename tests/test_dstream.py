@@ -1,0 +1,146 @@
+"""Datagram-stream transport (the QUIC slot): ARQ correctness under loss,
+encryption, and lifecycle semantics.
+
+The cluster-level conformance run (2-node serf over udpstream, v4+v6)
+lives in test_serf.py's stream-variant matrix; these tests drive the
+transport directly.
+"""
+
+import asyncio
+import os
+import random
+
+import pytest
+
+from serf_tpu.host.dstream import (
+    MSS,
+    DatagramStreamTransport,
+    T_SEGMENT,
+)
+from serf_tpu.host.keyring import SecretKeyring
+
+pytestmark = pytest.mark.asyncio
+
+
+async def _pair(**kw):
+    a = await DatagramStreamTransport.bind(("127.0.0.1", 0), **kw)
+    b = await DatagramStreamTransport.bind(("127.0.0.1", 0), **kw)
+    return a, b
+
+
+async def test_frame_round_trip_small_and_large():
+    a, b = await _pair()
+    try:
+        dial_task = asyncio.ensure_future(a.dial(b.local_addr))
+        peer, srv = await asyncio.wait_for(b.accept(), 5)
+        cli = await dial_task
+
+        await cli.send_frame(b"hello")
+        assert await srv.recv_frame(timeout=5) == b"hello"
+
+        # multi-segment frame (spans many MSS chunks) + empty frame
+        big = os.urandom(37 * MSS + 123)
+        await srv.send_frame(big)
+        await srv.send_frame(b"")
+        assert await cli.recv_frame(timeout=10) == big
+        assert await cli.recv_frame(timeout=5) == b""
+    finally:
+        await a.shutdown()
+        await b.shutdown()
+
+
+async def test_arq_recovers_from_heavy_loss():
+    """20% segment loss in both directions: the retransmit machinery must
+    still deliver every frame intact and in order."""
+    a, b = await _pair()
+    rng = random.Random(7)
+
+    def lossy(t):
+        orig = t._sendto
+
+        def send(wire, addr):
+            # drop only stream segments (never the bind machinery)
+            if wire and wire[0] == T_SEGMENT and rng.random() < 0.20:
+                return
+            orig(wire, addr)
+        t._sendto = send
+
+    lossy(a)
+    lossy(b)
+    try:
+        dial_task = asyncio.ensure_future(a.dial(b.local_addr))
+        peer, srv = await asyncio.wait_for(b.accept(), 10)
+        cli = await dial_task
+
+        frames = [os.urandom(rng.randrange(1, 4 * MSS)) for _ in range(12)]
+        for f in frames:
+            await cli.send_frame(f)
+        got = [await srv.recv_frame(timeout=30) for _ in frames]
+        assert got == frames
+    finally:
+        await a.shutdown()
+        await b.shutdown()
+
+
+async def test_encrypted_segments_and_foreign_injection_dropped():
+    key = os.urandom(32)
+    a, b = await _pair(keyring=SecretKeyring(key))
+    # an attacker (or misconfigured node) without the cluster key
+    intruder = await DatagramStreamTransport.bind(("127.0.0.1", 0),
+                                                  keyring=SecretKeyring(os.urandom(32)))
+    try:
+        dial_task = asyncio.ensure_future(a.dial(b.local_addr))
+        peer, srv = await asyncio.wait_for(b.accept(), 5)
+        cli = await dial_task
+        await cli.send_frame(b"secret payload")
+        assert await srv.recv_frame(timeout=5) == b"secret payload"
+
+        # wrong-key dial never completes a handshake (segments dropped)
+        with pytest.raises((TimeoutError, ConnectionError)):
+            await intruder.dial(b.local_addr, timeout=1.0)
+
+        # the established stream is unaffected by the garbage
+        await srv.send_frame(b"still fine")
+        assert await cli.recv_frame(timeout=5) == b"still fine"
+    finally:
+        await a.shutdown()
+        await b.shutdown()
+        await intruder.shutdown()
+
+
+async def test_close_signals_peer_eof():
+    a, b = await _pair()
+    try:
+        dial_task = asyncio.ensure_future(a.dial(b.local_addr))
+        peer, srv = await asyncio.wait_for(b.accept(), 5)
+        cli = await dial_task
+        await cli.send_frame(b"last words")
+        await cli.close()
+        assert await srv.recv_frame(timeout=5) == b"last words"
+        with pytest.raises(ConnectionError):
+            await srv.recv_frame(timeout=5)
+    finally:
+        await a.shutdown()
+        await b.shutdown()
+
+
+async def test_dial_unreachable_times_out():
+    a = await DatagramStreamTransport.bind(("127.0.0.1", 0))
+    # an address with nothing listening: SYN retransmits, then times out
+    try:
+        with pytest.raises((TimeoutError, ConnectionError)):
+            await a.dial(("127.0.0.1", 1), timeout=1.0)
+    finally:
+        await a.shutdown()
+
+
+async def test_packet_plane_coexists_with_streams():
+    a, b = await _pair()
+    try:
+        await a.send_packet(b.local_addr, b"gossip!")
+        src, payload = await asyncio.wait_for(b.recv_packet(), 5)
+        assert payload == b"gossip!"
+        assert src[1] == a.local_addr[1]
+    finally:
+        await a.shutdown()
+        await b.shutdown()

@@ -467,10 +467,13 @@ def _self_signed_cert(tmp_path, hostname="localhost"):
 
 
 @pytest.mark.parametrize("host", ["127.0.0.1", "::1"])
-@pytest.mark.parametrize("stream", ["tcp", "tls"])
+@pytest.mark.parametrize("stream", ["tcp", "tls", "udpstream"])
 async def test_net_transport_stream_variants(host, stream, tmp_path):
-    """Conformance over real sockets for both stream planes: plain TCP and
-    TLS-wrapped (the reference's NetTransport/TLS feature split), IPv4+IPv6."""
+    """Conformance over real sockets for every stream plane: plain TCP,
+    TLS-wrapped (the reference's NetTransport/TLS feature split), and the
+    QUIC-slot datagram-stream transport (reliable streams over UDP),
+    IPv4+IPv6."""
+    from serf_tpu.host.dstream import DatagramStreamTransport
     from serf_tpu.host.net import NetTransport, TlsNetTransport, make_tls_contexts
 
     if stream == "tls":
@@ -480,6 +483,8 @@ async def test_net_transport_stream_variants(host, stream, tmp_path):
     async def bind(addr):
         if stream == "tcp":
             return await NetTransport.bind(addr)
+        if stream == "udpstream":
+            return await DatagramStreamTransport.bind(addr)
         server_ctx, client_ctx = make_tls_contexts(cert, key)
         return await TlsNetTransport.bind(addr, server_ctx=server_ctx,
                                           client_ctx=client_ctx)
