@@ -52,6 +52,12 @@ MAX_RETRIES = 30        # per-oldest-segment retransmit budget
 MAX_OOO = 4 * WINDOW    # out-of-order buffer bound (segments)
 HANDSHAKE_TIMEOUT = 5.0
 MAX_FRAME = 32 * 1024 * 1024
+CLOSE_FLUSH_TIMEOUT = 5.0   # close() waits this long for inflight to drain
+FIN_LINGER = 2 * RTO_MAX    # FIN receiver keeps the conn this long for
+                            # re-acks (and frees it even if the app never
+                            # calls close after EOF)
+MAX_ACCEPT_BACKLOG = 128    # un-accepted streams queued transport-wide
+MAX_PEER_CONNS = 64         # connections (incl. pending) per remote addr
 
 T_PACKET = 0            # wire type: app gossip packet
 T_SEGMENT = 1           # wire type: stream segment
@@ -89,6 +95,8 @@ class _Conn:
         self.retx_handle: Optional[asyncio.TimerHandle] = None
         self.window_free = asyncio.Event()
         self.window_free.set()
+        self.drained = asyncio.Event()         # set while nothing is inflight
+        self.drained.set()
         # receiver state
         self.rcv_next = 0
         self.ooo: Dict[int, Tuple[int, bytes]] = {}   # seq -> (kind, payload)
@@ -106,6 +114,7 @@ class _Conn:
         wire = self.t._encode_segment(self.cid, kind, seq, payload)
         if track:
             self.inflight[seq] = wire
+            self.drained.clear()
             self._arm_retx()
         self.t._sendto(wire, self.peer)
 
@@ -167,9 +176,11 @@ class _Conn:
             self.established.set()
             # our SYN occupied no sequence number; just stop resending it
             self.inflight.pop(-1, None)
-            if not self.inflight and self.retx_handle is not None:
-                self.retx_handle.cancel()
-                self.retx_handle = None
+            if not self.inflight:
+                self.drained.set()
+                if self.retx_handle is not None:
+                    self.retx_handle.cancel()
+                    self.retx_handle = None
             self.retries = 0
             return
         if kind == K_ACK:
@@ -177,6 +188,8 @@ class _Conn:
                 self.snd_una = seq
                 for s in [s for s in self.inflight if s < seq]:
                     del self.inflight[s]
+                if not self.inflight:
+                    self.drained.set()
                 self.retries = 0
                 self.rto = RTO_MIN
                 if self.retx_handle is not None:
@@ -205,6 +218,14 @@ class _Conn:
     def _deliver(self, kind: int, payload: bytes) -> None:
         if kind == K_FIN:
             self.frames.put_nowait(None)
+            # the peer is done sending; keep the conn only long enough to
+            # re-ack FIN retransmits, then free it even if the application
+            # abandons the stream after EOF instead of calling close().
+            # Must not cut short OUR outgoing direction: while local
+            # segments are still unacked (a response being flushed), defer
+            # and re-check rather than tearing down.
+            asyncio.get_running_loop().call_later(FIN_LINGER,
+                                                  self._linger_teardown)
             return
         self.rbuf += payload
         while len(self.rbuf) >= 4:
@@ -218,6 +239,18 @@ class _Conn:
             del self.rbuf[:4 + ln]
             self.frames.put_nowait(frame)
 
+    def _linger_teardown(self) -> None:
+        """FIN-linger expiry: free the conn unless our own send direction
+        still has unacked segments (retransmission must keep running until
+        close()'s flush completes or the retransmit budget fails it)."""
+        if self.closed:
+            return
+        if self.inflight:
+            asyncio.get_running_loop().call_later(FIN_LINGER,
+                                                  self._linger_teardown)
+            return
+        self._teardown()
+
     def _fail(self, msg: str) -> None:
         if self.error is None:
             self.error = msg
@@ -229,6 +262,7 @@ class _Conn:
     def _teardown(self) -> None:
         self.closed = True
         self.inflight.clear()
+        self.drained.set()
         if self.retx_handle is not None:
             self.retx_handle.cancel()
             self.retx_handle = None
@@ -255,6 +289,9 @@ class DgramStream(Stream):
         except asyncio.TimeoutError:
             raise TimeoutError("stream recv timeout") from None
         if item is None:
+            # re-enqueue the EOF/error sentinel so EVERY post-EOF call
+            # raises (the TcpStream contract) instead of blocking forever
+            self._c.frames.put_nowait(None)
             if self._c.error:
                 raise ConnectionError(self._c.error)
             raise ConnectionError("stream closed by peer")
@@ -272,10 +309,17 @@ class DgramStream(Stream):
             c._send_segment(K_FIN, seq)
         except ConnectionError:
             pass
-        # linger briefly so the FIN (and its retransmits) can land, then
-        # tear down regardless — the peer's FIN handling is idempotent
-        loop = asyncio.get_running_loop()
-        loop.call_later(RTO_MAX, c._teardown)
+        # flush: wait until every inflight segment (data + the FIN) is
+        # acked, so the final frames of a stream are never silently dropped
+        # under loss (the TcpStream close() contract).  Retransmission keeps
+        # running through the wait; only on timeout (peer unresponsive) fall
+        # back to the fixed linger before tearing down regardless.
+        try:
+            await asyncio.wait_for(c.drained.wait(), CLOSE_FLUSH_TIMEOUT)
+        except asyncio.TimeoutError:
+            asyncio.get_running_loop().call_later(RTO_MAX, c._teardown)
+            return
+        c._teardown()
 
 
 class _DgramProtocol(asyncio.DatagramProtocol):
@@ -352,6 +396,19 @@ class DatagramStreamTransport(Transport):
         conn = self._conns.get(key)
         if conn is None:
             if kind == K_SYN and not self._shut:
+                # bound resource growth from unsolicited (or replayed) SYNs:
+                # cap the un-accepted backlog transport-wide and the live
+                # connection count per remote address.  A recorded encrypted
+                # SYN still decrypts (constant AAD), so replay cannot be
+                # rejected cryptographically without a handshake nonce echo —
+                # these caps bound what a replay storm can allocate.
+                if self._accepts.qsize() >= MAX_ACCEPT_BACKLOG:
+                    log.debug("dropping SYN from %r: accept backlog full", addr)
+                    return
+                if sum(1 for (a, _c) in self._conns if a == addr) \
+                        >= MAX_PEER_CONNS:
+                    log.debug("dropping SYN from %r: per-peer conn cap", addr)
+                    return
                 conn = _Conn(self, addr, cid)
                 conn.established.set()
                 self._conns[key] = conn

@@ -326,11 +326,21 @@ def pick_bounded(candidates: jnp.ndarray, max_events: int, key: jax.Array):
     defers extras).  This removes the full 1M-element sort that made the
     flat top_k the single most expensive op in the swim round.
     """
+    def topk_padded(scores: jnp.ndarray):
+        # top_k requires k <= the axis size; clamp and pad the tail with
+        # zero scores (inactive by the `vals > 0` predicate below)
+        kk = min(max_events, scores.shape[0])
+        vals, idx = jax.lax.top_k(scores, kk)
+        if kk < max_events:
+            vals = jnp.pad(vals, (0, max_events - kk))
+            idx = jnp.pad(idx, (0, max_events - kk))
+        return vals, idx
+
     n = candidates.shape[0]
     score = candidates.astype(jnp.float32) * (
         1.0 + jax.random.uniform(key, (n,)))
     if n <= _PICK_FLAT_MAX:
-        vals, idx = jax.lax.top_k(score, max_events)
+        vals, idx = topk_padded(score)
         active = vals > 0.0
         subjects = idx.astype(jnp.int32)
     else:
@@ -341,7 +351,9 @@ def pick_bounded(candidates: jnp.ndarray, max_events: int, key: jax.Array):
         s2 = padded.reshape(rows, g)        # column j = indices ≡ j mod g
         col_max = jnp.max(s2, axis=0)                          # f32[G]
         col_arg = jnp.argmax(s2, axis=0).astype(jnp.int32)     # i32[G]
-        vals, cols = jax.lax.top_k(col_max, max_events)
+        # at most one winner per group, so only min(max_events, G) picks
+        # are possible; the tail comes back inactive
+        vals, cols = topk_padded(col_max)
         active = vals > 0.0
         subjects = col_arg[cols] * g + cols.astype(jnp.int32)
     chosen = jnp.zeros((n,), bool).at[

@@ -168,3 +168,37 @@ def test_compound_with_empty_part_raises_decode_error():
     pkt = sm.encode_compound([b""])
     with pytest.raises(codec.DecodeError):
         sm.decode_swim(pkt)
+
+
+# ---------------------------------------------------------------------------
+# round-2 ADVICE: pick_bounded must trace for any max_events
+# ---------------------------------------------------------------------------
+
+def test_pick_bounded_max_events_above_group_count_traces():
+    """ADVICE r2 (low): the grouped path called top_k(col_max, max_events)
+    with a _PICK_GROUPS-element array — max_events > _PICK_GROUPS failed at
+    trace time.  The k is now clamped and the tail padded inactive."""
+    import jax
+    import jax.numpy as jnp
+    from serf_tpu.models.dissemination import (
+        _PICK_FLAT_MAX, _PICK_GROUPS, pick_bounded)
+
+    n = 2 * _PICK_FLAT_MAX          # forces the grouped path
+    jax.eval_shape(                  # trace only; no large CPU compute
+        lambda c, k: pick_bounded(c, _PICK_GROUPS + 64, k),
+        jax.ShapeDtypeStruct((n,), jnp.bool_),
+        jax.random.PRNGKey(0))
+
+
+def test_pick_bounded_max_events_above_n_flat():
+    """Flat path: max_events > n must clamp top_k's k and still pick every
+    candidate."""
+    import jax
+    import jax.numpy as jnp
+    from serf_tpu.models.dissemination import pick_bounded
+
+    candidates = jnp.asarray([True, False, True, False])
+    chosen, subjects, active = pick_bounded(
+        candidates, 8, jax.random.PRNGKey(3))
+    assert bool(jnp.all(chosen == candidates))
+    assert int(jnp.sum(active)) == 2

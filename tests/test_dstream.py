@@ -144,3 +144,92 @@ async def test_packet_plane_coexists_with_streams():
     finally:
         await a.shutdown()
         await b.shutdown()
+
+
+async def test_close_flushes_inflight_under_heavy_loss():
+    """ADVICE r2 (medium): close() must not tear down while DATA segments
+    are unacked — the final frames of a stream survive sustained loss
+    because retransmission keeps running until everything (incl. the FIN)
+    is acked."""
+    a, b = await _pair()
+    rng = random.Random(31)
+
+    def lossy(t):
+        orig = t._sendto
+
+        def send(wire, addr):
+            if wire and wire[0] == T_SEGMENT and rng.random() < 0.4:
+                return
+            orig(wire, addr)
+        t._sendto = send
+
+    lossy(a)
+    try:
+        dial_task = asyncio.ensure_future(a.dial(b.local_addr))
+        peer, srv = await asyncio.wait_for(b.accept(), 10)
+        cli = await dial_task
+        last = os.urandom(6 * MSS + 17)
+        await cli.send_frame(last)
+        await cli.close()          # returns only after all inflight acked
+        assert await srv.recv_frame(timeout=30) == last
+    finally:
+        await a.shutdown()
+        await b.shutdown()
+
+
+async def test_recv_after_eof_always_raises():
+    """ADVICE r2 (low): every recv_frame after EOF must raise (TcpStream
+    contract), not consume the sentinel once and hang forever."""
+    a, b = await _pair()
+    try:
+        dial_task = asyncio.ensure_future(a.dial(b.local_addr))
+        peer, srv = await asyncio.wait_for(b.accept(), 5)
+        cli = await dial_task
+        await cli.close()
+        for _ in range(3):
+            with pytest.raises(ConnectionError):
+                # timeout=None is the hang-prone path; bound it externally
+                await asyncio.wait_for(srv.recv_frame(), 5)
+    finally:
+        await a.shutdown()
+        await b.shutdown()
+
+
+async def test_fin_receiver_frees_conn_without_app_close(monkeypatch):
+    """ADVICE r2 (low): a stream abandoned by the application after EOF
+    must not leak its _Conn in transport._conns forever."""
+    from serf_tpu.host import dstream as ds
+    monkeypatch.setattr(ds, "FIN_LINGER", 0.3)
+    a, b = await _pair()
+    try:
+        dial_task = asyncio.ensure_future(a.dial(b.local_addr))
+        peer, srv = await asyncio.wait_for(b.accept(), 5)
+        cli = await dial_task
+        await cli.send_frame(b"bye")
+        await cli.close()
+        assert await srv.recv_frame(timeout=5) == b"bye"
+        # srv never calls close(); the FIN linger must still free the conn
+        deadline = asyncio.get_running_loop().time() + 5
+        while b._conns and asyncio.get_running_loop().time() < deadline:
+            await asyncio.sleep(0.05)
+        assert not b._conns
+    finally:
+        await a.shutdown()
+        await b.shutdown()
+
+
+async def test_syn_flood_is_bounded():
+    """ADVICE r2 (low): unsolicited SYNs must not grow _conns / the accept
+    queue without bound."""
+    from serf_tpu.host.dstream import MAX_PEER_CONNS, K_SYN
+    a, b = await _pair()
+    try:
+        for i in range(4 * MAX_PEER_CONNS):
+            cid = i.to_bytes(8, "big")
+            a._sendto(a._encode_segment(cid, K_SYN, 0), b.local_addr)
+        await asyncio.sleep(0.2)
+        assert len(b._conns) <= MAX_PEER_CONNS
+        assert b._accepts.qsize() <= MAX_PEER_CONNS
+    finally:
+        await a.shutdown()
+        await b.shutdown()
