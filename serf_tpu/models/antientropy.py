@@ -21,6 +21,7 @@ from serf_tpu.models.dissemination import (
     GossipConfig,
     GossipState,
     rolled_rows,
+    round_u8,
     sample_offsets,
     unpack_bits,
 )
@@ -54,9 +55,9 @@ def push_pull_round(state: GossipState, cfg: GossipConfig, key: jax.Array,
     new_words = incoming & ~state.known
     known = state.known | new_words
     new_mask = unpack_bits(new_words, k)
-    # age 0 = fresh transmit budget (budget ≡ transmit_limit - age)
-    age = jnp.where(new_mask, jnp.uint8(0), state.age)
-    return state._replace(known=known, age=age)
+    # a fresh stamp = age 0 = fresh transmit budget for newly synced facts
+    stamp = jnp.where(new_mask, round_u8(state.round), state.stamp)
+    return state._replace(known=known, stamp=stamp)
 
 
 def make_partition(n: int, split: float = 0.5) -> jnp.ndarray:

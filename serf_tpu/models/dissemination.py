@@ -8,11 +8,15 @@ This is the TPU-native re-design of serf's dissemination machinery
   facts ``(subject, kind, incarnation, ltime)``.  New facts overwrite ring
   slots, exactly like the reference's ``buffer[ltime % len]`` dedup cells.
 - each simulated node's state is a row: a packed bitset of which facts it
-  knows (``known``: N×W uint32) and a saturating rounds-since-learned age
-  (``age``: N×K uint8 — for suspicion timers).  The per-fact remaining
-  transmit budget (the TransmitLimitedQueue, vectorized) is DERIVED from
-  the age — ``budget = max(0, transmit_limit - age)`` (``budgets_of``) —
-  rather than stored; see ``GossipState``.
+  knows (``known``: N×W uint32) and a **learn-round stamp** (``stamp``:
+  N×K uint8 — the round mod 256 at which the fact became known, valid only
+  where the known bit is set).  A fact's knowledge age and its remaining
+  transmit budget (the TransmitLimitedQueue, vectorized) are DERIVED:
+  ``age = (round - stamp) mod 256`` (``age_of``) and ``budget =
+  max(0, transmit_limit - age)`` (``budgets_of``).  Stamps are written
+  once per LEARN event, never ticked — so neither the per-round budget
+  decrement nor fact retirement rewrites the N×K plane (see
+  ``GossipState``).
 - a gossip round = sample ``fanout`` peers per node, gather their packed
   packet words, bitwise-OR, then a masked Lamport-style merge — pure
   elementwise math plus one gather, which is exactly what the MXU-era memory
@@ -61,23 +65,36 @@ class FactTable(NamedTuple):
 class GossipState(NamedTuple):
     """The whole simulated cluster, struct-of-arrays.
 
-    There is deliberately no transmit-budget plane: a fact's remaining
-    transmit budget is fully determined by its knowledge age —
+    There is deliberately no transmit-budget plane and no stored age plane:
+    a fact's knowledge age is fully determined by its learn-round stamp —
+    ``age = (round - stamp) mod 256`` where the known bit is set (garbage
+    where it isn't) — and its remaining transmit budget by that age:
     ``budget = max(0, transmit_limit - age)`` (learn: budget=limit, age=0;
-    each round: one transmit, one age tick; never-known: age=255 ≥ limit).
-    Deriving it (``budgets_of``) instead of storing it drops a 64 MB
-    u8[N, K] plane at 1M nodes and its ~128 MB/round of HBM read+write.
+    each round: one transmit as long as age < limit).  Deriving both
+    (``age_of``/``budgets_of``) means the u8[N, K] plane is written only
+    on LEARN events (one full-plane select in the round's merge) — the
+    round-1 stored-budget plane's decrement pass AND the stored-age
+    plane's saturating tick AND the per-injection full-plane retirement
+    rewrite (64 MB × 3-4 injections/round at 1M) are all gone; retirement
+    is just the known-bit clear.
+
+    The mod-256 stamp wraps; ``round_step`` re-pins stale stamps to
+    ``AGE_PIN`` every ``CLAMP_EVERY`` rounds (an amortized full-plane
+    pass) so a fact's derived age can never wrap back under
+    ``transmit_limit``/``suspicion_rounds`` — both of which config
+    validation bounds to ``AGE_PIN``.
+
     One semantic consequence, closer to the reference than the stored
-    plane was: a node that is down ages past its budgets, so a rejoiner
-    does not resume retransmitting stale facts (the reference's restarted
-    node comes back with an empty broadcast queue,
+    budget plane was: a node that is down ages past its budgets, so a
+    rejoiner does not resume retransmitting stale facts (the reference's
+    restarted node comes back with an empty broadcast queue,
     serf-core/src/serf/base.rs:62-344 — queues are rebuilt, not restored).
     """
 
     facts: FactTable
     known: jnp.ndarray          # u32[N, W]  packed known-fact bitset
-    age: jnp.ndarray            # u8[N, K]   rounds since learned (saturating;
-                                #            255 also = never/unknown)
+    stamp: jnp.ndarray          # u8[N, K]   round mod 256 when learned
+                                #            (valid only where known)
     alive: jnp.ndarray          # bool[N]    ground-truth liveness
     incarnation: jnp.ndarray    # u32[N]     ground-truth own incarnation
     round: jnp.ndarray          # i32 scalar
@@ -110,13 +127,13 @@ class GossipConfig:
         if self.peer_sampling not in ("iid", "rotation"):
             raise ValueError(
                 f"unknown peer_sampling {self.peer_sampling!r}")
-        if self.transmit_limit > 254:
-            # age is a saturating u8 with 255 = never-known; budgets are
-            # derived as limit - age, so the limit must stay below the
-            # sentinel or never-known facts would appear to have budget
+        if self.transmit_limit > AGE_PIN:
+            # derived ages are pinned at AGE_PIN by the periodic stamp
+            # clamp; a limit above the pin would let pinned (very old)
+            # facts re-enter the sending set
             raise ValueError(
-                f"transmit_limit {self.transmit_limit} exceeds the u8 age "
-                f"plane bound 254 (lower retransmit_mult)")
+                f"transmit_limit {self.transmit_limit} exceeds the stamp "
+                f"age pin {AGE_PIN} (lower retransmit_mult)")
 
     @property
     def words(self) -> int:
@@ -127,6 +144,18 @@ class GossipConfig:
     def transmit_limit(self) -> int:
         import math
         return self.retransmit_mult * max(1, math.ceil(math.log10(self.n + 1)))
+
+
+#: derived ages are pinned here by the periodic stamp clamp; must exceed
+#: every age threshold the protocol compares against (transmit_limit,
+#: suspicion_rounds — both config-validated against it)
+AGE_PIN = 200
+#: rounds between stamp-clamp passes.  Correctness bound: a known fact's
+#: derived age is ≤ AGE_PIN right after a clamp, so it reaches at most
+#: AGE_PIN + CLAMP_EVERY < 256 before the next one — it can never wrap
+#: back under the thresholds.  Cost: one full-plane pass per CLAMP_EVERY
+#: rounds (amortized ~2 MB/round at 1M×64).
+CLAMP_EVERY = 32
 
 
 def make_state(cfg: GossipConfig) -> GossipState:
@@ -141,7 +170,7 @@ def make_state(cfg: GossipConfig) -> GossipState:
     return GossipState(
         facts=facts,
         known=jnp.zeros((n, w), jnp.uint32),
-        age=jnp.full((n, k), 255, jnp.uint8),
+        stamp=jnp.zeros((n, k), jnp.uint8),
         alive=jnp.ones((n,), bool),
         incarnation=jnp.ones((n,), jnp.uint32),
         round=jnp.asarray(0, jnp.int32),
@@ -149,11 +178,33 @@ def make_state(cfg: GossipConfig) -> GossipState:
     )
 
 
+def round_u8(round_) -> jnp.ndarray:
+    """The stamp-plane representation of a round counter: its low byte."""
+    return (jnp.asarray(round_, jnp.int32) & 0xFF).astype(jnp.uint8)
+
+
+def mod_age(state: GossipState, round_=None) -> jnp.ndarray:
+    """u8[N, K]: rounds since learned via wrapping u8 subtraction.
+    VALID ONLY where the known bit is set — callers must gate on the
+    ``known`` bitset (every protocol predicate already does)."""
+    r = state.round if round_ is None else round_
+    return round_u8(r) - state.stamp
+
+
+def age_of(state: GossipState, cfg: GossipConfig) -> jnp.ndarray:
+    """u8[N, K]: knowledge age with the round-1 stored-plane convention
+    (255 = never/unknown) — the gated, allocation-honest view for metrics
+    and tests; the round kernels use ``mod_age`` + known-gating inline."""
+    known = unpack_bits(state.known, cfg.k_facts)
+    return jnp.where(known, mod_age(state), jnp.uint8(255))
+
+
 def budgets_of(state: GossipState, cfg: GossipConfig) -> jnp.ndarray:
     """u8[N, K]: remaining transmit budget, derived from knowledge age
     (see the GossipState docstring for the invariant)."""
     limit = jnp.uint8(cfg.transmit_limit)
-    return jnp.where(state.age < limit, limit - state.age, jnp.uint8(0))
+    age = age_of(state, cfg)
+    return jnp.where(age < limit, limit - age, jnp.uint8(0))
 
 
 def sending_mask(state: GossipState, cfg: GossipConfig) -> jnp.ndarray:
@@ -162,7 +213,25 @@ def sending_mask(state: GossipState, cfg: GossipConfig) -> jnp.ndarray:
     derivation is encoded for the round kernels (round_step,
     push_round_step, ring.round_step_ring); keep in sync with
     ``budgets_of``."""
-    return (state.age < jnp.uint8(cfg.transmit_limit)) & state.alive[:, None]
+    known = unpack_bits(state.known, cfg.k_facts)
+    return (known & (mod_age(state) < jnp.uint8(cfg.transmit_limit))
+            & state.alive[:, None])
+
+
+def clamp_stamps(known: jnp.ndarray, stamp: jnp.ndarray, round_,
+                 k_facts: int) -> jnp.ndarray:
+    """Re-pin stale stamps so derived ages can never wrap (see AGE_PIN/
+    CLAMP_EVERY).  Rides a lax.cond in the round kernels: the full-plane
+    pass runs once per CLAMP_EVERY rounds."""
+    def clamp(s):
+        kb = unpack_bits(known, k_facts)
+        r8 = round_u8(round_)
+        stale = kb & ((r8 - s) > jnp.uint8(AGE_PIN))
+        return jnp.where(stale, r8 - jnp.uint8(AGE_PIN), s)
+
+    return jax.lax.cond(
+        jnp.asarray(round_, jnp.int32) % CLAMP_EVERY == 0,
+        clamp, lambda s: s, stamp)
 
 
 # -- rotation addressing -----------------------------------------------------
@@ -226,13 +295,14 @@ def inject_fact(state: GossipState, cfg: GossipConfig, subject, kind,
     word, bit = slot // 32, slot % 32
     bitmask = (jnp.uint32(1) << bit.astype(jnp.uint32)
                if hasattr(bit, "astype") else jnp.uint32(1 << int(bit)))
-    # clear the slot's bit everywhere (fact replaced), then set at origin
+    # clear the slot's bit everywhere (fact replaced — the known bit IS the
+    # retirement; stale stamps under a cleared bit are never read), then
+    # set at origin with a fresh stamp
     known = state.known.at[:, word].set(state.known[:, word] & ~bitmask)
     known = known.at[origin, word].set(known[origin, word] | bitmask)
-    age = state.age.at[:, slot].set(255)
-    age = age.at[origin, slot].set(0)
+    stamp = state.stamp.at[origin, slot].set(round_u8(state.round))
     return state._replace(facts=facts, known=known,
-                          age=age, next_slot=state.next_slot + 1)
+                          stamp=stamp, next_slot=state.next_slot + 1)
 
 
 def inject_facts_batch(state: GossipState, cfg: GossipConfig, subjects,
@@ -244,10 +314,12 @@ def inject_facts_batch(state: GossipState, cfg: GossipConfig, subjects,
     active facts take consecutive ring slots starting at ``next_slot``.
     Inactive entries are dropped via out-of-bounds scatter indices.
 
-    Equivalent to ``M`` sequential ``inject_fact`` calls, but touches each
-    N-major plane (known/age) exactly once instead of copying the full
-    cluster state per candidate — at 1M nodes the sequential form moved
-    ~130 MB × M per phase through HBM (round-1 verdict, "weak" #7).
+    Equivalent to ``M`` sequential ``inject_fact`` calls.  With the stamp
+    plane the whole batch is two bounded scatters (known-bit set at
+    origins, stamp at origins) plus one pass over the N×W word plane for
+    retirement — the N×K plane is NOT rewritten (the round-1 sequential
+    form moved ~130 MB × M per phase through HBM; the round-2 batched form
+    still rewrote the 64 MB age plane once per phase for retirement).
     """
     n, k = cfg.n, cfg.k_facts
     m = subjects.shape[0]
@@ -288,10 +360,10 @@ def inject_facts_batch(state: GossipState, cfg: GossipConfig, subjects,
     known = known.at[worigins, jnp.where(active, words, 0)].add(
         bitmasks, mode="drop")
 
-    age = jnp.where(written[None, :], jnp.uint8(255), state.age)
-    age = age.at[worigins, wslots].set(jnp.uint8(0), mode="drop")
+    stamp = state.stamp.at[worigins, wslots].set(
+        round_u8(state.round), mode="drop")
 
-    return state._replace(facts=facts, known=known, age=age,
+    return state._replace(facts=facts, known=known, stamp=stamp,
                           next_slot=state.next_slot
                           + jnp.sum(active).astype(jnp.int32))
 
@@ -385,14 +457,14 @@ def round_step(state: GossipState, cfg: GossipConfig,
 
     if use_pallas:
         alive_u8 = state.alive[:, None].astype(jnp.uint8)
-        # phase 1: pack sending bits (read-only over the age plane; the
-        # saturating age++ is folded into the merge kernel's single write)
+        # phase 1: pack sending bits — one read-only pass over the stamp
+        # plane + known words (derived age, no tick anywhere)
         packets = round_kernels.select_packets(
-            state.age, alive_u8, cfg.transmit_limit)
+            state.stamp, state.known, alive_u8, cfg.transmit_limit,
+            state.round)
     else:
-        # 1. packet selection: facts with remaining transmit budget
-        #    (age < limit — see GossipState: budget ≡ limit - age), from
-        #    alive nodes
+        # 1. packet selection: known facts with remaining transmit budget
+        #    (derived age < limit — see GossipState), from alive nodes
         sending = sending_mask(state, cfg)
         packets = pack_bits(sending)                          # u32[N, W]
 
@@ -421,10 +493,11 @@ def round_step(state: GossipState, cfg: GossipConfig,
                                   jnp.bitwise_or, (1,))        # u32[N, W]
 
     if use_pallas:
-        # phases 4+5 fused: learn + saturating age++ + age reset (fresh
-        # budget ≡ age 0) — the round's ONLY write over the age plane
-        known, age = round_kernels.merge_incoming(
-            state.known, incoming, alive_u8, state.age)
+        # phases 4+5 fused: learn — set known bits and stamp newly learned
+        # facts with the post-increment round (first visible at age 0 next
+        # round); nothing ticks
+        known, stamp = round_kernels.merge_incoming(
+            state.known, incoming, alive_u8, state.stamp, state.round + 1)
     else:
         # 4. merge: learn facts we did not know; dead nodes learn nothing
         alive_col = state.alive[:, None]
@@ -432,15 +505,15 @@ def round_step(state: GossipState, cfg: GossipConfig,
             alive_col, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
         known = state.known | new_words
         new_mask = unpack_bits(new_words, k)                  # bool[N, K]
-        # 5. one write over the age plane: saturating age++ (the budget
-        #    decrement) folded with the age-0 reset for newly learned
-        #    facts (the fresh budget).  Selection above read the
-        #    PRE-increment age, so this is semantically the original
-        #    two-pass (tick, then reset) sequence in a single pass.
-        aged = jnp.where(state.age < 255, state.age + 1, state.age)
-        age = jnp.where(new_mask, jnp.uint8(0), aged)
+        # 5. the round's only N×K write: stamp newly learned facts with
+        #    the post-increment round — their derived age is 0 at the next
+        #    round's selection, exactly the old age-plane reset; everyone
+        #    else's age advances for free because `round` advanced.
+        stamp = jnp.where(new_mask, round_u8(state.round + 1), state.stamp)
 
-    return state._replace(known=known, age=age,
+    # amortized wraparound guard (full-plane pass 1/CLAMP_EVERY rounds)
+    stamp = clamp_stamps(known, stamp, state.round + 1, k)
+    return state._replace(known=known, stamp=stamp,
                           round=state.round + 1)
 
 
@@ -488,9 +561,9 @@ def push_round_step(state: GossipState, cfg: GossipConfig,
     alive_col = state.alive[:, None]
     new_mask = incoming & ~unpack_bits(state.known, k) & alive_col
     known = state.known | pack_bits(new_mask)
-    aged = jnp.where(state.age < 255, state.age + 1, state.age)
-    age = jnp.where(new_mask, jnp.uint8(0), aged)
-    return state._replace(known=known, age=age,
+    stamp = jnp.where(new_mask, round_u8(state.round + 1), state.stamp)
+    stamp = clamp_stamps(known, stamp, state.round + 1, k)
+    return state._replace(known=known, stamp=stamp,
                           round=state.round + 1)
 
 

@@ -21,12 +21,14 @@ import jax
 import jax.numpy as jnp
 
 from serf_tpu.models.dissemination import (
+    AGE_PIN,
     GossipConfig,
     GossipState,
     K_ALIVE,
     K_DEAD,
     K_SUSPECT,
     inject_facts_batch,
+    mod_age,
     pick_bounded,
     rolled_rows,
     round_step,
@@ -52,12 +54,12 @@ class FailureConfig:
         if self.probe_schedule not in ("random", "round_robin"):
             raise ValueError(
                 f"unknown probe_schedule {self.probe_schedule!r}")
-        # knowledge age is a saturating uint8; 255 is the never-known
-        # sentinel, so windows beyond 254 rounds are unrepresentable
-        if not (0 < self.suspicion_rounds <= 254):
+        # knowledge ages derive from mod-256 learn-round stamps pinned at
+        # AGE_PIN, so windows beyond the pin are unrepresentable
+        if not (0 < self.suspicion_rounds <= AGE_PIN):
             raise ValueError(
-                f"suspicion_rounds must be in [1, 254] (u8 age plane), "
-                f"got {self.suspicion_rounds}")
+                f"suspicion_rounds must be in [1, {AGE_PIN}] (stamp age "
+                f"pin), got {self.suspicion_rounds}")
 
 
 def rotation_offset(round_, n: int) -> jnp.ndarray:
@@ -211,7 +213,9 @@ def declare_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
     n, k = cfg.n, cfg.k_facts
     known = unpack_bits(state.known, k)
     suspect = _facts_about(state, (K_SUSPECT,))
-    aged = state.age >= fcfg.suspicion_rounds
+    # mod_age is garbage where the known bit is clear; `expired` below
+    # ANDs with `known`, which gates it
+    aged = mod_age(state) >= fcfg.suspicion_rounds
     # a refutation is an alive fact about the same subject with strictly
     # higher incarnation present in the table
     refuted = jnp.zeros((k,), bool)
@@ -272,7 +276,7 @@ def believed_dead(state: GossipState, cfg: GossipConfig,
     known = unpack_bits(state.known, k)
     dead_fact = _facts_about(state, (K_DEAD,))
     aged_suspect = _facts_about(state, (K_SUSPECT,))
-    aged = state.age >= fcfg.suspicion_rounds
+    aged = mod_age(state) >= fcfg.suspicion_rounds  # gated by `known` below
     evidence = known & (dead_fact[None, :] | (aged_suspect[None, :] & aged))
     # refutation: knower also knows an alive fact about the same subject with
     # strictly higher incarnation

@@ -2,20 +2,21 @@
 
 The round kernel (serf_tpu/models/dissemination.py) has three phases:
 
-1. packet selection: pack ``age < transmit_limit & alive`` into uint32
-   words (a fact's remaining transmit budget is derived from its knowledge
-   age — see ``GossipState``) and tick the saturating age,
+1. packet selection: pack ``known & (derived age < transmit_limit) &
+   alive`` into uint32 words (a fact's age derives from its learn-round
+   stamp — see ``GossipState``; nothing ticks),
 2. pull-exchange: peer read + OR-reduce (left to XLA — rolls/gathers are
    already bandwidth-optimal and fuse with the RNG),
-3. merge: learn new facts (bit ops over N×W) and reset knowledge ages
-   (N×K) — age 0 is a fresh budget.
+3. merge: learn new facts (bit ops over N×W) and stamp them with the
+   post-increment round (N×K) — a fresh stamp is a fresh budget.
 
-Phases 1 and 3 each touch the N×K uint8 age plane plus the N×W word
+Phases 1 and 3 each touch the N×K uint8 stamp plane plus the N×W word
 plane; under plain XLA they materialize several N×K intermediates (the
-sending mask, the unpacked new-fact mask).  These kernels fuse each phase
-into a single pass: one read and one write per array, everything else in
-VMEM registers.  The XLA path in ``dissemination.py`` remains the semantic
-oracle; parity is pinned by tests (interpret mode on CPU, compiled on TPU).
+sending mask, the unpacked known/new-fact masks).  These kernels fuse each
+phase into a single pass: one read and one write per array, everything
+else in VMEM registers.  The XLA path in ``dissemination.py`` remains the
+semantic oracle; parity is pinned by tests (interpret mode on CPU,
+compiled on TPU).
 
 Layout notes (pallas_guide.md): blocks are (BLOCK_N, K) uint8 / (BLOCK_N, W)
 uint32 in VMEM; scalars ride SMEM as (1, 1); iota is 2-D broadcasted_iota;
@@ -49,49 +50,76 @@ def pallas_ok(n: int, k_facts: int) -> bool:
     return _block_for(n) > 0 and k_facts % 32 == 0
 
 
+def _unpack_words(words: jnp.ndarray, k: int) -> jnp.ndarray:
+    """(B, W) u32 -> (B, K) bool via static repeat + per-lane shift (no
+    gathers; pltpu.repeat tiles, so repeat a 1-wide slice per word)."""
+    w = words.shape[1]
+    groups = [pltpu.repeat(words[:, wi:wi + 1], 32, axis=1)
+              for wi in range(w)]
+    repeated = jnp.concatenate(groups, axis=1)                 # (B, K)
+    shifts = (jax.lax.broadcasted_iota(jnp.uint32, (1, k), 1) % 32)
+    return ((repeated >> shifts) & 1).astype(bool)
+
+
+def _pack_bits(mask: jnp.ndarray, k: int) -> jnp.ndarray:
+    """(B, K) bool -> (B, W) u32.  Mosaic has no unsigned reductions; sum
+    in int32 and bitcast.  Each weight 1<<j appears at most once per word,
+    so the signed sum is any 32-bit pattern reinterpreted — always
+    representable, never overflows."""
+    w = k // 32
+    bits = mask.astype(jnp.int32)
+    weights = (jnp.int32(1) << (
+        jax.lax.broadcasted_iota(jnp.int32, (1, k), 1) % 32))
+    weighted = bits * weights                      # (B, K)
+    words = []
+    for wi in range(w):
+        words.append(jnp.sum(weighted[:, wi * 32:(wi + 1) * 32], axis=1,
+                             keepdims=True, dtype=jnp.int32))
+    return jax.lax.bitcast_convert_type(
+        jnp.concatenate(words, axis=1), jnp.uint32)
+
+
 # ---------------------------------------------------------------------------
 # phase 1: packet selection
 # ---------------------------------------------------------------------------
 
 
-def _select_kernel(limit_ref, age_ref, alive_ref, packets_ref):
-    age = age_ref[:]                               # (B, K) u8
+def _select_kernel(limit_ref, round_ref, stamp_ref, known_ref, alive_ref,
+                   packets_ref):
+    stamp = stamp_ref[:]                           # (B, K) u8
+    known = known_ref[:]                           # (B, W) u32
     alive = alive_ref[:]                           # (B, 1) u8
-    k = age.shape[1]
-    w = k // 32
-    limit = limit_ref[0, 0].astype(jnp.uint8)
-    sending = (age < limit) & (alive > 0)          # (B, K) bool
-    # Mosaic has no unsigned reductions; sum in int32 and bitcast.  Each
-    # weight 1<<j appears at most once per word, so the signed sum is any
-    # 32-bit pattern reinterpreted — always representable, never overflows.
-    bits = sending.astype(jnp.int32)
-    weights = (jnp.int32(1) << (
-        jax.lax.broadcasted_iota(jnp.int32, (1, k), 1) % 32))
-    weighted = bits * weights                      # (B, K)
-    # sum each 32-lane group into one word
-    words = []
-    for wi in range(w):
-        words.append(jnp.sum(weighted[:, wi * 32:(wi + 1) * 32], axis=1,
-                             keepdims=True, dtype=jnp.int32))
-    packets_ref[:] = jax.lax.bitcast_convert_type(
-        jnp.concatenate(words, axis=1), jnp.uint32)
+    k = stamp.shape[1]
+    limit = limit_ref[0, 0]                        # i32
+    rnd = round_ref[0, 0]                          # i32
+    # derived age in i32 (mod-256 wrap): valid only where the known bit is
+    # set — the AND below gates it
+    age = (rnd - stamp.astype(jnp.int32)) & 0xFF   # (B, K)
+    known_bits = _unpack_words(known, k)           # (B, K) bool
+    sending = known_bits & (age < limit) & (alive > 0)
+    packets_ref[:] = _pack_bits(sending, k)
 
 
-def select_packets(age: jnp.ndarray, alive_u8: jnp.ndarray, limit: int
+def select_packets(stamp: jnp.ndarray, known: jnp.ndarray,
+                   alive_u8: jnp.ndarray, limit: int, round_
                    ) -> jnp.ndarray:
-    """packets u32[N,W]: one read-only pass over the age plane (the
-    saturating age++ lives in the merge kernel's single write)."""
-    n, k = age.shape
+    """packets u32[N,W]: one read-only pass over the stamp plane + known
+    words (ages derive from stamps; nothing is ticked anywhere)."""
+    n, k = stamp.shape
     w = k // 32
     BLOCK_N = _block_for(n)
     grid = (n // BLOCK_N,)
     limit_arr = jnp.asarray(limit, jnp.int32).reshape(1, 1)
+    round_arr = (jnp.asarray(round_, jnp.int32) & 0xFF).reshape(1, 1)
     return pl.pallas_call(
         _select_kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((BLOCK_N, 1), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
@@ -100,7 +128,7 @@ def select_packets(age: jnp.ndarray, alive_u8: jnp.ndarray, limit: int
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((n, w), jnp.uint32),
         interpret=_interpret(),
-    )(limit_arr, age, alive_u8)
+    )(limit_arr, round_arr, stamp, known, alive_u8)
 
 
 # ---------------------------------------------------------------------------
@@ -108,43 +136,37 @@ def select_packets(age: jnp.ndarray, alive_u8: jnp.ndarray, limit: int
 # ---------------------------------------------------------------------------
 
 
-def _merge_kernel(known_ref, incoming_ref, alive_ref, age_ref,
-                  known_out_ref, age_out_ref):
+def _merge_kernel(round_ref, known_ref, incoming_ref, alive_ref, stamp_ref,
+                  known_out_ref, stamp_out_ref):
     known = known_ref[:]                           # (B, W) u32
     incoming = incoming_ref[:]                     # (B, W) u32
     alive = alive_ref[:]                           # (B, 1) u8
-    age = age_ref[:]                               # (B, K) u8
-    k = age.shape[1]
+    stamp = stamp_ref[:]                           # (B, K) u8
+    k = stamp.shape[1]
     alive_words = jnp.where(alive > 0, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
     new_words = incoming & ~known & alive_words    # (B, W)
     known_out_ref[:] = known | new_words
-    # unpack: column k must read word k//32 — broadcast each single word
-    # column to 32 lanes (pltpu.repeat tiles, so repeat a 1-wide slice),
-    # concat the groups, then shift by k%32
-    w = new_words.shape[1]
-    groups = [pltpu.repeat(new_words[:, wi:wi + 1], 32, axis=1)
-              for wi in range(w)]
-    repeated = jnp.concatenate(groups, axis=1)                 # (B, K)
-    shifts = (jax.lax.broadcasted_iota(jnp.uint32, (1, k), 1) % 32)
-    new_mask = ((repeated >> shifts) & 1).astype(bool)
-    aged = jnp.where(age < 255, age + 1, age)      # saturating age++
-    age_out_ref[:] = jnp.where(new_mask, jnp.uint8(0), aged)
+    new_mask = _unpack_words(new_words, k)         # (B, K) bool
+    r8 = round_ref[0, 0].astype(jnp.uint8)
+    stamp_out_ref[:] = jnp.where(new_mask, r8, stamp)
 
 
 def merge_incoming(known: jnp.ndarray, incoming: jnp.ndarray,
-                   alive_u8: jnp.ndarray, age: jnp.ndarray
+                   alive_u8: jnp.ndarray, stamp: jnp.ndarray, next_round
                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(known', age') in one fused pass: learn + saturating age++ + age-0
-    reset for newly learned facts (age 0 = fresh transmit budget).  Takes
-    the PRE-increment age (selection's view)."""
-    n, k = age.shape
+    """(known', stamp') in one fused pass: learn new facts and stamp them
+    with ``next_round`` (the post-increment round — first visible at
+    derived age 0 in the next round's selection)."""
+    n, k = stamp.shape
     w = k // 32
     BLOCK_N = _block_for(n)
     grid = (n // BLOCK_N,)
+    round_arr = (jnp.asarray(next_round, jnp.int32) & 0xFF).reshape(1, 1)
     return pl.pallas_call(
         _merge_kernel,
         grid=grid,
         in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
@@ -165,4 +187,4 @@ def merge_incoming(known: jnp.ndarray, incoming: jnp.ndarray,
             jax.ShapeDtypeStruct((n, k), jnp.uint8),
         ],
         interpret=_interpret(),
-    )(known, incoming, alive_u8, age)
+    )(round_arr, known, incoming, alive_u8, stamp)

@@ -33,7 +33,9 @@ from jax.sharding import PartitionSpec as P
 from serf_tpu.models.dissemination import (
     GossipConfig,
     GossipState,
+    clamp_stamps,
     pack_bits,
+    round_u8,
     sending_mask,
     unpack_bits,
 )
@@ -96,7 +98,6 @@ def round_step_ring(state: GossipState, cfg: GossipConfig, key: jax.Array,
     # phases 1+2 exactly as round_step (elementwise; GSPMD shards freely)
     sending = sending_mask(state, cfg)
     packets = pack_bits(sending)                              # u32[N, W]
-    aged = jnp.where(state.age < 255, state.age + 1, state.age)
 
     srcs = jax.random.randint(key, (n, cfg.fanout), 0, n)     # i32[N, F]
     if group is not None:
@@ -122,6 +123,7 @@ def round_step_ring(state: GossipState, cfg: GossipConfig, key: jax.Array,
         alive_col, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
     known = state.known | new_words
     new_mask = unpack_bits(new_words, k)
-    age = jnp.where(new_mask, jnp.uint8(0), aged)
-    return state._replace(known=known, age=age,
+    stamp = jnp.where(new_mask, round_u8(state.round + 1), state.stamp)
+    stamp = clamp_stamps(known, stamp, state.round + 1, k)
+    return state._replace(known=known, stamp=stamp,
                           round=state.round + 1)

@@ -280,7 +280,7 @@ def test_sharded_parity_8_devices():
     s8 = run8(sharded, key=jax.random.key(2), num_rounds=30)
     s1 = run1(state, key=jax.random.key(2), num_rounds=30)
     assert bool(jnp.all(s1.gossip.known == s8.gossip.known))
-    assert bool(jnp.all(s1.gossip.age == s8.gossip.age))
+    assert bool(jnp.all(s1.gossip.stamp == s8.gossip.stamp))
     assert bool(jnp.allclose(s1.vivaldi.vec, s8.vivaldi.vec, atol=1e-6))
 
 
@@ -385,10 +385,12 @@ def test_composed_views_none_stays_none():
 
 
 def test_failure_config_rejects_oversized_suspicion_window():
-    """The u8 age plane caps representable windows at 254 rounds."""
+    """Derived ages are pinned at AGE_PIN; windows beyond it are
+    unrepresentable."""
+    from serf_tpu.models.dissemination import AGE_PIN
     with pytest.raises(ValueError):
-        FailureConfig(suspicion_rounds=300)
-    FailureConfig(suspicion_rounds=254)  # boundary ok
+        FailureConfig(suspicion_rounds=AGE_PIN + 1)
+    FailureConfig(suspicion_rounds=AGE_PIN)  # boundary ok
 
 
 def test_hybrid_multihost_mesh_runs():
@@ -498,13 +500,14 @@ def test_inject_facts_batch_jaxpr_has_no_per_candidate_state_copies():
     jaxpr = jax.make_jaxpr(f)(state)
     text = str(jaxpr)
     # count full-plane selects — jaxpr renders them as e.g.
-    # "c:u8[256,64] = select_n ...".  One for the age plane (plus
-    # incidental known-plane ops) is fine; one-per-candidate (8+) is the
-    # regression this guards against.
+    # "c:u8[256,64] = select_n ...".  With the stamp plane, injection needs
+    # NO full-plane select at all (retirement is the known-bit clear; the
+    # stamp write is a bounded scatter); a couple of incidental word-plane
+    # ops are fine; one-per-candidate (8+) is the regression this guards.
     import re
     full_plane = re.findall(r"\[256,64\] = select_n|\[256,2\] = select_n", text)
-    assert 1 <= len(full_plane) <= 4, \
-        f"expected 1-4 full-plane select_n ops, found {len(full_plane)}"
+    assert len(full_plane) <= 4, \
+        f"expected <=4 full-plane select_n ops, found {len(full_plane)}"
 
 
 def test_indirect_probes_suppress_false_suspicion():
@@ -570,7 +573,10 @@ def test_declare_round_attributes_declarer_per_subject():
     s = inject_fact(s, cfg, subject=11, kind=K_SUSPECT, incarnation=1,
                     ltime=1, origin=30)
     # age both past the suspicion window at their origins only
-    s = s._replace(age=s.age.at[20, 0].set(10).at[30, 1].set(10),
+    # back-date the learn stamps so the derived ages are 10
+    from serf_tpu.models.dissemination import round_u8
+    aged = round_u8(s.round) - jnp.uint8(10)
+    s = s._replace(stamp=s.stamp.at[20, 0].set(aged).at[30, 1].set(aged),
                    alive=s.alive.at[10].set(False).at[11].set(False))
     out = declare_round(s, cfg, fcfg, jax.random.key(0))
     dead_slots = jnp.nonzero((out.facts.kind == K_DEAD) & out.facts.valid)[0]
@@ -804,3 +810,48 @@ def test_pick_bounded_grouped_none_and_all():
     chosen, subjects, active = pick_bounded(every, 8, jax.random.key(4))
     assert int(active.sum()) == 8
     assert len({int(s) for s in subjects}) == 8
+
+
+# ---------------------------------------------------------------------------
+# stamp-plane wraparound (the mod-256 learn-round representation)
+# ---------------------------------------------------------------------------
+
+def test_stamp_wrap_never_resends_old_facts():
+    """The mod-256 stamp wraps every 256 rounds; without the periodic
+    clamp, a fully disseminated fact's derived age would wrap back under
+    transmit_limit around round ~256+learn and the whole cluster would
+    re-send it.  The clamp must keep budgets at zero forever."""
+    from serf_tpu.models.dissemination import budgets_of
+
+    cfg = GossipConfig(n=64, k_facts=32)
+    s = inject_fact(make_state(cfg), cfg, 0, K_USER_EVENT, 0, 1, 0)
+    run = jax.jit(functools.partial(run_rounds, cfg=cfg),
+                  static_argnames=("num_rounds",))
+    s = run(s, key=jax.random.key(0), num_rounds=40)
+    assert float(coverage(s, cfg)[0]) == 1.0
+    assert int(jnp.sum(budgets_of(s, cfg))) == 0
+    # cross the wrap (and several clamp periods): budgets must stay zero
+    for stop in (230, 258, 266, 300, 520):
+        extra = stop - int(s.round)
+        s = run(s, key=jax.random.key(stop), num_rounds=extra)
+        assert int(jnp.sum(budgets_of(s, cfg))) == 0, f"resend at {stop}"
+
+
+def test_stamp_wrap_age_of_view():
+    """age_of: derived ages track rounds-since-learn, 255 where unknown,
+    and stay pinned (>= thresholds) across the wrap."""
+    from serf_tpu.models.dissemination import AGE_PIN, age_of
+
+    cfg = GossipConfig(n=64, k_facts=32)
+    s = inject_fact(make_state(cfg), cfg, 5, K_USER_EVENT, 0, 1, origin=5)
+    ages = age_of(s, cfg)
+    assert int(ages[5, 0]) == 0          # origin learned now
+    assert int(ages[6, 0]) == 255        # everyone else unknown
+    run = jax.jit(functools.partial(run_rounds, cfg=cfg),
+                  static_argnames=("num_rounds",))
+    s2 = run(s, key=jax.random.key(1), num_rounds=7)
+    assert int(age_of(s2, cfg)[5, 0]) == 7
+    # far past the wrap the origin's age reads pinned-high, never young
+    s3 = run(s2, key=jax.random.key(2), num_rounds=600)
+    a = int(age_of(s3, cfg)[5, 0])
+    assert AGE_PIN - 32 <= a <= AGE_PIN + 32 and a >= cfg.transmit_limit

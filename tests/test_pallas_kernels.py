@@ -22,21 +22,23 @@ def _rand_state(cfg, key):
     k2, k3, k4 = jax.random.split(key, 3)
     s = make_state(cfg)
     known = jax.random.bits(k2, (cfg.n, cfg.words), jnp.uint32)
-    age = jax.random.randint(k3, (cfg.n, cfg.k_facts), 0, 256).astype(jnp.uint8)
+    # random stamps spanning the full wrap range, incl. values "newer"
+    # than the round (garbage under cleared known bits is legal)
+    stamp = jax.random.randint(k3, (cfg.n, cfg.k_facts), 0, 256
+                               ).astype(jnp.uint8)
     alive = jax.random.bernoulli(k4, 0.9, (cfg.n,))
-    return s._replace(known=known, age=age, alive=alive,
+    return s._replace(known=known, stamp=stamp, alive=alive,
                       round=jnp.asarray(7, jnp.int32))
 
 
 def test_select_packets_matches_oracle():
     cfg = GossipConfig(n=512, k_facts=64, use_pallas=True)
     s = _rand_state(cfg, jax.random.key(0))
-    from serf_tpu.models.dissemination import pack_bits
-    limit = cfg.transmit_limit
-    sending = (s.age < jnp.uint8(limit)) & s.alive[:, None]
-    want_packets = pack_bits(sending)
+    from serf_tpu.models.dissemination import pack_bits, sending_mask
+    want_packets = pack_bits(sending_mask(s, cfg))
     packets = round_kernels.select_packets(
-        s.age, s.alive[:, None].astype(jnp.uint8), limit)
+        s.stamp, s.known, s.alive[:, None].astype(jnp.uint8),
+        cfg.transmit_limit, s.round)
     assert bool(jnp.all(packets == want_packets))
 
 

@@ -43,7 +43,7 @@ def test_ring_round_bit_identical_to_all_gather():
         key, k2 = jax.random.split(key)
         a = ring(a, key=k2)
         b = ref(b, key=k2)
-    for name in ("known", "age", "round"):
+    for name in ("known", "stamp", "round"):
         assert bool(jnp.all(getattr(a, name) == getattr(b, name))), name
 
 

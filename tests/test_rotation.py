@@ -219,5 +219,5 @@ def test_rotation_sharded_parity_8_devices():
     s8 = run8(sharded, key=jax.random.key(2), num_rounds=30)
     s1 = run1(state, key=jax.random.key(2), num_rounds=30)
     assert bool(jnp.all(s1.gossip.known == s8.gossip.known))
-    assert bool(jnp.all(s1.gossip.age == s8.gossip.age))
+    assert bool(jnp.all(s1.gossip.stamp == s8.gossip.stamp))
     assert bool(jnp.allclose(s1.vivaldi.vec, s8.vivaldi.vec, atol=1e-6))

@@ -88,7 +88,7 @@ def main() -> int:
             a = step_x(a, key=k2)
             b = step_p(b, key=k2)
         jax.block_until_ready((a, b))
-        for name in ("known", "age"):
+        for name in ("known", "stamp"):
             if not bool(jnp.all(getattr(a, name) == getattr(b, name))):
                 equal = False
                 record("pallas_parity", ok=False, mismatch=name)
