@@ -1119,6 +1119,7 @@ class Serf:
 
     async def _reaper(self) -> None:
         zombie_since: Dict[str, float] = {}
+        leaving_since: Dict[str, float] = {}
         while not self._shutdown_event.is_set():
             await asyncio.sleep(self.opts.reap_interval)
             try:
@@ -1128,6 +1129,7 @@ class Serf:
                 self._reap(self._left, now, self.opts.tombstone_timeout)
                 reap_intents(self._recent_intents, now, self.opts.recent_intent_timeout)
                 self._sweep_zombies(zombie_since, now)
+                self._sweep_dangling_leaving(leaving_since, now)
             except Exception:  # noqa: BLE001
                 log.exception("reaper tick failed")
 
@@ -1179,6 +1181,56 @@ class Serf:
         for node_id in list(zombie_since):
             if node_id not in current:
                 zombie_since.pop(node_id, None)
+
+    def _sweep_dangling_leaving(self, leaving_since: Dict[str, float],
+                                now: float) -> None:
+        """Restore LEAVING members the SWIM layer still probes ALIVE long
+        past the time a genuine leave needs to complete.
+
+        Root cause this repairs (found by soak seed 2 under load): an
+        equal-Lamport-time join/leave race.  A rejoiner's fresh clock can
+        collide with its own old leave's ltime — the push/pull merge
+        witnesses ``pp.ltime - 1`` (reference-faithful, Go serf does the
+        same), so ``clock.increment()`` for the rejoin broadcast can
+        reproduce exactly the leave's ltime.  Both intent handlers ignore
+        ``ltime <= status_time``, so at EQUAL ltimes whichever intent a
+        node happened to apply first wins *at that node*, permanently:
+        nodes that saw join-then-leave hold ALIVE(t), nodes that saw
+        leave-then-join hold LEAVING(t), and no later message can flip
+        either (the reference has the same non-confluence and leans on
+        snapshot clock continuity to avoid the collision).
+
+        A genuinely leaving node completes ``memberlist.leave`` within
+        ``broadcast_timeout + leave_propagate_delay``, after which
+        notify_leave moves LEAVING→LEFT.  A member still SWIM-probed
+        ALIVE well past that window is the race, not a leave — the
+        failure detector's judgment wins (the same principle as the
+        zombie sweep, inverted).  Lamport state is left untouched, so a
+        genuinely newer leave intent still applies normally.
+        """
+        grace = 2 * (self.opts.broadcast_timeout
+                     + self.opts.leave_propagate_delay)
+        current: set = set()
+        for node_id, ms in self._members.items():
+            if node_id == self.local_id:
+                continue
+            if ms.member.status != MemberStatus.LEAVING:
+                continue
+            ns = self.memberlist.node_state(node_id)
+            if ns is None or ns.state != SwimState.ALIVE:
+                continue
+            current.add(node_id)
+            first = leaving_since.setdefault(node_id, now)
+            if now - first >= grace:
+                log.warning("restoring dangling LEAVING member %s to ALIVE "
+                            "(memberlist-alive %.1fs past the leave window)",
+                            node_id, now - first)
+                ms.member = ms.member.with_status(MemberStatus.ALIVE)
+                metrics.incr("serf.member.unleave", 1, self._labels)
+                current.discard(node_id)   # timer restarts if it re-enters
+        for node_id in list(leaving_since):
+            if node_id not in current:
+                leaving_since.pop(node_id, None)
 
     def _reap(self, lst: List[MemberState], now: float, timeout: float,
               use_reconnect_override: bool = False) -> None:

@@ -2,15 +2,73 @@
 
 Reference: serf-core/src/options.rs:495-530 (serf knobs) and the memberlist
 tunables serf's tests exercise (serf-core/src/serf/base/tests.rs:25-39).
-Durations are seconds (float) instead of the reference's humantime strings.
+Durations are seconds (float) in code; the serde layer (``Options.to_json/
+from_json/to_toml/from_toml``) reads and writes humantime strings
+("24h", "500ms", "1h30m") exactly like the reference's serde feature
+(options.rs:55, 567-590).
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
-from typing import Dict, Optional
+import re
+from dataclasses import dataclass, field, fields, replace
+from typing import Any, Dict, Optional
 
 from serf_tpu.types.tags import Tags
+
+# ---------------------------------------------------------------------------
+# humantime durations (reference options.rs:55 `serde(with = humantime)`)
+# ---------------------------------------------------------------------------
+
+_UNIT_SECONDS = {
+    "ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 1e-3,
+    "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0,
+}
+_DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)\s*(ns|us|µs|ms|s|m|h|d)")
+
+
+def parse_duration(value) -> float:
+    """Humantime-style duration → seconds.  Accepts plain numbers
+    (seconds) or strings like "500ms", "24h", "1h30m", "2.5s"."""
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        if value < 0:
+            raise ValueError(f"negative duration {value!r}")
+        return float(value)
+    if not isinstance(value, str):
+        raise ValueError(f"cannot parse duration from {value!r}")
+    s = value.strip()
+    if not s:
+        raise ValueError("empty duration")
+    try:
+        return parse_duration(float(s))      # bare "5" / "0.25" = seconds
+    except ValueError:
+        pass
+    pos, total = 0, 0.0
+    for m in _DURATION_RE.finditer(s):
+        if m.start() != pos:
+            break
+        total += float(m.group(1)) * _UNIT_SECONDS[m.group(2)]
+        pos = m.end()
+    if pos != len(s):
+        raise ValueError(f"invalid duration {value!r}")
+    return total
+
+
+def format_duration(seconds: float) -> str:
+    """Seconds → compact humantime string ("24h", "1h30m", "500ms")."""
+    if seconds < 0:
+        raise ValueError(f"negative duration {seconds!r}")
+    if seconds == 0:
+        return "0s"
+    ns = round(seconds * 1e9)
+    parts = []
+    for unit, mult in (("d", 86400_000_000_000), ("h", 3600_000_000_000),
+                       ("m", 60_000_000_000), ("s", 1_000_000_000),
+                       ("ms", 1_000_000), ("us", 1_000), ("ns", 1)):
+        q, ns = divmod(ns, mult)
+        if q:
+            parts.append(f"{q}{unit}")
+    return "".join(parts) or "0s"
 
 # Hard caps (reference serf-core/src/serf.rs:40-44)
 USER_EVENT_SIZE_LIMIT = 9 * 1024     # 9 KiB hard cap on encoded user events
@@ -156,3 +214,123 @@ class Options:
         )
         defaults.update(kw)
         return cls(**defaults)
+
+    # -- serde (reference options.rs:55, 567-590: serde + humantime) -------
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Plain-data form: durations as humantime strings, tags/labels as
+        string maps.  Round-trips through ``from_dict``."""
+        out: Dict[str, Any] = {}
+        for f in fields(self):
+            v = getattr(self, f.name)
+            if f.name == "memberlist":
+                out[f.name] = _ml_to_dict(v)
+            elif f.name == "tags":
+                out[f.name] = dict(v)
+            elif f.name in _OPTIONS_DURATIONS:
+                out[f.name] = format_duration(v)
+            else:
+                out[f.name] = v
+        return out
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Options":
+        """Inverse of ``to_dict``; duration fields also accept plain
+        numbers (seconds).  Unknown keys fail loudly."""
+        kw: Dict[str, Any] = {}
+        known = {f.name for f in fields(cls)}
+        unknown = set(data) - known
+        if unknown:
+            raise ValueError(f"unknown Options keys: {sorted(unknown)}")
+        for name, v in data.items():
+            if name == "memberlist":
+                kw[name] = _ml_from_dict(v)
+            elif name == "tags":
+                kw[name] = Tags(**v) if isinstance(v, dict) else v
+            elif name in _OPTIONS_DURATIONS:
+                kw[name] = parse_duration(v)
+            else:
+                kw[name] = v
+        return cls(**kw)
+
+    def to_json(self, **json_kw) -> str:
+        import json
+        json_kw.setdefault("indent", 2)
+        return json.dumps(self.to_dict(), **json_kw)
+
+    @classmethod
+    def from_json(cls, text: str) -> "Options":
+        import json
+        return cls.from_dict(json.loads(text))
+
+    def to_toml(self) -> str:
+        """Minimal TOML emitter for the two-level Options shape (stdlib has
+        a TOML reader, ``tomllib``, but no writer)."""
+        top = self.to_dict()
+        ml = top.pop("memberlist")
+        tags = top.pop("tags")
+        lines = [_toml_kv(k, v) for k, v in top.items() if v is not None]
+        if tags:
+            lines += ["", "[tags]"] + [_toml_kv(k, v) for k, v in tags.items()]
+        lines += ["", "[memberlist]"]
+        labels = ml.pop("metric_labels", {})
+        lines += [_toml_kv(k, v) for k, v in ml.items() if v is not None]
+        if labels:
+            lines += ["", "[memberlist.metric_labels]"]
+            lines += [_toml_kv(k, v) for k, v in labels.items()]
+        return "\n".join(lines) + "\n"
+
+    @classmethod
+    def from_toml(cls, text: str) -> "Options":
+        import tomllib
+        return cls.from_dict(tomllib.loads(text))
+
+
+#: fields (de)serialized as humantime duration strings
+_OPTIONS_DURATIONS = frozenset({
+    "broadcast_timeout", "leave_propagate_delay", "coalesce_period",
+    "quiescent_period", "user_coalesce_period", "user_quiescent_period",
+    "reap_interval", "reconnect_interval", "reconnect_timeout",
+    "tombstone_timeout", "flap_timeout", "queue_check_interval",
+    "recent_intent_timeout",
+})
+_ML_DURATIONS = frozenset({
+    "gossip_interval", "probe_interval", "probe_timeout",
+    "push_pull_interval", "timeout",
+})
+
+
+def _ml_to_dict(ml: MemberlistOptions) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for f in fields(ml):
+        v = getattr(ml, f.name)
+        if f.name in _ML_DURATIONS:
+            out[f.name] = format_duration(v)
+        elif f.name == "metric_labels":
+            out[f.name] = dict(v)
+        else:
+            out[f.name] = v
+    return out
+
+
+def _ml_from_dict(data) -> MemberlistOptions:
+    if isinstance(data, MemberlistOptions):
+        return data
+    known = {f.name for f in fields(MemberlistOptions)}
+    unknown = set(data) - known
+    if unknown:
+        raise ValueError(f"unknown MemberlistOptions keys: {sorted(unknown)}")
+    kw = {name: parse_duration(v) if name in _ML_DURATIONS else v
+          for name, v in data.items()}
+    return MemberlistOptions(**kw)
+
+
+def _toml_kv(key: str, v: Any) -> str:
+    if isinstance(v, bool):
+        return f"{key} = {'true' if v else 'false'}"
+    if isinstance(v, (int, float)):
+        return f"{key} = {v}"
+    if isinstance(v, str):
+        escaped = v.replace("\\", "\\\\").replace('"', '\\"')
+        return f'{key} = "{escaped}"'
+    raise ValueError(f"cannot TOML-encode {key}={v!r}")

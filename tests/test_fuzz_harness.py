@@ -39,3 +39,12 @@ def test_arbitrary_messages_cover_every_envelope_type():
         seen.add(raw[0])
         assert decode_message(raw) is not None
     assert seen == set(range(1, 11)), f"envelope tags not all covered: {seen}"
+
+
+def test_dstream_segment_fuzz_slice():
+    """CI slice of the dstream segment fuzzer (untrusted-UDP parser)."""
+    from fuzz_dstream import run as run_dstream
+
+    stats = run_dstream(seed=1, seconds=3.0)
+    assert stats["cases"] > 2000, f"fuzzer too slow: {stats['cases']}"
+    assert stats["violations"] == 0, stats["examples"]

@@ -1,0 +1,111 @@
+"""Options serde: humantime durations + JSON/TOML round-trips (the
+reference's serde feature, serf-core/src/options.rs:55, 567-590)."""
+
+import dataclasses
+
+import pytest
+
+from serf_tpu.options import (
+    MemberlistOptions,
+    Options,
+    format_duration,
+    parse_duration,
+)
+from serf_tpu.types.tags import Tags
+
+
+@pytest.mark.parametrize("text,want", [
+    ("500ms", 0.5),
+    ("24h", 86400.0),
+    ("1h30m", 5400.0),
+    ("2.5s", 2.5),
+    ("1d", 86400.0),
+    ("250us", 0.00025),
+    ("0s", 0.0),
+    ("5", 5.0),          # bare number = seconds
+    ("0.25", 0.25),
+    (3.0, 3.0),          # numbers pass through
+    (0, 0.0),
+])
+def test_parse_duration_vectors(text, want):
+    assert parse_duration(text) == pytest.approx(want)
+
+
+@pytest.mark.parametrize("bad", ["", "5x", "h", "1h30", "-5s", -1, None])
+def test_parse_duration_rejects_garbage(bad):
+    with pytest.raises(ValueError):
+        parse_duration(bad)
+
+
+@pytest.mark.parametrize("seconds", [0.0, 0.5, 2.5, 60.0, 5400.0, 86400.0,
+                                     0.025, 0.00025, 90061.5])
+def test_format_parse_round_trip(seconds):
+    assert parse_duration(format_duration(seconds)) == pytest.approx(seconds)
+
+
+def test_format_duration_is_humantime_style():
+    assert format_duration(86400.0) == "1d"
+    assert format_duration(5400.0) == "1h30m"
+    assert format_duration(0.5) == "500ms"
+    assert format_duration(0.0) == "0s"
+
+
+def _sample_options():
+    return Options(
+        reconnect_timeout=3600.0,
+        tombstone_timeout=5400.0,
+        max_user_event_size=777,
+        rejoin_after_leave=True,
+        snapshot_path="/tmp/snap.db",
+        tags=Tags(role="web", dc="eu-1"),
+        memberlist=dataclasses.replace(
+            MemberlistOptions.lan(),
+            gossip_interval=0.025,
+            compression="zlib",
+            checksum="crc32",
+            metric_labels={"env": "test"},
+        ),
+    )
+
+
+def test_json_round_trip():
+    opts = _sample_options()
+    back = Options.from_json(opts.to_json())
+    assert back == opts
+    # durations serialized as humantime strings, not floats
+    assert '"tombstone_timeout": "1h30m"' in opts.to_json()
+
+
+def test_toml_round_trip():
+    opts = _sample_options()
+    text = opts.to_toml()
+    assert 'tombstone_timeout = "1h30m"' in text
+    assert "[memberlist]" in text and "[tags]" in text
+    back = Options.from_toml(text)
+    assert back == opts
+
+
+def test_default_options_round_trip_both_formats():
+    opts = Options()
+    assert Options.from_json(opts.to_json()) == opts
+    assert Options.from_toml(opts.to_toml()) == opts
+
+
+def test_durations_accept_plain_seconds():
+    o = Options.from_dict({"broadcast_timeout": 2,
+                           "memberlist": {"probe_timeout": 0.25}})
+    assert o.broadcast_timeout == 2.0
+    assert o.memberlist.probe_timeout == 0.25
+
+
+def test_unknown_keys_fail_loudly():
+    with pytest.raises(ValueError, match="unknown Options keys"):
+        Options.from_dict({"broadcast_timeoutt": "5s"})
+    with pytest.raises(ValueError, match="unknown MemberlistOptions keys"):
+        Options.from_dict({"memberlist": {"gossip_intervall": "5ms"}})
+
+
+def test_loaded_options_validate_and_run():
+    """A config file's options must be usable end-to-end."""
+    o = Options.from_toml(_sample_options().to_toml())
+    o.validate()

@@ -427,3 +427,76 @@ async def test_leave_intent_avoids_infinite_rebroadcast():
         assert s._handle_node_leave_intent(LeaveMessage(21, "f")) is False
     finally:
         await s.shutdown()
+
+
+async def test_dangling_leaving_restored_by_reaper():
+    """Equal-Lamport-time join/leave race (root cause of the soak seed-2
+    flake): a rejoiner's fresh clock can collide with its old leave's
+    ltime (push/pull witnesses pp.ltime - 1, reference-faithful), so at
+    equal ltimes whichever intent a node applied FIRST wins at that node,
+    permanently — some nodes hold ALIVE(t), a minority that saw the leave
+    first holds LEAVING(t), and the <=-dedup means no message ever flips
+    them.  The reaper's dangling-LEAVING sweep must restore such members
+    to ALIVE while SWIM still probes them alive."""
+    from serf_tpu.types.messages import JoinMessage, LeaveMessage
+
+    net = LoopbackNetwork()
+    opts = Options.local(broadcast_timeout=0.3, leave_propagate_delay=0.1)
+    nodes = [await Serf.create(net.bind(f"dl{i}"), opts, f"dl-{i}")
+             for i in range(3)]
+    try:
+        for s in nodes[1:]:
+            await s.join("dl0")
+        await wait_until(lambda: all(s.num_members() == 3 for s in nodes),
+                         msg="3-node convergence")
+        s0 = nodes[0]
+        ms = s0._members["dl-2"]
+        lt = ms.status_time + 1
+        # the losing arrival order: leave(t) first ...
+        s0._handle_node_leave_intent(LeaveMessage(lt, "dl-2"),
+                                     rebroadcast=False)
+        assert s0._members["dl-2"].member.status == MemberStatus.LEAVING
+        # ... then the equal-ltime join is a no-op (the non-confluence)
+        s0._handle_node_join_intent(JoinMessage(lt, "dl-2"),
+                                    rebroadcast=False)
+        assert s0._members["dl-2"].member.status == MemberStatus.LEAVING
+        # dl-2 is still alive and SWIM-probed; the sweep must repair
+        await wait_until(
+            lambda: s0._members["dl-2"].member.status == MemberStatus.ALIVE,
+            deadline=10.0, msg="dangling LEAVING restored")
+        # lamport state untouched: a genuinely newer leave still applies
+        s0._handle_node_leave_intent(LeaveMessage(lt + 1, "dl-2"),
+                                     rebroadcast=False)
+        assert s0._members["dl-2"].member.status == MemberStatus.LEAVING
+    finally:
+        for s in nodes:
+            await s.shutdown()
+
+
+async def test_genuine_leaver_not_restored():
+    """The dangling-LEAVING sweep must not resurrect a node that is
+    actually leaving: its memberlist backing disappears within the leave
+    window, so the sweep's SWIM-alive condition fails."""
+    net = LoopbackNetwork()
+    opts = Options.local(broadcast_timeout=0.3, leave_propagate_delay=0.1)
+    nodes = [await Serf.create(net.bind(f"gl{i}"), opts, f"gl-{i}")
+             for i in range(3)]
+    try:
+        for s in nodes[1:]:
+            await s.join("gl0")
+        await wait_until(lambda: all(s.num_members() == 3 for s in nodes),
+                         msg="3-node convergence")
+        await nodes[2].leave()
+        await nodes[2].shutdown()
+        # LEFT everywhere, and it STAYS left well past the sweep grace
+        await wait_until(
+            lambda: all(s._members["gl-2"].member.status == MemberStatus.LEFT
+                        for s in nodes[:2]),
+            msg="graceful leave propagates")
+        await asyncio.sleep(1.5)   # > 2*(broadcast_timeout+propagate_delay)
+        for s in nodes[:2]:
+            assert s._members["gl-2"].member.status == MemberStatus.LEFT
+    finally:
+        for s in nodes:
+            if s.state != SerfState.SHUTDOWN:
+                await s.shutdown()

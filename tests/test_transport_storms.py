@@ -1,0 +1,251 @@
+"""Cluster storms over REAL transports: {tcp, tls, udpstream} × {drop
+storm, partition bisection} plus a mid-run key rotation over the
+datagram-stream transport.
+
+The loopback storm suite (tests/test_soak.py) pins the protocol under
+churn; these runs pin the TRANSPORTS — every stream plane the framework
+ships (the reference's NetTransport / TLS / QUIC feature split,
+serf/Cargo.toml:24-56) must carry the same cluster through loss,
+partition, and key rotation.  Loss/partition are injected at the sender
+seam (``send_packet`` for the UDP gossip plane of every transport;
+``_sendto`` additionally for dstream so stream SEGMENTS drop too —
+exercising the ARQ under cluster load, not just unit frames).
+"""
+
+import asyncio
+import dataclasses
+import random
+
+import pytest
+
+from serf_tpu.host import Serf, SerfState
+from serf_tpu.host.dstream import DatagramStreamTransport
+from serf_tpu.host.net import NetTransport, TlsNetTransport, make_tls_contexts
+from serf_tpu.options import Options
+from serf_tpu.types.member import MemberStatus
+
+from tests.test_serf import _self_signed_cert
+
+pytestmark = pytest.mark.asyncio
+
+STREAMS = ("tcp", "tls", "udpstream")
+
+
+async def _bind(stream, tmp_path, keyring=None, addr=("127.0.0.1", 0),
+                _cache={}):
+    # rejoiners rebind their OLD address: a same-id node on a new address
+    # is the name-conflict scenario (arbitrated away by majority vote),
+    # not the restart scenario the reference pins (base/tests/serf.rs:163)
+    if stream == "tcp":
+        return await NetTransport.bind(addr)
+    if stream == "udpstream":
+        return await DatagramStreamTransport.bind(addr, keyring=keyring)
+    if "tls" not in _cache:
+        _cache["tls"] = _self_signed_cert(tmp_path)
+    cert, key = _cache["tls"]
+    server_ctx, client_ctx = make_tls_contexts(cert, key)
+    return await TlsNetTransport.bind(addr, server_ctx=server_ctx,
+                                      client_ctx=client_ctx)
+
+
+def _inject_loss(t, rng, rate, blocked_ports=None):
+    """Sender-side fault injection: drop UDP packets (every transport) and
+    dstream segments; optionally blackhole a set of destination ports (the
+    partition).  Idempotent per transport (wraps once)."""
+    if getattr(t, "_storm_wrapped", False):
+        t._storm_rate = rate
+        t._storm_blocked = blocked_ports or set()
+        return
+    t._storm_wrapped = True
+    t._storm_rate = rate
+    t._storm_blocked = blocked_ports or set()
+
+    orig_send_packet = t.send_packet
+
+    async def send_packet(addr, buf):
+        if addr[1] in t._storm_blocked:
+            return
+        if rng.random() < t._storm_rate:
+            return
+        await orig_send_packet(addr, buf)
+
+    t.send_packet = send_packet
+
+    if isinstance(t, DatagramStreamTransport):
+        orig_sendto = t._sendto
+
+        def _sendto(wire, addr):
+            if addr[1] in t._storm_blocked:
+                return
+            if rng.random() < t._storm_rate:
+                return
+            orig_sendto(wire, addr)
+
+        t._sendto = _sendto
+    else:
+        orig_dial = t.dial
+
+        async def dial(addr, timeout=None):
+            if addr[1] in t._storm_blocked:
+                raise ConnectionError(f"partitioned from {addr!r}")
+            return await orig_dial(addr, timeout=timeout)
+
+        t.dial = dial
+
+
+async def _converged(nodes, live, deadline_s, label):
+    want = {nodes[i].local_id for i in live}
+    loop = asyncio.get_running_loop()
+    end = loop.time() + deadline_s
+    while loop.time() < end:
+        views = [{m.node.id for m in nodes[i].members()
+                  if m.status == MemberStatus.ALIVE} for i in live]
+        if all(v >= want for v in views):
+            return
+        await asyncio.sleep(0.05)
+    views = [{m.node.id for m in nodes[i].members()
+              if m.status == MemberStatus.ALIVE} for i in live]
+    for v in views:
+        assert v >= want, f"{label}: survivor view {v} missing {want - v}"
+
+
+@pytest.mark.parametrize("stream", STREAMS)
+async def test_drop_storm_converges(stream, tmp_path):
+    """10% sender-side loss on the gossip plane (and dstream segments)
+    through a kill/rejoin/user-event churn: survivors still converge."""
+    rng = random.Random(11)
+    n = 5
+    transports = [await _bind(stream, tmp_path) for _ in range(n)]
+    for t in transports:
+        _inject_loss(t, rng, 0.10)
+    nodes = {i: await Serf.create(transports[i], Options.local(),
+                                  f"{stream}-drop-{i}") for i in range(n)}
+    killed = set()
+    try:
+        for i in range(1, n):
+            await nodes[i].join(transports[0].local_addr)
+        for op in range(20):
+            live = [i for i in nodes if i not in killed]
+            r = rng.random()
+            if r < 0.2 and len(live) > 3:
+                v = rng.choice([i for i in live if i != 0])
+                if rng.random() < 0.5:
+                    await nodes[v].leave()
+                await nodes[v].shutdown()
+                killed.add(v)
+            elif r < 0.4 and killed:
+                b = rng.choice(sorted(killed))
+                killed.discard(b)
+                t = await _bind(stream, tmp_path,
+                                addr=transports[b].local_addr)
+                _inject_loss(t, rng, 0.10)
+                transports[b] = t
+                nodes[b] = await Serf.create(t, Options.local(),
+                                             f"{stream}-drop-{b}")
+                tgt = rng.choice([i for i in nodes
+                                  if i not in killed and i != b])
+                await nodes[b].join(transports[tgt].local_addr)
+            else:
+                await nodes[rng.choice(live)].user_event(
+                    f"ev-{op}", b"x" * rng.randint(0, 40), coalesce=False)
+            if rng.random() < 0.4:
+                await asyncio.sleep(0.02)
+        live = [i for i in nodes if i not in killed
+                and nodes[i].state == SerfState.ALIVE]
+        await _converged(nodes, live, 25.0, f"{stream} drop storm")
+    finally:
+        for s in nodes.values():
+            if s.state != SerfState.SHUTDOWN:
+                await s.shutdown()
+
+
+@pytest.mark.parametrize("stream", STREAMS)
+async def test_partition_bisection_heals(stream, tmp_path):
+    """Blackhole a 3/3 bisection mid-run (both packet and stream planes),
+    keep each side gossiping, heal, and require full re-convergence —
+    push/pull anti-entropy over the stream plane must carry the merge."""
+    rng = random.Random(12)
+    n = 6
+    transports = [await _bind(stream, tmp_path) for _ in range(n)]
+    for t in transports:
+        _inject_loss(t, rng, 0.0)
+    nodes = {i: await Serf.create(transports[i], Options.local(),
+                                  f"{stream}-part-{i}") for i in range(n)}
+    ports = [t.local_addr[1] for t in transports]
+    try:
+        for i in range(1, n):
+            await nodes[i].join(transports[0].local_addr)
+        await _converged(nodes, list(range(n)), 10.0,
+                         f"{stream} pre-partition")
+        # bisect: 0-2 | 3-5
+        for i in range(n):
+            other = set(ports[3:]) if i < 3 else set(ports[:3])
+            _inject_loss(transports[i], rng, 0.0, blocked_ports=other)
+        for op in range(8):
+            side = nodes[rng.choice(range(3))] if op % 2 else \
+                nodes[rng.choice(range(3, n))]
+            await side.user_event(f"part-{op}", b"y", coalesce=False)
+            await asyncio.sleep(0.05)
+        # heal
+        for i in range(n):
+            _inject_loss(transports[i], rng, 0.0, blocked_ports=set())
+        live = [i for i in nodes if nodes[i].state == SerfState.ALIVE]
+        await _converged(nodes, live, 30.0, f"{stream} post-heal")
+        # both sides' partition-era events eventually reached everyone:
+        # event clocks witnessed on both sides converge upward
+        assert all(nodes[i].event_clock.time() >= 8 for i in live)
+    finally:
+        for s in nodes.values():
+            if s.state != SerfState.SHUTDOWN:
+                await s.shutdown()
+
+
+async def test_key_rotation_storm_over_dstream(tmp_path):
+    """Mid-run cluster key rotation while the dstream SEGMENT plane is
+    encrypted with the same keyring: the rotation must propagate to both
+    the gossip wire and the stream segments (shared mutable keyring), and
+    a post-rotation rejoiner with the rotated ring must converge."""
+    from serf_tpu.host.keyring import SecretKeyring
+    from serf_tpu.options import MemberlistOptions
+
+    rng = random.Random(13)
+    k1, k2 = bytes(range(16)), bytes(range(16, 32))
+    n = 4
+    rings = [SecretKeyring(k1) for _ in range(n)]
+    ml = dataclasses.replace(MemberlistOptions.local(), compression="zlib")
+    opts = dataclasses.replace(Options.local(), memberlist=ml)
+    transports = [await DatagramStreamTransport.bind(("127.0.0.1", 0),
+                                                     keyring=rings[i])
+                  for i in range(n)]
+    for t in transports:
+        _inject_loss(t, rng, 0.05)
+    nodes = {i: await Serf.create(transports[i], opts, f"rot-{i}",
+                                  keyring=rings[i]) for i in range(n)}
+    try:
+        for i in range(1, n):
+            await nodes[i].join(transports[0].local_addr)
+        await _converged(nodes, list(range(n)), 10.0, "pre-rotation")
+        km = nodes[0].key_manager()
+        out = await km.install_key(k2)
+        assert out.num_err == 0, out
+        out = await km.use_key(k2)
+        assert out.num_err == 0, out
+        # kill + rejoin one node with the ROTATED ring (operator handout)
+        await nodes[3].shutdown()
+        ring = SecretKeyring(k2, keys=[k1])
+        t = await DatagramStreamTransport.bind(("127.0.0.1", 0),
+                                               keyring=ring)
+        _inject_loss(t, rng, 0.05)
+        transports[3] = t
+        nodes[3] = await Serf.create(t, opts, "rot-3", keyring=ring)
+        await nodes[3].join(transports[0].local_addr)
+        for op in range(6):
+            await nodes[op % 3].user_event(f"rot-{op}", b"z", coalesce=False)
+        live = [i for i in nodes if nodes[i].state == SerfState.ALIVE]
+        await _converged(nodes, live, 25.0, "post-rotation")
+        for i in live:
+            assert nodes[i].memberlist.keyring().primary_key() == k2
+    finally:
+        for s in nodes.values():
+            if s.state != SerfState.SHUTDOWN:
+                await s.shutdown()
