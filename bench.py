@@ -35,10 +35,15 @@ K_FACTS = 64
 ROUNDS_PER_CALL = 100
 TIMED_CALLS = 3
 TARGET_ROUNDS_PER_SEC = 10_000.0  # BASELINE.json north star (v5e-8)
-# generous: ~4 1M-node XLA compiles fit; the headline prints first anyway,
-# and killing the subprocess mid-claim is what wedges the tunnel
-TPU_TIMEOUT_S = int(os.environ.get("SERF_TPU_BENCH_TIMEOUT", "1500"))
-CPU_TIMEOUT_S = int(os.environ.get("SERF_TPU_BENCH_CPU_TIMEOUT", "900"))
+# Budget discipline (round-3 lesson: 1500+900 s exceeded the driver's own
+# timeout, which killed the orchestrator mid-fallback and recorded NOTHING
+# — rc=124 in BENCH_r03.json).  A cheap liveness probe decides TPU-vs-CPU
+# up front.  Worst case INCLUDING the 20 s SIGINT-grace each timed-out
+# child gets: (60+20) + (510+20) + (450+20) = 1080 s, inside the window
+# round 2 proved the driver allows (480 + ~400 s completed).
+PROBE_TIMEOUT_S = int(os.environ.get("SERF_TPU_BENCH_PROBE_TIMEOUT", "60"))
+TPU_TIMEOUT_S = int(os.environ.get("SERF_TPU_BENCH_TIMEOUT", "510"))
+CPU_TIMEOUT_S = int(os.environ.get("SERF_TPU_BENCH_CPU_TIMEOUT", "450"))
 
 
 def _round_scalar(state):
@@ -200,45 +205,87 @@ def main() -> None:
         pass
 
 
-def orchestrate() -> None:
-    """Run the measurement in a subprocess with a timeout; CPU fallback if
-    the TPU tunnel is wedged."""
-    me = os.path.abspath(__file__)
+def probe() -> None:
+    """Tunnel-liveness probe: tiny jit + a device->host transfer.
+
+    Exit 0 = a real (non-CPU) accelerator executed a program end-to-end;
+    exit 3 = only CPU visible; anything else / a hang = wedged tunnel.
+    Kept deliberately tiny so it finishes in seconds when healthy."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if jax.default_backend() == "cpu":
+        sys.exit(3)
+    x = jax.jit(lambda a: (a @ a.T).sum())(jnp.ones((256, 256),
+                                                    jnp.bfloat16))
+    got = float(np.asarray(x))        # host transfer = completion barrier
+    assert got == 256.0 * 256 * 256, got
+    sys.stderr.write(f"probe ok: {jax.devices()[0].device_kind}\n")
+    sys.exit(0)
+
+
+def _run_child(args, timeout_s: int, env=None):
+    """subprocess.run with SIGINT-first termination.
+
+    A SIGKILLed TPU client can leave the tunnel's allocator grant stuck
+    (observed round 2 — the wedge persisted across sessions).  On timeout
+    we SIGINT so Python unwinds and destroys the client, then escalate
+    only if the child ignores it.  Returns (returncode|None, stdout,
+    stderr); returncode None = timed out."""
+    import signal
+
+    proc = subprocess.Popen(args, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, env=env)
     try:
-        proc = subprocess.run([sys.executable, me, "--run"],
-                              capture_output=True, text=True,
-                              timeout=TPU_TIMEOUT_S)
-        sys.stderr.write(proc.stderr[-2000:] + "\n")
-        out = _last_json_line(proc.stdout)
-        if out is not None and (proc.returncode == 0
-                                or "ERROR" not in out):
-            print(out)
-            return
-    except subprocess.TimeoutExpired as e:
-        # the headline is printed+flushed before the secondary benches, so
-        # a hang in a secondary still leaves a salvageable headline
-        partial = e.stdout
-        if isinstance(partial, bytes):
-            partial = partial.decode(errors="replace")
-        out = _last_json_line(partial or "")
-        if out is not None and "ERROR" not in out:
-            sys.stderr.write("TPU bench timed out after the headline; "
-                             "keeping the measured headline\n")
-            print(out)
-            return
-        sys.stderr.write("TPU bench timed out (wedged tunnel?); "
-                         "falling back to CPU\n")
-    env = dict(os.environ, SERF_TPU_BENCH_CPU="1")
-    try:
-        proc = subprocess.run([sys.executable, me, "--run"],
-                              capture_output=True, text=True,
-                              timeout=CPU_TIMEOUT_S, env=env)
-        sys.stderr.write(proc.stderr[-2000:] + "\n")
-        out = _last_json_line(proc.stdout)
-        if proc.returncode == 0 and out is not None:
-            print(out)
-            return
+        out, err = proc.communicate(timeout=timeout_s)
+        return proc.returncode, out, err
     except subprocess.TimeoutExpired:
+        proc.send_signal(signal.SIGINT)
+        try:
+            out, err = proc.communicate(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, err = proc.communicate()
+        return None, out or "", err or ""
+
+
+def orchestrate() -> None:
+    """Probe the tunnel (~seconds, 60 s cap), then run the measurement on
+    whichever backend the probe proved; never exceed the driver window."""
+    me = os.path.abspath(__file__)
+    rc, _, perr = _run_child([sys.executable, me, "--probe"],
+                             PROBE_TIMEOUT_S)
+    sys.stderr.write(perr[-500:] + "\n")
+    tpu_alive = rc == 0
+
+    if tpu_alive:
+        rc, out_s, err_s = _run_child([sys.executable, me, "--run"],
+                                      TPU_TIMEOUT_S)
+        sys.stderr.write(err_s[-2000:] + "\n")
+        out = _last_json_line(out_s)
+        # the headline is printed+flushed before the secondary benches, so
+        # even a timeout (rc None) in a secondary leaves a salvageable line
+        if out is not None and "ERROR" not in out:
+            if rc is None:
+                sys.stderr.write("TPU bench timed out after the headline; "
+                                 "keeping the measured headline\n")
+            print(out)
+            return
+        sys.stderr.write("TPU bench produced no headline (probe had "
+                         "passed); falling back to CPU\n")
+    else:
+        sys.stderr.write("tunnel probe failed (rc=%s); CPU fallback\n" % rc)
+
+    env = dict(os.environ, SERF_TPU_BENCH_CPU="1")
+    rc, out_s, err_s = _run_child([sys.executable, me, "--run"],
+                                  CPU_TIMEOUT_S, env=env)
+    sys.stderr.write(err_s[-2000:] + "\n")
+    out = _last_json_line(out_s)
+    if out is not None and "ERROR" not in out:
+        print(out)
+        return
+    if rc is None:
         sys.stderr.write("CPU fallback bench also timed out\n")
     print(json.dumps({"metric": "ERROR: bench failed on TPU and CPU",
                       "value": 0, "unit": "rounds/sec",
@@ -255,7 +302,9 @@ def _last_json_line(stdout: str):
 
 
 if __name__ == "__main__":
-    if "--run" in sys.argv:
+    if "--probe" in sys.argv:
+        probe()
+    elif "--run" in sys.argv:
         if os.environ.get("SERF_TPU_BENCH_CPU") == "1":
             import jax
             jax.config.update("jax_platforms", "cpu")

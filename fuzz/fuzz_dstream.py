@@ -79,7 +79,8 @@ async def _fuzz(seed: int, seconds: float, cases_cap) -> dict:
     keyring = SecretKeyring(bytes(range(16)))
     stats = {"cases": 0, "violations": 0, "examples": []}
 
-    for ring in (None, keyring):
+    rings = (None, keyring)
+    for ring_idx, ring in enumerate(rings):
         t = await DatagramStreamTransport.bind(("127.0.0.1", 0), keyring=ring)
         peer = await DatagramStreamTransport.bind(("127.0.0.1", 0),
                                                   keyring=ring)
@@ -88,13 +89,25 @@ async def _fuzz(seed: int, seconds: float, cases_cap) -> dict:
         _, srv = await asyncio.wait_for(t.accept(), 5)
         cli = await dial
 
+        # each ring gets half the time budget and an even (ceil-split)
+        # share of the case budget; the cap must actually terminate the
+        # ring (not just the inner batch) so a cases-driven CI run is
+        # deterministic in size and sums to exactly cases_cap
+        if cases_cap:
+            remaining = max(0, cases_cap - stats["cases"])
+            share = -(-remaining // (len(rings) - ring_idx))
+            ring_cap = stats["cases"] + share
+        else:
+            ring_cap = None
         deadline = time.monotonic() + seconds / 2
         src = ("127.0.0.1", 54321)
         while time.monotonic() < deadline:
+            if ring_cap is not None and stats["cases"] >= ring_cap:
+                break
             for _ in range(200):
-                stats["cases"] += 1
-                if cases_cap and stats["cases"] >= cases_cap:
+                if ring_cap is not None and stats["cases"] >= ring_cap:
                     break
+                stats["cases"] += 1
                 roll = rng.random()
                 if roll < 0.3:
                     wire = os.urandom(rng.randrange(0, 200))

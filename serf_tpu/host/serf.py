@@ -621,8 +621,7 @@ class Serf:
     async def _broadcast_join(self, ltime: LamportTime) -> None:
         """(reference base.rs:364-397)"""
         msg = JoinMessage(ltime, self.local_id)
-        self._handle_node_join_intent(msg, rebroadcast=False,
-                                      self_origin=True)
+        self._handle_node_join_intent(msg, rebroadcast=False)
         self._queue(self.intent_broadcasts, encode_message(msg))
 
     async def leave(self) -> None:
@@ -877,8 +876,7 @@ class Serf:
         self._emit(MemberEvent(MemberEventType.UPDATE, (ms.member,)))
 
     def _handle_node_join_intent(self, msg: JoinMessage,
-                                 rebroadcast: bool = True,
-                                 self_origin: bool = False) -> bool:
+                                 rebroadcast: bool = True) -> bool:
         """(reference base.rs:1338-1373); returns whether to rebroadcast."""
         self.clock.witness(msg.ltime)
         ms = self._members.get(msg.id)
@@ -887,26 +885,19 @@ class Serf:
                                  msg.ltime)
         if msg.ltime <= ms.status_time:
             return False
-        if (not self_origin and msg.id == self.local_id
-                and self.state == SerfState.ALIVE):
-            # The network carries a newer story about us than we ever told:
-            # we rejoined through a stale partner, so our join broadcast
-            # used a clock that never witnessed our old leave, and some
-            # peers may hold LEAVING/LEFT at an ltime our intents cannot
-            # beat.  Re-assert aliveness with a beating ltime (the witness
-            # above already advanced the clock past msg.ltime).  Gated on
-            # ``self_origin`` so our own local apply in _broadcast_join
-            # cannot re-trigger it (that would be an intent-amplification
-            # loop).  Robustness addition beyond the reference, which only
-            # self-refutes leave intents (base.rs:1468-1480) and relies on
-            # snapshot clock continuity to avoid this corner.
-            log.warning("re-asserting aliveness over a newer join intent "
-                        "about ourselves (ltime %d > %d)",
-                        msg.ltime, ms.status_time)
-            ms.status_time = msg.ltime
-            self._spawn(self._broadcast_join(self.clock.increment()),
-                        "serf-reassert-join")
-            return False
+        # A newer join intent about ourselves needs no special handling:
+        # it is a story that we are ALIVE, which we are — adopt the ltime
+        # and move on.  (Push/pull ``status_ltimes`` carries no status, so
+        # a higher ltime about self is usually just an echo of our own
+        # state as witnessed elsewhere; broadcasting a "re-assert" here —
+        # as rounds 2-3 did — turns every such echo into clock churn and
+        # fights the dangling-LEAVING sweep over equal-ltime races.)  The
+        # genuine threats are covered elsewhere, matching the reference
+        # which only self-refutes leave intents (base.rs:1468-1480):
+        #   * a peer holding us LEFT exports us in push/pull left_members,
+        #     which arrives as a leave intent -> self-refutation above;
+        #   * a peer stuck holding us LEAVING while SWIM probes us alive
+        #     repairs ITS OWN view via _sweep_dangling_leaving.
         ms.status_time = msg.ltime
         if ms.member.status == MemberStatus.LEAVING:
             # join intent refutes an in-flight leave
@@ -1119,7 +1110,7 @@ class Serf:
 
     async def _reaper(self) -> None:
         zombie_since: Dict[str, float] = {}
-        leaving_since: Dict[str, float] = {}
+        leaving_since: Dict[str, list] = {}   # id -> [first_seen, grace_start]
         while not self._shutdown_event.is_set():
             await asyncio.sleep(self.opts.reap_interval)
             try:
@@ -1182,7 +1173,21 @@ class Serf:
             if node_id not in current:
                 zombie_since.pop(node_id, None)
 
-    def _sweep_dangling_leaving(self, leaving_since: Dict[str, float],
+    def _pending_leave_ltimes(self) -> Dict[str, LamportTime]:
+        """node id -> highest leave-intent ltime still sitting in the
+        local intent queue (decoded once per sweep; the queue is
+        depth-bounded by QueueChecker, so this scan is cheap)."""
+        pending: Dict[str, LamportTime] = {}
+        for b in self.intent_broadcasts._items:
+            try:
+                msg = decode_message(b.msg)
+            except codec.DecodeError:
+                continue
+            if isinstance(msg, LeaveMessage):
+                pending[msg.id] = max(pending.get(msg.id, 0), msg.ltime)
+        return pending
+
+    def _sweep_dangling_leaving(self, leaving_since: Dict[str, list],
                                 now: float) -> None:
         """Restore LEAVING members the SWIM layer still probes ALIVE long
         past the time a genuine leave needs to complete.
@@ -1210,6 +1215,7 @@ class Serf:
         """
         grace = 2 * (self.opts.broadcast_timeout
                      + self.opts.leave_propagate_delay)
+        pending_leaves = self._pending_leave_ltimes()
         current: set = set()
         for node_id, ms in self._members.items():
             if node_id == self.local_id:
@@ -1220,11 +1226,28 @@ class Serf:
             if ns is None or ns.state != SwimState.ALIVE:
                 continue
             current.add(node_id)
-            first = leaving_since.setdefault(node_id, now)
-            if now - first >= grace:
+            entry = leaving_since.get(node_id)
+            if entry is None:
+                entry = leaving_since[node_id] = [now, now]
+            first_seen, grace_start = entry
+            if (pending_leaves.get(node_id, -1) >= ms.status_time
+                    and now - first_seen < 5 * grace):
+                # the CURRENT leave story (ltime >= status_time — a stale
+                # superseded leave does not count) has not even finished
+                # disseminating locally (congested queue / large cluster):
+                # the grace window has not meaningfully started.  Hold the
+                # repair (grace restarts when dissemination completes) so
+                # a slow genuine leaver is not resurrected mid-leave — but
+                # only up to 5x grace total: a transmit-starved broadcast
+                # in a churning queue must not defer the repair forever
+                # (the sweep's whole point is ending a permanent wedge;
+                # the failure detector's judgment wins eventually).
+                entry[1] = now
+                continue
+            if now - grace_start >= grace:
                 log.warning("restoring dangling LEAVING member %s to ALIVE "
                             "(memberlist-alive %.1fs past the leave window)",
-                            node_id, now - first)
+                            node_id, now - grace_start)
                 ms.member = ms.member.with_status(MemberStatus.ALIVE)
                 metrics.incr("serf.member.unleave", 1, self._labels)
                 current.discard(node_id)   # timer restarts if it re-enters

@@ -10,6 +10,7 @@ from_json/to_toml/from_toml``) reads and writes humantime strings
 
 from __future__ import annotations
 
+import math
 import re
 from dataclasses import dataclass, field, fields, replace
 from typing import Any, Dict, Optional
@@ -31,8 +32,10 @@ def parse_duration(value) -> float:
     """Humantime-style duration → seconds.  Accepts plain numbers
     (seconds) or strings like "500ms", "24h", "1h30m", "2.5s"."""
     if isinstance(value, (int, float)) and not isinstance(value, bool):
-        if value < 0:
-            raise ValueError(f"negative duration {value!r}")
+        # NaN passes a bare `< 0` check (comparisons are False) and inf
+        # round-trips into format_duration's OverflowError — reject both
+        if not math.isfinite(value) or value < 0:
+            raise ValueError(f"non-finite or negative duration {value!r}")
         return float(value)
     if not isinstance(value, str):
         raise ValueError(f"cannot parse duration from {value!r}")
@@ -56,8 +59,8 @@ def parse_duration(value) -> float:
 
 def format_duration(seconds: float) -> str:
     """Seconds → compact humantime string ("24h", "1h30m", "500ms")."""
-    if seconds < 0:
-        raise ValueError(f"negative duration {seconds!r}")
+    if not math.isfinite(seconds) or seconds < 0:
+        raise ValueError(f"non-finite or negative duration {seconds!r}")
     if seconds == 0:
         return "0s"
     ns = round(seconds * 1e9)

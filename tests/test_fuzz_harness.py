@@ -45,6 +45,8 @@ def test_dstream_segment_fuzz_slice():
     """CI slice of the dstream segment fuzzer (untrusted-UDP parser)."""
     from fuzz_dstream import run as run_dstream
 
-    stats = run_dstream(seed=1, seconds=3.0)
-    assert stats["cases"] > 2000, f"fuzzer too slow: {stats['cases']}"
+    # fixed case budget, not a wall-clock throughput floor (a loaded CI
+    # machine made the old `cases > 2000 in 3s` assertion flake)
+    stats = run_dstream(seed=1, seconds=60.0, cases=2000)
+    assert stats["cases"] >= 2000, f"fuzzer stopped early: {stats['cases']}"
     assert stats["violations"] == 0, stats["examples"]

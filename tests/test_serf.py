@@ -650,13 +650,45 @@ async def test_corrupted_ping_payloads_rejected():
         await s.shutdown()
 
 
+async def test_pushpull_echo_of_self_does_not_broadcast():
+    """Regression (round-4): a newer join intent about OURSELVES — the
+    shape a push/pull ``status_ltimes`` echo takes — must be absorbed
+    silently: adopt the ltime, stay ALIVE, queue NO broadcast, and leave
+    the Lamport clock advanced only by the witness.  Rounds 2-3 turned
+    every such echo into a "re-assert aliveness" join broadcast, which
+    churned the clock during plain convergence and stomped equal-ltime
+    leave races (the dangling-LEAVING sweep's domain)."""
+    from serf_tpu.types.messages import JoinMessage
+
+    net = LoopbackNetwork()
+    s = await Serf.create(net.bind("echo"), Options.local(), "echo-node")
+    try:
+        me = s._members[s.local_id]
+        echo_lt = me.status_time + 5
+        depth_before = len(s.intent_broadcasts)
+        tasks_before = len(asyncio.all_tasks())
+        assert s._handle_node_join_intent(
+            JoinMessage(echo_lt, s.local_id), rebroadcast=False) is True
+        assert me.member.status == MemberStatus.ALIVE
+        assert me.status_time == echo_lt
+        # witness(echo_lt) makes time() == echo_lt + 1; anything larger
+        # means an increment fired (i.e. a refutation/re-assert path ran)
+        assert s.clock.time() == echo_lt + 1
+        assert len(s.intent_broadcasts) == depth_before
+        await asyncio.sleep(0.05)
+        assert len(s.intent_broadcasts) == depth_before
+        assert len(asyncio.all_tasks()) <= tasks_before + 1
+    finally:
+        await s.shutdown()
+
+
 async def test_rejoin_via_stale_partner_converges():
     """The stale-partner rejoin corner (found by soak seeds 7/8): A leaves
     at ltime L; C restarts knowing A only as a left-members entry; A then
     rejoins THROUGH C, so A's clock never witnesses L and its join
-    broadcast cannot beat stale LEAVING/LEFT states.  The re-assertion
-    path (a newer join intent about ourselves triggers a fresh broadcast
-    at a beating ltime) must converge every view to ALIVE."""
+    broadcast cannot beat stale LEAVING/LEFT states.  Convergence relies
+    on memberlist notify_join revival plus left_members -> leave-intent
+    self-refutation (base.rs:1468-1480); every view must reach ALIVE."""
     net = LoopbackNetwork()
     a = await Serf.create(net.bind("a"), Options.local(), "A")
     b = await Serf.create(net.bind("b"), Options.local(), "B")

@@ -429,6 +429,74 @@ async def test_leave_intent_avoids_infinite_rebroadcast():
         await s.shutdown()
 
 
+async def test_sweep_holds_while_leave_broadcast_pending():
+    """The dangling-LEAVING sweep must not resurrect a member whose leave
+    intent is still draining from OUR broadcast queue (congested queue /
+    large cluster): the grace timer holds until the local dissemination
+    finishes, then runs normally."""
+    from serf_tpu.host.broadcast import Broadcast
+    from serf_tpu.types.messages import LeaveMessage, encode_message
+
+    net = LoopbackNetwork()
+    opts = Options.local(broadcast_timeout=0.3, leave_propagate_delay=0.1)
+    nodes = [await Serf.create(net.bind(f"pb{i}"), opts, f"pb-{i}")
+             for i in range(2)]
+    try:
+        s0, s1 = nodes
+        await s1.join("pb0")
+        await wait_until(lambda: all(s.num_members() == 2 for s in nodes),
+                         msg="2-node convergence")
+        ms = s0._members["pb-1"]
+        lt = ms.status_time + 1
+        s0._handle_node_leave_intent(LeaveMessage(lt, "pb-1"),
+                                     rebroadcast=False)
+        assert ms.member.status == MemberStatus.LEAVING
+        # pin a leave broadcast for pb-1 in the queue: sweep must hold.
+        # grace = 2*(0.3+0.1) = 0.8s; the hold is capped at 5*grace = 4s.
+        raw = encode_message(LeaveMessage(lt, "pb-1"))
+        s0.intent_broadcasts.queue_broadcast(Broadcast(raw, name="pb-1"))
+        since: dict = {}
+        t0 = 1000.0
+        s0._sweep_dangling_leaving(since, t0)
+        s0._sweep_dangling_leaving(since, t0 + 2.0)    # >> grace, < cap
+        assert ms.member.status == MemberStatus.LEAVING, \
+            "sweep resurrected a member mid-leave-dissemination"
+        # a STALE leave broadcast (ltime < status_time) must NOT hold:
+        # replace the pinned broadcast with a superseded one and verify
+        # the timer logic ignores it (status_time is lt, broadcast lt-1)
+        s0.intent_broadcasts._items.clear()
+        stale = encode_message(LeaveMessage(lt - 1, "pb-1"))
+        s0.intent_broadcasts.queue_broadcast(Broadcast(stale, name="pb-1"))
+        assert s0._pending_leave_ltimes().get("pb-1") == lt - 1
+        # queue drained of CURRENT leaves -> grace restarts from the last
+        # pending sweep (t0+2), then the normal repair applies
+        s0._sweep_dangling_leaving(since, t0 + 2.5)
+        assert ms.member.status == MemberStatus.LEAVING  # grace restarted
+        s0._sweep_dangling_leaving(since, t0 + 10.0)
+        assert ms.member.status == MemberStatus.ALIVE
+
+        # cap: a leave broadcast that NEVER drains (transmit-starved in a
+        # churning queue) cannot defer the repair past 5*grace
+        s1_ms = None
+        lt2 = s1._members["pb-0"].status_time + 1
+        s1._handle_node_leave_intent(LeaveMessage(lt2, "pb-0"),
+                                     rebroadcast=False)
+        s1_ms = s1._members["pb-0"]
+        assert s1_ms.member.status == MemberStatus.LEAVING
+        raw2 = encode_message(LeaveMessage(lt2, "pb-0"))
+        s1.intent_broadcasts.queue_broadcast(Broadcast(raw2, name="pb-0"))
+        since2: dict = {}
+        s1._sweep_dangling_leaving(since2, t0)
+        s1._sweep_dangling_leaving(since2, t0 + 2.0)   # held (pending)
+        assert s1_ms.member.status == MemberStatus.LEAVING
+        s1._sweep_dangling_leaving(since2, t0 + 5.0)   # past 5*grace cap
+        assert s1_ms.member.status == MemberStatus.ALIVE, \
+            "a never-draining broadcast deferred the repair past the cap"
+    finally:
+        for s in nodes:
+            await s.shutdown()
+
+
 async def test_dangling_leaving_restored_by_reaper():
     """Equal-Lamport-time join/leave race (root cause of the soak seed-2
     flake): a rejoiner's fresh clock can collide with its old leave's
