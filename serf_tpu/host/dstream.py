@@ -20,10 +20,18 @@ architectural slot with an honest, minimal protocol:
 - frames (the `Stream` contract) are 4-byte length-prefixed byte strings
   chunked into ≤``MSS``-byte segments.
 
-What this is NOT (documented deviation, PARITY.md): QUIC's congestion
-control, path migration, 0-RTT, or wire format.  It is a LAN-profile ARQ
-sized for serf's push/pull exchanges, conformance-tested alongside
-tcp/tls through the same cluster scenarios.
+Congestion control (round 4): the in-flight window is AIMD-adapted per
+connection — additive increase of one segment per acked round-trip
+(``cwnd += acked / cwnd``), multiplicative halving on every retransmit
+timeout, bounded to [CWND_MIN, CWND_MAX].  That is the TCP-Reno-shaped
+response QUIC's NewReno default gives the reference's quinn transport
+(serf/Cargo.toml:40-56), so a WAN bottleneck or loss burst backs the
+sender off instead of flooding retransmits.
+
+What this is NOT (documented deviation, PARITY.md): QUIC's loss-based
+fast-recovery/SACK machinery, path migration, 0-RTT, or wire format.  It
+is an ARQ sized for serf's push/pull exchanges, conformance-tested
+alongside tcp/tls through the same cluster scenarios.
 
 Both endpoints of a cluster must run the same transport (exactly as a
 quinn-only reference cluster cannot interoperate with plain TCP nodes).
@@ -45,7 +53,10 @@ from serf_tpu.host.transport import Stream, Transport
 log = logging.getLogger("serf_tpu.dstream")
 
 MSS = 1200              # max segment payload (UDP-safe with header room)
-WINDOW = 64             # max in-flight segments per connection
+CWND_INIT = 16          # initial congestion window (segments)
+CWND_MIN = 2            # floor after repeated losses
+CWND_MAX = 256          # in-flight ceiling per connection
+WINDOW = CWND_MAX       # compat alias: the hard in-flight bound
 RTO_MIN = 0.15          # initial retransmit timeout (s)
 RTO_MAX = 2.0           # backoff cap (s)
 MAX_RETRIES = 30        # per-oldest-segment retransmit budget
@@ -92,6 +103,8 @@ class _Conn:
         self.inflight: Dict[int, bytes] = {}   # seq -> encoded wire segment
         self.retries = 0
         self.rto = RTO_MIN
+        self.cwnd = float(CWND_INIT)           # AIMD congestion window
+        self.cwnd_min_seen = float(CWND_INIT)  # diagnostics/tests
         self.retx_handle: Optional[asyncio.TimerHandle] = None
         self.window_free = asyncio.Event()
         self.window_free.set()
@@ -132,7 +145,14 @@ class _Conn:
             self._fail(f"retransmit budget exhausted to {self.peer}")
             return
         self.rto = min(self.rto * 2.0, RTO_MAX)
-        for seq in sorted(self.inflight):
+        # multiplicative decrease: a lost round means we overran the path
+        self.cwnd = max(float(CWND_MIN), self.cwnd / 2.0)
+        self.cwnd_min_seen = min(self.cwnd_min_seen, self.cwnd)
+        # retransmit at most the HALVED window, oldest-first: re-blasting
+        # the whole inflight set would re-flood the very bottleneck the
+        # cwnd cut is backing off from (the rest re-sends as the
+        # cumulative ACK advances or on later timeouts)
+        for seq in sorted(self.inflight)[:max(1, int(self.cwnd))]:
             self.t._sendto(self.inflight[seq], self.peer)
         self._arm_retx()
 
@@ -156,13 +176,13 @@ class _Conn:
         self._update_window()
 
     async def _wait_window(self) -> None:
-        while self.snd_next - self.snd_una >= WINDOW and not self.error \
+        while self.snd_next - self.snd_una >= self.cwnd and not self.error \
                 and not self.closed:
             self.window_free.clear()
             await self.window_free.wait()
 
     def _update_window(self) -> None:
-        if self.snd_next - self.snd_una < WINDOW:
+        if self.snd_next - self.snd_una < self.cwnd:
             self.window_free.set()
 
     # -- receiving (sync, called from the datagram callback) ----------------
@@ -185,6 +205,7 @@ class _Conn:
             return
         if kind == K_ACK:
             if seq > self.snd_una:
+                acked = seq - self.snd_una
                 self.snd_una = seq
                 for s in [s for s in self.inflight if s < seq]:
                     del self.inflight[s]
@@ -192,6 +213,9 @@ class _Conn:
                     self.drained.set()
                 self.retries = 0
                 self.rto = RTO_MIN
+                # additive increase: +1 segment per acked round-trip
+                self.cwnd = min(float(CWND_MAX),
+                                self.cwnd + acked / self.cwnd)
                 if self.retx_handle is not None:
                     self.retx_handle.cancel()
                     self.retx_handle = None
@@ -263,6 +287,13 @@ class _Conn:
         self.closed = True
         self.inflight.clear()
         self.drained.set()
+        # wake anyone parked on the window or a blocking recv: after
+        # teardown the _wait_window/_deliver conditions are never
+        # re-evaluated otherwise (transport.shutdown() reaches here
+        # directly, without _fail), and the AIMD floor parks senders in
+        # _wait_window far more often than the old fixed window did
+        self.window_free.set()
+        self.frames.put_nowait(None)
         if self.retx_handle is not None:
             self.retx_handle.cancel()
             self.retx_handle = None

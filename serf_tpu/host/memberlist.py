@@ -37,6 +37,39 @@ from serf_tpu.utils import metrics
 
 log = logging.getLogger("serf_tpu.memberlist")
 
+# Version-range constants live beside the wire format (DEFAULT_VSN) in
+# messages.py — a leaf module options.py can import without a cycle.
+from serf_tpu.host.messages import (  # noqa: F401 - re-exported API
+    DELEGATE_VERSION_MAX,
+    DELEGATE_VERSION_MIN,
+    PROTOCOL_VERSION_MAX,
+    PROTOCOL_VERSION_MIN,
+)
+
+
+class VersionError(Exception):
+    """A peer speaks an incompatible protocol/delegate version."""
+
+
+def vsn_mismatch(vsn) -> Optional[str]:
+    """Why ``vsn`` ([pmin, pmax, pcur, dmin, dmax, dcur]) cannot interop
+    with us — or None if it can.  Compatibility = the ranges intersect
+    AND the peer's CURRENT versions fall inside our supported ranges."""
+    pmin, pmax, pcur, dmin, dmax, dcur = vsn
+    if pmin > PROTOCOL_VERSION_MAX or pmax < PROTOCOL_VERSION_MIN:
+        return (f"protocol range [{pmin}, {pmax}] does not intersect our "
+                f"supported [{PROTOCOL_VERSION_MIN}, {PROTOCOL_VERSION_MAX}]")
+    if not PROTOCOL_VERSION_MIN <= pcur <= PROTOCOL_VERSION_MAX:
+        return (f"speaks protocol v{pcur}, outside our supported "
+                f"[{PROTOCOL_VERSION_MIN}, {PROTOCOL_VERSION_MAX}]")
+    if dmin > DELEGATE_VERSION_MAX or dmax < DELEGATE_VERSION_MIN:
+        return (f"delegate range [{dmin}, {dmax}] does not intersect our "
+                f"supported [{DELEGATE_VERSION_MIN}, {DELEGATE_VERSION_MAX}]")
+    if not DELEGATE_VERSION_MIN <= dcur <= DELEGATE_VERSION_MAX:
+        return (f"delegate v{dcur}, outside our supported "
+                f"[{DELEGATE_VERSION_MIN}, {DELEGATE_VERSION_MAX}]")
+    return None
+
 
 @dataclass
 class NodeState:
@@ -44,6 +77,7 @@ class NodeState:
     incarnation: int = 0
     state: SwimState = SwimState.ALIVE
     meta: bytes = b""
+    vsn: tuple = sm.DEFAULT_VSN
     state_change: float = field(default_factory=time.monotonic)
 
     @property
@@ -114,6 +148,10 @@ class Memberlist:
         opts.validate()
 
         self.local = Node(node_id, transport.local_addr)
+        self._vsn = (PROTOCOL_VERSION_MIN, PROTOCOL_VERSION_MAX,
+                     opts.protocol_version,
+                     DELEGATE_VERSION_MIN, DELEGATE_VERSION_MAX,
+                     opts.delegate_version)
         self._incarnation = 1
         self._nodes: Dict[str, NodeState] = {}
         self._probe_order: List[str] = []
@@ -146,7 +184,8 @@ class Memberlist:
     async def start(self) -> None:
         """Set the local node alive and spin up the protocol loops."""
         meta = self.delegate.node_meta(512)
-        me = NodeState(self.local, self._incarnation, SwimState.ALIVE, meta)
+        me = NodeState(self.local, self._incarnation, SwimState.ALIVE, meta,
+                       vsn=self._vsn)
         self._nodes[self.local.id] = me
         self._probe_order.append(self.local.id)
         self.delegate.notify_join(me)
@@ -265,7 +304,11 @@ class Memberlist:
         self._incarnation += 1
         me.incarnation = self._incarnation
         me.meta = self.delegate.node_meta(512)
-        msg = sm.Alive(me.incarnation, self.local, me.meta)
+        # the local delegate view must see the change too (memberlist's
+        # setAlive->aliveNode path notifies for the local node as well) —
+        # without this the tag-setter's OWN member table keeps stale tags
+        self.delegate.notify_update(me)
+        msg = sm.Alive(me.incarnation, self.local, me.meta, self._vsn)
         done = asyncio.Event()
         self._queue_broadcast(sm.encode_swim(msg), name=self.local.id, notify=done)
         if self._any_alive_peer():
@@ -428,7 +471,7 @@ class Memberlist:
         self._incarnation = max(self._incarnation, incarnation) + 1
         me.incarnation = self._incarnation
         self._awareness.apply_delta(1)
-        msg = sm.Alive(me.incarnation, self.local, me.meta)
+        msg = sm.Alive(me.incarnation, self.local, me.meta, self._vsn)
         self._queue_broadcast(sm.encode_swim(msg), name=self.local.id)
 
     def _handle_alive(self, a: sm.Alive) -> None:
@@ -438,9 +481,18 @@ class Memberlist:
         if err is not None:
             log.debug("alive for %r vetoed: %s", a.node.id, err)
             return
+        mismatch = vsn_mismatch(a.vsn)
+        if mismatch is not None:
+            # version gate (reference version.rs:9-43 / memberlist Vsn
+            # handshake): never admit a peer we cannot interop with
+            log.error("refusing node %r: %s", a.node.id, mismatch)
+            metrics.incr("memberlist.node.version_rejected", 1,
+                         self.opts.metric_labels)
+            return
         ns = self._nodes.get(a.node.id)
         if ns is None:
-            ns = NodeState(a.node, a.incarnation, SwimState.ALIVE, a.meta)
+            ns = NodeState(a.node, a.incarnation, SwimState.ALIVE, a.meta,
+                           vsn=a.vsn)
             self._nodes[a.node.id] = ns
             # insert at a random probe position so new nodes get probed fairly
             idx = self.rng.randint(0, len(self._probe_order))
@@ -476,6 +528,7 @@ class Memberlist:
         was_gone = ns.state in (SwimState.DEAD, SwimState.LEFT)
         ns.incarnation = a.incarnation
         ns.meta = a.meta
+        ns.vsn = a.vsn
         if ns.state != SwimState.ALIVE:
             ns.state = SwimState.ALIVE
             ns.state_change = time.monotonic()
@@ -706,7 +759,7 @@ class Memberlist:
 
     def _local_push_states(self) -> List[sm.PushNodeState]:
         return [
-            sm.PushNodeState(n.node, n.incarnation, n.state, n.meta)
+            sm.PushNodeState(n.node, n.incarnation, n.state, n.meta, n.vsn)
             for n in self._nodes.values()
         ]
 
@@ -738,13 +791,22 @@ class Memberlist:
             raw = await stream.recv_frame(self.opts.timeout)
             msg = self._decode_stream_msg(raw)
             if isinstance(msg, sm.PushPull):
+                if msg.join:
+                    # refuse BEFORE replying: the joiner must not learn
+                    # our state if we cannot interop with its cluster
+                    self._verify_versions(msg.states)
                 out = sm.PushPull(False, tuple(self._local_push_states()),
                                   self.delegate.local_state(msg.join))
                 await stream.send_frame(self._encode_wire(sm.encode_swim(out)))
-                self._merge_remote(msg, msg.join)
+                self._merge_remote(msg, msg.join, verified=True)
             elif isinstance(msg, sm.UserMsg):
                 self.delegate.notify_message(msg.payload)
-        except (codec.DecodeError, ConnectionError, TimeoutError, KeyringError) as e:
+        except VersionError as e:
+            log.warning("refusing push/pull from %r: %s", src, e)
+            metrics.incr("memberlist.node.version_rejected", 1,
+                         self.opts.metric_labels)
+        except (codec.DecodeError, ConnectionError, TimeoutError,
+                KeyringError) as e:
             log.debug("stream from %r failed: %s", src, e)
         except Exception:  # noqa: BLE001
             log.exception("stream handler error from %r", src)
@@ -757,14 +819,31 @@ class Memberlist:
             raise KeyringError("undecodable stream frame")
         return sm.decode_swim(buf)
 
-    def _merge_remote(self, pp: sm.PushPull, join: bool) -> None:
+    def _verify_versions(self, states) -> None:
+        """Joining is a handshake: an incompatible peer in the remote
+        state set fails the WHOLE join with a clear reason (the periodic
+        anti-entropy path instead just skips such nodes in _handle_alive).
+        Reference slot: version.rs:9-43."""
+        for st in states:
+            mismatch = vsn_mismatch(st.vsn)
+            if mismatch is not None:
+                raise VersionError(
+                    f"cannot join: remote node {st.node.id!r} {mismatch}")
+
+    def _merge_remote(self, pp: sm.PushPull, join: bool,
+                      verified: bool = False) -> None:
+        if join and not verified:
+            # client path: verify the seed's reply (the server path has
+            # already verified before replying — it passes verified=True)
+            self._verify_versions(pp.states)
         err = self.delegate.notify_merge(pp.states)
         if err is not None:
             log.warning("push/pull merge vetoed: %s", err)
             return
         for st in pp.states:
             if st.state == SwimState.ALIVE:
-                self._handle_alive(sm.Alive(st.incarnation, st.node, st.meta))
+                self._handle_alive(
+                    sm.Alive(st.incarnation, st.node, st.meta, st.vsn))
             elif st.state in (SwimState.SUSPECT, SwimState.DEAD):
                 # Remote suspect AND dead both merge as *suspect* (memberlist
                 # semantics): gives a live node the chance to refute instead

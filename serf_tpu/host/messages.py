@@ -166,11 +166,32 @@ class Suspect:
         return cls(inc, node, frm)
 
 
+# Supported version ranges (reference serf-core/src/types/version.rs:9-43
+# carries these as ProtocolVersion/DelegateVersion; the refusal semantics
+# mirror memberlist's Vsn handshake: a peer whose advertised [min, max]
+# range does not intersect ours is rejected, loudly).
+PROTOCOL_VERSION_MIN = 1
+PROTOCOL_VERSION_MAX = 1
+DELEGATE_VERSION_MIN = 1
+DELEGATE_VERSION_MAX = 1
+
+DEFAULT_VSN = (1, 1, 1, 1, 1, 1)
+
+
+def _decode_vsn(raw: bytes):
+    """6-byte version vector [pmin, pmax, pcur, dmin, dmax, dcur] (the
+    memberlist ``Vsn`` layout); anything malformed falls back to v1."""
+    if len(raw) == 6:
+        return tuple(raw)
+    return DEFAULT_VSN
+
+
 @dataclass(frozen=True)
 class Alive:
     incarnation: int
     node: Node
     meta: bytes = b""
+    vsn: tuple = DEFAULT_VSN
 
     TYPE = SwimMessageType.ALIVE
 
@@ -179,11 +200,14 @@ class Alive:
                + codec.encode_bytes_field(2, self.node.encode()))
         if self.meta:
             out += codec.encode_bytes_field(3, self.meta)
+        # always on the wire (8 bytes) so version carriage is real, not
+        # a default that decode would fabricate anyway
+        out += codec.encode_bytes_field(4, bytes(self.vsn))
         return out
 
     @classmethod
     def decode_body(cls, buf: bytes) -> "Alive":
-        inc, node, meta = 0, Node(""), b""
+        inc, node, meta, vsn = 0, Node(""), b"", DEFAULT_VSN
         for f, _w, v, _p in codec.iter_fields(buf):
             if f == 1:
                 inc = codec.as_uint(v)
@@ -191,7 +215,9 @@ class Alive:
                 node = Node.decode(codec.as_bytes(v))
             elif f == 3:
                 meta = codec.as_bytes(v)
-        return cls(inc, node, meta)
+            elif f == 4:
+                vsn = _decode_vsn(codec.as_bytes(v))
+        return cls(inc, node, meta, vsn)
 
 
 @dataclass(frozen=True)
@@ -231,6 +257,7 @@ class PushNodeState:
     incarnation: int
     state: SwimState
     meta: bytes = b""
+    vsn: tuple = DEFAULT_VSN
 
     def encode(self) -> bytes:
         out = (codec.encode_bytes_field(1, self.node.encode())
@@ -238,11 +265,12 @@ class PushNodeState:
                + codec.encode_varint_field(3, int(self.state)))
         if self.meta:
             out += codec.encode_bytes_field(4, self.meta)
+        out += codec.encode_bytes_field(5, bytes(self.vsn))
         return out
 
     @classmethod
     def decode(cls, buf: bytes) -> "PushNodeState":
-        node, inc, st, meta = Node(""), 0, SwimState.ALIVE, b""
+        node, inc, st, meta, vsn = Node(""), 0, SwimState.ALIVE, b"", DEFAULT_VSN
         for f, _w, v, _p in codec.iter_fields(buf):
             if f == 1:
                 node = Node.decode(codec.as_bytes(v))
@@ -252,7 +280,9 @@ class PushNodeState:
                 st = SwimState(codec.as_uint(v))
             elif f == 4:
                 meta = codec.as_bytes(v)
-        return cls(node, inc, st, meta)
+            elif f == 5:
+                vsn = _decode_vsn(codec.as_bytes(v))
+        return cls(node, inc, st, meta, vsn)
 
 
 @dataclass(frozen=True)

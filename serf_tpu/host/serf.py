@@ -820,9 +820,10 @@ class Serf:
             status = MemberStatus.LEAVING
             status_time = lt
         self._recent_intents.pop(ns.id, None)
+        pv, dv = ns.vsn[2], ns.vsn[5]   # current protocol/delegate versions
         if old is None:
             ms = MemberState(
-                Member(ns.node, tags, status), status_time, 0.0)
+                Member(ns.node, tags, status, pv, dv), status_time, 0.0)
             self._members[ns.id] = ms
         else:
             # rejoin: flap detection (reference base.rs:1236-1249)
@@ -832,9 +833,7 @@ class Serf:
                 self._failed = [m for m in self._failed if m.id != ns.id]
                 self._left = [m for m in self._left if m.id != ns.id]
             ms = old
-            ms.member = Member(ns.node, tags, status,
-                               old.member.protocol_version,
-                               old.member.delegate_version)
+            ms.member = Member(ns.node, tags, status, pv, dv)
             if status_time:
                 ms.status_time = status_time
         metrics.incr("serf.member.join", 1, self._labels)

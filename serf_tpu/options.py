@@ -96,15 +96,30 @@ class MemberlistOptions:
     timeout: float = 10.0                    # stream (push/pull) op timeout
     compression: Optional[str] = None        # None | zlib/lz4/snappy/zstd
     checksum: Optional[str] = None           # None | crc32/adler32/xxhash32/murmur3
+    protocol_version: int = 1                # advertised on the wire (vsn)
+    delegate_version: int = 1                # reference version.rs:9-43
     metric_labels: Dict[str, str] = field(default_factory=dict)
 
     def validate(self) -> None:
         from serf_tpu.host.wire import CHECKSUMS, compression_available
+        from serf_tpu.host.messages import (
+            PROTOCOL_VERSION_MIN, PROTOCOL_VERSION_MAX,
+            DELEGATE_VERSION_MIN, DELEGATE_VERSION_MAX)
         if self.compression is not None and not compression_available(
                 self.compression):
             raise ValueError(f"unsupported compression {self.compression!r}")
         if self.checksum is not None and self.checksum not in CHECKSUMS:
             raise ValueError(f"unsupported checksum {self.checksum!r}")
+        if not (PROTOCOL_VERSION_MIN <= self.protocol_version
+                <= PROTOCOL_VERSION_MAX):
+            raise ValueError(
+                f"protocol_version {self.protocol_version} outside supported "
+                f"[{PROTOCOL_VERSION_MIN}, {PROTOCOL_VERSION_MAX}]")
+        if not (DELEGATE_VERSION_MIN <= self.delegate_version
+                <= DELEGATE_VERSION_MAX):
+            raise ValueError(
+                f"delegate_version {self.delegate_version} outside supported "
+                f"[{DELEGATE_VERSION_MIN}, {DELEGATE_VERSION_MAX}]")
 
     @classmethod
     def lan(cls) -> "MemberlistOptions":

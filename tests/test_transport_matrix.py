@@ -1,0 +1,213 @@
+"""The core serf scenario suite stamped over every shipped transport —
+the analog of the reference's `test_mod!` macro, which expands each
+scenario over {tokio,smol} x {tcp,tls,quic} (76 files under
+serf/test/main/net/**, macro at serf/test/main.rs:1-23).
+
+Scenarios: join/converge, graceful leave, user-event dissemination,
+query request/response, snapshot crash-restart auto-rejoin.
+Transports: loopback (in-process fabric), tcp, tls, udpstream (the
+QUIC-slot datagram-stream transport).  IPv4/IPv6 family coverage for the
+socket transports lives in test_serf.py::test_net_transport_stream_variants;
+loss/partition storms in test_transport_storms.py.
+"""
+
+import asyncio
+
+import pytest
+
+from serf_tpu.host import Serf, SerfState
+from serf_tpu.host.dstream import DatagramStreamTransport
+from serf_tpu.host.events import EventSubscriber, QueryEvent, UserEvent
+from serf_tpu.host.net import NetTransport, TlsNetTransport, make_tls_contexts
+from serf_tpu.host.query import QueryParam
+from serf_tpu.host.transport import LoopbackNetwork
+from serf_tpu.options import Options
+from serf_tpu.types.member import MemberStatus
+
+from tests.test_serf import _self_signed_cert
+
+pytestmark = pytest.mark.asyncio
+
+TRANSPORTS = ("loopback", "tcp", "tls", "udpstream")
+
+
+class _Fabric:
+    """Uniform bind/addr-of surface over all four transport flavors, with
+    stable per-node addresses so a restarted node can rebind its slot."""
+
+    def __init__(self, kind, tmp_path):
+        self.kind = kind
+        self.net = LoopbackNetwork() if kind == "loopback" else None
+        self.tls = None
+        if kind == "tls":
+            cert, key = _self_signed_cert(tmp_path)
+            self.tls = make_tls_contexts(cert, key)
+        self.addrs = {}          # node name -> bound address
+
+    async def bind(self, name):
+        if self.kind == "loopback":
+            t = self.net.bind(name)
+        else:
+            addr = self.addrs.get(name, ("127.0.0.1", 0))
+            if self.kind == "tcp":
+                t = await NetTransport.bind(addr)
+            elif self.kind == "udpstream":
+                t = await DatagramStreamTransport.bind(addr)
+            else:
+                server_ctx, client_ctx = self.tls
+                t = await TlsNetTransport.bind(addr, server_ctx=server_ctx,
+                                               client_ctx=client_ctx)
+        self.addrs[name] = t.local_addr
+        return t
+
+    def addr(self, name):
+        return self.addrs[name]
+
+
+async def wait_until(cond, deadline=10.0, msg="condition"):
+    loop = asyncio.get_running_loop()
+    end = loop.time() + deadline
+    while loop.time() < end:
+        if cond():
+            return
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+async def _cluster(fabric, n, opts=None, subscribers=False):
+    nodes, subs = [], []
+    for i in range(n):
+        t = await fabric.bind(f"m{i}")
+        sub = EventSubscriber() if subscribers else None
+        s = await Serf.create(t, opts or Options.local(), f"mx-{i}",
+                              subscriber=sub)
+        nodes.append(s)
+        subs.append(sub)
+    for s in nodes[1:]:
+        await s.join(fabric.addr("m0"))
+    await wait_until(lambda: all(s.num_members() == n for s in nodes),
+                     msg=f"{n}-node convergence over {fabric.kind}")
+    return (nodes, subs) if subscribers else nodes
+
+
+async def _shutdown(nodes):
+    for s in nodes:
+        if s.state != SerfState.SHUTDOWN:
+            await s.shutdown()
+
+
+@pytest.mark.parametrize("transport", TRANSPORTS)
+async def test_join_and_graceful_leave(transport, tmp_path):
+    fabric = _Fabric(transport, tmp_path)
+    nodes = await _cluster(fabric, 3)
+    try:
+        await nodes[2].leave()
+        await wait_until(
+            lambda: all(s._members["mx-2"].member.status == MemberStatus.LEFT
+                        for s in nodes[:2]),
+            msg=f"graceful leave propagates over {transport}")
+    finally:
+        await _shutdown(nodes)
+
+
+@pytest.mark.parametrize("transport", TRANSPORTS)
+async def test_user_event_disseminates(transport, tmp_path):
+    fabric = _Fabric(transport, tmp_path)
+    nodes, subs = await _cluster(fabric, 3, subscribers=True)
+    try:
+        await nodes[0].user_event("deploy", b"v2-payload", coalesce=False)
+
+        async def saw_event(sub):
+            end = asyncio.get_running_loop().time() + 10.0
+            while asyncio.get_running_loop().time() < end:
+                ev = await sub.next(timeout=10.0)
+                if isinstance(ev, UserEvent) and ev.name == "deploy":
+                    return ev
+            raise AssertionError("deploy event never arrived")
+
+        for sub in subs[1:]:
+            ev = await saw_event(sub)
+            assert ev.payload == b"v2-payload"
+    finally:
+        await _shutdown(nodes)
+
+
+@pytest.mark.parametrize("transport", TRANSPORTS)
+async def test_query_request_response(transport, tmp_path):
+    fabric = _Fabric(transport, tmp_path)
+    nodes, subs = await _cluster(fabric, 3, subscribers=True)
+    responders = []
+
+    async def respond_loop(sub, node_id):
+        async for ev in sub:
+            if isinstance(ev, QueryEvent) and ev.name == "whoami":
+                try:
+                    await ev.respond(node_id.encode())
+                except (TimeoutError, ValueError):
+                    pass
+
+    try:
+        for s, sub in zip(nodes[1:], subs[1:]):
+            responders.append(asyncio.create_task(
+                respond_loop(sub, s.local_id)))
+        resp = await nodes[0].query("whoami", b"",
+                                    QueryParam(timeout=5.0))
+        got = await resp.collect()
+        names = sorted(r.payload.decode() for r in got)
+        assert names == ["mx-1", "mx-2"], \
+            f"query over {transport} answered by {names}"
+    finally:
+        for task in responders:
+            task.cancel()
+        await _shutdown(nodes)
+
+
+@pytest.mark.parametrize("transport", TRANSPORTS)
+async def test_snapshot_restart_auto_rejoins(transport, tmp_path):
+    """Crash-restart: a node with a snapshot comes back on its old address
+    and auto-rejoins from the recorded alive set — no explicit join()."""
+    fabric = _Fabric(transport, tmp_path)
+    snap = str(tmp_path / "m2.snap")
+    nodes = await _cluster(fabric, 2)
+    extra = None
+    try:
+        t2 = await fabric.bind("m2")
+        extra = await Serf.create(
+            t2, Options.local(snapshot_path=snap), "mx-2")
+        await extra.join(fabric.addr("m0"))
+        await wait_until(lambda: all(s.num_members() == 3
+                                     for s in (*nodes, extra)),
+                         msg=f"3-node convergence over {transport}")
+        # crash (no leave) ...
+        await extra.shutdown()
+        await wait_until(
+            lambda: nodes[0]._members["mx-2"].member.status
+            in (MemberStatus.FAILED, MemberStatus.LEFT),
+            msg=f"crash detected over {transport}")
+        # ... restart on the SAME address with the same snapshot
+        t2b = await fabric.bind("m2")
+        extra = await Serf.create(
+            t2b, Options.local(snapshot_path=snap), "mx-2")
+        await wait_until(
+            lambda: extra.num_members() == 3
+            and all(s._members["mx-2"].member.status == MemberStatus.ALIVE
+                    for s in nodes),
+            msg=f"snapshot auto-rejoin over {transport}")
+    finally:
+        await _shutdown(nodes + ([extra] if extra else []))
+
+
+@pytest.mark.parametrize("transport", TRANSPORTS)
+async def test_set_tags_propagates(transport, tmp_path):
+    fabric = _Fabric(transport, tmp_path)
+    from serf_tpu.types.tags import Tags
+
+    nodes = await _cluster(fabric, 3)
+    try:
+        await nodes[1].set_tags(Tags({"role": "db", "dc": "east"}))
+        await wait_until(
+            lambda: all(dict(s._members["mx-1"].member.tags) ==
+                        {"role": "db", "dc": "east"} for s in nodes),
+            msg=f"tag update propagates over {transport}")
+    finally:
+        await _shutdown(nodes)
