@@ -387,16 +387,23 @@ def pick_bounded(candidates: jnp.ndarray, max_events: int, key: jax.Array):
     and selection sorts descending).
 
     Small n: one flat randomized top_k (unbiased).  Large n: two-level —
-    index space is split into ``_PICK_GROUPS`` *strided* groups (group g =
-    indices ≡ g mod G), each group elects its max-score candidate in one
-    elementwise pass, and top_k runs over only the G group maxima.  At most
-    one winner per group per round is a selection bias, but candidates
-    co-resident in a strided group must collide modulo G: realistic
-    clustered candidate sets (contiguous id ranges — a range partition, a
-    rack failure) spread across groups, and un-picked candidates simply
-    remain candidates for the next round (the max_events bound already
-    defers extras).  This removes the full 1M-element sort that made the
-    flat top_k the single most expensive op in the swim round.
+    the index space is split into ``_PICK_GROUPS`` groups, each group
+    elects its max-score candidate in one elementwise pass, and top_k
+    runs over only the G group maxima.  At most one winner per group per
+    round is a selection bias; to keep any FIXED candidate set from
+    being degenerate, the grouping LAYOUT alternates per round (keyed off
+    the PRNG): *strided* groups (group j = indices ≡ j mod G — spreads
+    contiguous id ranges: range partitions, rack failures) or
+    *contiguous blocks* (group j = indices j·rows..(j+1)·rows — spreads
+    arithmetic progressions: a set colliding mod G is spaced ≥ G apart,
+    so blocks of rows < G hold at most one each).  No set collides under
+    BOTH layouts, so an adversarial set drains at ≥ half the ideal rate
+    (quantified in tests/test_device_plane.py::test_pick_bounded_adversarial_drain;
+    analysis in DESIGN.md).  Un-picked candidates simply remain
+    candidates for the next round (the max_events bound already defers
+    extras).  Both layouts are pure reshapes — no gathers — preserving
+    the win over the full 1M-element sort that made the flat top_k the
+    single most expensive op in the swim round.
     """
     def topk_padded(scores: jnp.ndarray):
         # top_k requires k <= the axis size; clamp and pad the tail with
@@ -409,25 +416,45 @@ def pick_bounded(candidates: jnp.ndarray, max_events: int, key: jax.Array):
         return vals, idx
 
     n = candidates.shape[0]
+    k_score, k_layout = jax.random.split(key)
     score = candidates.astype(jnp.float32) * (
-        1.0 + jax.random.uniform(key, (n,)))
+        1.0 + jax.random.uniform(k_score, (n,)))
     if n <= _PICK_FLAT_MAX:
         vals, idx = topk_padded(score)
         active = vals > 0.0
         subjects = idx.astype(jnp.int32)
     else:
         g = _PICK_GROUPS
+        # the blocks-vs-strided complementarity proof needs rows <= g
+        # (a mod-g-colliding set is spaced g apart, so blocks of rows <= g
+        # hold at most one member each); above n = g^2 (~16.7M) grow the
+        # group count to the next power of two >= sqrt(n).  n is static
+        # under jit, so this is trace-time Python.
+        while (n + g - 1) // g > g:
+            g *= 2
         rows = (n + g - 1) // g
         padded = score if rows * g == n else jnp.pad(score,
                                                      (0, rows * g - n))
-        s2 = padded.reshape(rows, g)        # column j = indices ≡ j mod g
-        col_max = jnp.max(s2, axis=0)                          # f32[G]
-        col_arg = jnp.argmax(s2, axis=0).astype(jnp.int32)     # i32[G]
+
+        def strided(p):
+            s2 = p.reshape(rows, g)     # column j = indices ≡ j mod g
+            winner = (jnp.argmax(s2, axis=0).astype(jnp.int32) * g
+                      + jnp.arange(g, dtype=jnp.int32))
+            return jnp.max(s2, axis=0), winner
+
+        def blocks(p):
+            s2 = p.reshape(g, rows)     # row j = indices j*rows..+rows
+            winner = (jnp.arange(g, dtype=jnp.int32) * rows
+                      + jnp.argmax(s2, axis=1).astype(jnp.int32))
+            return jnp.max(s2, axis=1), winner
+
+        grp_max, grp_winner = jax.lax.cond(
+            jax.random.bernoulli(k_layout), strided, blocks, padded)
         # at most one winner per group, so only min(max_events, G) picks
         # are possible; the tail comes back inactive
-        vals, cols = topk_padded(col_max)
+        vals, cols = topk_padded(grp_max)
         active = vals > 0.0
-        subjects = col_arg[cols] * g + cols.astype(jnp.int32)
+        subjects = grp_winner[cols]
     chosen = jnp.zeros((n,), bool).at[
         jnp.where(active, subjects, n)].set(True, mode="drop")
     return chosen, subjects, active
