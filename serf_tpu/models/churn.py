@@ -51,17 +51,25 @@ class ChurnConfig:
     leave_rate: float = 0.0     # per-alive-node per-round graceful-leave prob
     rejoin_rate: float = 0.0    # per-dead-node per-round rejoin probability
     max_events: int = 8         # cap per kind per round (bounded injection)
+    #: gossip rounds a graceful leaver stays up AFTER announcing K_LEAVE —
+    #: the device analog of the reference's leave broadcast drain
+    #: (broadcast_timeout + propagate delay spans several gossip
+    #: intervals).  One round is a ~e^-fanout chance the pull exchange
+    #: never reads the origin before it goes dark, orphaning the fact.
+    leave_linger_rounds: int = 3
 
 
 def churn_round(state: GossipState, cfg: GossipConfig, ccfg: ChurnConfig,
                 key: jax.Array):
     """Sample and apply one round of churn events to the gossip substate.
 
-    Returns ``(state, pending_down)``: fails and rejoins take effect
-    immediately; graceful leavers have announced their ``K_LEAVE`` fact but
-    stay alive until the caller applies ``pending_down`` AFTER the next
-    gossip round — otherwise the dead-sender masking in ``round_step``
-    would silence the announcement before it ever leaves the origin.
+    Returns ``(state, new_leavers)``: fails and rejoins take effect
+    immediately; graceful leavers have announced their ``K_LEAVE`` fact
+    but stay alive for ``leave_linger_rounds`` more gossip rounds —
+    thread ``new_leavers`` through ``linger_step`` and apply its
+    ``go_down`` mask after each round.  Going dark immediately would let
+    the dead-sender masking in ``round_step`` silence the announcement
+    before it ever leaves the origin.
     """
     n = cfg.n
     k_f, k_l, k_r, k_pf, k_pl, k_pr = jax.random.split(key, 6)
@@ -100,6 +108,33 @@ def churn_round(state: GossipState, cfg: GossipConfig, ccfg: ChurnConfig,
     return state, leaves
 
 
+def linger_init(n: int) -> jnp.ndarray:
+    """u8[N] leave countdown; 0 = not leaving."""
+    return jnp.zeros((n,), jnp.uint8)
+
+
+def linger_step(countdown: jnp.ndarray, new_leavers: jnp.ndarray,
+                linger_rounds: int, alive=None):
+    """Advance the leave-linger countdown one gossip round.
+
+    Returns ``(countdown', go_down)``: ``go_down`` marks leavers whose
+    drain window just expired — apply ``alive & ~go_down`` after the
+    round's exchange.  New leavers (re-)arm at ``linger_rounds`` (clamped
+    to the u8 countdown's range — silently wrapping would disarm
+    multiples of 256 entirely).  Pass ``alive`` to clear the countdown of
+    nodes that died mid-linger: a dead node is not draining, and a stale
+    armed countdown would otherwise force it back down the round after a
+    rejoin."""
+    if alive is not None:
+        countdown = jnp.where(alive, countdown, jnp.uint8(0))
+    arm = jnp.uint8(max(1, min(255, linger_rounds)))
+    cd = jnp.where(new_leavers, arm, countdown)
+    armed = cd > 0
+    cd = jnp.where(armed, cd - 1, cd)
+    go_down = armed & (cd == 0)
+    return cd, go_down
+
+
 class ChurnTrace(NamedTuple):
     """Ground-truth bookkeeping carried through a churned run."""
 
@@ -121,18 +156,21 @@ def run_cluster_churn(state: ClusterState, cfg: ClusterConfig,
                        always_up=state.gossip.alive)
 
     def body(carry, subkey):
-        st, tr = carry
+        st, tr, cd = carry
         k_churn, k_round = jax.random.split(subkey)
-        g, pending_down = churn_round(st.gossip, cfg.gossip, ccfg, k_churn)
+        g, new_leavers = churn_round(st.gossip, cfg.gossip, ccfg, k_churn)
         st = st._replace(gossip=g)
         st = cluster_round(st, cfg, k_round)
-        # leavers gossiped their announcement this round; now they go dark
+        # leavers drain their announcement for linger rounds, then go dark
+        cd, go_down = linger_step(cd, new_leavers, ccfg.leave_linger_rounds,
+                                  alive=st.gossip.alive)
         g = st.gossip
-        st = st._replace(gossip=g._replace(alive=g.alive & ~pending_down))
+        st = st._replace(gossip=g._replace(alive=g.alive & ~go_down))
         tr = ChurnTrace(ever_down=tr.ever_down | ~st.gossip.alive,
                         always_up=tr.always_up & st.gossip.alive)
-        return (st, tr), ()
+        return (st, tr, cd), ()
 
     keys = jax.random.split(key, num_rounds)
-    (final, trace), _ = jax.lax.scan(body, (state, trace), keys)
+    (final, trace, _cd), _ = jax.lax.scan(
+        body, (state, trace, linger_init(n)), keys)
     return final, trace

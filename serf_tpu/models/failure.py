@@ -101,17 +101,27 @@ def _bounded_inject(state: GossipState, cfg: GossipConfig, candidates,
     prefix (their scores are > 0, non-candidates score 0), so the whole
     batch lands in one masked multi-slot scatter — no per-candidate copy of
     the cluster state.
+
+    Skip-gated: with zero candidates the pick + scatters + the N×W known
+    pass are bit-exact identities, so the whole body runs under
+    ``lax.cond`` on ``any(candidates)`` — on quiescent rounds (no new
+    suspicions/refutations/deaths, the steady state of a healthy
+    cluster) the phase costs one N-reduce instead of a top_k plus a full
+    known-plane rewrite.
     """
-    _, subjects, active = pick_bounded(candidates, max_new, key)
-    return inject_facts_batch(
-        state, cfg,
-        subjects=subjects,
-        kind=kind,
-        incarnations=incarnations[subjects],
-        ltimes=jnp.full((max_new,), state.round.astype(jnp.uint32)),
-        origins=origins[subjects],
-        active=active,
-    )
+    def do(st):
+        _, subjects, active = pick_bounded(candidates, max_new, key)
+        return inject_facts_batch(
+            st, cfg,
+            subjects=subjects,
+            kind=kind,
+            incarnations=incarnations[subjects],
+            ltimes=jnp.full((max_new,), st.round.astype(jnp.uint32)),
+            origins=origins[subjects],
+            active=active,
+        )
+
+    return jax.lax.cond(jnp.any(candidates), do, lambda st: st, state)
 
 
 def probe_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
@@ -191,28 +201,51 @@ def probe_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
 def refute_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
                  key: jax.Array) -> GossipState:
     """Alive nodes that know they are suspected/declared-dead bump their
-    incarnation and emit an alive fact (reference _refute semantics)."""
-    n, k = cfg.n, cfg.k_facts
-    known = unpack_bits(state.known, k)                      # bool[N, K]
-    accusation = _facts_about(state, (K_SUSPECT, K_DEAD))    # bool[K]
-    about_me = state.facts.subject[None, :] == jnp.arange(n)[:, None]
-    inc_beats_me = state.facts.incarnation[None, :] >= state.incarnation[:, None]
-    accused = jnp.any(known & accusation[None, :] & about_me & inc_beats_me,
-                      axis=1) & state.alive
+    incarnation and emit an alive fact (reference _refute semantics).
 
-    new_inc = jnp.where(accused, state.incarnation + 1, state.incarnation)
-    state = state._replace(incarnation=new_inc)
-    return _bounded_inject(state, cfg, accused, K_ALIVE, new_inc,
-                           jnp.arange(n, dtype=jnp.int32),
-                           fcfg.max_new_facts, key)
+    Skip-gated on ``any(accusation)`` — a K-sized predicate: with no
+    suspect/dead fact in the table the N×K accusation scan and the
+    inject are bit-exact identities, so a quiescent round skips them."""
+    n, k = cfg.n, cfg.k_facts
+    accusation = _facts_about(state, (K_SUSPECT, K_DEAD))    # bool[K]
+
+    def do(state):
+        known = unpack_bits(state.known, k)                  # bool[N, K]
+        about_me = state.facts.subject[None, :] == jnp.arange(n)[:, None]
+        inc_beats_me = (state.facts.incarnation[None, :]
+                        >= state.incarnation[:, None])
+        accused = jnp.any(known & accusation[None, :] & about_me
+                          & inc_beats_me, axis=1) & state.alive
+        new_inc = jnp.where(accused, state.incarnation + 1,
+                            state.incarnation)
+        state = state._replace(incarnation=new_inc)
+        return _bounded_inject(state, cfg, accused, K_ALIVE, new_inc,
+                               jnp.arange(n, dtype=jnp.int32),
+                               fcfg.max_new_facts, key)
+
+    return jax.lax.cond(jnp.any(accusation), do, lambda st: st, state)
 
 
 def declare_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
                   key: jax.Array) -> GossipState:
-    """Suspicions that aged out without refutation become dead declarations."""
+    """Suspicions that aged out without refutation become dead declarations.
+
+    Skip-gated on ``any(suspect)`` — a K-sized predicate: with no
+    suspicion in the table every mask below is all-False and the round
+    is a bit-exact identity, so a quiescent round skips the N×K scans."""
+    suspect = _facts_about(state, (K_SUSPECT,))
+    return jax.lax.cond(
+        jnp.any(suspect),
+        lambda st: _declare_round_body(st, cfg, fcfg, suspect, key),
+        lambda st: st,
+        state)
+
+
+def _declare_round_body(state: GossipState, cfg: GossipConfig,
+                        fcfg: FailureConfig, suspect: jnp.ndarray,
+                        key: jax.Array) -> GossipState:
     n, k = cfg.n, cfg.k_facts
     known = unpack_bits(state.known, k)
-    suspect = _facts_about(state, (K_SUSPECT,))
     # mod_age is garbage where the known bit is clear; `expired` below
     # ANDs with `known`, which gates it
     aged = mod_age(state) >= fcfg.suspicion_rounds

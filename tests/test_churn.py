@@ -73,7 +73,8 @@ def test_churn_rates_zero_is_identity():
 def test_leave_announcement_disseminates_before_leaver_goes_dark():
     """A graceful leaver's K_LEAVE fact must actually spread: run churn with
     only leaves and verify the announcement reaches the cluster even though
-    the leaver is dark from the next round on."""
+    the leaver goes dark after its linger window (the device analog of the
+    reference's leave broadcast drain) expires."""
     from serf_tpu.models.dissemination import coverage
     from serf_tpu.models.swim import ClusterConfig, make_cluster
 
@@ -81,7 +82,7 @@ def test_leave_announcement_disseminates_before_leaver_goes_dark():
                         with_failure=False, with_vivaldi=False)
     ccfg = ChurnConfig(leave_rate=0.01, max_events=2)
     state = make_cluster(cfg, jax.random.key(0))
-    state, trace = run_cluster_churn(state, cfg, ccfg, jax.random.key(1), 3)
+    state, trace = run_cluster_churn(state, cfg, ccfg, jax.random.key(1), 8)
     downs = int(jnp.sum(trace.ever_down))
     assert downs > 0, "no leaves sampled; pick a different seed"
     # let the announcements disseminate among survivors
@@ -135,3 +136,43 @@ def test_poisson_churn_100k_detection_and_no_false_deaths():
     false_deaths = believed & trace.always_up
     assert int(jnp.sum(false_deaths)) == 0, \
         f"{int(jnp.sum(false_deaths))} false deaths among always-up nodes"
+
+
+def test_leave_linger_countdown_semantics():
+    """linger_step: a leaver stays up exactly leave_linger_rounds rounds
+    after announcing, re-announcing re-arms, and idle nodes never fire."""
+    from serf_tpu.models.churn import linger_init, linger_step
+
+    n = 4
+    cd = linger_init(n)
+    none = jnp.zeros((n,), bool)
+    leaver = none.at[1].set(True)
+
+    cd, down = linger_step(cd, leaver, 3)      # announce: cd 3 -> 2
+    assert not bool(down.any())
+    cd, down = linger_step(cd, none, 3)        # 2 -> 1
+    assert not bool(down.any())
+    cd, down = linger_step(cd, leaver, 3)      # re-announce re-arms: 3 -> 2
+    assert not bool(down.any())
+    cd, down = linger_step(cd, none, 3)        # 2 -> 1
+    cd, down = linger_step(cd, none, 3)        # 1 -> 0: goes down NOW
+    assert bool(down[1]) and int(down.sum()) == 1
+    cd, down = linger_step(cd, none, 3)        # stays down, no re-fire
+    assert not bool(down.any())
+
+    # a node that DIES mid-linger has its countdown cleared: a later
+    # rejoin must not be forced straight back down by the stale timer
+    cd = linger_init(n)
+    cd, down = linger_step(cd, leaver, 3)                  # announce
+    alive = jnp.ones((n,), bool).at[1].set(False)          # crashes now
+    cd, down = linger_step(cd, none, 3, alive=alive)       # cleared
+    assert not bool(down.any()) and int(cd[1]) == 0
+    alive = alive.at[1].set(True)                          # rejoins
+    for _ in range(4):
+        cd, down = linger_step(cd, none, 3, alive=alive)
+        assert not bool(down.any()), "stale linger killed a rejoiner"
+
+    # linger_rounds values past the u8 range clamp instead of wrapping
+    cd = linger_init(n)
+    cd, down = linger_step(cd, leaver, 256)
+    assert int(cd[1]) == 254                               # armed at 255

@@ -592,9 +592,11 @@ def test_declare_round_attributes_declarer_per_subject():
 
 
 def test_sharded_query_churn_parity_8_devices():
-    """Query gather + churn composed with the flagship round, sharded over
-    8 devices, must be bit-identical to the single-device run."""
-    from serf_tpu.models.churn import ChurnConfig, churn_round
+    """Query gather + churn composed with the flagship round — including
+    the leave-linger countdown carry the production step ships — sharded
+    over 8 devices, must be bit-identical to the single-device run."""
+    from serf_tpu.models.churn import (ChurnConfig, churn_round,
+                                       linger_init, linger_step)
     from serf_tpu.models.query import (QueryConfig, launch_query,
                                        make_queries, no_filter_mask,
                                        query_round)
@@ -612,17 +614,20 @@ def test_sharded_query_churn_parity_8_devices():
 
     def steps(st, qs, key, num_rounds):
         def body(carry, subkey):
-            st, qs = carry
+            st, qs, cd = carry
             k_c, k_r, k_q = jax.random.split(subkey, 3)
-            g, pending = churn_round(st.gossip, cfg.gossip, ccfg, k_c)
+            g, new_leavers = churn_round(st.gossip, cfg.gossip, ccfg, k_c)
             st = st._replace(gossip=g)
             st = cluster_round(st, cfg, k_r)
             qs = query_round(st.gossip, qs, cfg.gossip, qcfg, k_q)
+            cd, go_down = linger_step(cd, new_leavers,
+                                      ccfg.leave_linger_rounds,
+                                      alive=st.gossip.alive)
             g2 = st.gossip
-            st = st._replace(gossip=g2._replace(alive=g2.alive & ~pending))
-            return (st, qs), ()
-        (st, qs), _ = jax.lax.scan(body, (st, qs),
-                                   jax.random.split(key, num_rounds))
+            st = st._replace(gossip=g2._replace(alive=g2.alive & ~go_down))
+            return (st, qs, cd), ()
+        (st, qs, _cd), _ = jax.lax.scan(body, (st, qs, linger_init(cfg.n)),
+                                        jax.random.split(key, num_rounds))
         return st, qs
 
     mesh = make_mesh(8)
