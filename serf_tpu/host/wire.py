@@ -7,10 +7,11 @@ checksums {crc32, xxhash, murmur3} and compressions {snappy, zstd, lz4,
 brotli}.  Here the checksum registry carries the reference's exact variants
 (xxhash32 and murmur3 are hand-rolled below — small, well-specified, and
 dependency-free) plus adler32; the compression registry carries zlib, the
-hand-rolled native LZ4 and snappy block codecs (native/codec.cpp), and
-zstd via the baked-in ``zstandard`` module (brotli stays the one documented
-deviation in PARITY.md — the environment forbids new dependencies).
-Registering another algorithm is one dict entry.
+hand-rolled native LZ4 and snappy block codecs (native/codec.cpp), zstd
+via the baked-in ``zstandard`` module, and brotli via ctypes bindings to
+the system libbrotlienc/libbrotlidec (round 4 — the full reference
+variant set {snappy, zstd, lz4, brotli} is now covered with zero new
+dependencies).  Registering another algorithm is one dict entry.
 
 Wire layout (outermost first):  [AES-GCM]([checksum4](marker1 + payload))
 """
@@ -218,17 +219,145 @@ def _zstd_compress(data: bytes) -> bytes:
     return _zstd_c.compress(data)
 
 
+#: amplification cap for compressors WITHOUT a format-level expansion
+#: bound (zstd, brotli).  lz4/snappy literal runs cannot exceed ~255x by
+#: construction, so their guards stay strictly payload-proportional; a
+#: zstd/brotli stream can LEGITIMATELY exceed 255x on uniform data (found
+#: live: 5000 x 'x' -> a 19-byte zstd frame, declared 5000 > 19*255+64),
+#: so those guards get a 1 MiB allocation floor — still a hard bound on
+#: what a malicious tiny packet can force us to allocate.
+_ENTROPY_CAP_FLOOR = 1 << 20
+
+
+def _entropy_cap(payload_len: int) -> int:
+    return min(_LZ4_MAX_RAW, max(_ENTROPY_CAP_FLOOR, payload_len * 255 + 64))
+
+
 def _zstd_decompress(payload: bytes) -> bytes:
     # the frame header declares the content size (ZstdCompressor writes
-    # it); bound it with the same payload-proportional amplification guard
-    # as lz4/snappy before the decompressor allocates — a ~2 KB RLE frame
+    # it); bound it BEFORE the decompressor allocates — a ~2 KB RLE frame
     # can otherwise declare (and force allocation of) tens of MB
     params = _zstandard.get_frame_parameters(payload)
-    cap = min(_LZ4_MAX_RAW, len(payload) * 255 + 64)
+    cap = _entropy_cap(len(payload))
     if params.content_size > cap:
         raise ValueError(f"zstd declared size {params.content_size} "
                          f"implausible for a {len(payload)}-byte payload")
     return _zstd_d.decompress(payload, max_output_size=cap)
+
+
+# brotli rides the system shared libraries (libbrotlienc/libbrotlidec —
+# present in this image) through ctypes: no new Python dependency, no
+# vendored code.  This closes the reference's 4th feature-gated variant
+# (serf-core/Cargo.toml:30-37).  Absent from the registry when the
+# libraries are missing, exactly like zstd.
+def _load_brotli():
+    import ctypes
+
+    try:
+        enc = ctypes.CDLL("libbrotlienc.so.1")
+        dec = ctypes.CDLL("libbrotlidec.so.1")
+        _bind_brotli_symbols(enc, dec)
+    except (OSError, AttributeError):
+        # missing libraries OR a stripped/old build lacking a symbol:
+        # degrade to an absent registry entry, never an import crash
+        return None
+    return enc, dec
+
+
+def _bind_brotli_symbols(enc, dec):
+    import ctypes
+
+    enc.BrotliEncoderMaxCompressedSize.restype = ctypes.c_size_t
+    enc.BrotliEncoderMaxCompressedSize.argtypes = [ctypes.c_size_t]
+    enc.BrotliEncoderCompress.restype = ctypes.c_int
+    enc.BrotliEncoderCompress.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_size_t,
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_size_t), ctypes.c_void_p]
+    dec.BrotliDecoderCreateInstance.restype = ctypes.c_void_p
+    dec.BrotliDecoderCreateInstance.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+    dec.BrotliDecoderDestroyInstance.restype = None
+    dec.BrotliDecoderDestroyInstance.argtypes = [ctypes.c_void_p]
+    dec.BrotliDecoderDecompressStream.restype = ctypes.c_int
+    dec.BrotliDecoderDecompressStream.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_size_t),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+        ctypes.POINTER(ctypes.c_size_t),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+        ctypes.POINTER(ctypes.c_size_t)]
+
+
+_brotli = _load_brotli()
+
+
+def _brotli_compress(data: bytes) -> bytes:
+    import ctypes
+
+    enc, _ = _brotli
+    cap = enc.BrotliEncoderMaxCompressedSize(len(data)) or (len(data) + 1024)
+    out = ctypes.create_string_buffer(cap)
+    out_len = ctypes.c_size_t(cap)
+    # quality 1 / lgwin 22 / mode 0 (GENERIC): the latency-first setting,
+    # matching the level-1 stance of the zlib/zstd variants
+    ok = enc.BrotliEncoderCompress(1, 22, 0, len(data), data,
+                                   ctypes.byref(out_len), out)
+    if not ok:
+        raise ValueError("brotli compression failed")
+    return ctypes.string_at(out, out_len.value)
+
+
+_BROTLI_CHUNK = 65536
+
+
+def _brotli_decompress(payload: bytes) -> bytes:
+    """Streaming decode with the same payload-proportional amplification
+    guard as lz4/snappy/zstd.  Brotli streams carry no declared output
+    size, so the bound is enforced incrementally: output grows in
+    ``_BROTLI_CHUNK`` pieces and the decode aborts the moment the total
+    would exceed the cap — no full-cap allocation ever happens (a 1400-
+    byte packet must not cost a 357 KB zeroed buffer per decode)."""
+    import ctypes
+
+    _, dec = _brotli
+    cap = _entropy_cap(len(payload))
+    state = dec.BrotliDecoderCreateInstance(None, None, None)
+    if not state:
+        raise ValueError("brotli decoder allocation failed")
+    try:
+        # zero-copy input: the decoder only READS the buffer, and the
+        # `payload` local keeps the bytes object alive for the call
+        next_in = ctypes.cast(ctypes.c_char_p(payload),
+                              ctypes.POINTER(ctypes.c_ubyte))
+        avail_in = ctypes.c_size_t(len(payload))
+        total = ctypes.c_size_t(0)
+        out_chunk = (ctypes.c_ubyte * _BROTLI_CHUNK)()
+        chunks = []
+        produced_total = 0
+        while True:
+            next_out = ctypes.cast(out_chunk,
+                                   ctypes.POINTER(ctypes.c_ubyte))
+            avail_out = ctypes.c_size_t(_BROTLI_CHUNK)
+            res = dec.BrotliDecoderDecompressStream(
+                state, ctypes.byref(avail_in), ctypes.byref(next_in),
+                ctypes.byref(avail_out), ctypes.byref(next_out),
+                ctypes.byref(total))
+            produced = _BROTLI_CHUNK - avail_out.value
+            if produced:
+                produced_total += produced
+                if produced_total > cap:
+                    raise ValueError(
+                        f"brotli output exceeds {cap} bytes for a "
+                        f"{len(payload)}-byte payload (amplification)")
+                chunks.append(ctypes.string_at(out_chunk, produced))
+            if res == 1:                      # SUCCESS
+                return b"".join(chunks)
+            if res == 3:                      # NEEDS_MORE_OUTPUT
+                continue
+            # 0 = ERROR (corrupt), 2 = NEEDS_MORE_INPUT (truncated)
+            raise ValueError(f"brotli decode failed (result {res})")
+    finally:
+        dec.BrotliDecoderDestroyInstance(state)
 
 
 # marker byte → (compress, decompress); marker 0 = uncompressed
@@ -240,6 +369,8 @@ COMPRESSIONS: Dict[str, Tuple[int, Callable[[bytes], bytes],
 }
 if _zstandard is not None:
     COMPRESSIONS["zstd"] = (4, _zstd_compress, _zstd_decompress)
+if _brotli is not None:
+    COMPRESSIONS["brotli"] = (5, _brotli_compress, _brotli_decompress)
 _DECOMPRESS_BY_MARKER = {m: d for (m, _c, d) in COMPRESSIONS.values()}
 
 
@@ -311,8 +442,12 @@ def decode_wire(buf: bytes, compression: Optional[str],
 # worst-case expansion headroom per compressor on packet-sized payloads
 # (zlib: header+adler; lz4: varint size prefix + token overhead n/255+16,
 # ~27B at the 1400B UDP budget; snappy: preamble + literal tags n/60;
-# zstd: frame header + block headers)
-_COMPRESSION_OVERHEAD = {"zlib": 16, "lz4": 32, "snappy": 48, "zstd": 64}
+# zstd: frame header + block headers; brotli: stream header + uncompressed
+# meta-block headers).  Keep this table covering the whole COMPRESSIONS
+# registry — the .get default below is only a safety net for
+# externally-registered algorithms.
+_COMPRESSION_OVERHEAD = {"zlib": 16, "lz4": 32, "snappy": 48, "zstd": 64,
+                         "brotli": 64}
 
 
 def wire_overhead(compression: Optional[str], checksum: Optional[str]) -> int:

@@ -94,7 +94,8 @@ def _snappy_available() -> bool:
 _COMPRESSIONS = ([None, "zlib"]
                  + (["lz4"] if _lz4_available() else [])
                  + (["snappy"] if _snappy_available() else [])
-                 + (["zstd"] if "zstd" in COMPRESSIONS else []))
+                 + (["zstd"] if "zstd" in COMPRESSIONS else [])
+                 + (["brotli"] if "brotli" in COMPRESSIONS else []))
 
 
 @settings(max_examples=150, deadline=None)

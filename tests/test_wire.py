@@ -367,13 +367,20 @@ class TestZstd:
         assert len(big) < 1024 * 1024  # RLE frame: tiny payload, huge claim
         with pytest.raises(ValueError, match="implausible"):
             _zstd_decompress(big)
-        # under the 64 MiB absolute cap but still ~30,000x the payload:
-        # the payload-proportional bound (matching lz4/snappy) must reject
+        # under the 64 MiB absolute cap but far past the entropy cap
+        # (max(1 MiB floor, 255x payload)): must reject
+        from serf_tpu.host.wire import _entropy_cap
+
         mid = zstandard.ZstdCompressor(level=1).compress(
             b"\x00" * (63 * 1024 * 1024))
-        assert len(mid) * 255 + 64 < 63 * 1024 * 1024
+        assert _entropy_cap(len(mid)) < 63 * 1024 * 1024
         with pytest.raises(ValueError, match="implausible"):
             _zstd_decompress(mid)
+        # a LEGITIMATE >255x frame under the 1 MiB floor decodes fine
+        # (the old strict proportional bound falsely rejected these)
+        legit = zstandard.ZstdCompressor(level=1).compress(b"x" * 5000)
+        assert len(legit) * 255 + 64 < 5000
+        assert _zstd_decompress(legit) == b"x" * 5000
 
     @pytest.mark.asyncio
     async def test_cluster_converges_over_zstd(self):
@@ -403,3 +410,57 @@ class TestZstd:
         finally:
             for ml in nodes:
                 await ml.shutdown()
+
+
+@pytest.mark.skipif("brotli" not in COMPRESSIONS,
+                    reason="system brotli libraries unavailable")
+class TestBrotli:
+    """The 4th reference compression variant, via ctypes to the system
+    libbrotlienc/libbrotlidec (serf-core/Cargo.toml:30-37)."""
+
+    def test_wire_pipeline_with_brotli(self):
+        payload = b"gossip state " * 50
+        for checksum in (None, "crc32", "murmur3"):
+            enc = encode_wire(payload, "brotli", checksum)
+            assert decode_wire(enc, "brotli", checksum) == payload
+            assert len(enc) < len(payload) // 2
+
+    def test_round_trip_sizes(self):
+        import os
+
+        from serf_tpu.host.wire import _brotli_compress, _brotli_decompress
+
+        for size in (0, 1, 100, 1400, 65536):
+            data = os.urandom(size)
+            assert _brotli_decompress(_brotli_compress(data)) == data
+
+    def test_corruption_dropped(self):
+        enc = bytearray(encode_wire(b"y" * 200, "brotli", None))
+        enc[-3] ^= 0x20
+        with pytest.raises(WireError):
+            decode_wire(bytes(enc), "brotli", None)
+
+    def test_amplification_bounded(self):
+        """A tiny stream claiming a huge output fails at the capped
+        buffer instead of forcing the allocation."""
+        from serf_tpu.host.wire import _brotli_compress, _brotli_decompress
+
+        from serf_tpu.host.wire import _entropy_cap
+
+        bomb = _brotli_compress(b"\x00" * (8 * 1024 * 1024))
+        assert len(bomb) < 16 * 1024        # highly compressible
+        assert _entropy_cap(len(bomb)) < 8 * 1024 * 1024
+        with pytest.raises(ValueError, match="amplification"):
+            _brotli_decompress(bomb)
+        # a LEGITIMATE highly-compressible frame above 255x but under the
+        # 1 MiB floor decodes fine (the zstd guard's old strict bound
+        # falsely rejected these — found live)
+        legit = _brotli_compress(b"x" * (512 * 1024))
+        assert len(legit) * 255 + 64 < 512 * 1024
+        assert _brotli_decompress(legit) == b"x" * (512 * 1024)
+
+    def test_garbage_rejected(self):
+        from serf_tpu.host.wire import _brotli_decompress
+
+        with pytest.raises(ValueError):
+            _brotli_decompress(b"\xff\xfe\xfd not brotli at all")
