@@ -8,10 +8,14 @@ rolling adjustment, and gravity — pure elementwise f32 math that XLA fuses
 into a handful of kernels.  Baseline config #5 (BASELINE.json): 1M-node
 latency-graph estimation.
 
-Deviation from the host plane (documented): the per-peer median latency
-filter would need O(N²) state at cluster scale, so the device plane feeds
-raw RTT samples (equivalent to ``latency_filter_size=1``); the parity test
-pins device-vs-host equality under that setting.
+Latency filtering (round 4): the reference's per-PEER median filter
+would need O(N²) state at cluster scale; ``VivaldiConfig.
+latency_filter_size`` instead gives an optional per-NODE median ring
+over the partner sample stream (O(N) state, all elementwise).  Default
+1 (off) — on a clean stream cross-partner mixing corrupts the
+(partner, rtt) pairing; under spiked RTT noise the filter measurably
+wins (test pinned).  The parity test pins device-vs-host equality at
+``latency_filter_size=1``.
 """
 
 from __future__ import annotations
@@ -36,6 +40,26 @@ class VivaldiConfig:
     adjustment_window: int = 20
     height_min: float = 10.0e-6
     gravity_rho: float = 150.0
+    #: per-NODE median filter over the last F observed RTT samples
+    #: (f32[N, F] — 12 MB at 1M for F=3).  The reference filters per-PEER
+    #: (coordinate.rs latency filter, default 3), which is O(N²) state at
+    #: cluster scale; this per-node variant filters the rotation-partner
+    #: sample STREAM instead, rejecting transport spikes (the filter's
+    #: purpose) at the cost of mixing samples across partners.  Default 1
+    #: (off): on a clean RTT stream cross-partner mixing corrupts the
+    #: (partner, rtt) pairing the spring update needs; enable (3) for
+    #: noisy environments — test_vivaldi_latency_filter_rejects_spikes
+    #: quantifies the trade.  Must be <= adjustment_window: the ring
+    #: cursor rides adj_index, which wraps at the window (validated in
+    #: __post_init__).
+    latency_filter_size: int = 1
+
+    def __post_init__(self):
+        if not 1 <= self.latency_filter_size <= self.adjustment_window:
+            raise ValueError(
+                f"latency_filter_size {self.latency_filter_size} must be in "
+                f"[1, adjustment_window={self.adjustment_window}] — the "
+                f"ring cursor rides adj_index, which wraps at the window")
 
 
 class VivaldiState(NamedTuple):
@@ -50,6 +74,9 @@ class VivaldiState(NamedTuple):
                               # 1M); re-summed exactly at each ring wrap so
                               # f32 drift is bounded to `window` updates
     adj_index: jnp.ndarray    # i32 scalar ring cursor
+    rtt_ring: jnp.ndarray     # f32[N, F] recent raw RTT samples (median
+                              # latency filter; F=1 plane unused)
+    rtt_seen: jnp.ndarray     # bool[N] ring seeded by a first sample
 
 
 def make_vivaldi(n: int, cfg: VivaldiConfig) -> VivaldiState:
@@ -61,6 +88,9 @@ def make_vivaldi(n: int, cfg: VivaldiConfig) -> VivaldiState:
         adj_samples=jnp.zeros((n, cfg.adjustment_window), jnp.float32),
         adj_sum=jnp.zeros((n,), jnp.float32),
         adj_index=jnp.asarray(0, jnp.int32),
+        rtt_ring=jnp.zeros((n, max(1, cfg.latency_filter_size)),
+                           jnp.float32),
+        rtt_seen=jnp.zeros((n,), bool),
     )
 
 
@@ -110,6 +140,25 @@ def vivaldi_update(state: VivaldiState, cfg: VivaldiConfig,
         active = jnp.ones((n,), bool)
     k_force, k_grav = jax.random.split(key)
     rtt = jnp.maximum(rtt, ZERO_THRESHOLD)
+
+    # -- optional per-node median latency filter (see VivaldiConfig)
+    fsize = cfg.latency_filter_size
+    if fsize > 1:
+        # the first active sample seeds the whole ring (median of fewer-
+        # than-F observed samples ≈ the host filter's warmup); later
+        # actives overwrite one slot under a shared cursor — a stale slot
+        # still holds this node's own older sample.  All elementwise over
+        # [N, F]: no scatters.
+        seed = (~state.rtt_seen & active)[:, None]
+        ring = jnp.where(seed, rtt[:, None], state.rtt_ring)
+        col = state.adj_index % fsize
+        onehot = (jnp.arange(fsize) == col)[None, :]
+        ring = jnp.where(onehot & (state.rtt_seen & active)[:, None],
+                         rtt[:, None], ring)
+        rtt = jnp.where(active, jnp.median(ring, axis=1), rtt)
+        rtt_seen = state.rtt_seen | active
+    else:
+        ring, rtt_seen = state.rtt_ring, state.rtt_seen
 
     if peer_roll is None:
         p_vec = state.vec[peer]
@@ -179,7 +228,8 @@ def vivaldi_update(state: VivaldiState, cfg: VivaldiConfig,
     # -- NaN/Inf safety: reset invalid rows (reference validity check)
     cand = VivaldiState(g_vec, g_height, error, adjustment, adj_samples,
                         adj_sum,
-                        (state.adj_index + 1) % cfg.adjustment_window)
+                        (state.adj_index + 1) % cfg.adjustment_window,
+                        ring, rtt_seen)
     bad = ~(jnp.all(jnp.isfinite(cand.vec), axis=-1)
             & jnp.isfinite(cand.height) & jnp.isfinite(cand.error)
             & jnp.isfinite(cand.adjustment))
@@ -226,6 +276,14 @@ def vivaldi_update(state: VivaldiState, cfg: VivaldiConfig,
         adj_samples=adj_samples_f,
         adj_sum=adj_sum_f,
         adj_index=cand.adj_index,
+        # ring rows already route inactive nodes to their old samples;
+        # bad-row wipe matches the fresh state (re-seeded on next sample).
+        # At fsize == 1 the planes are semantically unused — pass them
+        # through untouched so the round pays nothing for them.
+        rtt_ring=(pick(cand.rtt_ring, state.rtt_ring, fresh.rtt_ring)
+                  if fsize > 1 else state.rtt_ring),
+        rtt_seen=(pick(cand.rtt_seen, state.rtt_seen, fresh.rtt_seen)
+                  if fsize > 1 else state.rtt_seen),
     )
 
 
