@@ -145,6 +145,23 @@ def main() -> int:
     record("flagship_1m", rps=round(rps, 1), coverage0=cov,
            vs_10k_target=round(rps / 10_000.0, 2))
 
+    # -- stage 2b: flagship with the fused Pallas select/merge kernels
+    #    (the VERDICT-r3 #4 lever: fusion in the HEADLINE path, not just
+    #    the swim subset) — best-effort like every Pallas stage
+    if not pallas_failed:
+        try:
+            ccfg_p = dataclasses.replace(
+                ccfg, gossip=dataclasses.replace(gcfg, use_pallas=True))
+            run_fp = jax.jit(functools.partial(run_cluster, cfg=ccfg_p),
+                             static_argnames=("num_rounds",),
+                             donate_argnums=(0,))
+            _, fp_rps = timed(run_fp, seeded())
+            record("flagship_1m_pallas", rps=round(fp_rps, 1),
+                   speedup_vs_xla=round(fp_rps / rps, 3))
+        except Exception as e:  # noqa: BLE001 - keep capturing evidence
+            pallas_failed = True
+            record("flagship_1m_pallas", ok=False, error=repr(e)[:500])
+
     # -- stage 3: swim-only + Pallas A/B ------------------------------------
     run_sw = jax.jit(functools.partial(run_swim, cfg=gcfg, fcfg=fcfg),
                      static_argnames=("num_rounds",), donate_argnums=(0,))
