@@ -91,6 +91,49 @@ def _subject_covered(state: GossipState, cfg: GossipConfig,
     return covered
 
 
+def accusations_pending(state: GossipState) -> jnp.ndarray:
+    """bool[K]: accusation facts (suspect/dead) that could still trigger a
+    refutation — incarnation beats the subject's AND the subject is
+    alive.  The refute_round skip-gate: all-False means the phase is a
+    bit-exact identity (retired-but-valid ring facts fail this, so the
+    gate switches OFF again in the post-detection steady state)."""
+    accusation = _facts_about(state, (K_SUSPECT, K_DEAD))
+    subj = jnp.clip(state.facts.subject, 0)
+    return (accusation
+            & (state.facts.incarnation >= state.incarnation[subj])
+            & state.alive[subj])
+
+
+def _refutation_matrix(state: GossipState) -> jnp.ndarray:
+    """bool[K, K]: slot j refutes slot i — an alive fact about the same
+    subject with STRICTLY higher incarnation.  The single source of the
+    refutation semantics; the declare gate, declare body, and
+    believed_dead all derive from it (a semantic change here must not be
+    able to diverge between the gate and the body it keys)."""
+    alive_facts = _facts_about(state, (K_ALIVE,))
+    same_subject = (state.facts.subject[:, None]
+                    == state.facts.subject[None, :])
+    higher_inc = (state.facts.incarnation[None, :]
+                  > state.facts.incarnation[:, None])
+    return same_subject & alive_facts[None, :] & higher_inc
+
+
+def live_suspicions(state: GossipState) -> jnp.ndarray:
+    """bool[K]: suspicion facts that could still produce a declaration —
+    neither refuted (alive fact, same subject, higher incarnation) nor
+    already covered by a dead declaration.  The declare_round skip-gate;
+    all-False makes the phase a bit-exact identity."""
+    suspect = _facts_about(state, (K_SUSPECT,))
+    refuted = jnp.any(_refutation_matrix(state), axis=1)
+    subj = jnp.clip(state.facts.subject, 0)
+    same_subject = (state.facts.subject[:, None]
+                    == state.facts.subject[None, :])
+    dead_slot = (_facts_about(state, (K_DEAD,))
+                 & (state.facts.incarnation >= state.incarnation[subj]))
+    dead_covered = jnp.any(same_subject & dead_slot[None, :], axis=1)
+    return suspect & ~refuted & ~dead_covered
+
+
 def _bounded_inject(state: GossipState, cfg: GossipConfig, candidates,
                     kind: int, incarnations, origins, max_new: int,
                     key: jax.Array) -> GossipState:
@@ -203,19 +246,23 @@ def refute_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
     """Alive nodes that know they are suspected/declared-dead bump their
     incarnation and emit an alive fact (reference _refute semantics).
 
-    Skip-gated on ``any(accusation)`` — a K-sized predicate: with no
-    suspect/dead fact in the table the N×K accusation scan and the
-    inject are bit-exact identities, so a quiescent round skips them."""
+    Skip-gated on a K-sized predicate: an accusation fact can only
+    trigger a refutation while its incarnation still beats the subject's
+    AND the subject is alive.  Retired-but-valid ring facts (a declared
+    death, a refuted suspicion) fail the predicate, so the gate switches
+    the phase OFF again in the post-detection steady state — with it the
+    N×K accusation scan and the inject are bit-exact identities."""
     n, k = cfg.n, cfg.k_facts
-    accusation = _facts_about(state, (K_SUSPECT, K_DEAD))    # bool[K]
+    could_accuse = accusations_pending(state)
 
     def do(state):
+        # single-source with the gate: per-fact pending already encodes
+        # "accusation kind & incarnation beats the subject's & subject
+        # alive" for exactly the about_me row, so the body can never
+        # diverge from the gate it runs under
         known = unpack_bits(state.known, k)                  # bool[N, K]
         about_me = state.facts.subject[None, :] == jnp.arange(n)[:, None]
-        inc_beats_me = (state.facts.incarnation[None, :]
-                        >= state.incarnation[:, None])
-        accused = jnp.any(known & accusation[None, :] & about_me
-                          & inc_beats_me, axis=1) & state.alive
+        accused = jnp.any(known & could_accuse[None, :] & about_me, axis=1)
         new_inc = jnp.where(accused, state.incarnation + 1,
                             state.incarnation)
         state = state._replace(incarnation=new_inc)
@@ -223,19 +270,23 @@ def refute_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
                                jnp.arange(n, dtype=jnp.int32),
                                fcfg.max_new_facts, key)
 
-    return jax.lax.cond(jnp.any(accusation), do, lambda st: st, state)
+    return jax.lax.cond(jnp.any(could_accuse), do, lambda st: st, state)
 
 
 def declare_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
                   key: jax.Array) -> GossipState:
     """Suspicions that aged out without refutation become dead declarations.
 
-    Skip-gated on ``any(suspect)`` — a K-sized predicate: with no
-    suspicion in the table every mask below is all-False and the round
-    is a bit-exact identity, so a quiescent round skips the N×K scans."""
+    Skip-gated on a K-sized predicate: a suspicion can only produce a
+    declaration while it is neither refuted (an alive fact about the
+    same subject with higher incarnation) nor already covered by a dead
+    declaration.  Retired-but-valid ring facts fail it, so the gate
+    switches the phase OFF again in the post-detection steady state —
+    with it every mask in the body is all-False and the round is a
+    bit-exact identity skipping the N×K scans."""
     suspect = _facts_about(state, (K_SUSPECT,))
     return jax.lax.cond(
-        jnp.any(suspect),
+        jnp.any(live_suspicions(state)),
         lambda st: _declare_round_body(st, cfg, fcfg, suspect, key),
         lambda st: st,
         state)
@@ -249,13 +300,7 @@ def _declare_round_body(state: GossipState, cfg: GossipConfig,
     # mod_age is garbage where the known bit is clear; `expired` below
     # ANDs with `known`, which gates it
     aged = mod_age(state) >= fcfg.suspicion_rounds
-    # a refutation is an alive fact about the same subject with strictly
-    # higher incarnation present in the table
-    refuted = jnp.zeros((k,), bool)
-    alive_facts = _facts_about(state, (K_ALIVE,))
-    same_subject = state.facts.subject[:, None] == state.facts.subject[None, :]
-    higher_inc = state.facts.incarnation[None, :] > state.facts.incarnation[:, None]
-    refuted = jnp.any(same_subject & alive_facts[None, :] & higher_inc, axis=1)
+    refuted = jnp.any(_refutation_matrix(state), axis=1)
 
     expired = known & suspect[None, :] & aged & ~refuted[None, :] \
         & state.alive[:, None]
@@ -311,12 +356,9 @@ def believed_dead(state: GossipState, cfg: GossipConfig,
     aged_suspect = _facts_about(state, (K_SUSPECT,))
     aged = mod_age(state) >= fcfg.suspicion_rounds  # gated by `known` below
     evidence = known & (dead_fact[None, :] | (aged_suspect[None, :] & aged))
-    # refutation: knower also knows an alive fact about the same subject with
-    # strictly higher incarnation
-    alive_fact = _facts_about(state, (K_ALIVE,))
-    same_subject = state.facts.subject[:, None] == state.facts.subject[None, :]
-    higher = state.facts.incarnation[None, :] > state.facts.incarnation[:, None]
-    refutes = same_subject & alive_fact[None, :] & higher    # [K, K]
+    # refutation: knower also knows an alive fact about the same subject
+    # with strictly higher incarnation
+    refutes = _refutation_matrix(state)                      # [K, K]
     knower_refutes = jnp.einsum("nk,jk->nj", known.astype(jnp.float32),
                                 refutes.astype(jnp.float32)) > 0
     active = evidence & ~knower_refutes                      # bool[N, K]

@@ -946,3 +946,32 @@ def test_vivaldi_latency_filter_rejects_spikes():
     assert err_filtered < err_raw, \
         (f"median filter did not help under spike noise: "
          f"filtered {err_filtered:.3f} vs raw {err_raw:.3f}")
+
+
+def test_failure_gates_requiesce_after_detection():
+    """The refute/declare skip-gates must switch OFF again once the
+    detection cycle completes — retired-but-valid ring facts (declared
+    deaths, refuted suspicions) may NOT keep the N×K phases hot, or the
+    steady-state round (what the bench's timed scans measure) pays the
+    active-round cost forever."""
+    from serf_tpu.models.failure import (accusations_pending,
+                                         live_suspicions)
+
+    cfg = GossipConfig(n=512, k_facts=64)
+    fcfg = FailureConfig(suspicion_rounds=8, max_new_facts=4,
+                         probe_drop_rate=0.05)
+    s = make_state(cfg)
+    dead = jnp.array([3, 77, 200])
+    s = s._replace(alive=s.alive.at[dead].set(False))
+    run = jax.jit(functools.partial(run_swim, cfg=cfg, fcfg=fcfg),
+                  static_argnames=("num_rounds",))
+    s = run(s, key=jax.random.key(5), num_rounds=120)
+    assert bool(detection_complete(s, cfg, fcfg))
+    # the ring still holds the history (valid suspect/dead facts) ...
+    assert int(jnp.sum((s.facts.kind == K_DEAD) & s.facts.valid)) >= 3
+    assert int(jnp.sum((s.facts.kind == K_SUSPECT) & s.facts.valid)) >= 3
+    # ... but nothing can still act: both gates read quiescent
+    assert not bool(jnp.any(accusations_pending(s))), \
+        "refute gate stayed hot after detection completed"
+    assert not bool(jnp.any(live_suspicions(s))), \
+        "declare gate stayed hot after detection completed"
