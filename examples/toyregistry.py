@@ -24,6 +24,13 @@ self-signed deployment:
     python examples/toyregistry.py agent /tmp/a.sock 127.0.0.1:7946 \
         --tls cluster.pem cluster.key &
 
+``--udpstream`` runs gossip AND streams over one UDP socket (the
+QUIC-slot datagram-stream transport, AIMD congestion control); mutually
+exclusive with ``--tls``:
+
+    python examples/toyregistry.py agent /tmp/a.sock 127.0.0.1:7946 \
+        --udpstream &
+
 Or run an in-process demo cluster:
 
     python examples/toyregistry.py demo
@@ -139,22 +146,31 @@ async def demo() -> None:
 
 
 async def serve_agent(sock_path: str, bind: str, join: Optional[str],
-                      tls: Optional[tuple] = None) -> None:
+                      tls: Optional[tuple] = None,
+                      udpstream: bool = False) -> None:
     """Run one agent on real UDP/TCP (or TLS streams with ``--tls CERT
-    KEY``), controllable over a unix socket with line-delimited JSON:
-    {"op": "register"|"deregister"|"list"|"list-consistent"|"members"|
-    "leave", ...}.  ``--join`` accepts hostnames (resolved through the
-    transport's DNS seam)."""
+    KEY``, or the QUIC-slot single-UDP-socket transport with
+    ``--udpstream``), controllable over a unix socket with line-delimited
+    JSON: {"op": "register"|"deregister"|"list"|"list-consistent"|
+    "members"|"leave", ...}.  ``--join`` accepts hostnames (resolved
+    through the transport's DNS seam)."""
     from serf_tpu.host.net import NetTransport, TlsNetTransport, make_tls_contexts
 
     host, port = bind.rsplit(":", 1)
-    if tls:
+    if udpstream:
+        from serf_tpu.host.dstream import DatagramStreamTransport
+        transport = await DatagramStreamTransport.bind((host, int(port)))
+    elif tls:
         server_ctx, client_ctx = make_tls_contexts(*tls)
         transport = await TlsNetTransport.bind(
             (host, int(port)), server_ctx=server_ctx, client_ctx=client_ctx)
     else:
         transport = await NetTransport.bind((host, int(port)))
-    agent = await ToyRegistry.start(transport, Options(), f"agent@{bind}")
+    # identity from the ACTUAL bound address: naming from the bind string
+    # makes every ":0"-bound agent the same node (instant name conflict)
+    real_host, real_port = transport.local_addr[:2]
+    agent = await ToyRegistry.start(transport, Options(),
+                                    f"agent@{real_host}:{real_port}")
     if join:
         # raw string: the transport resolver handles host:port / DNS / IPv6
         await agent.serf.join(join)
@@ -181,7 +197,8 @@ async def serve_agent(sock_path: str, bind: str, join: Optional[str],
                                "services": await agent.list_consistent()}
                     elif op == "members":
                         out = {"ok": True, "members": [
-                            {"id": m.node.id, "status": m.status.name}
+                            {"id": m.node.id, "status": m.status.name,
+                             "addr": m.node.addr}
                             for m in agent.serf.members()]}
                     elif op == "leave":
                         await agent.serf.leave()
@@ -236,7 +253,13 @@ if __name__ == "__main__":
                 if idx + 2 >= len(sys.argv):
                     sys.exit("error: --tls requires CERT and KEY paths")
                 tls = (sys.argv[idx + 1], sys.argv[idx + 2])
-            asyncio.run(serve_agent(sys.argv[2], sys.argv[3], join_addr, tls))
+            udpstream = "--udpstream" in sys.argv
+            if udpstream and tls:
+                sys.exit("error: --udpstream and --tls are mutually "
+                         "exclusive (for an encrypted UDP-stream cluster, "
+                         "use a keyring — see serf_tpu.host.dstream)")
+            asyncio.run(serve_agent(sys.argv[2], sys.argv[3], join_addr, tls,
+                                    udpstream=udpstream))
         elif len(sys.argv) > 3 and sys.argv[1] == "client":
             asyncio.run(client_cmd(sys.argv[2], sys.argv[3:]))
         else:

@@ -12,6 +12,18 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
 pytestmark = pytest.mark.asyncio
 
 
+async def agent_rpc(sock, req, timeout=5.0):
+    """Line-delimited JSON RPC over the agent's unix control socket."""
+    import json
+
+    reader, writer = await asyncio.open_unix_connection(sock)
+    writer.write((json.dumps(req) + "\n").encode())
+    await writer.drain()
+    out = json.loads(await asyncio.wait_for(reader.readline(), timeout))
+    writer.close()
+    return out
+
+
 async def test_toyregistry_end_to_end():
     from toyregistry import ToyRegistry
     from serf_tpu.host import LoopbackNetwork
@@ -73,13 +85,7 @@ async def test_agent_unix_socket_rpc():
         serve_agent(sb, f"127.0.0.1:{pb}", f"127.0.0.1:{pa}"))
     await asyncio.sleep(0.5)
 
-    async def rpc(sock, req):
-        reader, writer = await asyncio.open_unix_connection(sock)
-        writer.write((json.dumps(req) + "\n").encode())
-        await writer.drain()
-        out = json.loads(await reader.readline())
-        writer.close()
-        return out
+    rpc = agent_rpc
 
     try:
         assert (await rpc(sa, {"op": "register", "name": "api",
@@ -128,13 +134,7 @@ async def test_agent_rpc_over_tls():
         serve_agent(sb, f"127.0.0.1:{pb}", f"127.0.0.1:{pa}", (cert, key)))
     await asyncio.sleep(0.5)
 
-    async def rpc(sock, req):
-        reader, writer = await asyncio.open_unix_connection(sock)
-        writer.write((json.dumps(req) + "\n").encode())
-        await writer.drain()
-        out = json.loads(await reader.readline())
-        writer.close()
-        return out
+    rpc = agent_rpc
 
     try:
         assert (await rpc(sa, {"op": "register", "name": "db",
@@ -150,3 +150,53 @@ async def test_agent_rpc_over_tls():
     finally:
         t1.cancel()
         t2.cancel()
+
+
+async def test_agent_over_udpstream():
+    """The agent CLI's --udpstream path: a 2-agent cluster over the
+    QUIC-slot transport, driven through the unix-socket control plane
+    exactly as the documented CLI would."""
+    import tempfile
+
+    from toyregistry import serve_agent
+
+    with tempfile.TemporaryDirectory() as d:
+        s0 = os.path.join(d, "a0.sock")
+        s1 = os.path.join(d, "a1.sock")
+        t0 = asyncio.create_task(
+            serve_agent(s0, "127.0.0.1:0", None, udpstream=True))
+        t1 = None
+        try:
+            for _ in range(100):
+                if os.path.exists(s0):
+                    break
+                await asyncio.sleep(0.05)
+            # discover the first agent's real bound port via the members
+            # op, then join the second agent to it
+            members = await agent_rpc(s0, {"op": "members"})
+            port = members["members"][0]["addr"][1]
+            t1 = asyncio.create_task(
+                serve_agent(s1, "127.0.0.1:0", f"127.0.0.1:{port}",
+                            udpstream=True))
+            for _ in range(100):
+                if os.path.exists(s1):
+                    break
+                await asyncio.sleep(0.05)
+            for _ in range(200):
+                m = await agent_rpc(s0, {"op": "members"})
+                if len(m["members"]) == 2:
+                    break
+                await asyncio.sleep(0.05)
+            assert len(m["members"]) == 2, m
+            await agent_rpc(s0, {"op": "register", "name": "api",
+                                 "addr": "10.0.0.1:80"})
+            for _ in range(200):
+                listing = await agent_rpc(s1, {"op": "list"})
+                if listing.get("services", {}).get("api") == "10.0.0.1:80":
+                    break
+                await asyncio.sleep(0.05)
+            assert listing["services"]["api"] == "10.0.0.1:80"
+        finally:
+            t0.cancel()
+            if t1 is not None:
+                t1.cancel()
