@@ -131,8 +131,11 @@ def main() -> None:
                         peer_sampling="rotation")
     fcfg = FailureConfig(suspicion_rounds=12, max_new_facts=8,
                          probe_schedule="round_robin")
+    # probe_every=5: the reference LAN profile's cadence mapping (gossip
+    # 200ms, probe 1s — probes and the vivaldi updates riding their acks
+    # run at 1/5 the gossip cadence)
     cfg = ClusterConfig(gossip=gcfg, failure=fcfg, push_pull_every=16,
-                        with_failure=True, with_vivaldi=True)
+                        probe_every=5, with_failure=True, with_vivaldi=True)
 
     def seeded_state(c):
         key = jax.random.key(0)

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from serf_tpu.models.dissemination import (
     GossipConfig,
     GossipState,
+    bump_last_learn,
     rolled_rows,
     round_u8,
     sample_offsets,
@@ -57,7 +58,9 @@ def push_pull_round(state: GossipState, cfg: GossipConfig, key: jax.Array,
     new_mask = unpack_bits(new_words, k)
     # a fresh stamp = age 0 = fresh transmit budget for newly synced facts
     stamp = jnp.where(new_mask, round_u8(state.round), state.stamp)
-    return state._replace(known=known, stamp=stamp)
+    last_learn = bump_last_learn(jnp.any(new_words != 0), state.round,
+                                 state.last_learn)
+    return state._replace(known=known, stamp=stamp, last_learn=last_learn)
 
 
 def make_partition(n: int, split: float = 0.5) -> jnp.ndarray:

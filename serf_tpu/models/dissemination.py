@@ -99,6 +99,16 @@ class GossipState(NamedTuple):
     incarnation: jnp.ndarray    # u32[N]     ground-truth own incarnation
     round: jnp.ndarray          # i32 scalar
     next_slot: jnp.ndarray      # i32 scalar ring cursor for fact injection
+    last_learn: jnp.ndarray     # i32 scalar round of the most recent learn
+                                # event ANYWHERE (inject or merge).  Once
+                                # `round - last_learn >= transmit_limit`,
+                                # every knower's derived age is >= the
+                                # limit, so NO fact is sendable and the
+                                # gossip exchange is provably an identity
+                                # — round_step skips it under lax.cond
+                                # (serf's empty broadcast queue sends
+                                # nothing).  Every path that writes
+                                # stamps/known must update this scalar.
 
 
 @dataclasses.dataclass(frozen=True)
@@ -175,6 +185,7 @@ def make_state(cfg: GossipConfig) -> GossipState:
         incarnation=jnp.ones((n,), jnp.uint32),
         round=jnp.asarray(0, jnp.int32),
         next_slot=jnp.asarray(0, jnp.int32),
+        last_learn=jnp.asarray(0, jnp.int32),
     )
 
 
@@ -216,6 +227,16 @@ def sending_mask(state: GossipState, cfg: GossipConfig) -> jnp.ndarray:
     known = unpack_bits(state.known, cfg.k_facts)
     return (known & (mod_age(state) < jnp.uint8(cfg.transmit_limit))
             & state.alive[:, None])
+
+
+def bump_last_learn(learned_any, learn_round, prev) -> jnp.ndarray:
+    """i32 scalar: ``learn_round`` if ``learned_any`` else ``prev``.
+
+    THE one way to maintain GossipState.last_learn — every path that
+    writes known/stamp must route through this (the quiet-round gate's
+    correctness depends on it; a writer that forgets the bump freezes
+    dissemination of its facts once the gate closes)."""
+    return jnp.where(learned_any, jnp.asarray(learn_round, jnp.int32), prev)
 
 
 def clamp_stamps(known: jnp.ndarray, stamp: jnp.ndarray, round_,
@@ -302,7 +323,9 @@ def inject_fact(state: GossipState, cfg: GossipConfig, subject, kind,
     known = known.at[origin, word].set(known[origin, word] | bitmask)
     stamp = state.stamp.at[origin, slot].set(round_u8(state.round))
     return state._replace(facts=facts, known=known,
-                          stamp=stamp, next_slot=state.next_slot + 1)
+                          stamp=stamp, next_slot=state.next_slot + 1,
+                          last_learn=bump_last_learn(True, state.round,
+                                                     state.last_learn))
 
 
 def inject_facts_batch(state: GossipState, cfg: GossipConfig, subjects,
@@ -365,7 +388,10 @@ def inject_facts_batch(state: GossipState, cfg: GossipConfig, subjects,
 
     return state._replace(facts=facts, known=known, stamp=stamp,
                           next_slot=state.next_slot
-                          + jnp.sum(active).astype(jnp.int32))
+                          + jnp.sum(active).astype(jnp.int32),
+                          last_learn=bump_last_learn(
+                              jnp.any(active), state.round,
+                              state.last_learn))
 
 
 #: below this, a flat top_k over all n scores is cheap; above it, top_k's
@@ -474,6 +500,13 @@ def round_step(state: GossipState, cfg: GossipConfig,
     ``group`` (optional i32[N]) is the partition mask: packets only flow
     between nodes in the same group — the device analog of the reference's
     block-diagonal adjacency partition (SURVEY.md §7 stage 6).
+
+    Skip-gated on ``round - last_learn < transmit_limit``: past that,
+    every knower's derived age is >= the limit, the sending set is
+    provably empty, and the whole select/exchange/merge is a bit-exact
+    identity — a fully quiescent cluster (serf with an empty broadcast
+    queue) pays only the round increment and the amortized clamp.  A new
+    injection or merge bumps ``last_learn`` and re-opens the gate.
     """
     n, k, w = cfg.n, cfg.k_facts, cfg.words
 
@@ -482,65 +515,85 @@ def round_step(state: GossipState, cfg: GossipConfig,
         from serf_tpu.ops import round_kernels
         use_pallas = round_kernels.pallas_ok(n, k)
 
-    if use_pallas:
-        alive_u8 = state.alive[:, None].astype(jnp.uint8)
-        # phase 1: pack sending bits — one read-only pass over the stamp
-        # plane + known words (derived age, no tick anywhere)
-        packets = round_kernels.select_packets(
-            state.stamp, state.known, alive_u8, cfg.transmit_limit,
-            state.round)
-    else:
-        # 1. packet selection: known facts with remaining transmit budget
-        #    (derived age < limit — see GossipState), from alive nodes
-        sending = sending_mask(state, cfg)
-        packets = pack_bits(sending)                          # u32[N, W]
+    def active(state):
+        if use_pallas:
+            alive_u8 = state.alive[:, None].astype(jnp.uint8)
+            # phase 1: pack sending bits — one read-only pass over the
+            # stamp plane + known words (derived age, no tick anywhere)
+            packets = round_kernels.select_packets(
+                state.stamp, state.known, alive_u8, cfg.transmit_limit,
+                state.round)
+        else:
+            # 1. packet selection: known facts with remaining transmit
+            #    budget (derived age < limit), from alive nodes
+            sending = sending_mask(state, cfg)
+            packets = pack_bits(sending)                      # u32[N, W]
 
-    # 3. pull-exchange: each alive node samples `fanout` peers and ORs
-    #    their packet words
-    if cfg.peer_sampling == "rotation":
-        # fanout random rotations shared by all nodes: peer reads are
-        # contiguous slices, no gather (see GossipConfig.peer_sampling)
-        offs = sample_offsets(key, cfg.fanout, n)
-        incoming = jnp.zeros_like(packets)
-        for f in range(cfg.fanout):
-            contrib = rolled_rows(packets, offs[f])           # u32[N, W]
+        # 3. pull-exchange: each alive node samples `fanout` peers and
+        #    ORs their packet words
+        if cfg.peer_sampling == "rotation":
+            # fanout random rotations shared by all nodes: peer reads are
+            # contiguous slices, no gather (GossipConfig.peer_sampling)
+            offs = sample_offsets(key, cfg.fanout, n)
+            incoming = jnp.zeros_like(packets)
+            for f in range(cfg.fanout):
+                contrib = rolled_rows(packets, offs[f])       # u32[N, W]
+                if group is not None:
+                    allowed = rolled_rows(group, offs[f]) == group
+                    contrib = jnp.where(allowed[:, None], contrib,
+                                        jnp.uint32(0))
+                incoming = incoming | contrib
+        else:
+            srcs = jax.random.randint(key, (n, cfg.fanout), 0, n)
+            gathered = packets[srcs]                          # u32[N, F, W]
             if group is not None:
-                allowed = rolled_rows(group, offs[f]) == group
-                contrib = jnp.where(allowed[:, None], contrib,
-                                    jnp.uint32(0))
-            incoming = incoming | contrib
-    else:
-        srcs = jax.random.randint(key, (n, cfg.fanout), 0, n)  # i32[N, F]
-        gathered = packets[srcs]                               # u32[N, F, W]
-        if group is not None:
-            allowed = (group[srcs] == group[:, None])          # bool[N, F]
-            gathered = jnp.where(allowed[:, :, None], gathered,
-                                 jnp.uint32(0))
-        incoming = jax.lax.reduce(gathered, jnp.uint32(0),
-                                  jnp.bitwise_or, (1,))        # u32[N, W]
+                allowed = (group[srcs] == group[:, None])     # bool[N, F]
+                gathered = jnp.where(allowed[:, :, None], gathered,
+                                     jnp.uint32(0))
+            incoming = jax.lax.reduce(gathered, jnp.uint32(0),
+                                      jnp.bitwise_or, (1,))   # u32[N, W]
 
-    if use_pallas:
-        # phases 4+5 fused: learn — set known bits and stamp newly learned
-        # facts with the post-increment round (first visible at age 0 next
-        # round); nothing ticks
-        known, stamp = round_kernels.merge_incoming(
-            state.known, incoming, alive_u8, state.stamp, state.round + 1)
-    else:
-        # 4. merge: learn facts we did not know; dead nodes learn nothing
-        alive_col = state.alive[:, None]
-        new_words = incoming & ~state.known & jnp.where(
-            alive_col, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
-        known = state.known | new_words
-        new_mask = unpack_bits(new_words, k)                  # bool[N, K]
-        # 5. the round's only N×K write: stamp newly learned facts with
-        #    the post-increment round — their derived age is 0 at the next
-        #    round's selection, exactly the old age-plane reset; everyone
-        #    else's age advances for free because `round` advanced.
-        stamp = jnp.where(new_mask, round_u8(state.round + 1), state.stamp)
+        if use_pallas:
+            # phases 4+5 fused: learn — set known bits and stamp newly
+            # learned facts with the post-increment round (first visible
+            # at age 0 next round); nothing ticks.  "learned anything" is
+            # definitional (output vs input known) so it can never desync
+            # from whatever the kernel's learn semantics are.
+            known, stamp = round_kernels.merge_incoming(
+                state.known, incoming, alive_u8, state.stamp,
+                state.round + 1)
+            learned_any = jnp.any(known != state.known)
+        else:
+            # 4. merge: learn facts we did not know; dead learn nothing
+            alive_col = state.alive[:, None]
+            new_words = incoming & ~state.known & jnp.where(
+                alive_col, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+            known = state.known | new_words
+            new_mask = unpack_bits(new_words, k)              # bool[N, K]
+            # 5. the round's only N×K write: stamp newly learned facts
+            #    with the post-increment round — their derived age is 0
+            #    at the next round's selection, exactly the old age-plane
+            #    reset; everyone else's age advances for free because
+            #    `round` advanced.
+            stamp = jnp.where(new_mask, round_u8(state.round + 1),
+                              state.stamp)
+            learned_any = jnp.any(new_words != 0)
+        last_learn = bump_last_learn(learned_any, state.round + 1,
+                                     state.last_learn)
+        return known, stamp, last_learn
 
-    # amortized wraparound guard (full-plane pass 1/CLAMP_EVERY rounds)
+    def quiet(state):
+        return state.known, state.stamp, state.last_learn
+
+    known, stamp, last_learn = jax.lax.cond(
+        state.round - state.last_learn < cfg.transmit_limit,
+        active, quiet, state)
+
+    # amortized wraparound guard (full-plane pass 1/CLAMP_EVERY rounds);
+    # runs in BOTH branches — the clamp is what keeps mod-256 stamp ages
+    # from wrapping back under the thresholds while the cluster is quiet
     stamp = clamp_stamps(known, stamp, state.round + 1, k)
-    return state._replace(known=known, stamp=stamp,
+    return state._replace(known=known, stamp=stamp, last_learn=last_learn,
                           round=state.round + 1)
 
 
@@ -590,7 +643,9 @@ def push_round_step(state: GossipState, cfg: GossipConfig,
     known = state.known | pack_bits(new_mask)
     stamp = jnp.where(new_mask, round_u8(state.round + 1), state.stamp)
     stamp = clamp_stamps(known, stamp, state.round + 1, k)
-    return state._replace(known=known, stamp=stamp,
+    last_learn = bump_last_learn(jnp.any(new_mask), state.round + 1,
+                                 state.last_learn)
+    return state._replace(known=known, stamp=stamp, last_learn=last_learn,
                           round=state.round + 1)
 
 

@@ -52,8 +52,25 @@ class ClusterConfig:
     failure: FailureConfig = FailureConfig()
     vivaldi: VivaldiConfig = VivaldiConfig()
     push_pull_every: int = 0       # rounds between anti-entropy syncs; 0=off
+    #: gossip rounds per probe (and per Vivaldi update, which rides probe
+    #: acks in the reference).  1 = probe every round (the conservative
+    #: default every detection test assumes).  The reference LAN profile
+    #: is gossip_interval=200ms / probe_interval=1s — i.e. probe_every=5
+    #: is the FAITHFUL cadence mapping; suspicion windows stay measured
+    #: in gossip rounds either way.  refute/declare stay every round
+    #: (they are driven by gossiped facts, not probes, and their
+    #: could-still-act gates make them free when idle).
+    probe_every: int = 1
     with_failure: bool = True
     with_vivaldi: bool = True
+
+    def __post_init__(self):
+        if self.probe_every < 1:
+            # no "0 = off" convention here (unlike push_pull_every):
+            # disabling probing entirely is with_failure=False
+            raise ValueError(
+                f"probe_every must be >= 1, got {self.probe_every} "
+                f"(use with_failure=False to disable probing)")
 
     @property
     def n(self) -> int:
@@ -84,9 +101,17 @@ def cluster_round(state: ClusterState, cfg: ClusterConfig,
     k_gossip, k_probe, k_refute, k_declare, k_pp, k_viv, k_peer = \
         jax.random.split(key, 7)
     g = state.gossip
+    probe_tick = (g.round % cfg.probe_every == 0) \
+        if cfg.probe_every > 1 else None
     g = round_step(g, cfg.gossip, k_gossip, group=state.group)
     if cfg.with_failure:
-        g = probe_round(g, cfg.gossip, cfg.failure, k_probe)
+        if probe_tick is None:
+            g = probe_round(g, cfg.gossip, cfg.failure, k_probe)
+        else:
+            g = jax.lax.cond(
+                probe_tick,
+                lambda s: probe_round(s, cfg.gossip, cfg.failure, k_probe),
+                lambda s: s, g)
         g = refute_round(g, cfg.gossip, cfg.failure, k_refute)
         g = declare_round(g, cfg.gossip, cfg.failure, k_declare)
     if cfg.push_pull_every > 0:
@@ -98,24 +123,33 @@ def cluster_round(state: ClusterState, cfg: ClusterConfig,
     viv = state.vivaldi
     if cfg.with_vivaldi:
         n = cfg.n
-        if cfg.gossip.peer_sampling == "rotation":
-            # one rotation pairs every node with a pseudo-random RTT
-            # partner; every peer read (liveness, group, hidden position,
-            # coordinate state) is a contiguous roll, no 1M-row gather
-            voff = sample_offsets(k_peer, 1, n)[0]
-            same_group = state.group == rolled_rows(state.group, voff)
-            reachable = g.alive & rolled_rows(g.alive, voff) & same_group
-            rtt = ground_truth_rtt_rolled(state.positions, voff)
-            viv = vivaldi_update(viv, cfg.vivaldi, None, rtt, k_viv,
-                                 active=reachable, peer_roll=voff)
-        else:
+
+        def viv_step(viv):
+            if cfg.gossip.peer_sampling == "rotation":
+                # one rotation pairs every node with a pseudo-random RTT
+                # partner; every peer read (liveness, group, hidden
+                # position, coordinate state) is a contiguous roll, no
+                # 1M-row gather
+                voff = sample_offsets(k_peer, 1, n)[0]
+                same_group = state.group == rolled_rows(state.group, voff)
+                reachable = g.alive & rolled_rows(g.alive, voff) & same_group
+                rtt = ground_truth_rtt_rolled(state.positions, voff)
+                return vivaldi_update(viv, cfg.vivaldi, None, rtt, k_viv,
+                                      active=reachable, peer_roll=voff)
             peers = jax.random.randint(k_peer, (n,), 0, n)
             same_group = state.group == state.group[peers]
             reachable = g.alive & g.alive[peers] & same_group \
                 & (peers != jnp.arange(n))
             rtt = ground_truth_rtt(state.positions, jnp.arange(n), peers)
-            viv = vivaldi_update(viv, cfg.vivaldi, peers, rtt, k_viv,
-                                 active=reachable)
+            return vivaldi_update(viv, cfg.vivaldi, peers, rtt, k_viv,
+                                  active=reachable)
+
+        if probe_tick is None:
+            viv = viv_step(viv)
+        else:
+            # coordinate samples ride probe acks (reference delegate
+            # ping payloads), so they follow the probe cadence
+            viv = jax.lax.cond(probe_tick, viv_step, lambda v: v, viv)
     return ClusterState(g, viv, state.positions, state.group)
 
 

@@ -33,6 +33,7 @@ from jax.sharding import PartitionSpec as P
 from serf_tpu.models.dissemination import (
     GossipConfig,
     GossipState,
+    bump_last_learn,
     clamp_stamps,
     pack_bits,
     round_u8,
@@ -125,5 +126,7 @@ def round_step_ring(state: GossipState, cfg: GossipConfig, key: jax.Array,
     new_mask = unpack_bits(new_words, k)
     stamp = jnp.where(new_mask, round_u8(state.round + 1), state.stamp)
     stamp = clamp_stamps(known, stamp, state.round + 1, k)
-    return state._replace(known=known, stamp=stamp,
+    last_learn = bump_last_learn(jnp.any(new_words != 0), state.round + 1,
+                                 state.last_learn)
+    return state._replace(known=known, stamp=stamp, last_learn=last_learn,
                           round=state.round + 1)

@@ -975,3 +975,65 @@ def test_failure_gates_requiesce_after_detection():
         "refute gate stayed hot after detection completed"
     assert not bool(jnp.any(live_suspicions(s))), \
         "declare gate stayed hot after detection completed"
+
+
+def test_quiet_round_gate_fixed_point_and_reopen():
+    """The round_step quiet gate (last_learn): once nothing has been
+    learned for transmit_limit rounds, the gossip exchange is a bit-exact
+    identity (known/stamp are a fixed point); a NEW injection re-opens
+    the gate and the fresh fact still fully disseminates."""
+    cfg = GossipConfig(n=256, k_facts=32)
+    s = inject_fact(make_state(cfg), cfg, 0, K_USER_EVENT, 0, 1, 0)
+    run = jax.jit(functools.partial(run_rounds, cfg=cfg),
+                  static_argnames=("num_rounds",))
+    # converge + exhaust every budget, then some quiet rounds
+    s = run(s, key=jax.random.key(1), num_rounds=120)
+    assert float(coverage(s, cfg)[0]) == 1.0
+    assert int(s.round) - int(s.last_learn) >= cfg.transmit_limit, \
+        "cluster did not go quiet"
+    # fixed point: further rounds change NOTHING but the round counter
+    s2 = run(s, key=jax.random.key(2), num_rounds=40)
+    assert bool(jnp.all(s2.known == s.known))
+    assert int(s2.last_learn) == int(s.last_learn)
+    # stamps may only change via the clamp re-pin; derived ages must
+    # still read >= the pin for every known fact
+    from serf_tpu.models.dissemination import AGE_PIN, age_of
+    ages = age_of(s2, cfg)
+    known = unpack_bits(s2.known, cfg.k_facts)
+    assert int(jnp.min(jnp.where(known, ages, jnp.uint8(255)))) \
+        >= cfg.transmit_limit
+    # re-open: a new fact injected into the quiet cluster disseminates
+    s3 = inject_fact(s2, cfg, 9, K_USER_EVENT, 0, 2, origin=9)
+    assert int(s3.last_learn) == int(s3.round)
+    s3 = run(s3, key=jax.random.key(3), num_rounds=40)
+    assert float(coverage(s3, cfg)[1]) == 1.0, \
+        "fresh fact did not disseminate after the quiet gate re-opened"
+
+
+def test_probe_cadence_detects_and_converges():
+    """probe_every=5 (the reference LAN profile's gossip:probe cadence
+    mapping): detection still completes — suspicion windows are measured
+    in gossip rounds, probes just fire less often — and vivaldi still
+    converges on the sparser ack stream."""
+    from serf_tpu.models.vivaldi import mean_relative_error
+
+    cfg = ClusterConfig(gossip=GossipConfig(n=512, k_facts=64),
+                        failure=FailureConfig(suspicion_rounds=8,
+                                              max_new_facts=8),
+                        probe_every=5, push_pull_every=16)
+    state = make_cluster(cfg, jax.random.key(0))
+    g = state.gossip
+    dead = jnp.array([3, 200, 400])
+    g = g._replace(alive=g.alive.at[dead].set(False))
+    state = state._replace(gossip=g)
+    run = jax.jit(functools.partial(run_cluster, cfg=cfg),
+                  static_argnames=("num_rounds",))
+    e0 = float(mean_relative_error(state.vivaldi, cfg.vivaldi,
+                                   state.positions, jax.random.key(5)))
+    state = run(state, key=jax.random.key(1), num_rounds=250)
+    assert bool(detection_complete(state.gossip, cfg.gossip, cfg.failure))
+    bd = believed_dead(state.gossip, cfg.gossip, cfg.failure)
+    assert int(jnp.sum(bd & state.gossip.alive)) == 0
+    e1 = float(mean_relative_error(state.vivaldi, cfg.vivaldi,
+                                   state.positions, jax.random.key(6)))
+    assert e1 < e0 * 0.7, (e0, e1)
