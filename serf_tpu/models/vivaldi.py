@@ -235,23 +235,28 @@ def vivaldi_update(state: VivaldiState, cfg: VivaldiConfig,
             & jnp.isfinite(cand.adjustment))
     fresh = make_vivaldi(n, cfg)
     act = active & ~bad
+    reset = bad & active          # rows to wipe to fresh state
+    any_reset = jnp.any(reset)
 
     def pick(new, old, fresh_arr):
         if new.ndim == 0:
             return new
         mask = act if new.ndim == 1 else act[:, None]
-        bmask = bad if new.ndim == 1 else bad[:, None]
         out = jnp.where(mask, new, old)
-        return jnp.where(bmask & (active if new.ndim == 1 else active[:, None]),
-                         fresh_arr, out)
+        # the bad-row wipe is a second full-plane select per field: ride
+        # a lax.cond so the (overwhelmingly common) all-finite round
+        # pays only the first
+        rmask = reset if new.ndim == 1 else reset[:, None]
+        return jax.lax.cond(
+            any_reset,
+            lambda o: jnp.where(rmask, fresh_arr, o),
+            lambda o: o,
+            out)
 
     # adj_samples needs no act-select (inactive rows already kept their
-    # old column above); the bad-row wipe is a full-plane pass, so it
-    # rides a lax.cond and costs nothing on the (overwhelmingly common)
-    # all-finite round
-    reset = bad & active
+    # old column above); same single reset mask/predicate as pick()
     adj_samples_f = jax.lax.cond(
-        jnp.any(reset),
+        any_reset,
         lambda s: jnp.where(reset[:, None], 0.0, s),
         lambda s: s,
         cand.adj_samples)
