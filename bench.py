@@ -1,11 +1,15 @@
 """Benchmark: 1M-node serf/SWIM cluster simulation throughput on TPU.
 
 Headline metric (BASELINE.md north star): FULL protocol rounds/sec
-simulating a 1,000,000-node cluster with the flagship ``cluster_round`` —
+simulating a 1,000,000-node cluster with the flagship ``cluster_round``
+under SUSTAINED LOAD — ``EVENTS_PER_ROUND`` fresh user events injected
+every round (the reference's continuous-broadcast workload) on top of
 gossip dissemination with transmit-limited budgets + probe/indirect-probe/
 suspect/refute/declare failure detection + periodic push/pull anti-entropy
 + Vivaldi coordinate co-training — target >= 10,000 rounds/sec on a v5e-8.
-``vs_baseline`` is measured against that 10k target.
+``vs_baseline`` is measured against that 10k target.  The quiescent
+steady state and the detection-hot active window are reported alongside
+in ``BENCH_DETAIL.json``.
 
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
@@ -32,6 +36,11 @@ import time
 
 N_NODES = int(os.environ.get("SERF_TPU_BENCH_N", 1_000_000))
 K_FACTS = 64
+#: sustained-load headline: fresh user events injected per round.  2 at
+#: K_FACTS=64 gives each fact a 32-round ring lifetime, above the 1M-node
+#: transmit_limit of 28 — facts fully disseminate before retirement
+#: (mirrors the reference's event-buffer headroom, event_buffer_size=512)
+EVENTS_PER_ROUND = 2
 ROUNDS_PER_CALL = 100
 TIMED_CALLS = 3
 #: rounds the warmup must cover so the seeded churn's detection cycle
@@ -122,7 +131,12 @@ def main() -> None:
         inject_fact,
     )
     from serf_tpu.models.failure import FailureConfig, run_swim
-    from serf_tpu.models.swim import ClusterConfig, make_cluster, run_cluster
+    from serf_tpu.models.swim import (
+        ClusterConfig,
+        make_cluster,
+        run_cluster,
+        run_cluster_sustained,
+    )
 
     detail = {}
     # rotation sampling + round-robin probes: the at-scale mode — no
@@ -169,19 +183,32 @@ def main() -> None:
             g = g._replace(alive=g.alive.at[jnp.asarray(ids)].set(False))
         return st._replace(gossip=g)
 
-    # --- headline: the flagship cluster round (all subsystems on) ---------
-    run_flag = jax.jit(functools.partial(run_cluster, cfg=cfg),
-                       static_argnames=("num_rounds",), donate_argnums=(0,))
-    state, flagship_rps, flagship_active = _time_rounds(
-        run_flag, lambda: seeded_state(cfg), jax.random.key(1),
-        rounds_per_call, timed_calls)
-    detail["cluster_round_rps"] = round(flagship_rps, 2)
-    detail["cluster_round_active_rps"] = round(flagship_active, 2)
+    # --- HEADLINE: the flagship cluster round under SUSTAINED LOAD --------
+    # EVENTS_PER_ROUND fresh user events injected every round (the
+    # reference's continuous-broadcast workload, BASELINE.json config #2)
+    # keep the quiescent gate open: every round pays the full select/
+    # exchange/merge cost, so this number rewards doing the dissemination
+    # work faster — a cluster idling at speed cannot inflate it (VERDICT
+    # r4: the steady-state headline mostly measured the gated path).
+    run_sus = jax.jit(functools.partial(run_cluster_sustained, cfg=cfg,
+                                        events_per_round=EVENTS_PER_ROUND),
+                      static_argnames=("num_rounds",), donate_argnums=(0,))
+    sus_state, sustained_rps, _ = _time_rounds(
+        run_sus, lambda: seeded_state(cfg), jax.random.key(3),
+        rounds_per_call, timed_calls, measure_active=False)
+    detail["cluster_round_sustained_rps"] = round(sustained_rps, 2)
+    detail["sustained_events_per_round"] = EVENTS_PER_ROUND
 
-    # sanity: the simulation made protocol progress (facts spread)
-    cov = float(coverage(state.gossip, cfg.gossip)[0])
-    if not (0.0 < cov <= 1.0):
-        print(json.dumps({"metric": "ERROR: no protocol progress",
+    # sanity: injection genuinely ran every round (the gate never closed)
+    # and dissemination made real progress (facts spreading, ring live)
+    g = sus_state.gossip
+    gate_open = (int(g.round) - int(g.last_learn)
+                 < cfg.gossip.transmit_limit)
+    mean_cov = float(jnp.where(g.facts.valid,
+                               coverage(g, cfg.gossip), 0.0).mean())
+    if not gate_open or not (0.0 < mean_cov <= 1.0):
+        print(json.dumps({"metric": "ERROR: no protocol progress under "
+                                    "sustained load",
                           "value": 0, "unit": "rounds/sec",
                           "vs_baseline": 0.0}))
         sys.exit(1)
@@ -193,13 +220,32 @@ def main() -> None:
     if on_cpu:
         platform += " (CPU FALLBACK — TPU tunnel unavailable)"
     print(json.dumps({
-        "metric": f"full serf cluster rounds/sec @ {N_NODES} simulated nodes "
-                  f"(gossip + failure detection + anti-entropy + vivaldi), "
-                  f"{platform}",
-        "value": round(flagship_rps, 2),
+        "metric": f"full serf cluster rounds/sec under sustained load "
+                  f"({EVENTS_PER_ROUND} fresh user events injected/round) "
+                  f"@ {N_NODES} simulated nodes (gossip + failure "
+                  f"detection + anti-entropy + vivaldi), {platform}",
+        "value": round(sustained_rps, 2),
         "unit": "rounds/sec",
-        "vs_baseline": round(flagship_rps / TARGET_ROUNDS_PER_SEC, 4),
+        "vs_baseline": round(sustained_rps / TARGET_ROUNDS_PER_SEC, 4),
     }), flush=True)
+
+    # --- secondary: quiescent steady state + detection-hot active window --
+    run_flag = jax.jit(functools.partial(run_cluster, cfg=cfg),
+                       static_argnames=("num_rounds",), donate_argnums=(0,))
+    state, flagship_rps, flagship_active = _time_rounds(
+        run_flag, lambda: seeded_state(cfg), jax.random.key(1),
+        rounds_per_call, timed_calls)
+    detail["cluster_round_rps"] = round(flagship_rps, 2)
+    detail["cluster_round_active_rps"] = round(flagship_active, 2)
+
+    # sanity: the steady-state simulation made protocol progress; a run
+    # that didn't discredits BOTH its numbers
+    cov = float(coverage(state.gossip, cfg.gossip)[0])
+    if not (0.0 < cov <= 1.0):
+        sys.stderr.write("WARNING: steady-state run made no protocol "
+                         "progress\n")
+        detail["cluster_round_rps"] = 0.0
+        detail["cluster_round_active_rps"] = 0.0
 
     # --- secondary: swim-only (dissemination + failure detection) ---------
     run_sw = jax.jit(functools.partial(run_swim, cfg=gcfg, fcfg=fcfg),
@@ -260,8 +306,11 @@ def probe() -> None:
 
     if jax.default_backend() == "cpu":
         sys.exit(3)
-    x = jax.jit(lambda a: (a @ a.T).sum())(jnp.ones((256, 256),
-                                                    jnp.bfloat16))
+    # accumulate in f32: a backend summing the reduce in bf16 saturates
+    # far below 2^24 and an exact-equality check would misclassify a
+    # healthy accelerator as a wedged tunnel (ADVICE r4)
+    x = jax.jit(lambda a: (a @ a.T).astype(jnp.float32).sum())(
+        jnp.ones((256, 256), jnp.bfloat16))
     got = float(np.asarray(x))        # host transfer = completion barrier
     assert got == 256.0 * 256 * 256, got
     sys.stderr.write(f"probe ok: {jax.devices()[0].device_kind}\n")

@@ -60,7 +60,13 @@ WINDOW = CWND_MAX       # compat alias: the hard in-flight bound
 RTO_MIN = 0.15          # initial retransmit timeout (s)
 RTO_MAX = 2.0           # backoff cap (s)
 MAX_RETRIES = 30        # per-oldest-segment retransmit budget
-MAX_OOO = 4 * WINDOW    # out-of-order buffer bound (segments)
+# Out-of-order buffer bound: a compliant sender never has more than
+# CWND_MAX segments in flight, and one of those is the in-order hole the
+# receiver is waiting on, so CWND_MAX bounds what can legitimately arrive
+# out of order.  Sized explicitly (not a WINDOW multiple — ADVICE r4: the
+# old 4*WINDOW rode the CWND_MAX alias up to 1024 segments, letting one
+# remote address pin ~75 MB across MAX_PEER_CONNS connections).
+MAX_OOO = CWND_MAX      # out-of-order buffer bound (segments, ~300 KB/conn)
 HANDSHAKE_TIMEOUT = 5.0
 MAX_FRAME = 32 * 1024 * 1024
 CLOSE_FLUSH_TIMEOUT = 5.0   # close() waits this long for inflight to drain

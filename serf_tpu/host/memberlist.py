@@ -54,7 +54,16 @@ class VersionError(Exception):
 def vsn_mismatch(vsn) -> Optional[str]:
     """Why ``vsn`` ([pmin, pmax, pcur, dmin, dmax, dcur]) cannot interop
     with us — or None if it can.  Compatibility = the ranges intersect
-    AND the peer's CURRENT versions fall inside our supported ranges."""
+    AND the peer's CURRENT versions fall inside our supported ranges.
+
+    The current-version containment is DELIBERATELY stricter than pure
+    range intersection (ADVICE r4): peers encode their wire traffic at
+    their *current* version and this implementation has no
+    downgrade-negotiation step, so a peer whose cur is outside our
+    supported range would send frames we cannot decode even though some
+    lower version is mutually supported.  If a future version bump adds
+    down-negotiation (advertise-and-agree before the alive gate), relax
+    the pcur/dcur checks to range-intersection-only at the same time."""
     pmin, pmax, pcur, dmin, dmax, dcur = vsn
     if pmin > PROTOCOL_VERSION_MAX or pmax < PROTOCOL_VERSION_MIN:
         return (f"protocol range [{pmin}, {pmax}] does not intersect our "
@@ -771,6 +780,10 @@ class Memberlist:
             await stream.send_frame(self._encode_wire(sm.encode_swim(out)))
             reply_raw = await stream.recv_frame(self.opts.timeout)
             reply = self._decode_stream_msg(reply_raw)
+            if isinstance(reply, sm.ErrorResp):
+                # the server refused before replying (today: version
+                # incompatibility) — surface its reason directly
+                raise VersionError(f"refused by {addr}: {reply.error}")
             if not isinstance(reply, sm.PushPull):
                 raise codec.DecodeError("expected push/pull reply")
             self._merge_remote(reply, join)
@@ -793,8 +806,19 @@ class Memberlist:
             if isinstance(msg, sm.PushPull):
                 if msg.join:
                     # refuse BEFORE replying: the joiner must not learn
-                    # our state if we cannot interop with its cluster
-                    self._verify_versions(msg.states)
+                    # our state if we cannot interop with its cluster.
+                    # Tell it WHY (ErrorResp) before closing — otherwise
+                    # the joiner only sees a generic recv timeout and
+                    # repeated joins look like network failures (ADVICE r4)
+                    try:
+                        self._verify_versions(msg.states)
+                    except VersionError as e:
+                        try:
+                            await stream.send_frame(self._encode_wire(
+                                sm.encode_swim(sm.ErrorResp(str(e)))))
+                        except (ConnectionError, TimeoutError):
+                            pass
+                        raise
                 out = sm.PushPull(False, tuple(self._local_push_states()),
                                   self.delegate.local_state(msg.join))
                 await stream.send_frame(self._encode_wire(sm.encode_swim(out)))

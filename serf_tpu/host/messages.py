@@ -36,6 +36,7 @@ class SwimMessageType(enum.IntEnum):
     PUSH_PULL = 8
     COMPOUND = 9
     USER = 10          # serf-layer payload (delegate notify_message)
+    ERROR = 11         # stream-level refusal (memberlist's errResp analog)
 
 
 @dataclass(frozen=True)
@@ -337,6 +338,28 @@ class UserMsg:
         return cls(payload)
 
 
+@dataclass(frozen=True)
+class ErrorResp:
+    """Stream-level refusal sent before closing, so the dialing side fails
+    fast with the reason spelled out instead of timing out (the analog of
+    memberlist's errResp; today sent for version-incompatible joins)."""
+
+    error: str
+
+    TYPE = SwimMessageType.ERROR
+
+    def encode_body(self) -> bytes:
+        return codec.encode_str_field(1, self.error)
+
+    @classmethod
+    def decode_body(cls, buf: bytes) -> "ErrorResp":
+        error = ""
+        for f, _w, v, _p in codec.iter_fields(buf):
+            if f == 1:
+                error = codec.as_str(v)
+        return cls(error)
+
+
 _DECODERS = {
     SwimMessageType.PING: Ping.decode_body,
     SwimMessageType.INDIRECT_PING: IndirectPing.decode_body,
@@ -347,6 +370,7 @@ _DECODERS = {
     SwimMessageType.DEAD: Dead.decode_body,
     SwimMessageType.PUSH_PULL: PushPull.decode_body,
     SwimMessageType.USER: UserMsg.decode_body,
+    SwimMessageType.ERROR: ErrorResp.decode_body,
 }
 
 

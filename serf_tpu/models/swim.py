@@ -25,6 +25,8 @@ from serf_tpu.models.antientropy import push_pull_round
 from serf_tpu.models.dissemination import (
     GossipConfig,
     GossipState,
+    K_USER_EVENT,
+    inject_facts_batch,
     make_state,
     rolled_rows,
     round_step,
@@ -157,6 +159,53 @@ def run_cluster(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
                 num_rounds: int) -> ClusterState:
     def body(carry, subkey):
         return cluster_round(carry, cfg, subkey), ()
+
+    keys = jax.random.split(key, num_rounds)
+    final, _ = jax.lax.scan(body, state, keys)
+    return final
+
+
+def sustained_round(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
+                    events_per_round: int) -> ClusterState:
+    """``cluster_round`` under continuous dissemination load: inject
+    ``events_per_round`` fresh user events at uniform random origins, then
+    run the round.
+
+    This is the device analog of the reference's steady broadcast workload
+    (``Serf::user_event`` arriving every gossip tick, SURVEY.md §3.3 /
+    BASELINE.json config #2): the fact ring keeps cycling, the
+    ``last_learn`` quiescent gate never closes, and every round pays the
+    full select/exchange/merge cost — so a throughput number measured here
+    rewards doing the work faster, not gating it off.  Each fact lives
+    ``k_facts / events_per_round`` rounds before its ring slot recycles;
+    keep that above ``transmit_limit`` (e.g. 2/round at K=64, n=1M) so
+    facts can fully disseminate before retirement, matching the
+    reference's event-buffer headroom sizing (event_buffer_size=512).
+
+    Origins are sampled over ALL nodes: a fact injected at a dead origin
+    never spreads (exactly the reference — an event originating at a node
+    that dies with the queue undrained is lost); with realistic churn
+    fractions this is noise.
+    """
+    m = events_per_round
+    k_org, k_rnd = jax.random.split(key)
+    g = state.gossip
+    # unique, monotonically increasing event ids double as ltimes
+    eids = g.round * m + jnp.arange(m, dtype=jnp.int32) + 1
+    origins = jax.random.randint(k_org, (m,), 0, cfg.n, dtype=jnp.int32)
+    g = inject_facts_batch(
+        g, cfg.gossip, eids, K_USER_EVENT,
+        incarnations=jnp.zeros((m,), jnp.uint32),
+        ltimes=eids.astype(jnp.uint32),
+        origins=origins, active=jnp.ones((m,), bool))
+    return cluster_round(state._replace(gossip=g), cfg, k_rnd)
+
+
+def run_cluster_sustained(state: ClusterState, cfg: ClusterConfig,
+                          key: jax.Array, num_rounds: int,
+                          events_per_round: int = 2) -> ClusterState:
+    def body(carry, subkey):
+        return sustained_round(carry, cfg, subkey, events_per_round), ()
 
     keys = jax.random.split(key, num_rounds)
     final, _ = jax.lax.scan(body, state, keys)

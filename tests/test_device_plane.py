@@ -260,6 +260,39 @@ def test_cluster_round_composes():
     assert int(out.gossip.round) == 25
 
 
+def test_sustained_load_keeps_gate_open_and_disseminates():
+    """``run_cluster_sustained`` (the bench headline workload): continuous
+    event injection keeps the quiescent gate open, the fact ring fills and
+    recycles, and a fact that lived out its ring lifetime reached every
+    alive node before its slot recycled — i.e. the sustained config does
+    full dissemination work every round AND that work completes."""
+    from serf_tpu.models.swim import run_cluster_sustained
+
+    cfg = ClusterConfig(gossip=GossipConfig(n=1024, k_facts=32,
+                                            peer_sampling="rotation"),
+                        probe_every=5)
+    state = make_cluster(cfg, jax.random.key(0))
+    run = jax.jit(functools.partial(run_cluster_sustained, cfg=cfg),
+                  static_argnames=("num_rounds", "events_per_round"))
+    out = run(state, key=jax.random.key(1), num_rounds=100,
+              events_per_round=2)
+    g = out.gossip
+    assert int(g.round) == 100
+    assert int(g.next_slot) == 200, "injection did not run every round"
+    assert bool(jnp.all(g.facts.valid)), "ring did not fill"
+    # the quiescent gate never closed: the last injection was this round
+    assert int(g.round) - int(g.last_learn) < cfg.gossip.transmit_limit
+    cov = coverage(g, cfg.gossip)
+    k = cfg.gossip.k_facts
+    oldest = [(int(g.next_slot) + i) % k for i in range(4)]
+    newest = (int(g.next_slot) - 1) % k
+    # oldest surviving facts (injected k/rate = 16 = transmit_limit rounds
+    # ago) fully disseminated; the fact injected THIS round has not
+    for s in oldest:
+        assert float(cov[s]) == 1.0, f"old fact {s} never fully spread"
+    assert float(cov[newest]) < 1.0, "a fresh fact cannot be everywhere"
+
+
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
 def test_sharded_parity_8_devices():
     """The same simulation sharded over 8 devices must be bit-identical to

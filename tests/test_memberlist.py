@@ -8,6 +8,7 @@ timings (gossip 5 ms / probe 50 ms), convergence asserted by polling with a
 """
 
 import asyncio
+import time
 
 import pytest
 
@@ -345,12 +346,14 @@ async def test_incompatible_version_peer_refused(caplog):
         alien._vsn = (2, 3, 2, 1, 1, 1)
         alien._nodes[alien.local_id()].vsn = alien._vsn
         with caplog.at_level(logging.WARNING, logger="serf_tpu.memberlist"):
-            # the seed refuses the handshake before replying, so the
-            # alien's dial surfaces as a failed/refused join
-            try:
+            # the seed sends an ErrorResp refusal frame before closing
+            # (ADVICE r4), so the alien's join fails FAST with the version
+            # conflict spelled out — not a generic 10 s recv timeout
+            t0 = time.monotonic()
+            with pytest.raises(VersionError, match="protocol"):
                 await alien.join(nodes[0].transport.local_addr)
-            except (VersionError, ConnectionError, TimeoutError):
-                pass
+            assert time.monotonic() - t0 < 5.0, \
+                "refusal did not reach the joiner (timed out instead)"
             await asyncio.sleep(0.3)
         assert nodes[0].num_online_members() == 1, \
             "incompatible peer was admitted"

@@ -83,6 +83,7 @@ swim_messages = st.one_of(
     st.builds(sm.Ack, seqs, payloads),
     st.builds(sm.Nack, seqs),
     st.builds(sm.UserMsg, payloads),
+    st.builds(sm.ErrorResp, st.text(max_size=200)),
 )
 
 
