@@ -130,26 +130,22 @@ def main() -> None:
         coverage,
         inject_fact,
     )
-    from serf_tpu.models.failure import FailureConfig, run_swim
+    from serf_tpu.models.failure import run_swim
     from serf_tpu.models.swim import (
-        ClusterConfig,
+        flagship_config,
         make_cluster,
         run_cluster,
         run_cluster_sustained,
     )
 
     detail = {}
-    # rotation sampling + round-robin probes: the at-scale mode — no
-    # 1M-row random gathers/scatters (each is a serial loop on TPU)
-    gcfg = GossipConfig(n=N_NODES, k_facts=K_FACTS,
-                        peer_sampling="rotation")
-    fcfg = FailureConfig(suspicion_rounds=12, max_new_facts=8,
-                         probe_schedule="round_robin")
-    # probe_every=5: the reference LAN profile's cadence mapping (gossip
-    # 200ms, probe 1s — probes and the vivaldi updates riding their acks
-    # run at 1/5 the gossip cadence)
-    cfg = ClusterConfig(gossip=gcfg, failure=fcfg, push_pull_every=16,
-                        probe_every=5, with_failure=True, with_vivaldi=True)
+    # THE flagship workload definition (swim.flagship_config): rotation
+    # sampling + round-robin probes (the at-scale mode — no 1M-row random
+    # gathers), reference LAN gossip:probe cadence, push/pull every 16.
+    # The accounting model and tests/test_accounting.py budget the same
+    # definition, so bench and budget cannot drift apart.
+    cfg = flagship_config(N_NODES, k_facts=K_FACTS)
+    gcfg, fcfg = cfg.gossip, cfg.failure
 
     def seeded_state(c):
         key = jax.random.key(0)

@@ -28,10 +28,19 @@ response QUIC's NewReno default gives the reference's quinn transport
 (serf/Cargo.toml:40-56), so a WAN bottleneck or loss burst backs the
 sender off instead of flooding retransmits.
 
-What this is NOT (documented deviation, PARITY.md): QUIC's loss-based
-fast-recovery/SACK machinery, path migration, 0-RTT, or wire format.  It
-is an ARQ sized for serf's push/pull exchanges, conformance-tested
-alongside tcp/tls through the same cluster scenarios.
+Loss recovery (round 5): SACK + fast retransmit.  Every ACK carries a
+selective-ack bitmap of the receiver's out-of-order buffer; the sender
+marks SACKed segments (never re-sent) and, on ``FAST_RETX_DUPS``
+duplicate cumulative ACKs, enters a NewReno-style fast-recovery episode:
+halve cwnd ONCE per episode, immediately resend the unSACKed holes, and
+resend the next hole on each partial ACK — so single-segment loss
+recovers in ~1 RTT instead of waiting out the RTO (the reference's quinn
+gives the same property via QUIC's SACK ranges + NewReno recovery).
+
+What this is NOT (documented deviation, PARITY.md): QUIC's stream
+multiplexing, path migration, 0-RTT, or wire format.  It is an ARQ sized
+for serf's push/pull exchanges, conformance-tested alongside tcp/tls
+through the same cluster scenarios.
 
 Both endpoints of a cluster must run the same transport (exactly as a
 quinn-only reference cluster cannot interoperate with plain TCP nodes).
@@ -60,6 +69,7 @@ WINDOW = CWND_MAX       # compat alias: the hard in-flight bound
 RTO_MIN = 0.15          # initial retransmit timeout (s)
 RTO_MAX = 2.0           # backoff cap (s)
 MAX_RETRIES = 30        # per-oldest-segment retransmit budget
+FAST_RETX_DUPS = 3      # duplicate cumulative ACKs before fast retransmit
 # Out-of-order buffer bound: a compliant sender never has more than
 # CWND_MAX segments in flight, and one of those is the in-order hole the
 # receiver is waiting on, so CWND_MAX bounds what can legitimately arrive
@@ -111,6 +121,12 @@ class _Conn:
         self.rto = RTO_MIN
         self.cwnd = float(CWND_INIT)           # AIMD congestion window
         self.cwnd_min_seen = float(CWND_INIT)  # diagnostics/tests
+        # SACK / fast-recovery state (NewReno-shaped, see module docstring)
+        self.sacked: set = set()               # seqs the peer holds OOO
+        self.dup_acks = 0                      # consecutive dup cumulative acks
+        self.recovery_until = -1               # episode ends when snd_una passes
+        self.fast_retx_done: set = set()       # holes resent this episode
+        self.fast_retx_count = 0               # diagnostics/tests
         self.retx_handle: Optional[asyncio.TimerHandle] = None
         self.window_free = asyncio.Event()
         self.window_free.set()
@@ -154,13 +170,43 @@ class _Conn:
         # multiplicative decrease: a lost round means we overran the path
         self.cwnd = max(float(CWND_MIN), self.cwnd / 2.0)
         self.cwnd_min_seen = min(self.cwnd_min_seen, self.cwnd)
-        # retransmit at most the HALVED window, oldest-first: re-blasting
-        # the whole inflight set would re-flood the very bottleneck the
-        # cwnd cut is backing off from (the rest re-sends as the
-        # cumulative ACK advances or on later timeouts)
-        for seq in sorted(self.inflight)[:max(1, int(self.cwnd))]:
+        # retransmit at most the HALVED window, oldest-first, skipping
+        # SACKed segments (the peer already holds them): re-blasting the
+        # whole inflight set would re-flood the very bottleneck the cwnd
+        # cut is backing off from (the rest re-sends as the cumulative
+        # ACK advances or on later timeouts)
+        pending = sorted(s for s in self.inflight if s not in self.sacked)
+        if not pending:
+            # every tracked segment is SACKed but the cumulative ack is
+            # lost/stale: nudge ONLY the oldest — one delivered duplicate
+            # elicits a fresh cumulative ACK without re-blasting
+            # already-delivered data into the congested path
+            pending = sorted(self.inflight)[:1]
+        for seq in pending[:max(1, int(self.cwnd))]:
             self.t._sendto(self.inflight[seq], self.peer)
         self._arm_retx()
+
+    def _retransmit_holes(self, limit: Optional[int] = None) -> None:
+        """Fast-recovery resend: unSACKed inflight segments the receiver
+        has demonstrably missed (below the highest SACKed seq), plus the
+        cumulative hole itself, oldest first; each hole is resent at most
+        once per recovery episode (the RTO path still backstops a lost
+        resend)."""
+        if self.closed or not self.inflight:
+            return
+        high = max(self.sacked) if self.sacked else self.snd_una
+        holes = sorted(
+            s for s in self.inflight
+            if s >= 0 and s not in self.sacked
+            and s not in self.fast_retx_done
+            and (s <= high))
+        holes = holes[:max(1, int(self.cwnd)) if limit is None else limit]
+        for s in holes:
+            self.fast_retx_done.add(s)
+            self.fast_retx_count += 1
+            self.t._sendto(self.inflight[s], self.peer)
+        if holes:
+            self._arm_retx()
 
     async def send_bytes(self, data: bytes) -> None:
         """Chunk into sequenced DATA segments, respecting the window."""
@@ -210,15 +256,31 @@ class _Conn:
             self.retries = 0
             return
         if kind == K_ACK:
+            # SACK bitmap payload: bit i set => seq + 1 + i is buffered
+            # out of order at the receiver (never retransmit those)
+            if payload:
+                base = seq + 1
+                for bi, byte in enumerate(payload):
+                    off = bi * 8
+                    while byte:
+                        low = byte & -byte
+                        s = base + off + low.bit_length() - 1
+                        byte ^= low
+                        if s >= self.snd_una and s in self.inflight:
+                            self.sacked.add(s)
             if seq > self.snd_una:
                 acked = seq - self.snd_una
                 self.snd_una = seq
                 for s in [s for s in self.inflight if s < seq]:
                     del self.inflight[s]
+                self.sacked = {s for s in self.sacked if s >= seq}
+                self.fast_retx_done = {s for s in self.fast_retx_done
+                                       if s >= seq}
                 if not self.inflight:
                     self.drained.set()
                 self.retries = 0
                 self.rto = RTO_MIN
+                self.dup_acks = 0
                 # additive increase: +1 segment per acked round-trip
                 self.cwnd = min(float(CWND_MAX),
                                 self.cwnd + acked / self.cwnd)
@@ -226,7 +288,30 @@ class _Conn:
                     self.retx_handle.cancel()
                     self.retx_handle = None
                 self._arm_retx()
+                if self.snd_una < self.recovery_until:
+                    # NewReno partial ack: the next hole is already lost
+                    # too — resend it now rather than waiting for dup-acks
+                    self._retransmit_holes(limit=1)
+                else:
+                    self.recovery_until = -1
                 self._update_window()
+            elif self.inflight and seq == self.snd_una:
+                # duplicate cumulative ack: the hole at snd_una is still
+                # missing while later segments keep landing
+                self.dup_acks += 1
+                if (self.dup_acks >= FAST_RETX_DUPS
+                        and self.snd_una >= self.recovery_until):
+                    # enter fast recovery ONCE per loss episode: halve,
+                    # mark where the episode ends, resend the holes
+                    self.recovery_until = self.snd_next
+                    self.cwnd = max(float(CWND_MIN), self.cwnd / 2.0)
+                    self.cwnd_min_seen = min(self.cwnd_min_seen, self.cwnd)
+                    self.dup_acks = 0
+                    self.fast_retx_done.clear()
+                    self._retransmit_holes()
+                elif self.sacked and self.snd_una < self.recovery_until:
+                    # new SACK info inside an episode exposes more holes
+                    self._retransmit_holes(limit=1)
             return
         if kind == K_RST:
             self._fail(f"connection reset by {self.peer}")
@@ -243,7 +328,25 @@ class _Conn:
                     self.rcv_next += 1
             elif len(self.ooo) < MAX_OOO:
                 self.ooo[seq] = (kind, payload)
-            self._send_segment(K_ACK, self.rcv_next, track=False)
+            self._send_segment(K_ACK, self.rcv_next, self._sack_bitmap(),
+                               track=False)
+
+    def _sack_bitmap(self) -> bytes:
+        """Selective-ack bitmap over the out-of-order buffer: bit i set =>
+        seq ``rcv_next + 1 + i`` is held (``rcv_next`` itself is the hole).
+        ≤ MAX_OOO/8 = 32 bytes, trailing zero bytes trimmed — well inside
+        a segment's MSS budget."""
+        if not self.ooo:
+            return b""
+        bm = bytearray((MAX_OOO + 7) // 8)
+        base = self.rcv_next + 1
+        for s in self.ooo:
+            off = s - base
+            if 0 <= off < MAX_OOO:
+                bm[off >> 3] |= 1 << (off & 7)
+        while bm and bm[-1] == 0:
+            bm.pop()
+        return bytes(bm)
 
     def _deliver(self, kind: int, payload: bytes) -> None:
         if kind == K_FIN:

@@ -55,11 +55,19 @@ def push_pull_round(state: GossipState, cfg: GossipConfig, key: jax.Array,
     incoming = jnp.where(ok[:, None], partner_known, jnp.uint32(0))
     new_words = incoming & ~state.known
     known = state.known | new_words
-    new_mask = unpack_bits(new_words, k)
-    # a fresh stamp = age 0 = fresh transmit budget for newly synced facts
-    stamp = jnp.where(new_mask, round_u8(state.round), state.stamp)
-    last_learn = bump_last_learn(jnp.any(new_words != 0), state.round,
-                                 state.last_learn)
+    learned_any = jnp.any(new_words != 0)
+
+    # a fresh stamp = age 0 = fresh transmit budget for newly synced facts.
+    # Gated on learned_any: a fully in-sync pair exchange learns nothing
+    # and the stamp where-pass (R+W the whole N×K plane) is a bit-exact
+    # identity — skipping it makes the periodic sync of a converged
+    # cluster cost only the known-word merge (accounting.py quantifies).
+    def stamp_learns(s):
+        new_mask = unpack_bits(new_words, k)
+        return jnp.where(new_mask, round_u8(state.round), s)
+
+    stamp = jax.lax.cond(learned_any, stamp_learns, lambda s: s, state.stamp)
+    last_learn = bump_last_learn(learned_any, state.round, state.last_learn)
     return state._replace(known=known, stamp=stamp, last_learn=last_learn)
 
 

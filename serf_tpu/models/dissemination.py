@@ -569,15 +569,23 @@ def round_step(state: GossipState, cfg: GossipConfig,
             new_words = incoming & ~state.known & jnp.where(
                 alive_col, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
             known = state.known | new_words
-            new_mask = unpack_bits(new_words, k)              # bool[N, K]
+            learned_any = jnp.any(new_words != 0)
+
             # 5. the round's only N×K write: stamp newly learned facts
             #    with the post-increment round — their derived age is 0
             #    at the next round's selection, exactly the old age-plane
             #    reset; everyone else's age advances for free because
-            #    `round` advanced.
-            stamp = jnp.where(new_mask, round_u8(state.round + 1),
-                              state.stamp)
-            learned_any = jnp.any(new_words != 0)
+            #    `round` advanced.  Gated on learned_any: with zero learns
+            #    the where is a bit-exact identity, and skipping it saves
+            #    the round's biggest single pass (stamp R+W, 128 MB at
+            #    1M×64) during the fully-disseminated window the gossip
+            #    gate hasn't closed yet (see serf_tpu/models/accounting.py).
+            def stamp_learns(s):
+                new_mask = unpack_bits(new_words, k)          # bool[N, K]
+                return jnp.where(new_mask, round_u8(state.round + 1), s)
+
+            stamp = jax.lax.cond(learned_any, stamp_learns,
+                                 lambda s: s, state.stamp)
         last_learn = bump_last_learn(learned_any, state.round + 1,
                                      state.last_learn)
         return known, stamp, last_learn

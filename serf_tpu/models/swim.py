@@ -86,6 +86,21 @@ class ClusterState(NamedTuple):
     group: jnp.ndarray       # i32[N] partition group (all zeros = healed)
 
 
+def flagship_config(n: int, k_facts: int = 64) -> ClusterConfig:
+    """The flagship configuration — the ONE definition of the workload
+    bench.py measures, the accounting model budgets, and the tests pin.
+    rotation sampling + round-robin probes (no 1M-row random gathers),
+    probe_every=5 = the reference LAN profile's gossip:probe cadence
+    (200 ms : 1 s), push/pull anti-entropy every 16 rounds."""
+    return ClusterConfig(
+        gossip=GossipConfig(n=n, k_facts=k_facts,
+                            peer_sampling="rotation"),
+        failure=FailureConfig(suspicion_rounds=12, max_new_facts=8,
+                              probe_schedule="round_robin"),
+        push_pull_every=16, probe_every=5,
+        with_failure=True, with_vivaldi=True)
+
+
 def make_cluster(cfg: ClusterConfig, key: jax.Array) -> ClusterState:
     n = cfg.n
     positions = jax.random.uniform(key, (n, 3), jnp.float32) * 0.05

@@ -1,0 +1,73 @@
+"""Pins the HBM traffic accounting (VERDICT r4 next-1a): the analytic
+per-plane model stays inside its byte budget, identifies the true
+dominator, and tracks the compiled HLO within a fusion band."""
+
+import functools
+
+import jax
+import pytest
+
+from serf_tpu.models.accounting import (
+    hlo_bytes_per_round,
+    round_traffic,
+)
+from serf_tpu.models.swim import (
+    flagship_config,
+    make_cluster,
+    run_cluster_sustained,
+)
+
+
+#: the tracked byte budget for one sustained flagship round @1M (bytes).
+#: Computed 352.6 MB as of round 5 — a kernel change that pushes past the
+#: budget must either be paid for deliberately (raise this with a note)
+#: or fixed.  Floor guards against the model silently dropping terms.
+SUSTAINED_BUDGET_1M = 360e6
+SUSTAINED_FLOOR_1M = 250e6
+
+
+def test_sustained_budget_at_1m():
+    r = round_traffic(flagship_config(1_000_000), regime="sustained")
+    assert SUSTAINED_FLOOR_1M < r.total_bytes <= SUSTAINED_BUDGET_1M, (
+        f"sustained round moved {r.total_bytes / 1e6:.1f} MB, budget "
+        f"{SUSTAINED_BUDGET_1M / 1e6:.0f} MB\n{r.table()}")
+    # the stamp plane is the known dominator (>50%); if this flips, the
+    # optimization target has moved — update STATUS.md
+    assert r.dominator() == "stamp"
+    assert r.by_plane()["stamp"] / r.total_bytes > 0.5
+
+
+def test_regime_ordering_matches_gate_design():
+    """quiescent << active < sustained: the skip-gates must show up in
+    the byte model exactly as they do in the measured rps splits."""
+    cfg = flagship_config(1_000_000)
+    sus = round_traffic(cfg, regime="sustained").total_bytes
+    act = round_traffic(cfg, regime="active").total_bytes
+    qui = round_traffic(cfg, regime="quiescent").total_bytes
+    assert qui < 0.15 * sus, "quiescent regime must be >85% cheaper"
+    assert act < sus, "no-learn active rounds skip the stamp learn pass"
+    # single-chip ceiling arithmetic (STATUS.md): the 10k target is out
+    # of reach for the sustained regime on ONE chip but inside it for
+    # the gated regime — the 8-chip shard is where the target lives
+    assert round_traffic(cfg, regime="sustained").ceiling_rounds_per_sec() < 10_000
+    assert round_traffic(cfg, regime="quiescent").ceiling_rounds_per_sec() > 10_000
+
+
+def test_hlo_cross_check_small_n():
+    """XLA's compiled bytes-accessed stays within a fusion band of the
+    analytic model — keeps the model's fusion assumptions honest."""
+    n = 16_384
+    cfg = flagship_config(n)
+    state = make_cluster(cfg, jax.random.key(0))
+    run = jax.jit(functools.partial(run_cluster_sustained, cfg=cfg,
+                                    events_per_round=2),
+                  static_argnames=("num_rounds",))
+    hlo = hlo_bytes_per_round(run, state, key=jax.random.key(1),
+                              num_rounds=10)
+    if hlo is None:
+        pytest.skip("backend exposes no cost analysis")
+    model = round_traffic(cfg, regime="sustained").total_bytes
+    ratio = hlo / model
+    assert 0.3 < ratio < 3.0, (
+        f"HLO {hlo / 1e6:.1f} MB/round vs model {model / 1e6:.1f} "
+        f"MB/round (ratio {ratio:.2f}) — model assumptions drifted")

@@ -235,6 +235,60 @@ async def test_syn_flood_is_bounded():
         await b.shutdown()
 
 
+async def test_fast_retransmit_recovers_single_loss_below_rto():
+    """SACK + dup-ack fast retransmit (VERDICT r4 next-7): one lost DATA
+    segment must be recovered in ~1 RTT via the duplicate-ACK path —
+    latency well under the 150 ms RTO floor — and the SACKed later
+    segments must never be retransmitted."""
+    import time as _time
+
+    from serf_tpu.host.dstream import _HDR, K_DATA, RTO_MIN
+
+    a, b = await _pair()
+    sent_counts: dict = {}
+    dropped = []
+    orig = a._sendto
+
+    def send(wire, addr):
+        if wire and wire[0] == T_SEGMENT:
+            _cid, kind, seq = _HDR.unpack_from(wire, 1)
+            if kind == K_DATA:
+                sent_counts[seq] = sent_counts.get(seq, 0) + 1
+                if seq == 1 and not dropped:
+                    dropped.append(seq)
+                    return          # the single injected loss
+        orig(wire, addr)
+
+    a._sendto = send
+    try:
+        dial_task = asyncio.ensure_future(a.dial(b.local_addr))
+        peer, srv = await asyncio.wait_for(b.accept(), 5)
+        cli = await dial_task
+        conn = cli._c
+
+        frame = os.urandom(8 * MSS)     # 9 segments: plenty of dup-acks
+        t0 = _time.monotonic()
+        await cli.send_frame(frame)
+        got = await srv.recv_frame(timeout=5)
+        dt = _time.monotonic() - t0
+
+        assert got == frame
+        assert dropped, "loss never injected — test is vacuous"
+        assert conn.fast_retx_count >= 1, \
+            "recovery did not go through fast retransmit"
+        assert dt < RTO_MIN, \
+            f"recovery took {dt * 1000:.0f} ms — waited out the RTO"
+        # the hole was resent exactly once; every SACKed segment exactly
+        # never (no spurious retransmission of delivered data)
+        assert sent_counts[1] == 2, sent_counts
+        spurious = {s: c for s, c in sent_counts.items()
+                    if s != 1 and c != 1}
+        assert not spurious, f"SACKed segments retransmitted: {spurious}"
+    finally:
+        await a.shutdown()
+        await b.shutdown()
+
+
 async def test_aimd_backs_off_through_bottleneck():
     """AIMD congestion response (the QUIC-slot WAN story): a token-bucket
     bottleneck between the endpoints drops whatever exceeds its rate.  The

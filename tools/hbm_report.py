@@ -1,0 +1,68 @@
+"""Print the HBM traffic model for the bench configurations.
+
+Usage:
+    python tools/hbm_report.py [--n 1000000] [--hlo [N]]
+
+``--hlo N`` additionally compiles the sustained flagship at N nodes
+(default 65536; forced CPU unless SERF_TPU_HBM_TPU=1) and prints XLA's
+own bytes-accessed figure next to the model.  See
+serf_tpu/models/accounting.py; budgets pinned in tests/test_accounting.py.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1_000_000)
+    ap.add_argument("--hlo", type=int, nargs="?", const=65_536,
+                    default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    if os.environ.get("SERF_TPU_HBM_TPU") != "1":
+        # env rule: ad-hoc scripts must not touch the tunnel
+        jax.config.update("jax_platforms", "cpu")
+
+    from serf_tpu.models.accounting import (
+        hlo_bytes_per_round,
+        round_traffic,
+    )
+    from serf_tpu.models.swim import flagship_config
+
+    cfg = flagship_config(args.n)
+    for regime in ("sustained", "active", "quiescent"):
+        r = round_traffic(cfg, regime=regime)
+        print(r.table())
+        print()
+
+    if args.hlo:
+        import functools
+
+        from serf_tpu.models.swim import make_cluster, run_cluster_sustained
+
+        cfg_s = flagship_config(args.hlo)
+        state = make_cluster(cfg_s, jax.random.key(0))
+        run = jax.jit(functools.partial(run_cluster_sustained, cfg=cfg_s,
+                                        events_per_round=2),
+                      static_argnames=("num_rounds",))
+        hlo = hlo_bytes_per_round(run, state, key=jax.random.key(1),
+                                  num_rounds=10)
+        model = round_traffic(cfg_s, regime="sustained").total_bytes
+        if hlo is None:
+            print(f"HLO cross-check @n={args.hlo}: backend exposes no "
+                  f"cost analysis")
+        else:
+            print(f"HLO cross-check @n={args.hlo}: compiled "
+                  f"{hlo / 1e6:.1f} MB/round vs model "
+                  f"{model / 1e6:.1f} MB/round "
+                  f"(ratio {hlo / model:.2f})")
+
+
+if __name__ == "__main__":
+    main()
