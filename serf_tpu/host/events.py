@@ -94,18 +94,33 @@ Event = object  # MemberEvent | UserEvent | QueryEvent
 
 
 class EventSubscriber:
-    """Async stream of events (bounded queue; drops-oldest on overflow so a
-    slow consumer cannot wedge the protocol)."""
+    """Async stream of events.
 
-    def __init__(self, maxsize: int = 4096):
+    Two bounded modes, matching the reference's channel split
+    (event.rs:394-512 offers bounded *blocking* and unbounded channels):
+
+    - default (``lossless=False``): drop-oldest on overflow — a slow
+      consumer can never wedge the protocol; losses are counted in
+      ``dropped`` and the ``serf.subscriber.dropped`` metric.
+    - ``lossless=True``: bounded BLOCKING — the event pipeline awaits
+      until the consumer makes room, so no event is ever dropped.  This
+      backpressures the delivery pipeline task only (gossip itself keeps
+      running; the inbox between the protocol and the pipeline is still
+      bounded by process memory), which is exactly the reference's
+      bounded-producer semantics.  Opt in only when every event matters
+      more than delivery latency.
+    """
+
+    def __init__(self, maxsize: int = 4096, lossless: bool = False):
         self._q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
-        #: events discarded by drop-oldest overflow.  Deliberate deviation
-        #: from the reference's backpressuring bounded channel: a slow
-        #: consumer must not wedge the protocol; the counter (plus the
-        #: serf.subscriber.dropped metric) makes the loss observable.
+        self.lossless = lossless
+        #: events discarded by drop-oldest overflow (always 0 in
+        #: lossless mode)
         self.dropped = 0
 
     def _push(self, ev) -> None:
+        """Synchronous push: drop-oldest semantics regardless of mode —
+        prefer ``push`` from async producers (it honors lossless)."""
         while True:
             try:
                 self._q.put_nowait(ev)
@@ -118,6 +133,14 @@ class EventSubscriber:
                     log.warning("event subscriber overflow: dropping oldest event")
                 except asyncio.QueueEmpty:
                     pass
+
+    async def push(self, ev) -> None:
+        """Async push honoring the mode: awaits for room when lossless,
+        drop-oldest otherwise."""
+        if self.lossless:
+            await self._q.put(ev)
+        else:
+            self._push(ev)
 
     async def next(self, timeout: Optional[float] = None):
         if timeout is None:
@@ -219,17 +242,17 @@ async def coalesce_loop(
                 ev = await asyncio.wait_for(inbox.get(), timeout)
         except asyncio.TimeoutError:
             for flushed in coalescer.flush():
-                out._push(flushed)
+                await out.push(flushed)
             pending = False
             flush_deadline = None
             continue
         if ev is None:  # shutdown: flush what we have
             for flushed in coalescer.flush():
-                out._push(flushed)
+                await out.push(flushed)
             return
         if coalescer.handle(ev):
             if not pending:
                 pending = True
                 flush_deadline = loop.time() + coalesce_period
         else:
-            out._push(ev)
+            await out.push(ev)

@@ -464,13 +464,31 @@ class Serf:
     # ------------------------------------------------------------------
 
     async def _passthrough_pipeline(self) -> None:
-        while True:
-            ev = await self._event_inbox.get()
-            if ev is None:
-                return
-            if self.snapshotter is not None:
-                self.snapshotter.observe(ev)
-            self._subscriber._push(ev)
+        # The snapshotter is a non-blocking tee (reference snapshot.rs
+        # tee_stream): it must observe every event even while a LOSSLESS
+        # subscriber backpressures the delivery stage — otherwise a
+        # stalled consumer would freeze snapshot persistence and a crash
+        # in that window would replay a stale alive-set.
+        mid: asyncio.Queue = asyncio.Queue()
+
+        async def tee() -> None:
+            while True:
+                ev = await self._event_inbox.get()
+                if ev is not None and self.snapshotter is not None:
+                    self.snapshotter.observe(ev)
+                await mid.put(ev)
+                if ev is None:
+                    return
+
+        t = asyncio.create_task(tee())
+        try:
+            while True:
+                ev = await mid.get()
+                if ev is None:
+                    return
+                await self._subscriber.push(ev)
+        finally:
+            t.cancel()
 
     async def _drain_pipeline(self) -> None:
         while True:

@@ -43,6 +43,9 @@ from serf_tpu.models.dissemination import CLAMP_EVERY, GossipConfig
 
 #: v5e HBM bandwidth, bytes/s (the ceiling arithmetic in STATUS.md)
 V5E_HBM_BYTES_PER_S = 819e9
+#: v5e inter-chip interconnect, bytes/s per chip (public spec: 1600 Gbps
+#: ICI per chip on v5e)
+V5E_ICI_BYTES_PER_S = 200e9
 
 
 @dataclasses.dataclass(frozen=True)
@@ -215,6 +218,69 @@ def round_traffic(cfg, regime: str = "sustained",
                   1.0 / cfg.probe_every, "vivaldi.vivaldi_update"))
 
     return TrafficReport(n=n, k=k, regime=regime, entries=E)
+
+
+def ici_round_traffic(cfg, n_devices: int = 8) -> dict:
+    """Per-chip ICI bytes for one gossip exchange under node sharding —
+    the arithmetic behind the 8-chip throughput claim (VERDICT r4
+    next-3; STATUS.md carries the 1M/8-chip table).
+
+    Three exchange schedules:
+
+    - ``rotation`` (the production flagship path): each of the ``fanout``
+      rolled reads shifts the packed packet plane by a global offset, so
+      a chip's rolled block arrives from (at most two) offset-neighbor
+      chips — bytes/chip ≈ fanout × the local packet block.  The probe /
+      vivaldi / push_pull rolls move N-sized columns at their cadences.
+    - ``iid_allgather`` (GSPMD's lowering of ``packets[srcs]`` with
+      random sources): every chip materializes the full packet plane —
+      (D-1)/D of it arrives over ICI.
+    - ``iid_ring`` (``parallel/ring.py``): D-1 ppermute hops of the
+      local block — the SAME total ICI bytes as the all-gather ring
+      algorithm, but peak HBM stays at the block size and the per-hop
+      transfers overlap with the per-hop resolve compute.
+
+    Returns a dict of bytes/chip/round plus derived μs at v5e bandwidths
+    and the implied 8-chip sustained ceiling.
+    """
+    g: GossipConfig = cfg.gossip
+    n, w, d = g.n, g.words, n_devices
+    packets_plane = float(n * w * 4)            # u32[N, W] packed packets
+    block = packets_plane / d                   # one chip's shard
+
+    rot_gossip = g.fanout * block               # fanout rolled block reads
+    # push_pull: known-plane roll at its cadence
+    rot_aux = ((packets_plane / d) / max(cfg.push_pull_every, 1)
+               if cfg.push_pull_every > 0 else 0.0)
+    if cfg.with_failure:
+        # probe rolls: N-sized liveness columns per probe tick
+        rot_aux += ((2 + cfg.failure.indirect_probes) * n / d
+                    ) / cfg.probe_every
+    if cfg.with_vivaldi:
+        # vivaldi partner rolls (positions f32[N,3] + liveness) ride the
+        # probe cadence (cluster_round wires them to probe_tick)
+        rot_aux += ((3 * 4 * n + 4 * n) / d) / cfg.probe_every
+    rotation = rot_gossip + rot_aux
+
+    allgather = (d - 1) / d * packets_plane     # the rest of the plane in
+    ring = (d - 1) * block                      # D-1 hops of the block
+
+    hbm_per_chip = round_traffic(cfg, regime="sustained").total_bytes / d
+    out = {
+        "n": n, "n_devices": d,
+        "rotation_bytes_per_chip": rotation,
+        "iid_allgather_bytes_per_chip": allgather,
+        "iid_ring_bytes_per_chip": ring,
+        "hbm_bytes_per_chip_sustained": hbm_per_chip,
+        "rotation_ici_us": rotation / V5E_ICI_BYTES_PER_S * 1e6,
+        "allgather_ici_us": allgather / V5E_ICI_BYTES_PER_S * 1e6,
+        "hbm_us_per_chip": hbm_per_chip / V5E_HBM_BYTES_PER_S * 1e6,
+    }
+    # the round is bound by the slower of HBM and ICI (they overlap at
+    # best); the implied D-chip sustained ceiling uses the rotation path
+    bound_s = max(out["rotation_ici_us"], out["hbm_us_per_chip"]) / 1e6
+    out["implied_sustained_ceiling_rps"] = 1.0 / bound_s if bound_s else 0.0
+    return out
 
 
 def hlo_bytes_per_round(jitted, *args, num_rounds: int,

@@ -1,0 +1,61 @@
+"""The shared randomized API-storm op loop.
+
+One definition of the storm mix (leave/shutdown churn, rejoin, user
+events, scatter-gather queries, tag flaps) drawn from the full public
+API surface — used by the loopback soak (test_soak.py) and the
+real-socket storms (test_transport_storms.py) so the two suites cannot
+silently diverge.  Transport plumbing differs per caller and comes in
+through the ``respawn`` / ``join_addr`` callbacks.
+"""
+
+import asyncio
+import random
+from typing import Callable, Dict, Set
+
+from serf_tpu.host import QueryParam, Serf
+
+
+async def run_api_storm(rng: random.Random, nodes: Dict[int, Serf],
+                        killed: Set[int], ops: int,
+                        respawn: Callable, join_addr: Callable) -> None:
+    """Drive ``ops`` randomized API operations against the cluster.
+
+    ``respawn(i) -> Serf``: restart node i on its OLD address (a same-id
+    node on a new address is the name-conflict scenario, not a restart).
+    ``join_addr(i)``: the address/name node i is joinable at.
+    ``nodes``/``killed`` are mutated in place so the caller can assert on
+    the final population.
+    """
+    from serf_tpu.types.tags import Tags
+
+    for op in range(ops):
+        live = [i for i in nodes if i not in killed]
+        if not live:
+            break
+        actor = nodes[rng.choice(live)]
+        r = rng.random()
+        if r < 0.15 and len(live) > 4:
+            victim = rng.choice([i for i in live if i != 0])
+            if rng.random() < 0.5:
+                await nodes[victim].leave()
+            await nodes[victim].shutdown()
+            killed.add(victim)
+        elif r < 0.30 and killed:
+            back = rng.choice(sorted(killed))
+            killed.discard(back)
+            nodes[back] = await respawn(back)
+            tgt = rng.choice([i for i in nodes
+                              if i not in killed and i != back])
+            await nodes[back].join(join_addr(tgt))
+        elif r < 0.6:
+            await actor.user_event(
+                f"ev-{op}", bytes([op % 256]) * rng.randint(0, 50),
+                coalesce=False)
+        elif r < 0.8:
+            resp = await actor.query(f"q-{op}", b"",
+                                     QueryParam(timeout=0.3))
+            await resp.collect()
+        else:
+            await actor.set_tags(Tags(v=str(op)))
+        if rng.random() < 0.3:
+            await asyncio.sleep(0.02)

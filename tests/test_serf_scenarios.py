@@ -395,6 +395,29 @@ def test_subscriber_overflow_counted():
     asyncio.run(main())
 
 
+async def test_lossless_subscriber_backpressures_never_drops():
+    """Opt-in bounded BLOCKING subscriber (the reference's bounded
+    channel semantics, event.rs:394-512): the producer awaits until the
+    consumer makes room; every event arrives in order, none dropped."""
+    from serf_tpu.host.events import EventSubscriber
+
+    sub = EventSubscriber(maxsize=2, lossless=True)
+    pushed = []
+
+    async def producer():
+        for i in range(10):
+            await sub.push(i)
+            pushed.append(i)
+
+    task = asyncio.create_task(producer())
+    await asyncio.sleep(0.05)
+    assert len(pushed) < 10, "producer never backpressured"
+    got = [await asyncio.wait_for(sub.next(), 2.0) for _ in range(10)]
+    await task
+    assert got == list(range(10))
+    assert sub.dropped == 0
+
+
 async def test_leave_intent_avoids_infinite_rebroadcast():
     """The consul#8179 guard: a leave intent about an already-leaving/left
     member updates the time but must NOT be rebroadcast (the reference pins

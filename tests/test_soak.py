@@ -46,6 +46,8 @@ async def _assert_converges(nodes, live, want, deadline_s, label):
 
 @pytest.mark.parametrize("seed", [1, 2, 7, 8])
 async def test_randomized_soak(seed):
+    from tests.storm_ops import run_api_storm
+
     rng = random.Random(seed)
     net = LoopbackNetwork()
     n = 10
@@ -57,35 +59,12 @@ async def test_randomized_soak(seed):
         await nodes[i].join("s0")
     killed = set()
     try:
-        for op in range(60):
-            choice = rng.random()
-            live = [i for i in nodes if i not in killed]
-            if not live:
-                break
-            actor = nodes[rng.choice(live)]
-            if choice < 0.15 and len(live) > 4:
-                victim = rng.choice([i for i in live if i != 0])
-                if rng.random() < 0.5:
-                    await nodes[victim].leave()
-                await nodes[victim].shutdown()
-                killed.add(victim)
-            elif choice < 0.30 and killed:
-                back = rng.choice(sorted(killed))
-                killed.discard(back)
-                nodes[back] = await Serf.create(
-                    _rebind(net, f"s{back}"), Options.local(), f"soak-{back}")
-                await nodes[back].join(f"s{rng.choice([i for i in nodes if i not in killed and i != back])}")
-            elif choice < 0.6:
-                await actor.user_event(f"ev-{op}", bytes([op % 256]) * rng.randint(0, 50),
-                                       coalesce=False)
-            elif choice < 0.8:
-                resp = await actor.query(f"q-{op}", b"", QueryParam(timeout=0.2))
-                await resp.collect()
-            else:
-                from serf_tpu.types.tags import Tags
-                await actor.set_tags(Tags(v=str(op)))
-            if rng.random() < 0.3:
-                await asyncio.sleep(0.02)
+        async def respawn(i):
+            return await Serf.create(_rebind(net, f"s{i}"),
+                                     Options.local(), f"soak-{i}")
+
+        await run_api_storm(rng, nodes, killed, 60, respawn,
+                            lambda i: f"s{i}")
         live = [i for i in nodes if i not in killed
                 and nodes[i].state == SerfState.ALIVE]
         await _assert_converges(nodes, live, {f"soak-{i}" for i in live},

@@ -200,6 +200,55 @@ async def test_partition_bisection_heals(stream, tmp_path):
                 await s.shutdown()
 
 
+@pytest.mark.parametrize("stream", ("tcp", "udpstream"))
+@pytest.mark.parametrize("seed", (71, 72))
+async def test_api_storm_over_real_sockets(stream, seed, tmp_path):
+    """The loopback randomized API storm (test_soak.py) ported to real
+    stream transports (VERDICT r4 next-6): leave/shutdown churn, rejoins
+    on the old address, user events, scatter-gather queries, and tag
+    flaps interleave over live sockets.  The udpstream variant runs
+    FULLY ENCRYPTED (cluster keyring on both the gossip wire and the
+    stream segments) with 5% segment loss, so AIMD + SACK recovery +
+    keyring decrypt + churn all interleave — the combination round 4
+    shipped untested."""
+    from serf_tpu.host.keyring import SecretKeyring
+
+    from tests.storm_ops import run_api_storm
+
+    rng = random.Random(seed)
+    n = 8
+    keyring = SecretKeyring(bytes(range(16))) if stream == "udpstream" \
+        else None
+    loss = 0.05 if stream == "udpstream" else 0.0
+    addrs = {}
+    nodes = {}
+
+    async def spawn(i):
+        t = await _bind(stream, tmp_path, keyring=keyring,
+                        addr=addrs.get(i, ("127.0.0.1", 0)))
+        _inject_loss(t, rng, loss)
+        addrs[i] = t.local_addr
+        return await Serf.create(t, Options.local(), f"st-{i}",
+                                 keyring=keyring)
+
+    for i in range(n):
+        nodes[i] = await spawn(i)
+    killed = set()
+    try:
+        for i in range(1, n):
+            await nodes[i].join(addrs[0])
+        await run_api_storm(rng, nodes, killed, 40, spawn,
+                            lambda i: addrs[i])
+        live = [i for i in nodes if i not in killed
+                and nodes[i].state == SerfState.ALIVE]
+        await _converged(nodes, live, 30.0,
+                         f"{stream} api storm seed {seed}")
+    finally:
+        for s in nodes.values():
+            if s.state != SerfState.SHUTDOWN:
+                await s.shutdown()
+
+
 async def test_key_rotation_storm_over_dstream(tmp_path):
     """Mid-run cluster key rotation while the dstream SEGMENT plane is
     encrypted with the same keyring: the rotation must propagate to both

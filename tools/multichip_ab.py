@@ -1,0 +1,125 @@
+"""Ring-vs-allgather crossover sweep on a virtual 8-device mesh.
+
+VERDICT r4 next-3: scale the A/B across n ∈ {1k, 16k, 128k} per device
+(× 8 devices) and find where the ppermute ring overtakes the GSPMD
+all-gather for the iid-sampling exchange.  CPU-mesh timings quantify the
+collective SCHEDULE (dispatch count, materialization, overlap shape) —
+not ICI bandwidth; the bandwidth arithmetic lives in
+``accounting.ici_round_traffic`` and STATUS.md.
+
+Writes MULTICHIP_AB.json at the repo root and prints the table.
+
+Usage: python tools/multichip_ab.py [--devices 8] [--reps 20]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--reps", type=int, default=20)
+    ap.add_argument("--per-device", type=int, nargs="*",
+                    default=[1024, 16384, 131072])
+    args = ap.parse_args()
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={args.devices}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+    import functools
+
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from serf_tpu.models.accounting import ici_round_traffic
+    from serf_tpu.models.dissemination import (
+        GossipConfig,
+        K_USER_EVENT,
+        inject_fact,
+        make_state,
+        round_step,
+    )
+    from serf_tpu.models.swim import flagship_config
+    from serf_tpu.parallel.mesh import make_mesh, shard_state, state_shardings
+    from serf_tpu.parallel.ring import round_step_ring
+
+    d = args.devices
+    mesh = make_mesh(d)
+    results = []
+    for n_local in args.per_device:
+        n = n_local * d
+        # iid sampling: the mode where the exchange is a data-dependent
+        # gather — GSPMD lowers it to an all-gather of the packet plane;
+        # the ring resolves it in D-1 ppermute hops
+        cfg = GossipConfig(n=n, k_facts=64, peer_sampling="iid")
+        g = make_state(cfg)
+        for i in range(8):
+            g = inject_fact(g, cfg, subject=i, kind=K_USER_EVENT,
+                            incarnation=0, ltime=i + 1,
+                            origin=(i * (n // 8)) % n)
+        g = shard_state(g, mesh)
+        sh = state_shardings(g, mesh)
+
+        ag = jax.jit(lambda s, key: round_step(s, cfg, key),
+                     out_shardings=sh)
+        ring = jax.jit(functools.partial(round_step_ring, cfg=cfg,
+                                         mesh=mesh))
+
+        def rps(stepfn, g0):
+            g1 = stepfn(g0, key=jax.random.key(1))     # compile + warm
+            int(np.asarray(g1.round))
+            t0 = time.perf_counter()
+            gg = g0
+            for i in range(args.reps):
+                gg = stepfn(gg, key=jax.random.key(2 + i))
+            int(np.asarray(gg.round))                  # completion barrier
+            return args.reps / (time.perf_counter() - t0)
+
+        ag_rps, ring_rps = rps(ag, g), rps(ring, g)
+        model = ici_round_traffic(flagship_config(n), d)
+        row = {
+            "n": n, "n_per_device": n_local,
+            "allgather_rps": round(ag_rps, 1),
+            "ring_rps": round(ring_rps, 1),
+            "ring_wins": ring_rps > ag_rps,
+            "model_allgather_bytes_per_chip":
+                model["iid_allgather_bytes_per_chip"],
+            "model_ring_bytes_per_chip":
+                model["iid_ring_bytes_per_chip"],
+        }
+        results.append(row)
+        print(f"n={n:>8} ({n_local}/dev): allgather {ag_rps:8.1f} rps, "
+              f"ring {ring_rps:8.1f} rps -> "
+              f"{'RING' if row['ring_wins'] else 'ALLGATHER'} wins",
+              flush=True)
+
+    crossover = next((r["n"] for r in results if r["ring_wins"]), None)
+    out = {
+        "devices": d, "reps": args.reps, "results": results,
+        "crossover_n": crossover,
+        "note": "CPU virtual mesh: collective schedule shape, not ICI "
+                "bandwidth; bandwidth arithmetic in "
+                "accounting.ici_round_traffic / STATUS.md",
+        "ici_model_1m_8chip": ici_round_traffic(flagship_config(1_000_000),
+                                                8),
+    }
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "MULTICHIP_AB.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {path}; crossover at n={crossover}")
+
+
+if __name__ == "__main__":
+    main()

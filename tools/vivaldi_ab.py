@@ -1,0 +1,139 @@
+"""Device-plane Vivaldi latency-filter accuracy A/B at scale.
+
+VERDICT r4 next-8: the device plane's per-NODE median filter (an O(N)
+stand-in for the reference's O(N²)-state per-PEER filter,
+coordinate.rs:708-723) defaults OFF.  This tool quantifies the deviation
+at 100k nodes under two noise regimes:
+
+- ``clean``:  rtt_true × lognormal jitter (σ=0.1) — ordinary variance
+- ``spiky``:  the same plus 5% ×10 spikes (retries/queueing bursts) —
+  the failure mode latency filters exist for
+
+and runs the HOST per-peer oracle (the faithful reference
+implementation) at small N on the same noise model as the reference
+point.  Writes VIVALDI_AB.json; the default-on/off decision and numbers
+live in STATUS.md.
+
+Usage: python tools/vivaldi_ab.py [--n 100000] [--rounds 300]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def device_run(n, rounds, fsize, spike_p, seed=0):
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from serf_tpu.models.dissemination import rolled_rows, sample_offsets
+    from serf_tpu.models.vivaldi import (
+        VivaldiConfig,
+        ground_truth_rtt_rolled,
+        make_vivaldi,
+        mean_relative_error,
+        vivaldi_update,
+    )
+
+    cfg = VivaldiConfig(latency_filter_size=fsize)
+    key = jax.random.key(seed)
+    k_pos, key = jax.random.split(key)
+    positions = jax.random.uniform(k_pos, (n, 3), jnp.float32) * 0.05
+    dev = make_vivaldi(n, cfg)
+
+    def round_fn(dev, k):
+        k_off, k_jit, k_spk, k_upd = jax.random.split(k, 4)
+        off = sample_offsets(k_off, 1, n)[0]
+        rtt = ground_truth_rtt_rolled(positions, off)
+        # multiplicative lognormal jitter + occasional large spikes
+        jitter = jnp.exp(jax.random.normal(k_jit, (n,)) * 0.1)
+        spike = jnp.where(
+            jax.random.bernoulli(k_spk, spike_p, (n,)), 10.0, 1.0)
+        rtt_obs = rtt * jitter * spike
+        return vivaldi_update(dev, cfg, None, rtt_obs, k_upd,
+                              peer_roll=off), ()
+
+    run = jax.jit(functools.partial(jax.lax.scan, round_fn))
+    dev, _ = run(dev, jax.random.split(key, rounds))
+    err = float(mean_relative_error(dev, cfg, positions,
+                                    jax.random.key(99)))
+    return err
+
+
+def host_oracle_run(n, rounds, spike_p, seed=0):
+    """The reference per-peer filter implementation (host plane), same
+    noise model, random-pair observations."""
+    import random as pyrandom
+
+    import numpy as np
+
+    from serf_tpu.host.coordinate import CoordinateClient
+
+    rng = pyrandom.Random(seed)
+    nprng = np.random.default_rng(seed)
+    positions = nprng.uniform(0, 0.05, size=(n, 3)).astype(np.float64)
+    clients = [CoordinateClient() for _ in range(n)]
+
+    def true_rtt(i, j):
+        return 0.005 + float(np.linalg.norm(positions[i] - positions[j]))
+
+    for _ in range(rounds):
+        # one observation per node per round, like the device rotation
+        off = rng.randrange(1, n)
+        for i in range(n):
+            j = (i + off) % n
+            rtt = true_rtt(i, j) * float(np.exp(nprng.normal() * 0.1))
+            if nprng.random() < spike_p:
+                rtt *= 10.0
+            clients[i].update(f"node-{j}", clients[j].get_coordinate(),
+                              rtt)
+    errs = []
+    for _ in range(4096):
+        i, j = rng.randrange(n), rng.randrange(n)
+        if i == j:
+            continue
+        est = clients[i].get_coordinate().distance_to(
+            clients[j].get_coordinate())
+        t = true_rtt(i, j)
+        errs.append(abs(est - t) / t)
+    return float(np.mean(errs))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=100_000)
+    ap.add_argument("--rounds", type=int, default=300)
+    ap.add_argument("--host-n", type=int, default=192)
+    ap.add_argument("--host-rounds", type=int, default=120)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")   # env rule: never the tunnel
+
+    out = {"n": args.n, "rounds": args.rounds, "device": {}, "host": {}}
+    for regime, spike_p in (("clean", 0.0), ("spiky", 0.05)):
+        for fsize in (1, 3):
+            err = device_run(args.n, args.rounds, fsize, spike_p)
+            out["device"][f"{regime}_filter{fsize}"] = round(err, 4)
+            print(f"device n={args.n} {regime:>5} filter={fsize}: "
+                  f"mean rel err {err:.4f}", flush=True)
+        herr = host_oracle_run(args.host_n, args.host_rounds, spike_p)
+        out["host"][f"{regime}_perpeer_n{args.host_n}"] = round(herr, 4)
+        print(f"host  n={args.host_n} {regime:>5} per-peer filter: "
+              f"mean rel err {herr:.4f}", flush=True)
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "VIVALDI_AB.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()
