@@ -123,10 +123,19 @@ def round_step_ring(state: GossipState, cfg: GossipConfig, key: jax.Array,
     new_words = incoming & ~state.known & jnp.where(
         alive_col, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
     known = state.known | new_words
-    new_mask = unpack_bits(new_words, k)
-    stamp = jnp.where(new_mask, round_u8(state.round + 1), state.stamp)
+    learned_any = jnp.any(new_words != 0)
+
+    # stamp learn pass gated on learned_any exactly as round_step phase 5
+    # (bit-exact identity when skipped) — keeps the ring both bit-identical
+    # to the all-gather round AND equally gated in the byte model
+    def stamp_learns(s):
+        new_mask = unpack_bits(new_words, k)
+        return jnp.where(new_mask, round_u8(state.round + 1), s)
+
+    stamp = jax.lax.cond(learned_any, stamp_learns, lambda s: s,
+                         state.stamp)
     stamp = clamp_stamps(known, stamp, state.round + 1, k)
-    last_learn = bump_last_learn(jnp.any(new_words != 0), state.round + 1,
+    last_learn = bump_last_learn(learned_any, state.round + 1,
                                  state.last_learn)
     return state._replace(known=known, stamp=stamp, last_learn=last_learn,
                           round=state.round + 1)
