@@ -138,7 +138,10 @@ def main() -> None:
         run_cluster_sustained,
     )
 
-    detail = {}
+    # the node count disambiguates this artifact from smaller-N smoke
+    # runs (a 100k validation and a 1M record look like a 100x collapse
+    # without it)
+    detail = {"n": N_NODES}
     # THE flagship workload definition (swim.flagship_config): rotation
     # sampling + round-robin probes (the at-scale mode — no 1M-row random
     # gathers), reference LAN gossip:probe cadence, push/pull every 16.
