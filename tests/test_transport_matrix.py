@@ -313,13 +313,16 @@ async def test_snapshot_compaction_then_restart_rejoins(transport,
                          msg=f"3-node convergence over {transport}")
         for i in range(200):
             await extra.user_event(f"e{i}", b"payload", coalesce=False)
+        # generous deadlines: 200 events + the 500 ms flush/compact
+        # cadence stretch well past 10 s on a loaded CI box (liveness,
+        # not latency, is what this pins — the soak-suite convention)
         await wait_until(
             lambda: len(sink.histogram("serf.snapshot.compact", {})) > 0,
-            msg=f"snapshot compaction ran over {transport}")
+            deadline=25.0, msg=f"snapshot compaction ran over {transport}")
         await wait_until(
             lambda: os.path.exists(snap)
             and os.path.getsize(snap) < 4096,
-            msg="snapshot compacted below write volume")
+            deadline=25.0, msg="snapshot compacted below write volume")
         # crash (no leave), restart on the same address from the
         # compacted snapshot: the alive set survived compaction, so the
         # node auto-rejoins without an explicit join()
@@ -332,6 +335,7 @@ async def test_snapshot_compaction_then_restart_rejoins(transport,
             lambda: extra.num_members() == 3
             and all(s._members["mx-2"].member.status == MemberStatus.ALIVE
                     for s in nodes),
+            deadline=25.0,
             msg=f"auto-rejoin from compacted snapshot over {transport}")
     finally:
         metrics_mod.set_global_sink(metrics_mod.MetricsSink())
