@@ -143,21 +143,36 @@ def round_traffic(cfg, regime: str = "sustained",
     gossip_on = regime in ("sustained", "active")
     learns = regime == "sustained"
 
+    # the sendable cache is valid exactly when the previous round's merge
+    # learned something — i.e. (essentially) every round under sustained
+    # load, and never in the no-learn "active" window or quiescent state
+    cache_hot = g.use_sendable_cache and regime == "sustained"
+
     if sustained_rate > 0 and regime == "sustained":
         # inject_facts_batch: retirement clears known bits everywhere
-        # (R+W the word plane); the per-fact scatters are O(m) cells
-        add(Entry("inject", "known", "RW", 2 * known, 1.0,
+        # (R+W the word plane); the per-fact scatters are O(m) cells;
+        # the sendable cache mirrors the same passes
+        add(Entry("inject", "known", "RW",
+                  (4 if g.use_sendable_cache else 2) * known, 1.0,
                   "dissemination.inject_facts_batch"))
 
     if gossip_on:
-        # selection: sending_mask + pack — one fused read pass over the
-        # stamp plane + known words + alive, one packed write
-        add(Entry("selection", "stamp", "R", stamp, 1.0,
-                  "dissemination.sending_mask"))
-        add(Entry("selection", "known", "R", known, 1.0,
-                  "dissemination.sending_mask"))
-        add(Entry("selection", "alive", "R", alive, 1.0,
-                  "dissemination.sending_mask"))
+        if cache_hot:
+            # selection: alive-masked read of the packed cache — the
+            # stamp plane is NOT touched (the 64 MB/round saving at 1M)
+            add(Entry("selection", "sendable", "R", known, 1.0,
+                      "dissemination.round_step cached selection"))
+            add(Entry("selection", "alive", "R", alive, 1.0,
+                      "dissemination.round_step cached selection"))
+        else:
+            # selection fallback: sending_mask + pack — one fused read
+            # pass over the stamp plane + known words + alive
+            add(Entry("selection", "stamp", "R", stamp, 1.0,
+                      "dissemination.sending_mask"))
+            add(Entry("selection", "known", "R", known, 1.0,
+                      "dissemination.sending_mask"))
+            add(Entry("selection", "alive", "R", alive, 1.0,
+                      "dissemination.sending_mask"))
         add(Entry("selection", "packets", "W", known, 1.0,
                   "dissemination.round_step phase 1"))
         # exchange (rotation): ONE doubled copy of packets (XLA CSEs the
@@ -175,9 +190,14 @@ def round_traffic(cfg, regime: str = "sustained",
                   "dissemination.round_step phase 4"))
         if learns:
             # stamp learn pass (gated on learned_any; in the sustained
-            # regime fresh facts spread every round so it runs)
+            # regime fresh facts spread every round so it runs); the
+            # sendable-cache recompute rides the same fusion (+1 packed
+            # write)
             add(Entry("merge", "stamp", "RW", 2 * stamp, 1.0,
                       "dissemination.round_step phase 5"))
+            if g.use_sendable_cache:
+                add(Entry("merge", "sendable", "W", known, 1.0,
+                          "dissemination.round_step cache recompute"))
 
     # amortized wraparound clamp (both branches)
     add(Entry("clamp", "stamp", "RW", 2 * stamp + known,
@@ -204,6 +224,8 @@ def round_traffic(cfg, regime: str = "sustained",
         pp_bytes = 3 * known + 3 * known + 3 * alive
         if learns:
             pp_bytes += 2 * stamp
+            if g.use_sendable_cache:
+                pp_bytes += 2 * known   # sendable OR of the learn bits
         add(Entry("push_pull", "known", "RW", pp_bytes,
                   1.0 / cfg.push_pull_every,
                   "antientropy.push_pull_round"))

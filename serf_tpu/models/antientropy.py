@@ -67,8 +67,23 @@ def push_pull_round(state: GossipState, cfg: GossipConfig, key: jax.Array,
         return jnp.where(new_mask, round_u8(state.round), s)
 
     stamp = jax.lax.cond(learned_any, stamp_learns, lambda s: s, state.stamp)
+    # sendable cache (flag-gated at trace time): the newly synced facts
+    # are age-0 sendable — OR-ing their packed bits preserves the cache
+    # invariant for the round the plane is valid for (round_step's merge
+    # set it for the CURRENT round; on a stale plane the OR is harmless,
+    # it is never read)
+    if cfg.use_sendable_cache:
+        sendable = state.sendable | new_words
+        sendable_round = state.sendable_round
+    else:
+        sendable = state.sendable
+        # learned without mirroring: mixed-flag hygiene (see inject_fact)
+        sendable_round = jnp.where(learned_any, jnp.int32(-1),
+                                   state.sendable_round)
     last_learn = bump_last_learn(learned_any, state.round, state.last_learn)
-    return state._replace(known=known, stamp=stamp, last_learn=last_learn)
+    return state._replace(known=known, stamp=stamp, sendable=sendable,
+                          sendable_round=sendable_round,
+                          last_learn=last_learn)
 
 
 def make_partition(n: int, split: float = 0.5) -> jnp.ndarray:

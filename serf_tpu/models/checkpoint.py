@@ -47,6 +47,17 @@ def restore(path: str, template: Any) -> Any:
             for path_k, leaf in flat:
                 key = jax.tree_util.keystr(path_k)
                 if key not in data:
+                    # back-compat for checkpoints written before the
+                    # round-5 sendable cache: the cache fields have an
+                    # always-safe default by their own invariant —
+                    # sendable_round = -1 means "stale, never read", so
+                    # the first cached selection recomputes from stamps
+                    if key.endswith(".sendable"):
+                        leaves.append(jnp.zeros_like(leaf))
+                        continue
+                    if key.endswith(".sendable_round"):
+                        leaves.append(jnp.asarray(-1, leaf.dtype))
+                        continue
                     raise ValueError(f"checkpoint missing array {key!r}")
                 arr = data[key]
                 if arr.shape != leaf.shape:

@@ -109,6 +109,30 @@ class GossipState(NamedTuple):
                                 # (serf's empty broadcast queue sends
                                 # nothing).  Every path that writes
                                 # stamps/known must update this scalar.
+    sendable: jnp.ndarray       # u32[N, W]  packed CACHE of the selection
+                                # predicate `known & (mod_age < limit)`
+                                # (alive NOT folded in — liveness changes
+                                # externally).  Valid ONLY when
+                                # sendable_round == round; see below.
+    sendable_round: jnp.ndarray  # i32 scalar: the round `sendable` is
+                                # valid for (-1 = never).  INVARIANT:
+                                # sendable_round == R implies sendable ==
+                                # pack(known & (mod_age(R) < limit)).
+                                # Writers: the merge's learn pass
+                                # recomputes the full plane for round+1
+                                # (the only place the validity round
+                                # advances — expiry transitions are only
+                                # visible while the stamp plane is being
+                                # streamed anyway); inject/push_pull OR
+                                # their age-0 learn bits in and clear
+                                # retired slots, which preserves validity
+                                # for the SAME round (and is harmless on
+                                # a stale plane — a stale plane is never
+                                # read).  Selection uses the cache only
+                                # when valid, else falls back to the
+                                # stamp-plane recompute (accounting.py
+                                # quantifies the 64 MB/round this saves
+                                # in the sustained regime at 1M).
 
 
 @dataclasses.dataclass(frozen=True)
@@ -132,6 +156,13 @@ class GossipConfig:
     #: shuffled round-robin probe list and converges like random gossip
     #: (random Cayley-graph expanders); it is the intended mode at scale.
     peer_sampling: str = "iid"
+    #: use the packed ``sendable`` cache for packet selection when valid
+    #: (GossipState.sendable_round): saves the selection's full stamp-
+    #: plane read (64 MB/round at 1M) whenever the previous round's merge
+    #: learned anything — i.e. nearly always under sustained load.
+    #: Bit-exact either way (tests/test_sendable_cache.py pins it);
+    #: the flag exists for that A/B and as an escape hatch.
+    use_sendable_cache: bool = True
 
     def __post_init__(self):
         if self.peer_sampling not in ("iid", "rotation"):
@@ -186,6 +217,8 @@ def make_state(cfg: GossipConfig) -> GossipState:
         round=jnp.asarray(0, jnp.int32),
         next_slot=jnp.asarray(0, jnp.int32),
         last_learn=jnp.asarray(0, jnp.int32),
+        sendable=jnp.zeros((n, w), jnp.uint32),
+        sendable_round=jnp.asarray(-1, jnp.int32),
     )
 
 
@@ -322,8 +355,24 @@ def inject_fact(state: GossipState, cfg: GossipConfig, subject, kind,
     known = state.known.at[:, word].set(state.known[:, word] & ~bitmask)
     known = known.at[origin, word].set(known[origin, word] | bitmask)
     stamp = state.stamp.at[origin, slot].set(round_u8(state.round))
+    # mirror on the sendable cache (flag-gated at trace time — the
+    # escape-hatch config must not pay maintenance): the fresh fact is
+    # age-0 sendable at the origin, the retired slot is sendable nowhere
+    # — preserves the cache invariant for whatever round the cache is
+    # valid for (and is harmless on a stale plane, which is never read)
+    sendable = state.sendable
+    sendable_round = state.sendable_round
+    if cfg.use_sendable_cache:
+        sendable = sendable.at[:, word].set(sendable[:, word] & ~bitmask)
+        sendable = sendable.at[origin, word].set(
+            sendable[origin, word] | bitmask)
+    else:
+        # learned without mirroring: a later flag-on run must not trust
+        # this plane (mixed-flag hygiene)
+        sendable_round = jnp.asarray(-1, jnp.int32)
     return state._replace(facts=facts, known=known,
                           stamp=stamp, next_slot=state.next_slot + 1,
+                          sendable=sendable, sendable_round=sendable_round,
                           last_learn=bump_last_learn(True, state.round,
                                                      state.last_learn))
 
@@ -386,7 +435,19 @@ def inject_facts_batch(state: GossipState, cfg: GossipConfig, subjects,
     stamp = state.stamp.at[worigins, wslots].set(
         round_u8(state.round), mode="drop")
 
+    # sendable cache mirror (see inject_fact; flag-gated at trace time):
+    # retire everywhere, age-0 bits at the origins
+    sendable = state.sendable
+    sendable_round = state.sendable_round
+    if cfg.use_sendable_cache:
+        sendable = sendable & ~clear_words[None, :]
+        sendable = sendable.at[worigins, jnp.where(active, words, 0)].add(
+            bitmasks, mode="drop")
+    else:
+        sendable_round = jnp.asarray(-1, jnp.int32)
+
     return state._replace(facts=facts, known=known, stamp=stamp,
+                          sendable=sendable, sendable_round=sendable_round,
                           next_slot=state.next_slot
                           + jnp.sum(active).astype(jnp.int32),
                           last_learn=bump_last_learn(
@@ -519,10 +580,22 @@ def round_step(state: GossipState, cfg: GossipConfig,
         if use_pallas:
             alive_u8 = state.alive[:, None].astype(jnp.uint8)
             # phase 1: pack sending bits — one read-only pass over the
-            # stamp plane + known words (derived age, no tick anywhere)
+            # stamp plane + known words (derived age, no tick anywhere).
+            # The pallas path neither reads nor maintains the sendable
+            # cache (it leaves sendable_round stale, which is safe).
             packets = round_kernels.select_packets(
                 state.stamp, state.known, alive_u8, cfg.transmit_limit,
                 state.round)
+        elif cfg.use_sendable_cache:
+            # 1. packet selection: use the cached predicate when valid
+            #    (one 8 MB word-plane read at 1M instead of the 64 MB
+            #    stamp-plane pass), else recompute from stamps
+            packets = jax.lax.cond(
+                state.sendable_round == state.round,
+                lambda s: jnp.where(s.alive[:, None], s.sendable,
+                                    jnp.uint32(0)),
+                lambda s: pack_bits(sending_mask(s, cfg)),
+                state)
         else:
             # 1. packet selection: known facts with remaining transmit
             #    budget (derived age < limit), from alive nodes
@@ -563,6 +636,11 @@ def round_step(state: GossipState, cfg: GossipConfig,
                 state.known, incoming, alive_u8, state.stamp,
                 state.round + 1)
             learned_any = jnp.any(known != state.known)
+            # the kernel learns without maintaining the cache — a later
+            # cached selection on this state would miss those learns, so
+            # invalidate (the pallas path always selects from stamps)
+            sendable = state.sendable
+            sendable_round = jnp.asarray(-1, jnp.int32)
         else:
             # 4. merge: learn facts we did not know; dead learn nothing
             alive_col = state.alive[:, None]
@@ -580,28 +658,51 @@ def round_step(state: GossipState, cfg: GossipConfig,
             #    the round's biggest single pass (stamp R+W, 128 MB at
             #    1M×64) during the fully-disseminated window the gossip
             #    gate hasn't closed yet (see serf_tpu/models/accounting.py).
-            def stamp_learns(s):
+            #    While the stamp plane is streaming through this pass
+            #    anyway, the sendable cache for round+1 is recomputed in
+            #    the same fusion — expiry transitions included — which is
+            #    the only place the cache's validity round advances.
+            def stamp_learns(_):
                 new_mask = unpack_bits(new_words, k)          # bool[N, K]
-                return jnp.where(new_mask, round_u8(state.round + 1), s)
+                stamp2 = jnp.where(new_mask, round_u8(state.round + 1),
+                                   state.stamp)
+                if cfg.use_sendable_cache:
+                    kb = unpack_bits(known, k)
+                    age_next = round_u8(state.round + 1) - stamp2
+                    send2 = pack_bits(
+                        kb & (age_next < jnp.uint8(cfg.transmit_limit)))
+                    sr2 = jnp.asarray(state.round + 1, jnp.int32)
+                else:
+                    # learned without mirroring: mixed-flag hygiene
+                    send2 = state.sendable
+                    sr2 = jnp.asarray(-1, jnp.int32)
+                return stamp2, send2, sr2
 
-            stamp = jax.lax.cond(learned_any, stamp_learns,
-                                 lambda s: s, state.stamp)
+            stamp, sendable, sendable_round = jax.lax.cond(
+                learned_any, stamp_learns,
+                lambda _: (state.stamp, state.sendable,
+                           state.sendable_round), None)
         last_learn = bump_last_learn(learned_any, state.round + 1,
                                      state.last_learn)
-        return known, stamp, last_learn
+        return known, stamp, last_learn, sendable, sendable_round
 
     def quiet(state):
-        return state.known, state.stamp, state.last_learn
+        return (state.known, state.stamp, state.last_learn,
+                state.sendable, state.sendable_round)
 
-    known, stamp, last_learn = jax.lax.cond(
+    known, stamp, last_learn, sendable, sendable_round = jax.lax.cond(
         state.round - state.last_learn < cfg.transmit_limit,
         active, quiet, state)
 
     # amortized wraparound guard (full-plane pass 1/CLAMP_EVERY rounds);
     # runs in BOTH branches — the clamp is what keeps mod-256 stamp ages
-    # from wrapping back under the thresholds while the cluster is quiet
+    # from wrapping back under the thresholds while the cluster is quiet.
+    # Cache-safe: the clamp only re-pins stamps whose derived age exceeds
+    # AGE_PIN (> transmit_limit by config validation), i.e. cells that
+    # are non-sendable before AND after — the sendable invariant holds.
     stamp = clamp_stamps(known, stamp, state.round + 1, k)
     return state._replace(known=known, stamp=stamp, last_learn=last_learn,
+                          sendable=sendable, sendable_round=sendable_round,
                           round=state.round + 1)
 
 
@@ -653,7 +754,11 @@ def push_round_step(state: GossipState, cfg: GossipConfig,
     stamp = clamp_stamps(known, stamp, state.round + 1, k)
     last_learn = bump_last_learn(jnp.any(new_mask), state.round + 1,
                                  state.last_learn)
+    # this conformance-mode kernel learns without maintaining the
+    # sendable cache — invalidate so a later cached selection can't read
+    # a plane that misses these learns
     return state._replace(known=known, stamp=stamp, last_learn=last_learn,
+                          sendable_round=jnp.asarray(-1, jnp.int32),
                           round=state.round + 1)
 
 

@@ -96,9 +96,17 @@ def round_step_ring(state: GossipState, cfg: GossipConfig, key: jax.Array,
         raise ValueError(f"n={n} not divisible by mesh size {n_devices}")
     n_local = n // n_devices
 
-    # phases 1+2 exactly as round_step (elementwise; GSPMD shards freely)
-    sending = sending_mask(state, cfg)
-    packets = pack_bits(sending)                              # u32[N, W]
+    # phases 1+2 exactly as round_step (elementwise; GSPMD shards freely),
+    # including the cached selection when the sendable plane is valid
+    if cfg.use_sendable_cache:
+        packets = jax.lax.cond(
+            state.sendable_round == state.round,
+            lambda s: jnp.where(s.alive[:, None], s.sendable,
+                                jnp.uint32(0)),
+            lambda s: pack_bits(sending_mask(s, cfg)),
+            state)
+    else:
+        packets = pack_bits(sending_mask(state, cfg))         # u32[N, W]
 
     srcs = jax.random.randint(key, (n, cfg.fanout), 0, n)     # i32[N, F]
     if group is not None:
@@ -126,16 +134,33 @@ def round_step_ring(state: GossipState, cfg: GossipConfig, key: jax.Array,
     learned_any = jnp.any(new_words != 0)
 
     # stamp learn pass gated on learned_any exactly as round_step phase 5
-    # (bit-exact identity when skipped) — keeps the ring both bit-identical
-    # to the all-gather round AND equally gated in the byte model
-    def stamp_learns(s):
+    # (bit-exact identity when skipped), with the sendable-cache
+    # recompute riding the same pass — keeps the ring bit-identical to
+    # the all-gather round INCLUDING the cache, so the ring schedule
+    # gets the same cached-selection saving (without this the ring leg
+    # of any A/B pays the full stamp-plane selection read every round)
+    def stamp_learns(_):
         new_mask = unpack_bits(new_words, k)
-        return jnp.where(new_mask, round_u8(state.round + 1), s)
+        stamp2 = jnp.where(new_mask, round_u8(state.round + 1),
+                           state.stamp)
+        if cfg.use_sendable_cache:
+            kb = unpack_bits(known, k)
+            age_next = round_u8(state.round + 1) - stamp2
+            send2 = pack_bits(
+                kb & (age_next < jnp.uint8(cfg.transmit_limit)))
+            sr2 = jnp.asarray(state.round + 1, jnp.int32)
+        else:
+            send2 = state.sendable
+            sr2 = jnp.asarray(-1, jnp.int32)
+        return stamp2, send2, sr2
 
-    stamp = jax.lax.cond(learned_any, stamp_learns, lambda s: s,
-                         state.stamp)
+    stamp, sendable, sendable_round = jax.lax.cond(
+        learned_any, stamp_learns,
+        lambda _: (state.stamp, state.sendable, state.sendable_round),
+        None)
     stamp = clamp_stamps(known, stamp, state.round + 1, k)
     last_learn = bump_last_learn(learned_any, state.round + 1,
                                  state.last_learn)
     return state._replace(known=known, stamp=stamp, last_learn=last_learn,
+                          sendable=sendable, sendable_round=sendable_round,
                           round=state.round + 1)

@@ -19,10 +19,12 @@ from serf_tpu.models.swim import (
 
 
 #: the tracked byte budget for one sustained flagship round @1M (bytes).
-#: Computed 352.6 MB as of round 5 — a kernel change that pushes past the
-#: budget must either be paid for deliberately (raise this with a note)
-#: or fixed.  Floor guards against the model silently dropping terms.
-SUSTAINED_BUDGET_1M = 360e6
+#: Computed 352.6 MB mid round 5; 313.6 MB after the sendable-bitset
+#: cache landed (selection's stamp read → one packed word-plane read).
+#: A kernel change that pushes past the budget must either be paid for
+#: deliberately (raise this with a note) or fixed.  Floor guards against
+#: the model silently dropping terms.
+SUSTAINED_BUDGET_1M = 320e6
 SUSTAINED_FLOOR_1M = 250e6
 
 
@@ -31,10 +33,12 @@ def test_sustained_budget_at_1m():
     assert SUSTAINED_FLOOR_1M < r.total_bytes <= SUSTAINED_BUDGET_1M, (
         f"sustained round moved {r.total_bytes / 1e6:.1f} MB, budget "
         f"{SUSTAINED_BUDGET_1M / 1e6:.0f} MB\n{r.table()}")
-    # the stamp plane is the known dominator (>50%); if this flips, the
-    # optimization target has moved — update STATUS.md
+    # the stamp plane is still the dominator, but the sendable cache cut
+    # its share from 56% to ~42% (selection no longer reads it); if the
+    # dominator flips, the optimization target has moved — update
+    # STATUS.md
     assert r.dominator() == "stamp"
-    assert r.by_plane()["stamp"] / r.total_bytes > 0.5
+    assert 0.35 < r.by_plane()["stamp"] / r.total_bytes < 0.5
 
 
 def test_regime_ordering_matches_gate_design():

@@ -1,0 +1,177 @@
+"""The sendable-bitset cache must be a pure accelerator: every protocol
+output (known/stamp/round/last_learn/facts) bit-identical with the cache
+on or off, under the compositions the flagship actually runs — sustained
+injection, failure detection, push/pull anti-entropy, external
+alive-flips, out-of-band injections, and the stale-cache fallback after
+a non-maintaining kernel ran (GossipState.sendable_round invariant,
+serf_tpu/models/dissemination.py)."""
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    K_USER_EVENT,
+    inject_facts_batch,
+    push_round_step,
+    sending_mask,
+    pack_bits,
+)
+from serf_tpu.models.failure import FailureConfig, run_swim
+from serf_tpu.models.swim import (
+    ClusterConfig,
+    make_cluster,
+    run_cluster_sustained,
+)
+
+
+def _gossip_equal(a, b):
+    for name in ("known", "stamp", "round", "last_learn", "next_slot",
+                 "alive", "incarnation"):
+        assert bool(jnp.all(getattr(a, name) == getattr(b, name))), name
+    for name in ("subject", "kind", "incarnation", "ltime", "valid"):
+        assert bool(jnp.all(getattr(a.facts, name)
+                            == getattr(b.facts, name))), f"facts.{name}"
+
+
+def _cluster_cfg(cache: bool) -> ClusterConfig:
+    return ClusterConfig(
+        gossip=GossipConfig(n=2048, k_facts=32, peer_sampling="rotation",
+                            use_sendable_cache=cache),
+        failure=FailureConfig(suspicion_rounds=8, max_new_facts=8,
+                              probe_schedule="round_robin"),
+        push_pull_every=8, probe_every=5)
+
+
+def test_sustained_flagship_bit_exact_cache_on_off():
+    """Three sustained scan segments with external churn + injections
+    between them: the full gossip state must match bit-for-bit."""
+    cfgs = {c: _cluster_cfg(c) for c in (True, False)}
+    runs = {c: jax.jit(functools.partial(run_cluster_sustained, cfg=cfg,
+                                         events_per_round=2),
+                       static_argnames=("num_rounds",))
+            for c, cfg in cfgs.items()}
+    states = {c: make_cluster(cfg, jax.random.key(0))
+              for c, cfg in cfgs.items()}
+
+    for seg in range(3):
+        for c in (True, False):
+            states[c] = runs[c](states[c], key=jax.random.key(10 + seg),
+                                num_rounds=30)
+        _gossip_equal(states[True].gossip, states[False].gossip)
+        # external churn: kill a few nodes, revive one — alive is not
+        # folded into the cache, so this must not desync anything
+        for c in (True, False):
+            g = states[c].gossip
+            g = g._replace(alive=g.alive.at[
+                jnp.asarray([7 + seg, 300 + seg])].set(False))
+            g = g._replace(alive=g.alive.at[5].set(True))
+            # out-of-band injection (the host plane can inject between
+            # scan segments): preserves cache validity by construction
+            g = inject_facts_batch(
+                g, cfgs[c].gossip,
+                subjects=jnp.asarray([1000 + seg], jnp.int32),
+                kind=K_USER_EVENT,
+                incarnations=jnp.zeros((1,), jnp.uint32),
+                ltimes=jnp.asarray([900 + seg], jnp.uint32),
+                origins=jnp.asarray([11], jnp.int32),
+                active=jnp.ones((1,), bool))
+            states[c] = states[c]._replace(gossip=g)
+
+    _gossip_equal(states[True].gossip, states[False].gossip)
+
+
+def test_swim_only_bit_exact_cache_on_off():
+    """Probe/refute/declare injections ride the cache-maintaining inject
+    path; detection outcomes must be identical either way."""
+    outs = {}
+    for cache in (True, False):
+        gcfg = GossipConfig(n=1024, k_facts=32, peer_sampling="rotation",
+                            use_sendable_cache=cache)
+        fcfg = FailureConfig(suspicion_rounds=8,
+                             probe_schedule="round_robin")
+        from serf_tpu.models.dissemination import inject_fact, make_state
+
+        g = make_state(gcfg)
+        g = inject_fact(g, gcfg, subject=3, kind=K_USER_EVENT,
+                        incarnation=0, ltime=1, origin=0)
+        g = g._replace(alive=g.alive.at[jnp.asarray([17, 400])].set(False))
+        run = jax.jit(functools.partial(run_swim, cfg=gcfg, fcfg=fcfg),
+                      static_argnames=("num_rounds",))
+        outs[cache] = run(g, key=jax.random.key(1), num_rounds=60)
+    _gossip_equal(outs[True], outs[False])
+
+
+def test_checkpoint_backcompat_without_cache_fields(tmp_path):
+    """A checkpoint written before the cache fields existed must restore
+    with the always-safe defaults (stale plane, never read) instead of
+    failing closed — long-running bench continuity."""
+    import numpy as np
+
+    from serf_tpu.models import checkpoint
+    from serf_tpu.models.dissemination import inject_fact, make_state
+
+    cfg = GossipConfig(n=128, k_facts=32)
+    g = inject_fact(make_state(cfg), cfg, 3, K_USER_EVENT, 0, 1, 0)
+    flat = {jax.tree_util.keystr(p): np.asarray(leaf)
+            for p, leaf in jax.tree_util.tree_flatten_with_path(g)[0]
+            if not jax.tree_util.keystr(p).endswith(
+                (".sendable", ".sendable_round"))}
+    path = str(tmp_path / "pre_r5.npz")
+    with open(path, "wb") as f:
+        np.savez(f, **flat)
+    back = checkpoint.restore(path, make_state(cfg))
+    assert int(back.sendable_round) == -1
+    assert bool(jnp.all(back.sendable == 0))
+    assert bool(jnp.all(back.known == g.known))
+    # any OTHER missing array still fails closed
+    flat2 = {k: v for k, v in flat.items() if not k.endswith(".known")}
+    path2 = str(tmp_path / "broken.npz")
+    with open(path2, "wb") as f:
+        np.savez(f, **flat2)
+    try:
+        checkpoint.restore(path2, make_state(cfg))
+        raise AssertionError("restore accepted a checkpoint missing known")
+    except ValueError:
+        pass
+
+
+def test_stale_cache_falls_back_after_nonmaintaining_kernel():
+    """push_round_step learns without maintaining the cache and must
+    invalidate it; the next cached-config round falls back to the stamp
+    recompute and stays bit-exact vs the cache-off config."""
+    outs = {}
+    for cache in (True, False):
+        cfg = GossipConfig(n=256, k_facts=32, use_sendable_cache=cache)
+        from serf_tpu.models.dissemination import (
+            inject_fact,
+            make_state,
+            round_step,
+        )
+        g = make_state(cfg)
+        g = inject_fact(g, cfg, subject=3, kind=K_USER_EVENT,
+                        incarnation=0, ltime=1, origin=0)
+        step = jax.jit(functools.partial(round_step, cfg=cfg))
+        push = jax.jit(functools.partial(push_round_step, cfg=cfg))
+        key = jax.random.key(2)
+        for i in range(4):
+            key, k2 = jax.random.split(key)
+            g = step(g, key=k2)
+        assert (int(g.sendable_round) == int(g.round)) == cache
+        key, k2 = jax.random.split(key)
+        g = push(g, key=k2)          # learns + invalidates
+        assert int(g.sendable_round) == -1
+        for i in range(4):
+            key, k2 = jax.random.split(key)
+            g = step(g, key=k2)      # first step falls back, then re-arms
+        outs[cache] = g
+    _gossip_equal(outs[True], outs[False])
+    # and wherever the cache re-armed, it matches the semantic predicate
+    g = outs[True]
+    cfg = GossipConfig(n=256, k_facts=32)
+    if int(g.sendable_round) == int(g.round):
+        have = jnp.where(g.alive[:, None], g.sendable, jnp.uint32(0))
+        assert bool(jnp.all(pack_bits(sending_mask(g, cfg)) == have))

@@ -152,7 +152,9 @@ async def test_drop_storm_converges(stream, tmp_path):
                 await asyncio.sleep(0.02)
         live = [i for i in nodes if i not in killed
                 and nodes[i].state == SerfState.ALIVE]
-        await _converged(nodes, live, 25.0, f"{stream} drop storm")
+        # 40 s liveness deadline: 10% loss stretches RTO/backoff badly on
+        # a CI box that is mid-suite; this pins convergence, not latency
+        await _converged(nodes, live, 40.0, f"{stream} drop storm")
     finally:
         for s in nodes.values():
             if s.state != SerfState.SHUTDOWN:
