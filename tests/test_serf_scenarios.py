@@ -72,19 +72,20 @@ def test_user_event_coalescer_dedups_by_ltime_name():
     assert not c.handle(UserEvent(7, "x", b"", False))  # non-coalescable
 
 
-async def test_coalesced_member_events_flow():
-    """End-to-end: with coalesce_period set, join events arrive merged."""
+async def _coalesced_join_ids(prefix: str, sub, opts) -> set:
+    """Shared harness: a 4-node cluster whose seed delivers through
+    ``sub`` with ``opts``; returns the node-id set collected from the
+    coalesced JOIN member events."""
     net = LoopbackNetwork()
-    sub = EventSubscriber()
-    opts = Options.local(coalesce_period=0.1, quiescent_period=0.05)
-    s0 = await Serf.create(net.bind("c0"), opts, "c-0", subscriber=sub)
+    s0 = await Serf.create(net.bind(f"{prefix}0"), opts, f"{prefix}-0",
+                           subscriber=sub)
     others = []
-    for i in range(1, 4):
-        s = await Serf.create(net.bind(f"c{i}"), Options.local(), f"c-{i}")
-        others.append(s)
     try:
-        for s in others:
-            await s.join("c0")
+        for i in range(1, 4):
+            s = await Serf.create(net.bind(f"{prefix}{i}"), Options.local(),
+                                  f"{prefix}-{i}")
+            others.append(s)
+            await s.join(f"{prefix}0")
         joined = set()
 
         async def collect():
@@ -94,11 +95,19 @@ async def test_coalesced_member_events_flow():
                     joined.update(m.node.id for m in ev.members)
 
         await asyncio.wait_for(collect(), DEADLINE)
-        assert joined == {"c-0", "c-1", "c-2", "c-3"}
+        return joined
     finally:
         await s0.shutdown()
         for s in others:
             await s.shutdown()
+
+
+async def test_coalesced_member_events_flow():
+    """End-to-end: with coalesce_period set, join events arrive merged."""
+    joined = await _coalesced_join_ids(
+        "c", EventSubscriber(),
+        Options.local(coalesce_period=0.1, quiescent_period=0.05))
+    assert joined == {"c-0", "c-1", "c-2", "c-3"}
 
 
 # -- reaper (reference base.rs:483-610) -------------------------------------
@@ -416,6 +425,18 @@ async def test_lossless_subscriber_backpressures_never_drops():
     await task
     assert got == list(range(10))
     assert sub.dropped == 0
+
+
+async def test_lossless_subscriber_composes_with_coalescers():
+    """The coalesce pipeline delivers through ``await push``: with a
+    tiny LOSSLESS subscriber the flush blocks instead of dropping, and
+    every coalesced member event still arrives once drained."""
+    sub = EventSubscriber(maxsize=1, lossless=True)
+    joined = await _coalesced_join_ids(
+        "lc", sub,
+        Options.local(coalesce_period=0.05, quiescent_period=0.02))
+    assert joined == {"lc-0", "lc-1", "lc-2", "lc-3"}
+    assert sub.dropped == 0, "lossless subscriber dropped events"
 
 
 async def test_leave_intent_avoids_infinite_rebroadcast():
