@@ -175,11 +175,12 @@ def round_traffic(cfg, regime: str = "sustained",
                       "dissemination.sending_mask"))
         add(Entry("selection", "packets", "W", known, 1.0,
                   "dissemination.round_step phase 1"))
-        # exchange (rotation): ONE doubled copy of packets (XLA CSEs the
-        # identical concatenate across fanout), then per-fanout a
-        # contiguous slice read OR-accumulated into incoming
+        # exchange (rotation): ONE doubled copy of packets (hoisted by
+        # construction in round_step and sliced per fanout via
+        # rolled_rows(doubled=...)), then per-fanout a contiguous slice
+        # read OR-accumulated into incoming
         add(Entry("exchange", "packets", "RW", 3 * known, 1.0,
-                  "dissemination.rolled_rows (concat once)"))
+                  "dissemination.round_step hoisted double"))
         add(Entry("exchange", "packets", "R",
                   known * g.fanout, 1.0,
                   "dissemination.round_step phase 3 slices"))

@@ -290,17 +290,24 @@ def clamp_stamps(known: jnp.ndarray, stamp: jnp.ndarray, round_,
 
 # -- rotation addressing -----------------------------------------------------
 
-def rolled_rows(x: jnp.ndarray, shift) -> jnp.ndarray:
+def rolled_rows(x: jnp.ndarray, shift, doubled=None) -> jnp.ndarray:
     """``y[i] = x[(i + shift) % n]`` along axis 0, without a gather.
 
     A random-index gather over 1M small rows lowers to a serial loop on
     TPU (measured ~10 ms each); this is one concatenate + one contiguous
     dynamic slice (~2 sequential passes).  ``shift`` may be a traced
-    scalar in [0, n)."""
+    scalar in [0, n).
+
+    ``doubled``: optionally the precomputed ``concatenate([x, x])`` —
+    pass it when slicing the SAME array at several shifts (the fanout
+    exchange, the indirect-probe rolls) so the doubling materializes
+    once by construction rather than by trusting XLA CSE to dedupe
+    identical concatenates."""
     n = x.shape[0]
+    if doubled is None:
+        doubled = jnp.concatenate([x, x], axis=0)
     return jax.lax.dynamic_slice_in_dim(
-        jnp.concatenate([x, x], axis=0),
-        jnp.asarray(shift, jnp.int32), n, axis=0)
+        doubled, jnp.asarray(shift, jnp.int32), n, axis=0)
 
 
 def sample_offsets(key: jax.Array, m: int, n: int) -> jnp.ndarray:
@@ -606,13 +613,20 @@ def round_step(state: GossipState, cfg: GossipConfig,
         #    ORs their packet words
         if cfg.peer_sampling == "rotation":
             # fanout random rotations shared by all nodes: peer reads are
-            # contiguous slices, no gather (GossipConfig.peer_sampling)
+            # contiguous slices, no gather (GossipConfig.peer_sampling).
+            # The doubled arrays are hoisted across the fanout slices —
+            # ONE materialization by construction (the byte model's
+            # "concat once" term, accounting.py)
             offs = sample_offsets(key, cfg.fanout, n)
+            doubled = jnp.concatenate([packets, packets], axis=0)
+            dgroup = (jnp.concatenate([group, group], axis=0)
+                      if group is not None else None)
             incoming = jnp.zeros_like(packets)
             for f in range(cfg.fanout):
-                contrib = rolled_rows(packets, offs[f])       # u32[N, W]
+                contrib = rolled_rows(packets, offs[f], doubled=doubled)
                 if group is not None:
-                    allowed = rolled_rows(group, offs[f]) == group
+                    allowed = rolled_rows(group, offs[f],
+                                          doubled=dgroup) == group
                     contrib = jnp.where(allowed[:, None], contrib,
                                         jnp.uint32(0))
                 incoming = incoming | contrib

@@ -187,9 +187,12 @@ def probe_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
         # (i + offset) % n, so every node is probed exactly once — AND the
         # rotation is invertible, so target liveness is a contiguous roll
         # and "who probed me" is analytic: no 1M-row gather or scatter
-        # (each of those lowers to a serial loop on TPU, ~10 ms apiece)
+        # (each of those lowers to a serial loop on TPU, ~10 ms apiece).
+        # alive is rolled at 1 + indirect_probes shifts — hoist its
+        # doubled copy once (see rolled_rows)
         offset = rotation_offset(state.round, n).astype(jnp.int32)
-        target_up = rolled_rows(state.alive, offset)
+        dalive = jnp.concatenate([state.alive, state.alive], axis=0)
+        target_up = rolled_rows(state.alive, offset, doubled=dalive)
         ack = target_up & ~dropped
         if fcfg.indirect_probes > 0:
             # helpers are per-round random rotations too (the reference
@@ -199,7 +202,8 @@ def probe_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
             h_drop = jax.random.bernoulli(
                 k_hdrop, fcfg.probe_drop_rate, (n, fcfg.indirect_probes))
             for h in range(fcfg.indirect_probes):
-                helper_ok = rolled_rows(state.alive, h_offs[h])
+                helper_ok = rolled_rows(state.alive, h_offs[h],
+                                        doubled=dalive)
                 ack = ack | (target_up & helper_ok & ~h_drop[:, h])
         # offset ∈ [1, n-1] means never self-probe — except n == 1, where
         # every rotation is the identity and the lone node must not be
