@@ -155,6 +155,12 @@ def round_traffic(cfg, regime: str = "sustained",
         add(Entry("inject", "known", "RW",
                   (4 if g.use_sendable_cache else 2) * known, 1.0,
                   "dissemination.inject_facts_batch"))
+        # tombstone fold at retirement: m known-plane COLUMN gathers (u32
+        # words, 4 bytes/cell) + alive read + incarnation lookups +
+        # the bool[N] plane R+W
+        add(Entry("inject", "tombstone", "RW",
+                  sustained_rate * 4 * n + 3 * alive, 1.0,
+                  "dissemination.inject_facts_batch tombstone fold"))
 
     if gossip_on:
         if cache_hot:

@@ -48,11 +48,14 @@ def restore(path: str, template: Any) -> Any:
                 key = jax.tree_util.keystr(path_k)
                 if key not in data:
                     # back-compat for checkpoints written before the
-                    # round-5 sendable cache: the cache fields have an
-                    # always-safe default by their own invariant —
-                    # sendable_round = -1 means "stale, never read", so
-                    # the first cached selection recomputes from stamps
-                    if key.endswith(".sendable"):
+                    # round-5 fields.  The CACHE fields are lossless to
+                    # default (sendable_round = -1 means "stale, never
+                    # read" — the first cached selection recomputes).
+                    # The TOMBSTONE default is lossy-but-recoverable:
+                    # already-retired deaths are forgotten on resume and
+                    # get re-suspected/re-declared by the detector —
+                    # acceptable degradation, NOT lossless.
+                    if key.endswith((".sendable", ".tombstone")):
                         leaves.append(jnp.zeros_like(leaf))
                         continue
                     if key.endswith(".sendable_round"):

@@ -109,6 +109,26 @@ class GossipState(NamedTuple):
                                 # (serf's empty broadcast queue sends
                                 # nothing).  Every path that writes
                                 # stamps/known must update this scalar.
+    tombstone: jnp.ndarray      # bool[N]    durable per-subject death
+                                # record: set when a fully-disseminated
+                                # K_DEAD fact RETIRES from the ring
+                                # (slot overwritten), cleared by any
+                                # K_ALIVE injection for the subject
+                                # (refutation / rejoin).  The device
+                                # analog of the reference's member table
+                                # holding FAILED after the broadcast
+                                # queue drains (base.rs:1375-1440): ring
+                                # facts are transient dissemination
+                                # state, but the cluster must not FORGET
+                                # a death when the slot recycles — under
+                                # sustained load the ring cycles every
+                                # k_facts/rate rounds.  A death that
+                                # retires only PARTIALLY disseminated is
+                                # dropped (documented compression: a
+                                # per-subject bit cannot represent
+                                # per-knower splits once the per-knower
+                                # evidence is gone; the detector will
+                                # re-suspect such a subject).
     sendable: jnp.ndarray       # u32[N, W]  packed CACHE of the selection
                                 # predicate `known & (mod_age < limit)`
                                 # (alive NOT folded in — liveness changes
@@ -217,6 +237,7 @@ def make_state(cfg: GossipConfig) -> GossipState:
         round=jnp.asarray(0, jnp.int32),
         next_slot=jnp.asarray(0, jnp.int32),
         last_learn=jnp.asarray(0, jnp.int32),
+        tombstone=jnp.zeros((n,), bool),
         sendable=jnp.zeros((n, w), jnp.uint32),
         sendable_round=jnp.asarray(-1, jnp.int32),
     )
@@ -346,6 +367,31 @@ def inject_fact(state: GossipState, cfg: GossipConfig, subject, kind,
     under jit (origin/subject/... may be traced scalars).
     """
     slot = state.next_slot % cfg.k_facts
+    word, bit = slot // 32, slot % 32
+
+    # durable death record (see GossipState.tombstone): a K_DEAD fact
+    # being retired by this overwrite folds into the tombstone IF its
+    # dissemination completed (every alive node knows it); the injected
+    # fact clears the record when it is a superseding K_ALIVE
+    old_kind = state.facts.kind[slot]
+    old_subject = jnp.clip(state.facts.subject[slot], 0)
+    known_col = ((state.known[:, word]
+                  >> jnp.asarray(bit, jnp.uint32)) & 1).astype(bool)
+    covered = jnp.all(known_col | ~state.alive) & jnp.any(state.alive)
+    # supersession check (as accusations_pending): a REFUTED death — the
+    # subject bumped its incarnation above the declaration's — must not
+    # fold, or a live node would be durably recorded dead with no
+    # clearing path
+    not_superseded = (state.facts.incarnation[slot]
+                      >= state.incarnation[old_subject])
+    dead_retired = (state.facts.valid[slot] & (old_kind == K_DEAD)
+                    & covered & not_superseded)
+    tombstone = state.tombstone.at[old_subject].max(dead_retired)
+    is_alive_fact = jnp.asarray(kind, jnp.uint8) == K_ALIVE
+    subj_idx = jnp.clip(jnp.asarray(subject, jnp.int32), 0)
+    tombstone = tombstone.at[subj_idx].set(
+        tombstone[subj_idx] & ~is_alive_fact)
+
     facts = FactTable(
         subject=state.facts.subject.at[slot].set(jnp.asarray(subject, jnp.int32)),
         kind=state.facts.kind.at[slot].set(jnp.asarray(kind, jnp.uint8)),
@@ -353,7 +399,6 @@ def inject_fact(state: GossipState, cfg: GossipConfig, subject, kind,
         ltime=state.facts.ltime.at[slot].set(jnp.asarray(ltime, jnp.uint32)),
         valid=state.facts.valid.at[slot].set(True),
     )
-    word, bit = slot // 32, slot % 32
     bitmask = (jnp.uint32(1) << bit.astype(jnp.uint32)
                if hasattr(bit, "astype") else jnp.uint32(1 << int(bit)))
     # clear the slot's bit everywhere (fact replaced — the known bit IS the
@@ -379,6 +424,7 @@ def inject_fact(state: GossipState, cfg: GossipConfig, subject, kind,
         sendable_round = jnp.asarray(-1, jnp.int32)
     return state._replace(facts=facts, known=known,
                           stamp=stamp, next_slot=state.next_slot + 1,
+                          tombstone=tombstone,
                           sendable=sendable, sendable_round=sendable_round,
                           last_learn=bump_last_learn(True, state.round,
                                                      state.last_learn))
@@ -413,6 +459,29 @@ def inject_facts_batch(state: GossipState, cfg: GossipConfig, subjects,
     # OOB index (k / n) + mode='drop' skips the write entirely
     wslots = jnp.where(active, slots, k)
     worigins = jnp.where(active, origins, n)
+
+    # durable death record (see GossipState.tombstone): retiring,
+    # fully-disseminated K_DEAD facts fold in; K_ALIVE injections clear
+    # their subjects.  Per retired slot, "covered" = every alive node
+    # holds the known bit (m columns of the packed plane).
+    r_slots = jnp.clip(slots, 0, k - 1)
+    r_words, r_bits = r_slots // 32, (r_slots % 32).astype(jnp.uint32)
+    cols = ((state.known[:, r_words] >> r_bits[None, :]) & 1).astype(bool)
+    covered = (jnp.all(cols | ~state.alive[:, None], axis=0)
+               & jnp.any(state.alive))                        # bool[M]
+    r_subj = jnp.clip(state.facts.subject[r_slots], 0)
+    # supersession check (see inject_fact): refuted deaths must not fold
+    not_superseded = (state.facts.incarnation[r_slots]
+                      >= state.incarnation[r_subj])
+    dead_retired = (state.facts.valid[r_slots]
+                    & (state.facts.kind[r_slots] == K_DEAD)
+                    & covered & not_superseded & active)
+    old_subjects = jnp.where(dead_retired, r_subj, n)
+    tombstone = state.tombstone.at[old_subjects].max(True, mode="drop")
+    if kind == K_ALIVE:
+        tombstone = tombstone.at[
+            jnp.where(active, jnp.clip(subjects, 0), n)].set(
+            False, mode="drop")
 
     facts = FactTable(
         subject=state.facts.subject.at[wslots].set(subjects, mode="drop"),
@@ -454,6 +523,7 @@ def inject_facts_batch(state: GossipState, cfg: GossipConfig, subjects,
         sendable_round = jnp.asarray(-1, jnp.int32)
 
     return state._replace(facts=facts, known=known, stamp=stamp,
+                          tombstone=tombstone,
                           sendable=sendable, sendable_round=sendable_round,
                           next_slot=state.next_slot
                           + jnp.sum(active).astype(jnp.int32),

@@ -238,7 +238,11 @@ def probe_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
             det_writes)
         detector_of = jnp.maximum(detector_plus1 - 1, 0)
 
-    already = _subject_covered(state, cfg, (K_SUSPECT, K_DEAD))
+    # tombstoned subjects are durably recorded dead — re-suspecting them
+    # every ring cycle would churn injections forever under sustained
+    # load (the reference never re-suspects a FAILED member either)
+    already = (_subject_covered(state, cfg, (K_SUSPECT, K_DEAD))
+               | state.tombstone)
     candidates = subject_detected & ~already
     return _bounded_inject(state, cfg, candidates, K_SUSPECT,
                            state.incarnation, detector_of,
@@ -311,7 +315,7 @@ def _declare_round_body(state: GossipState, cfg: GossipConfig,
     # subjects with at least one expired suspicion at some knower
     subj = jnp.clip(state.facts.subject, 0)
     subject_expired = jnp.zeros((n,), bool).at[subj].max(jnp.any(expired, axis=0))
-    already_dead = _subject_covered(state, cfg, (K_DEAD,))
+    already_dead = _subject_covered(state, cfg, (K_DEAD,)) | state.tombstone
     candidates = subject_expired & ~already_dead
     # declarer PER SUBJECT: the lowest-id knower whose suspicion of that
     # subject expired (argmax of bool = first True).  A single global
@@ -372,7 +376,9 @@ def believed_dead(state: GossipState, cfg: GossipConfig,
     all_believe = per_fact_believers >= alive_n
     believed = jnp.zeros((n,), bool).at[subj].max(
         all_believe & state.facts.valid)
-    return believed
+    # durable record: a fully-disseminated death whose ring slot has
+    # recycled lives on in the tombstone plane (GossipState.tombstone)
+    return believed | state.tombstone
 
 
 def detection_complete(state: GossipState, cfg: GossipConfig,

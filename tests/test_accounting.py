@@ -20,11 +20,14 @@ from serf_tpu.models.swim import (
 
 #: the tracked byte budget for one sustained flagship round @1M (bytes).
 #: Computed 352.6 MB mid round 5; 313.6 MB after the sendable-bitset
-#: cache landed (selection's stamp read → one packed word-plane read).
-#: A kernel change that pushes past the budget must either be paid for
-#: deliberately (raise this with a note) or fixed.  Floor guards against
-#: the model silently dropping terms.
-SUSTAINED_BUDGET_1M = 320e6
+#: cache landed (selection's stamp read → one packed word-plane read);
+#: 324.6 MB after the tombstone fold (durable death records cost ~11 MB
+#: of retirement-coverage reads — paid deliberately: without them the
+#: cluster forgets deaths when the ring recycles AND wastes ring slots
+#: re-declaring them forever).  A kernel change that pushes past the
+#: budget must either be paid for deliberately (raise this with a note)
+#: or fixed.  Floor guards against the model silently dropping terms.
+SUSTAINED_BUDGET_1M = 330e6
 SUSTAINED_FLOOR_1M = 250e6
 
 

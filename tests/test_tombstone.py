@@ -1,0 +1,172 @@
+"""Durable death records (GossipState.tombstone): the cluster must not
+FORGET a detected death when the fact ring recycles under sustained
+load — the device analog of the reference's member table holding FAILED
+after the broadcast queue drains (base.rs:1375-1440).  Found by the
+round-5 200k sustained validation: detection_complete flipped back to
+False once the rotating user events overwrote the death declarations."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from serf_tpu.models.churn import ChurnConfig, churn_round
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    K_ALIVE,
+    K_DEAD,
+    K_USER_EVENT,
+    inject_fact,
+    inject_facts_batch,
+    make_state,
+)
+from serf_tpu.models.failure import believed_dead, detection_complete
+from serf_tpu.models.swim import (
+    flagship_config,
+    make_cluster,
+    run_cluster_sustained,
+)
+
+
+def test_detection_survives_ring_recycling_under_sustained_load():
+    """Seeded deaths stay detected long after their declarations' ring
+    slots were overwritten by the sustained event stream."""
+    cfg = flagship_config(2048, k_facts=32)
+    st = make_cluster(cfg, jax.random.key(0))
+    g = st.gossip
+    dead = [101, 700, 1500]
+    g = g._replace(alive=g.alive.at[jnp.asarray(dead)].set(False))
+    st = st._replace(gossip=g)
+    run = jax.jit(functools.partial(run_cluster_sustained, cfg=cfg,
+                                    events_per_round=2),
+                  static_argnames=("num_rounds",))
+    # 200 rounds at 2 events/round cycles the 32-slot ring ~12 times:
+    # every detection-era fact has long been retired
+    st = run(st, key=jax.random.key(1), num_rounds=200)
+    g = st.gossip
+    assert bool(jnp.all(g.tombstone[jnp.asarray(dead)])), \
+        "retired death declarations did not fold into the tombstone"
+    assert bool(detection_complete(g, cfg.gossip, cfg.failure)), \
+        "cluster forgot detected deaths after ring recycling"
+    # and the detector is NOT re-declaring them every cycle: no live
+    # K_DEAD facts for tombstoned subjects should keep appearing (the
+    # ring is all user events by now)
+    live_dead_facts = int(jnp.sum((g.facts.kind == K_DEAD) & g.facts.valid))
+    assert live_dead_facts == 0, \
+        f"{live_dead_facts} dead facts still being re-declared"
+
+
+def test_rejoin_clears_tombstone():
+    """A rejoiner (K_ALIVE injection with bumped incarnation) clears its
+    durable death record — the reference's refutation/rejoin path."""
+    cfg = flagship_config(1024, k_facts=32)
+    st = make_cluster(cfg, jax.random.key(0))
+    g = st.gossip._replace(alive=st.gossip.alive.at[77].set(False))
+    st = st._replace(gossip=g)
+    run = jax.jit(functools.partial(run_cluster_sustained, cfg=cfg,
+                                    events_per_round=2),
+                  static_argnames=("num_rounds",))
+    st = run(st, key=jax.random.key(1), num_rounds=120)
+    g = st.gossip
+    assert bool(g.tombstone[77])
+    # revive through the churn path's exact mechanics (alive + bumped
+    # incarnation + K_ALIVE fact)
+    g = g._replace(alive=g.alive.at[77].set(True),
+                   incarnation=g.incarnation.at[77].add(1))
+    g = inject_fact(g, cfg.gossip, subject=77, kind=K_ALIVE,
+                    incarnation=int(g.incarnation[77]), ltime=999,
+                    origin=77)
+    assert not bool(g.tombstone[77]), "K_ALIVE did not clear the tombstone"
+    assert not bool(believed_dead(g, cfg.gossip, cfg.failure)[77])
+
+
+def test_partial_dissemination_retirement_drops_record():
+    """A K_DEAD fact retired before full dissemination does NOT set the
+    tombstone (the documented compression: per-knower splits cannot be
+    represented once the evidence is gone) — the detector re-suspects."""
+    cfg = GossipConfig(n=256, k_facts=32)
+    g = make_state(cfg)
+    g = g._replace(alive=g.alive.at[9].set(False))
+    # a declaration known ONLY by its declarer, then overwrite the whole
+    # ring so it retires while partially disseminated
+    g = inject_fact(g, cfg, subject=9, kind=K_DEAD, incarnation=1,
+                    ltime=1, origin=0)
+    for i in range(cfg.k_facts):
+        g = inject_fact(g, cfg, subject=1000 + i, kind=K_USER_EVENT,
+                        incarnation=0, ltime=10 + i, origin=0)
+    assert not bool(g.tombstone[9]), \
+        "partially-spread death must not fold into the tombstone"
+
+
+def test_refuted_death_never_folds_into_tombstone():
+    """A FALSE declaration the subject refuted (incarnation bumped above
+    it) must not fold at retirement — otherwise a live node would be
+    durably recorded dead with no clearing path (review finding)."""
+    cfg = GossipConfig(n=256, k_facts=32)
+    g = make_state(cfg)
+    # false K_DEAD about ALIVE node 9 at its current incarnation (1)
+    g = inject_fact(g, cfg, subject=9, kind=K_DEAD, incarnation=1,
+                    ltime=1, origin=0)
+    # ... which fully disseminates
+    g = g._replace(known=g.known.at[:, 0].set(
+        g.known[:, 0] | jnp.uint32(1)))
+    # node 9 refutes: incarnation above the declaration + alive fact
+    g = g._replace(incarnation=g.incarnation.at[9].set(2))
+    g = inject_fact(g, cfg, subject=9, kind=K_ALIVE, incarnation=2,
+                    ltime=2, origin=9)
+    # recycle the ring so the stale covered declaration retires
+    for i in range(cfg.k_facts):
+        g = inject_fact(g, cfg, subject=500 + i, kind=K_USER_EVENT,
+                        incarnation=0, ltime=10 + i, origin=0)
+    assert not bool(g.tombstone[9]), \
+        "refuted death folded into the tombstone"
+    assert not bool(believed_dead(g, cfg, cfg_failure())[9])
+
+
+def cfg_failure():
+    from serf_tpu.models.failure import FailureConfig
+    return FailureConfig()
+
+
+def test_batch_retirement_folds_covered_deaths():
+    """inject_facts_batch retirement path: a fully-known K_DEAD fact in
+    the overwritten slots folds in; K_ALIVE batches clear subjects."""
+    cfg = GossipConfig(n=128, k_facts=32)
+    g = make_state(cfg)
+    g = g._replace(alive=g.alive.at[5].set(False))
+    g = inject_fact(g, cfg, subject=5, kind=K_DEAD, incarnation=1,
+                    ltime=1, origin=0)
+    # everyone learns it (set the known bit everywhere by brute force)
+    word, bit = 0, 0
+    g = g._replace(known=g.known.at[:, word].set(
+        g.known[:, word] | jnp.uint32(1 << bit)))
+    # overwrite the whole ring in ONE batch (wraps past slot 0)
+    m = cfg.k_facts
+    g = inject_facts_batch(
+        g, cfg, subjects=jnp.arange(m, dtype=jnp.int32) + 500,
+        kind=K_USER_EVENT, incarnations=jnp.zeros((m,), jnp.uint32),
+        ltimes=jnp.arange(m, dtype=jnp.uint32) + 10,
+        origins=jnp.zeros((m,), jnp.int32), active=jnp.ones((m,), bool))
+    assert bool(g.tombstone[5])
+    # an alive batch for subject 5 clears it
+    g = inject_facts_batch(
+        g, cfg, subjects=jnp.asarray([5], jnp.int32), kind=K_ALIVE,
+        incarnations=jnp.asarray([2], jnp.uint32),
+        ltimes=jnp.asarray([99], jnp.uint32),
+        origins=jnp.asarray([5], jnp.int32),
+        active=jnp.ones((1,), bool))
+    assert not bool(g.tombstone[5])
+
+
+def test_churn_rejoin_clears_tombstone_in_composition():
+    """End-to-end through churn_round: a tombstoned node rejoining via
+    the churn process is no longer believed dead."""
+    cfg = flagship_config(512, k_facts=32)
+    st = make_cluster(cfg, jax.random.key(0))
+    g = st.gossip._replace(alive=st.gossip.alive.at[33].set(False),
+                           tombstone=st.gossip.tombstone.at[33].set(True))
+    ccfg = ChurnConfig(rejoin_rate=1.0, max_events=4)
+    # rejoin_rate=1: node 33 (the only dead one) rejoins this round
+    g2, _ = churn_round(g, cfg.gossip, ccfg, jax.random.key(7))
+    assert bool(g2.alive[33])
+    assert not bool(g2.tombstone[33])
