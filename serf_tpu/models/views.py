@@ -36,7 +36,11 @@ class ClusterStats(NamedTuple):
     members: jnp.ndarray          # i32 alive nodes (ground truth)
     failed: jnp.ndarray           # i32 dead nodes
     suspected: jnp.ndarray        # i32 subjects with a live suspicion fact
-    declared_dead: jnp.ndarray    # i32 subjects with a live dead fact
+    declared_dead: jnp.ndarray    # i32 subjects with a live dead fact OR a
+                                  # durable tombstone record (the member
+                                  # table's FAILED entries persist in the
+                                  # reference Stats after the broadcast
+                                  # queue drains)
     leaving: jnp.ndarray          # i32 subjects with a live leave intent
     queue_depth: jnp.ndarray      # i32 facts still holding transmit budget
     intent_facts: jnp.ndarray     # i32 live join/leave intent facts
@@ -51,10 +55,15 @@ def _count_kind(state: GossipState, kind: int) -> jnp.ndarray:
                    & state.facts.valid).astype(jnp.int32)
 
 
-def _subjects_with_kind(state: GossipState, n: int, kind: int) -> jnp.ndarray:
+def _subjects_with_kind(state: GossipState, n: int, kind: int,
+                        also=None) -> jnp.ndarray:
+    """``also``: optional bool[N] of subjects that count regardless of
+    live ring facts (the tombstone plane for K_DEAD)."""
     mask = (state.facts.kind == kind) & state.facts.valid
     subj = jnp.clip(state.facts.subject, 0)
     hit = jnp.zeros((n,), bool).at[subj].max(mask)
+    if also is not None:
+        hit = hit | also
     return jnp.sum(hit).astype(jnp.int32)
 
 
@@ -65,7 +74,8 @@ def cluster_stats(state: GossipState, cfg: GossipConfig) -> ClusterStats:
         members=jnp.sum(state.alive).astype(jnp.int32),
         failed=jnp.sum(~state.alive).astype(jnp.int32),
         suspected=_subjects_with_kind(state, n, K_SUSPECT),
-        declared_dead=_subjects_with_kind(state, n, K_DEAD),
+        declared_dead=_subjects_with_kind(state, n, K_DEAD,
+                                          also=state.tombstone),
         leaving=_subjects_with_kind(state, n, K_LEAVE),
         queue_depth=jnp.sum(
             jnp.any(budgets_of(state, cfg) > 0, axis=0)

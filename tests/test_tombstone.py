@@ -158,6 +158,20 @@ def test_batch_retirement_folds_covered_deaths():
     assert not bool(g.tombstone[5])
 
 
+def test_cluster_stats_counts_tombstoned_dead():
+    """The operator Stats snapshot must not forget retired deaths either
+    (reference Stats reads the member table, api.rs:586-602)."""
+    from serf_tpu.models.views import cluster_stats
+
+    cfg = GossipConfig(n=128, k_facts=32)
+    g = make_state(cfg)
+    g = g._replace(alive=g.alive.at[5].set(False),
+                   tombstone=g.tombstone.at[5].set(True))
+    st = cluster_stats(g, cfg)
+    assert int(st.declared_dead) == 1
+    assert int(st.failed) == 1
+
+
 def test_churn_rejoin_clears_tombstone_in_composition():
     """End-to-end through churn_round: a tombstoned node rejoining via
     the churn process is no longer believed dead."""
