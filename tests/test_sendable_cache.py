@@ -30,7 +30,7 @@ from serf_tpu.models.swim import (
 
 def _gossip_equal(a, b):
     for name in ("known", "stamp", "round", "last_learn", "next_slot",
-                 "alive", "incarnation"):
+                 "alive", "incarnation", "tombstone"):
         assert bool(jnp.all(getattr(a, name) == getattr(b, name))), name
     for name in ("subject", "kind", "incarnation", "ltime", "valid"):
         assert bool(jnp.all(getattr(a.facts, name)
