@@ -11,14 +11,17 @@
 - ``query`` — scatter/filter/gather query engine + conflict majority vote
 - ``churn`` — Poisson leave/fail/rejoin processes with ground-truth traces
 - ``views`` — operator stats snapshot + string-tags→tag-plane bridge
+- ``accounting`` — HBM/ICI bytes-per-round models (the tracked perf budget)
 """
 
 from serf_tpu.models.swim import (
     ClusterConfig,
     ClusterState,
     cluster_round,
+    flagship_config,
     make_cluster,
     run_cluster,
+    run_cluster_sustained,
 )
 from serf_tpu.models.dissemination import (
     GossipConfig,
