@@ -27,6 +27,12 @@ The regimes map to the protocol states the bench measures:
 - ``"quiescent"``: gossip gate closed (``round - last_learn >=
   transmit_limit``): select/exchange/merge all skipped; only the probe
   sweep, the amortized clamp, and Vivaldi still run.
+- ``"detection"``: the detection-hot window — on top of the sustained
+  regime, the refute/declare skip-gates are OPEN (pending accusations /
+  live suspicions), so their bodies' plane scans and bounded injections
+  run.  This is the regime ``cluster_round_active_rps`` measures and
+  why it runs several times slower than steady: declare's expiry scan
+  re-reads the stamp plane.
 
 Bandwidth arithmetic: a v5e chip streams ~819 GB/s from HBM, so the
 single-chip round-rate ceiling is roughly ``819e9 / total_bytes``
@@ -123,7 +129,7 @@ def round_traffic(cfg, regime: str = "sustained",
     (unpack/compare/select feed their consumer without materializing) —
     the HLO cross-check in tests keeps that assumption honest.
     """
-    if regime not in ("sustained", "active", "quiescent"):
+    if regime not in ("sustained", "active", "quiescent", "detection"):
         raise ValueError(f"unknown regime {regime!r}")
     g: GossipConfig = cfg.gossip
     n, k = g.n, g.k_facts
@@ -140,13 +146,15 @@ def round_traffic(cfg, regime: str = "sustained",
     E: List[Entry] = []
     add = E.append
 
-    gossip_on = regime in ("sustained", "active")
-    learns = regime == "sustained"
+    gossip_on = regime in ("sustained", "active", "detection")
+    learns = regime in ("sustained", "detection")
 
     # the sendable cache is valid exactly when the previous round's merge
     # learned something — i.e. (essentially) every round under sustained
-    # load, and never in the no-learn "active" window or quiescent state
-    cache_hot = g.use_sendable_cache and regime == "sustained"
+    # load or a detection burst, and never in the no-learn "active"
+    # window or quiescent state
+    cache_hot = g.use_sendable_cache and regime in ("sustained",
+                                                    "detection")
 
     if sustained_rate > 0 and regime == "sustained":
         # inject_facts_batch: retirement clears known bits everywhere
@@ -222,7 +230,23 @@ def round_traffic(cfg, regime: str = "sustained",
                   1.0 / cfg.probe_every,
                   "failure.probe_round (round_robin)"))
         # refute/declare: gated by K-sized predicates in all steady
-        # regimes (accusations_pending / live_suspicions) — O(K) only
+        # regimes (accusations_pending / live_suspicions) — O(K) only.
+        # In the DETECTION regime those gates are open and the bodies'
+        # plane scans + bounded injections run:
+        if regime == "detection":
+            # refute: accusation scan over the unpacked known plane
+            add(Entry("refute", "known", "R", known, 1.0,
+                      "failure.refute_round body"))
+            # declare: the expiry scan derives ages — a full stamp-plane
+            # read (the reason the active window runs ~4x slower)
+            add(Entry("declare", "stamp", "R", stamp + known, 1.0,
+                      "failure._declare_round_body mod_age scan"))
+            # up to three bounded injections (suspect/alive/dead):
+            # pick_bounded score passes + batch scatters + retirement
+            # passes incl. the cache/tombstone mirrors
+            add(Entry("detect-inj", "known", "RW",
+                      3 * (4 * known + 4 * n + 3 * alive), 1.0,
+                      "failure._bounded_inject x3"))
 
     if cfg.push_pull_every > 0:
         # partner roll of known (concat + slice) + merge pass; stamp

@@ -53,6 +53,8 @@ def test_regime_ordering_matches_gate_design():
     qui = round_traffic(cfg, regime="quiescent").total_bytes
     assert qui < 0.15 * sus, "quiescent regime must be >85% cheaper"
     assert act < sus, "no-learn active rounds skip the stamp learn pass"
+    det = round_traffic(cfg, regime="detection").total_bytes
+    assert det > sus, "detection bursts must cost more than sustained"
     # single-chip ceiling arithmetic (STATUS.md): the 10k target is out
     # of reach for the sustained regime on ONE chip but inside it for
     # the gated regime — the 8-chip shard is where the target lives
