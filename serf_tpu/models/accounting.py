@@ -156,7 +156,7 @@ def round_traffic(cfg, regime: str = "sustained",
     cache_hot = g.use_sendable_cache and regime in ("sustained",
                                                     "detection")
 
-    if sustained_rate > 0 and regime == "sustained":
+    if sustained_rate > 0 and regime in ("sustained", "detection"):
         # inject_facts_batch: retirement clears known bits everywhere
         # (R+W the word plane); the per-fact scatters are O(m) cells;
         # the sendable cache mirrors the same passes
@@ -239,13 +239,16 @@ def round_traffic(cfg, regime: str = "sustained",
                       "failure.refute_round body"))
             # declare: the expiry scan derives ages — a full stamp-plane
             # read (the reason the active window runs ~4x slower)
-            add(Entry("declare", "stamp", "R", stamp + known, 1.0,
+            add(Entry("declare", "stamp", "R", stamp, 1.0,
                       "failure._declare_round_body mod_age scan"))
+            add(Entry("declare", "known", "R", known, 1.0,
+                      "failure._declare_round_body"))
             # up to three bounded injections (suspect/alive/dead):
             # pick_bounded score passes + batch scatters + retirement
-            # passes incl. the cache/tombstone mirrors
+            # passes (cache mirror only when the flag is on)
+            inj_known = (4 if g.use_sendable_cache else 2) * known
             add(Entry("detect-inj", "known", "RW",
-                      3 * (4 * known + 4 * n + 3 * alive), 1.0,
+                      3 * (inj_known + 4 * n + 3 * alive), 1.0,
                       "failure._bounded_inject x3"))
 
     if cfg.push_pull_every > 0:

@@ -36,7 +36,7 @@ def main() -> None:
     from serf_tpu.models.swim import flagship_config
 
     cfg = flagship_config(args.n)
-    for regime in ("sustained", "active", "quiescent"):
+    for regime in ("sustained", "detection", "active", "quiescent"):
         r = round_traffic(cfg, regime=regime)
         print(r.table())
         print()
