@@ -65,7 +65,7 @@ def _round_scalar(state):
 
 
 def _time_rounds(jitted, state_factory, key, rounds_per_call, timed_calls,
-                 measure_active=True):
+                 measure_active=True, op=None):
     """Time with a per-call HOST TRANSFER of the round counter.
 
     Returns ``(state, steady_rps, active_rps)``.  The warmup call on the
@@ -88,6 +88,21 @@ def _time_rounds(jitted, state_factory, key, rounds_per_call, timed_calls,
     import jax
     import numpy as np
 
+    from serf_tpu.obs.device import dispatch_timer
+
+    def call(state, k):
+        """One jitted call ending in the host-transfer barrier, timed
+        into the obs dispatch registry (first call for the op/signature
+        = compile phase, the rest steady) when ``op`` is named."""
+        if op is None:
+            state = jitted(state, key=k, num_rounds=rounds_per_call)
+            int(np.asarray(_round_scalar(state)))
+            return state
+        with dispatch_timer(op, signature=rounds_per_call):
+            state = jitted(state, key=k, num_rounds=rounds_per_call)
+            int(np.asarray(_round_scalar(state)))
+        return state
+
     state = state_factory()
     # warm up PAST the detection cycle (suspicion_rounds=12 + declaration
     # + dissemination) so the timed calls genuinely measure steady state
@@ -96,21 +111,18 @@ def _time_rounds(jitted, state_factory, key, rounds_per_call, timed_calls,
     warm_calls = max(1, -(-WARMUP_ROUNDS // rounds_per_call))
     for _ in range(warm_calls):
         key, k = jax.random.split(key)
-        state = jitted(state, key=k, num_rounds=rounds_per_call)
-    int(np.asarray(_round_scalar(state)))
+        state = call(state, k)
     t0 = time.perf_counter()
     for _ in range(timed_calls):
         key, k = jax.random.split(key)
-        state = jitted(state, key=k, num_rounds=rounds_per_call)
-        int(np.asarray(_round_scalar(state)))
+        state = call(state, k)
     steady_rps = (rounds_per_call * timed_calls) / (time.perf_counter() - t0)
     active_rps = None
     if measure_active:
         fresh = state_factory()
         key, k = jax.random.split(key)
         t0 = time.perf_counter()
-        fresh = jitted(fresh, key=k, num_rounds=rounds_per_call)
-        int(np.asarray(_round_scalar(fresh)))
+        fresh = call(fresh, k)
         active_rps = rounds_per_call / (time.perf_counter() - t0)
     return state, steady_rps, active_rps
 
@@ -132,11 +144,15 @@ def main() -> None:
     )
     from serf_tpu.models.failure import run_swim
     from serf_tpu.models.swim import (
+        emit_cluster_metrics,
         flagship_config,
         make_cluster,
         run_cluster,
         run_cluster_sustained,
     )
+    from serf_tpu.obs.device import dispatch_summary, reset_dispatch_registry
+
+    reset_dispatch_registry()
 
     # the node count disambiguates this artifact from smaller-N smoke
     # runs (a 100k validation and a 1M record look like a 100x collapse
@@ -194,7 +210,8 @@ def main() -> None:
                       static_argnames=("num_rounds",), donate_argnums=(0,))
     sus_state, sustained_rps, _ = _time_rounds(
         run_sus, lambda: seeded_state(cfg), jax.random.key(3),
-        rounds_per_call, timed_calls, measure_active=False)
+        rounds_per_call, timed_calls, measure_active=False,
+        op="bench.run_cluster_sustained")
     detail["cluster_round_sustained_rps"] = round(sustained_rps, 2)
     detail["sustained_events_per_round"] = EVENTS_PER_ROUND
 
@@ -233,7 +250,7 @@ def main() -> None:
                        static_argnames=("num_rounds",), donate_argnums=(0,))
     state, flagship_rps, flagship_active = _time_rounds(
         run_flag, lambda: seeded_state(cfg), jax.random.key(1),
-        rounds_per_call, timed_calls)
+        rounds_per_call, timed_calls, op="bench.run_cluster")
     detail["cluster_round_rps"] = round(flagship_rps, 2)
     detail["cluster_round_active_rps"] = round(flagship_active, 2)
 
@@ -251,7 +268,7 @@ def main() -> None:
                      static_argnames=("num_rounds",), donate_argnums=(0,))
     _, swim_rps, swim_active = _time_rounds(
         run_sw, lambda: seeded_state(cfg).gossip, jax.random.key(2),
-        rounds_per_call, timed_calls)
+        rounds_per_call, timed_calls, op="bench.run_swim")
     detail["run_swim_rps"] = round(swim_rps, 2)
     detail["run_swim_active_rps"] = round(swim_active, 2)
 
@@ -263,7 +280,8 @@ def main() -> None:
                       static_argnames=("num_rounds",), donate_argnums=(0,))
     _, iid_rps, _ = _time_rounds(
         run_iid, lambda: seeded_state(cfg).gossip, jax.random.key(2),
-        rounds_per_call, timed_calls, measure_active=False)
+        rounds_per_call, timed_calls, measure_active=False,
+        op="bench.run_swim_iid")
     detail["run_swim_iid_rps"] = round(iid_rps, 2)
 
     # --- secondary: Pallas fused-kernel A/B (TPU only; compiled, not
@@ -278,10 +296,22 @@ def main() -> None:
             _, pal_rps, _ = _time_rounds(
                 run_pal, lambda: seeded_state(cfg_p).gossip,
                 jax.random.key(2), rounds_per_call, timed_calls,
-                measure_active=False)
+                measure_active=False, op="bench.run_swim_pallas")
             detail["run_swim_pallas_rps"] = round(pal_rps, 2)
         except Exception as e:  # noqa: BLE001 - A/B is best-effort detail
             detail["run_swim_pallas_error"] = repr(e)[:300]
+
+    # device-plane gauges off the final sustained state (the same
+    # emitters operators get through the metrics sink) plus the per-op
+    # compile-vs-steady dispatch split — the TPU-time attribution the
+    # headline number alone cannot give
+    try:
+        detail["device_metrics"] = {
+            k: round(v, 6) for k, v in
+            emit_cluster_metrics(sus_state, cfg).items()}
+    except Exception as e:  # noqa: BLE001 - attribution is best-effort
+        detail["device_metrics_error"] = repr(e)[:300]
+    detail["dispatch"] = dispatch_summary()
 
     detail["platform"] = platform
     sys.stderr.write(json.dumps(detail) + "\n")

@@ -10,13 +10,14 @@ is pinned by tests/test_native_codec.py.
 from __future__ import annotations
 
 import ctypes
-import logging
 import os
 import subprocess
 import threading
 from typing import Optional
 
-log = logging.getLogger("serf_tpu.codec.native")
+from serf_tpu.utils.logging import get_logger
+
+log = get_logger("codec.native")
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _SRC = os.path.join(_REPO_ROOT, "native", "codec.cpp")

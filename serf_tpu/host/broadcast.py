@@ -14,7 +14,10 @@ from __future__ import annotations
 
 import asyncio
 import math
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
+
+from serf_tpu.obs import flight
+from serf_tpu.utils import metrics
 
 
 class Broadcast:
@@ -47,9 +50,16 @@ class TransmitLimitedQueue:
     size as it changes.
     """
 
-    def __init__(self, retransmit_mult: int, node_count_fn: Callable[[], int]):
+    def __init__(self, retransmit_mult: int, node_count_fn: Callable[[], int],
+                 name: Optional[str] = None,
+                 labels: Optional[Dict[str, str]] = None):
         self.retransmit_mult = retransmit_mult
         self.node_count_fn = node_count_fn
+        #: observability identity: named queues emit ``serf.queue.<name>``
+        #: depth gauges at every mutation (queue/drain/prune) and flight
+        #: events on overflow/retirement; unnamed queues stay silent
+        self.name = name
+        self.labels = labels
         self._items: List[Broadcast] = []
         self._seq = 0
 
@@ -58,6 +68,11 @@ class TransmitLimitedQueue:
 
     def num_queued(self) -> int:
         return len(self._items)
+
+    def _gauge_depth(self) -> None:
+        if self.name is not None:
+            metrics.gauge(f"serf.queue.{self.name}", len(self._items),
+                          self.labels)
 
     def queue_broadcast(self, b: Broadcast) -> None:
         if b.name is not None:
@@ -68,6 +83,7 @@ class TransmitLimitedQueue:
         self._seq += 1
         b._seq = self._seq
         self._items.append(b)
+        self._gauge_depth()
 
     def get_broadcasts(self, overhead: int, limit: int) -> List[bytes]:
         """Drain up to ``limit`` bytes of broadcasts, ``overhead`` bytes
@@ -93,6 +109,12 @@ class TransmitLimitedQueue:
         for b in retired:
             self._items.remove(b)
             b.finished()
+            if self.name is not None:
+                flight.record("broadcast-retired", queue=self.name,
+                              transmits=b.transmits, bytes=len(b.msg),
+                              subject=b.name)
+        if out:
+            self._gauge_depth()
         return out
 
     def prune(self, max_retained: int) -> None:
@@ -101,6 +123,11 @@ class TransmitLimitedQueue:
         if len(self._items) <= max_retained:
             return
         self._items.sort(key=lambda b: (b.transmits, -b._seq))
+        dropped = len(self._items) - max_retained
         for b in self._items[max_retained:]:
             b.finished()
         del self._items[max_retained:]
+        if self.name is not None:
+            flight.record("queue-overflow", queue=self.name,
+                          dropped=dropped, retained=max_retained)
+        self._gauge_depth()

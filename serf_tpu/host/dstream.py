@@ -54,12 +54,13 @@ import struct
 from collections import deque
 from typing import Dict, Optional, Tuple
 
-import logging
 
 from serf_tpu.host.net import _resolve_address
 from serf_tpu.host.transport import Stream, Transport
 
-log = logging.getLogger("serf_tpu.dstream")
+from serf_tpu.utils.logging import get_logger
+
+log = get_logger("dstream")
 
 MSS = 1200              # max segment payload (UDP-safe with header room)
 CWND_INIT = 16          # initial congestion window (segments)

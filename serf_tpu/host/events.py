@@ -10,11 +10,11 @@ from __future__ import annotations
 
 import asyncio
 import enum
-import logging
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from serf_tpu.obs import flight
 from serf_tpu.types.clock import LamportTime
 from serf_tpu.types.member import Member
 from serf_tpu.utils import metrics
@@ -25,7 +25,9 @@ from serf_tpu.types.messages import (
 )
 from serf_tpu.types.member import Node
 
-log = logging.getLogger("serf_tpu.events")
+from serf_tpu.utils.logging import get_logger
+
+log = get_logger("events")
 
 
 class MemberEventType(enum.IntEnum):
@@ -127,9 +129,12 @@ class EventSubscriber:
                 return
             except asyncio.QueueFull:
                 try:
-                    self._q.get_nowait()  # drop oldest
+                    dropped_ev = self._q.get_nowait()  # drop oldest
                     self.dropped += 1
                     metrics.incr("serf.subscriber.dropped", 1)
+                    flight.record("subscriber-drop",
+                                  event=type(dropped_ev).__name__,
+                                  total_dropped=self.dropped)
                     log.warning("event subscriber overflow: dropping oldest event")
                 except asyncio.QueueEmpty:
                     pass

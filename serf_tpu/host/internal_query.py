@@ -8,7 +8,6 @@ the four keyring ops, with size-aware truncation of key-list responses.
 
 from __future__ import annotations
 
-import logging
 
 from serf_tpu.host.events import QueryEvent
 from serf_tpu.host.keyring import KeyringError
@@ -21,7 +20,9 @@ from serf_tpu.types.messages import (
 )
 from serf_tpu import codec
 
-log = logging.getLogger("serf_tpu.internal_query")
+from serf_tpu.utils.logging import get_logger
+
+log = get_logger("internal_query")
 
 # minimum bytes to encode one key in a list response; used for truncation
 # (reference MIN_ENCODED_KEY_LENGTH = 25, internal_query.rs)

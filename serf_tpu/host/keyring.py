@@ -16,7 +16,14 @@ import threading
 from base64 import b64decode, b64encode
 from typing import List, Optional
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # pragma: no cover - environment-dependent
+    # Encryption is an optional capability: images without the
+    # ``cryptography`` wheel must still import the host plane (plaintext
+    # clusters, tests, tooling).  Constructing a SecretKeyring without it
+    # raises KeyringError with the reason.
+    AESGCM = None
 
 ENCRYPTION_VERSION = 1
 KEY_SIZES = (16, 24, 32)
@@ -29,6 +36,10 @@ class KeyringError(Exception):
 
 class SecretKeyring:
     def __init__(self, primary: bytes, keys: Optional[List[bytes]] = None):
+        if AESGCM is None:
+            raise KeyringError(
+                "encryption unavailable: the 'cryptography' package is not "
+                "installed in this environment")
         _check_key(primary)
         self._lock = threading.Lock()
         self._primary = primary

@@ -16,7 +16,6 @@ Object API parity (grep-verified list in SURVEY.md §2.9): ``join``,
 from __future__ import annotations
 
 import asyncio
-import logging
 import math
 import random
 import time
@@ -31,11 +30,15 @@ from serf_tpu.host.keyring import KeyringError, SecretKeyring
 from serf_tpu.host.messages import SwimState
 from serf_tpu.host.transport import Transport
 from serf_tpu.host import wire
+from serf_tpu.obs import flight
+from serf_tpu.obs.trace import span
 from serf_tpu.options import MemberlistOptions
 from serf_tpu.types.member import Node
 from serf_tpu.utils import metrics
 
-log = logging.getLogger("serf_tpu.memberlist")
+from serf_tpu.utils.logging import get_logger
+
+log = get_logger("memberlist")
 
 # Version-range constants live beside the wire format (DEFAULT_VSN) in
 # messages.py — a leaf module options.py can import without a cycle.
@@ -340,28 +343,36 @@ class Memberlist:
         (capability parity with the reference's compression/checksum/
         encryption transport features, SURVEY.md §2.9; algorithm
         registries in ``host/wire.py``)."""
-        buf = wire.encode_wire(buf, self.opts.compression, self.opts.checksum)
-        if self._keyring is not None:
-            buf = self._keyring.encrypt(buf)
-        return buf
+        with span("wire.encode", node=self.local.id, bytes=len(buf)):
+            buf = wire.encode_wire(buf, self.opts.compression,
+                                   self.opts.checksum)
+            if self._keyring is not None:
+                buf = self._keyring.encrypt(buf)
+            return buf
 
     def _decode_wire(self, buf: bytes) -> Optional[bytes]:
         """Inbound pipeline: decrypt -> verify checksum -> decompress.
-        Any failure drops the packet (UDP semantics), with a metric."""
-        if self._keyring is not None:
+        Any failure drops the packet (UDP semantics), with a metric and a
+        flight-recorder entry naming the failed stage."""
+        with span("wire.decode", node=self.local.id, bytes=len(buf)):
+            if self._keyring is not None:
+                try:
+                    buf = self._keyring.decrypt(buf)
+                except KeyringError:
+                    metrics.incr("memberlist.packet.decrypt_failed", 1,
+                                 self.opts.metric_labels)
+                    flight.record("packet-dropped", node=self.local.id,
+                                  stage="decrypt", bytes=len(buf))
+                    return None
             try:
-                buf = self._keyring.decrypt(buf)
-            except KeyringError:
-                metrics.incr("memberlist.packet.decrypt_failed", 1,
+                return wire.decode_wire(buf, self.opts.compression,
+                                        self.opts.checksum)
+            except wire.WireError as e:
+                metrics.incr(f"memberlist.packet.{e.stage}_failed", 1,
                              self.opts.metric_labels)
+                flight.record("packet-dropped", node=self.local.id,
+                              stage=e.stage, bytes=len(buf))
                 return None
-        try:
-            return wire.decode_wire(buf, self.opts.compression,
-                                    self.opts.checksum)
-        except wire.WireError as e:
-            metrics.incr(f"memberlist.packet.{e.stage}_failed", 1,
-                         self.opts.metric_labels)
-            return None
 
     def _wire_overhead(self) -> int:
         """Worst-case bytes _encode_wire adds (marker + checksum + expansion
@@ -543,6 +554,8 @@ class Memberlist:
             ns.state_change = time.monotonic()
             self._suspicions.pop(ns.id, None)
         if was_gone:
+            flight.record("swim-state", node=self.local.id, member=ns.id,
+                          state="ALIVE", incarnation=ns.incarnation)
             self.delegate.notify_join(ns)
             metrics.incr("memberlist.node.join", 1, self.opts.metric_labels)
         elif meta_changed:
@@ -569,6 +582,9 @@ class Memberlist:
         self._start_suspicion(ns, s.incarnation, s.from_node)
         self._queue_broadcast(sm.encode_swim(s), name=s.node)
         metrics.incr("memberlist.node.suspect", 1, self.opts.metric_labels)
+        flight.record("swim-state", node=self.local.id, member=s.node,
+                      state="SUSPECT", accuser=s.from_node,
+                      incarnation=s.incarnation)
 
     def _start_suspicion(self, ns: NodeState, incarnation: int, from_node: str) -> None:
         n = max(1, self.num_online_members())
@@ -619,6 +635,9 @@ class Memberlist:
         ns.state_change = time.monotonic()
         self._suspicions.pop(d.node, None)
         self._queue_broadcast(sm.encode_swim(d), name=d.node)
+        flight.record("swim-state", node=self.local.id, member=d.node,
+                      state=ns.state.name, from_node=d.from_node,
+                      incarnation=d.incarnation)
         self.delegate.notify_leave(ns)
         metrics.incr("memberlist.node.dead", 1, self.opts.metric_labels)
 
@@ -663,6 +682,10 @@ class Memberlist:
         return None
 
     async def _probe_node(self, ns: NodeState) -> None:
+        with span("swim.probe", node=self.local.id, target=ns.id) as sp:
+            await self._probe_node_inner(ns, sp)
+
+    async def _probe_node_inner(self, ns: NodeState, sp) -> None:
         seq = self._next_seq()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._ack_futures[seq] = fut
@@ -674,6 +697,8 @@ class Memberlist:
                 _, payload = await asyncio.wait_for(fut, timeout)
                 rtt = time.monotonic() - sent
                 self._awareness.apply_delta(-1)
+                sp.attrs["outcome"] = "ack"
+                sp.attrs["rtt_ms"] = round(rtt * 1e3, 3)
                 self.delegate.notify_ping_complete(ns, rtt, payload)
                 return
             except asyncio.TimeoutError:
@@ -696,6 +721,7 @@ class Memberlist:
                 try:
                     await asyncio.wait_for(fut2, self._awareness.scale(self.opts.probe_timeout) * 2)
                     self._awareness.apply_delta(-1)
+                    sp.attrs["outcome"] = "indirect-ack"
                     return
                 except asyncio.TimeoutError:
                     pass
@@ -709,6 +735,9 @@ class Memberlist:
                 self._awareness.apply_delta(1)
             if ns.state == SwimState.ALIVE:
                 metrics.incr("memberlist.probe.failed", 1, self.opts.metric_labels)
+                sp.attrs["outcome"] = "failed"
+                flight.record("probe-failed", node=self.local.id,
+                              target=ns.id, relays=len(relays))
                 s = sm.Suspect(ns.incarnation, ns.id, self.local.id)
                 self._handle_suspect(s)
         finally:
@@ -725,6 +754,10 @@ class Memberlist:
                 log.exception("gossip tick failed")
 
     async def _gossip_once(self) -> None:
+        with span("swim.gossip", node=self.local.id):
+            await self._gossip_once_inner()
+
+    async def _gossip_once_inner(self) -> None:
         # gossip to alive + suspect nodes, and occasionally to dead ones
         # (gives partitioned/dead nodes a chance to refute and recover)
         candidates = [
@@ -773,6 +806,11 @@ class Memberlist:
         ]
 
     async def _push_pull_with(self, addr, join: bool) -> None:
+        with span("swim.push-pull", node=self.local.id, join=join,
+                  target=str(addr)):
+            await self._push_pull_with_inner(addr, join)
+
+    async def _push_pull_with_inner(self, addr, join: bool) -> None:
         stream = await self.transport.dial(addr, timeout=self.opts.timeout)
         try:
             out = sm.PushPull(join, tuple(self._local_push_states()),

@@ -48,7 +48,8 @@ class QueryResponse:
                  num_nodes: int):
         self.ltime = ltime
         self.id = id
-        self.deadline = time.monotonic() + timeout
+        self.started = time.monotonic()
+        self.deadline = self.started + timeout
         self.with_acks = with_acks
         self.num_nodes = num_nodes
         self._acks: asyncio.Queue = asyncio.Queue()
@@ -86,6 +87,9 @@ class QueryResponse:
             return
         self._resp_seen.add(from_id)
         metrics.incr("serf.query.responses", 1, labels)
+        # round-trip latency: query broadcast -> this node's answer
+        metrics.observe("serf.query.rtt-ms",
+                        (time.monotonic() - self.started) * 1e3, labels)
         self._responses.put_nowait(NodeResponse(from_id, payload))
 
     # consuming

@@ -17,10 +17,9 @@ from __future__ import annotations
 
 import asyncio
 import enum
-import logging
 import random
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field as dataclass_field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from serf_tpu import codec
@@ -77,9 +76,13 @@ from serf_tpu.types.messages import (
     encode_relay_message,
 )
 from serf_tpu.types.tags import Tags
+from serf_tpu import obs
+from serf_tpu.obs.trace import span
 from serf_tpu.utils import metrics
 
-log = logging.getLogger("serf_tpu.serf")
+from serf_tpu.utils.logging import get_logger
+
+log = get_logger("serf")
 
 # Internal query name-space (reference event/crate_event.rs:60-69)
 INTERNAL_PING = "_serf_ping"
@@ -100,7 +103,10 @@ class SerfState(enum.IntEnum):
 
 @dataclass
 class Stats:
-    """Operator snapshot (reference api.rs:586-602)."""
+    """Operator snapshot (reference api.rs:586-602), extended with the
+    full observability picture: the metrics sink, the retained trace
+    spans, and the flight-recorder events (serf_tpu.obs) — one call
+    yields everything needed to reconstruct a protocol round."""
 
     members: int
     failed: int
@@ -114,6 +120,12 @@ class Stats:
     query_queue: int
     encrypted: bool
     coordinate_resets: int
+    #: JSON-ready metrics snapshot (counters/gauges/histogram summaries)
+    metrics: dict = dataclass_field(default_factory=dict)
+    #: finished trace spans, oldest first (obs.trace ring)
+    trace: list = dataclass_field(default_factory=list)
+    #: flight-recorder events, oldest first (obs.flight ring)
+    flight: list = dataclass_field(default_factory=list)
 
 
 class _SerfSwimDelegate(SwimDelegate):
@@ -151,12 +163,16 @@ class _SerfSwimDelegate(SwimDelegate):
             return []
         out: List[bytes] = []
         used = 0
-        for q in (s.intent_broadcasts, s.event_broadcasts, s.query_broadcasts):
-            msgs = q.get_broadcasts(overhead, limit - used)
-            for m in msgs:
-                used += overhead + len(m)
-                metrics.observe("serf.messages.sent", len(m), s._labels)
-            out.extend(msgs)
+        with span("serf.broadcast.drain", node=s.local_id) as sp:
+            for q in (s.intent_broadcasts, s.event_broadcasts,
+                      s.query_broadcasts):
+                msgs = q.get_broadcasts(overhead, limit - used)
+                for m in msgs:
+                    used += overhead + len(m)
+                    metrics.observe("serf.messages.sent", len(m), s._labels)
+                out.extend(msgs)
+            sp.attrs["messages"] = len(out)
+            sp.attrs["bytes"] = used
         return out
 
     # -- anti-entropy -------------------------------------------------------
@@ -181,6 +197,11 @@ class _SerfSwimDelegate(SwimDelegate):
         return encode_message(pp)
 
     def merge_remote_state(self, buf: bytes, is_join: bool) -> None:
+        s = self.serf
+        with span("serf.push-pull.merge", node=s.local_id, join=is_join):
+            self._merge_remote_state(buf, is_join)
+
+    def _merge_remote_state(self, buf: bytes, is_join: bool) -> None:
         s = self.serf
         try:
             pp = decode_message(buf)
@@ -269,12 +290,16 @@ class _SerfSwimDelegate(SwimDelegate):
         if payload[0] != PING_VERSION:
             log.warning("unsupported ping version %d from %s", payload[0], ns.id)
             metrics.incr("serf.coordinate.rejected", 1, s._labels)
+            obs.record("coordinate-rejected", node=s.local_id, peer=ns.id,
+                       reason=f"ping version {payload[0]}")
             return
         try:
             other = Coordinate.decode(payload[1:])
         except codec.DecodeError as e:
             log.warning("bad coordinate from %s: %s", ns.id, e)
             metrics.incr("serf.coordinate.rejected", 1, s._labels)
+            obs.record("coordinate-rejected", node=s.local_id, peer=ns.id,
+                       reason=f"undecodable: {e}")
             return
         if rtt <= 0.0:
             metrics.incr("serf.coordinate.zero-rtt", 1, s._labels)
@@ -285,6 +310,8 @@ class _SerfSwimDelegate(SwimDelegate):
         except ValueError as e:
             log.debug("coordinate update rejected for %s: %s", ns.id, e)
             metrics.incr("serf.coordinate.rejected", 1, s._labels)
+            obs.record("coordinate-rejected", node=s.local_id, peer=ns.id,
+                       reason=str(e))
             return
         metrics.observe("serf.coordinate.adjustment-ms",
                         (time.monotonic() - start) * 1e3, s._labels)
@@ -366,9 +393,14 @@ class Serf:
             return max(1, len(self._members))
 
         rm = opts.memberlist.retransmit_mult
-        self.intent_broadcasts = TransmitLimitedQueue(rm, _num_nodes)
-        self.event_broadcasts = TransmitLimitedQueue(rm, _num_nodes)
-        self.query_broadcasts = TransmitLimitedQueue(rm, _num_nodes)
+        # named queues emit serf.queue.<name> depth gauges at every
+        # mutation (the QueueChecker still re-gauges periodically)
+        self.intent_broadcasts = TransmitLimitedQueue(
+            rm, _num_nodes, name="intent", labels=self._labels)
+        self.event_broadcasts = TransmitLimitedQueue(
+            rm, _num_nodes, name="event", labels=self._labels)
+        self.query_broadcasts = TransmitLimitedQueue(
+            rm, _num_nodes, name="query", labels=self._labels)
 
         self.coord_client: Optional[CoordinateClient] = None
         self._coord_cache: Dict[str, Coordinate] = {}
@@ -587,6 +619,9 @@ class Serf:
 
     def stats(self) -> Stats:
         return Stats(
+            metrics=obs.metrics_snapshot(),
+            trace=obs.trace_dump(),
+            flight=obs.flight_dump(),
             members=len(self._members),
             failed=len(self._failed),
             left=len(self._left),
@@ -728,8 +763,10 @@ class Serf:
             raise ValueError(
                 f"encoded user event exceeds sane limit of {USER_EVENT_SIZE_LIMIT} bytes")
         # metrics are counted once, inside the handler (reference base.rs:818)
-        self._handle_user_event(msg, rebroadcast=False)
-        self._queue(self.event_broadcasts, raw)
+        with span("serf.user-event", node=self.local_id, event=name,
+                  bytes=len(raw)):
+            self._handle_user_event(msg, rebroadcast=False)
+            self._queue(self.event_broadcasts, raw)
 
     # -- queries ------------------------------------------------------------
 
@@ -760,8 +797,10 @@ class Serf:
                              len(self._members))
         self._query_responses[(ltime, qid)] = resp
         self._spawn(self._expire_query(resp), "serf-query-expire")
-        self._handle_query(msg, rebroadcast=False)
-        self._queue(self.query_broadcasts, raw)
+        with span("serf.query", node=self.local_id, query=name,
+                  bytes=len(raw)):
+            self._handle_query(msg, rebroadcast=False)
+            self._queue(self.query_broadcasts, raw)
         return resp
 
     async def _expire_query(self, resp: QueryResponse) -> None:
@@ -855,6 +894,8 @@ class Serf:
             if status_time:
                 ms.status_time = status_time
         metrics.incr("serf.member.join", 1, self._labels)
+        obs.record("member-state", node=self.local_id, member=ns.id,
+                   status=ms.member.status.name, via="notify_join")
         self._emit(MemberEvent(MemberEventType.JOIN, (ms.member,)))
 
     def _handle_node_leave(self, ns: NodeState) -> None:
@@ -877,6 +918,8 @@ class Serf:
             metrics.incr("serf.member.failed", 1, self._labels)
         else:
             return
+        obs.record("member-state", node=self.local_id, member=ns.id,
+                   status=ms.member.status.name, via="notify_leave")
         self._emit(MemberEvent(ty, (ms.member,)))
 
     def _handle_node_update(self, ns: NodeState) -> None:
@@ -960,6 +1003,8 @@ class Serf:
         if status == MemberStatus.ALIVE:
             ms.member = ms.member.with_status(MemberStatus.LEAVING)
             ms.status_time = msg.ltime
+            obs.record("member-state", node=self.local_id, member=msg.id,
+                       status="LEAVING", via="leave_intent")
             if msg.prune:
                 self._handle_prune(ms)
             return True
@@ -971,6 +1016,8 @@ class Serf:
             ms.leave_time = time.monotonic()
             self._failed = [m for m in self._failed if m.id != msg.id]
             self._left.append(ms)
+            obs.record("member-state", node=self.local_id, member=msg.id,
+                       status="LEFT", via="leave_intent_on_failed")
             self._emit(MemberEvent(MemberEventType.LEAVE, (ms.member,)))
             if msg.prune:
                 self._handle_prune(ms)
@@ -1183,6 +1230,9 @@ class Serf:
                 ms.member = ms.member.with_status(MemberStatus.FAILED)
                 ms.leave_time = time.monotonic()
                 self._failed.append(ms)
+                obs.record("member-state", node=self.local_id,
+                           member=node_id, status="FAILED",
+                           via="zombie_sweep")
                 self._emit(MemberEvent(MemberEventType.FAILED, (ms.member,)))
                 metrics.incr("serf.member.failed", 1, self._labels)
         # forget healed or departed entries so the timer restarts fresh
@@ -1267,6 +1317,9 @@ class Serf:
                             node_id, now - grace_start)
                 ms.member = ms.member.with_status(MemberStatus.ALIVE)
                 metrics.incr("serf.member.unleave", 1, self._labels)
+                obs.record("member-state", node=self.local_id,
+                           member=node_id, status="ALIVE",
+                           via="dangling_leaving_sweep")
                 current.discard(node_id)   # timer restarts if it re-enters
         for node_id in list(leaving_since):
             if node_id not in current:

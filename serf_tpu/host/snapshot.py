@@ -15,7 +15,6 @@ known-alive nodes for shuffled auto-rejoin (reference base.rs:129-165).
 from __future__ import annotations
 
 import asyncio
-import logging
 import os
 import time
 from dataclasses import dataclass, field
@@ -23,10 +22,13 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from serf_tpu import codec
 from serf_tpu.host.events import MemberEvent, MemberEventType, QueryEvent, UserEvent
+from serf_tpu.obs.trace import span
 from serf_tpu.types.member import Node
 from serf_tpu.utils import metrics
 
-log = logging.getLogger("serf_tpu.snapshot")
+from serf_tpu.utils.logging import get_logger
+
+log = get_logger("snapshot")
 
 # record types
 R_ALIVE = 1
@@ -210,20 +212,22 @@ class Snapshotter:
             return
         start = time.monotonic()
         tmp = self.path + ".compact"
-        with open(tmp, "wb") as out:
-            c, e, q = self._last_clocks
-            if self.clock_fn is not None:
-                c, e, q = self.clock_fn()
-            out.write(_record(R_CLOCK, codec.encode_varint(c)))
-            out.write(_record(R_EVENT_CLOCK, codec.encode_varint(e)))
-            out.write(_record(R_QUERY_CLOCK, codec.encode_varint(q)))
-            for node in self._alive.values():
-                out.write(_record(R_ALIVE, node.encode()))
-            out.flush()
-            os.fsync(out.fileno())
-        self._f.close()
-        os.replace(tmp, self.path)
-        self._f = open(self.path, "ab")
+        with span("snapshot.compact", bytes_before=size) as sp:
+            with open(tmp, "wb") as out:
+                c, e, q = self._last_clocks
+                if self.clock_fn is not None:
+                    c, e, q = self.clock_fn()
+                out.write(_record(R_CLOCK, codec.encode_varint(c)))
+                out.write(_record(R_EVENT_CLOCK, codec.encode_varint(e)))
+                out.write(_record(R_QUERY_CLOCK, codec.encode_varint(q)))
+                for node in self._alive.values():
+                    out.write(_record(R_ALIVE, node.encode()))
+                out.flush()
+                os.fsync(out.fileno())
+            self._f.close()
+            os.replace(tmp, self.path)
+            self._f = open(self.path, "ab")
+            sp.attrs["bytes_after"] = self._f.tell()
         metrics.observe("serf.snapshot.compact",
                         (time.monotonic() - start) * 1e3, self.labels)
         log.info("snapshot compacted to %d bytes", self._f.tell())

@@ -356,3 +356,26 @@ def hlo_bytes_per_round(jitted, *args, num_rounds: int,
     if total is None:
         return None
     return float(total) / num_rounds
+
+
+def emit_traffic_metrics(report: TrafficReport, labels=None) -> dict:
+    """Emit the analytic HBM traffic model as gauges onto the process
+    sink: modeled bytes/round per plane (label ``plane=...``), the total,
+    and the single-chip bandwidth-ceiling rounds/sec.  Operators (and
+    ``Serf.stats()`` consumers) can then compare the model against the
+    measured ``serf.device.dispatch-ms`` timings without re-deriving it.
+    """
+    from serf_tpu.utils import metrics
+
+    vals = {}
+    for plane, nbytes in report.by_plane().items():
+        metrics.gauge("serf.model.traffic.plane-bytes", nbytes,
+                      dict(labels or {}, plane=plane))
+        vals[f"serf.model.traffic.plane-bytes{{plane={plane}}}"] = nbytes
+    vals["serf.model.traffic.bytes-per-round"] = report.total_bytes
+    vals["serf.model.traffic.ceiling-rps"] = report.ceiling_rounds_per_sec()
+    metrics.gauge("serf.model.traffic.bytes-per-round",
+                  report.total_bytes, labels)
+    metrics.gauge("serf.model.traffic.ceiling-rps",
+                  report.ceiling_rounds_per_sec(), labels)
+    return vals

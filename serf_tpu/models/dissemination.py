@@ -861,3 +861,39 @@ def fully_disseminated(state: GossipState, cfg: GossipConfig) -> jnp.ndarray:
     """bool[K]: every alive node knows the fact (for valid facts)."""
     cov = coverage(state, cfg)
     return jnp.where(state.facts.valid, cov >= 1.0, True)
+
+
+def emit_gossip_metrics(state: GossipState, cfg: GossipConfig,
+                        labels=None) -> dict:
+    """Emit device-plane dissemination gauges onto the process sink.
+
+    The model runs under jit where Python-side counters cannot fire, so
+    observability is pull-based: call this between scans (bench.py does,
+    after each timed block) and it summarizes the HBM-resident state into
+    host scalars — one device->host sync plus an N×K unpack for coverage
+    and fan-out, so never call it inside a jitted round.  Returns the
+    emitted ``{name: value}`` dict so callers can embed it in artifacts.
+    """
+    from serf_tpu.utils import metrics
+
+    valid = state.facts.valid
+    n_valid = jnp.maximum(jnp.sum(valid), 1).astype(jnp.float32)
+    mean_cov = jnp.sum(jnp.where(valid, coverage(state, cfg), 0.0)) / n_valid
+    # dissemination fan-out: packets each alive node would select this
+    # round (the transmit-limited queue's aggregate depth, vectorized)
+    fan_out = jnp.sum(sending_mask(state, cfg)).astype(jnp.float32) \
+        / jnp.maximum(jnp.sum(state.alive), 1).astype(jnp.float32)
+    # one device_get for the whole dict: async-copies every leaf, then a
+    # single blocking wait — not one round-trip per metric
+    vals = jax.device_get({
+        "serf.model.gossip.round": state.round,
+        "serf.model.gossip.alive": jnp.sum(state.alive),
+        "serf.model.gossip.facts-valid": jnp.sum(valid),
+        "serf.model.gossip.coverage": mean_cov,
+        "serf.model.gossip.fan-out": fan_out,
+        "serf.model.gossip.tombstones": jnp.sum(state.tombstone),
+    })
+    vals = {name: float(v) for name, v in vals.items()}
+    for name, v in vals.items():
+        metrics.gauge(name, v, labels)
+    return vals

@@ -386,3 +386,35 @@ def detection_complete(state: GossipState, cfg: GossipConfig,
     """Scalar bool: every dead node is believed dead by every alive node."""
     believed = believed_dead(state, cfg, fcfg)
     return jnp.all(jnp.where(~state.alive, believed, True))
+
+
+def emit_swim_metrics(state: GossipState, cfg: GossipConfig,
+                      fcfg: FailureConfig = FailureConfig(),
+                      labels=None) -> dict:
+    """Emit device-plane SWIM round-outcome gauges onto the process sink.
+
+    The host-side companion of :func:`serf_tpu.models.dissemination.
+    emit_gossip_metrics` (same pull-based contract: one device->host
+    sync, call between scans, never inside jit): how many suspicions are
+    live (could still declare), how many accusations could still be
+    refuted, and how many death declarations occupy ring slots — the
+    numbers that say which phase gates are open and why.
+    """
+    from serf_tpu.utils import metrics
+
+    # one device_get for the whole dict (see emit_gossip_metrics)
+    vals = jax.device_get({
+        "serf.model.swim.live-suspicions":
+            jnp.sum(live_suspicions(state)),
+        "serf.model.swim.accusations-pending":
+            jnp.sum(accusations_pending(state)),
+        "serf.model.swim.dead-facts":
+            jnp.sum(_facts_about(state, (K_DEAD,))),
+        "serf.model.swim.undetected-deaths":
+            jnp.sum(~state.alive
+                    & ~believed_dead(state, cfg, fcfg)),
+    })
+    vals = {name: float(v) for name, v in vals.items()}
+    for name, v in vals.items():
+        metrics.gauge(name, v, labels)
+    return vals

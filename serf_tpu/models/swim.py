@@ -225,3 +225,25 @@ def run_cluster_sustained(state: ClusterState, cfg: ClusterConfig,
     keys = jax.random.split(key, num_rounds)
     final, _ = jax.lax.scan(body, state, keys)
     return final
+
+
+def emit_cluster_metrics(state: ClusterState, cfg: ClusterConfig,
+                         labels=None) -> dict:
+    """One call emits every device-plane gauge for the flagship cluster:
+    dissemination + SWIM outcomes + (when enabled) Vivaldi.  Pull-based —
+    the model runs under jit where counters cannot fire, so benchmarks
+    and tests call this between scans; one device->host sync.  Returns
+    the merged ``{name: value}`` dict (bench.py embeds it in
+    BENCH_DETAIL.json).
+    """
+    from serf_tpu.models.dissemination import emit_gossip_metrics
+    from serf_tpu.models.failure import emit_swim_metrics
+    from serf_tpu.models.vivaldi import emit_vivaldi_metrics
+
+    out = {}
+    out.update(emit_gossip_metrics(state.gossip, cfg.gossip, labels))
+    out.update(emit_swim_metrics(state.gossip, cfg.gossip, cfg.failure,
+                                 labels))
+    if cfg.with_vivaldi:
+        out.update(emit_vivaldi_metrics(state.vivaldi, labels))
+    return out

@@ -320,3 +320,25 @@ def mean_relative_error(state: VivaldiState, cfg: VivaldiConfig,
     est = estimated_rtt(state, i, j)
     true = ground_truth_rtt(positions, i, j)
     return jnp.mean(jnp.abs(est - true) / jnp.maximum(true, 1e-9))
+
+
+def emit_vivaldi_metrics(state: VivaldiState, labels=None) -> dict:
+    """Emit device-plane Vivaldi coordinate gauges onto the process sink.
+
+    Same pull-based contract as ``emit_gossip_metrics``: one
+    device->host sync of population means, call between scans — the
+    device analog of the host plane's per-sample
+    ``serf.coordinate.adjustment-ms`` observations.
+    """
+    from serf_tpu.utils import metrics
+
+    # one device_get for the whole dict (see emit_gossip_metrics)
+    vals = jax.device_get({
+        "serf.model.vivaldi.error": jnp.mean(state.error),
+        "serf.model.vivaldi.height": jnp.mean(state.height),
+        "serf.model.vivaldi.adjustment": jnp.mean(state.adjustment),
+    })
+    vals = {name: float(v) for name, v in vals.items()}
+    for name, v in vals.items():
+        metrics.gauge(name, v, labels)
+    return vals

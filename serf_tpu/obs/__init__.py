@@ -1,0 +1,65 @@
+"""Unified observability: trace spans, flight recorder, exporters.
+
+The reference serf leans on the Rust ``metrics`` facade plus
+``tracing`` subscribers for its operational surface (SURVEY.md §5); this
+package is that surface for the reproduction, spanning BOTH planes:
+
+- :mod:`serf_tpu.obs.trace` — ``span(name, **attrs)`` context manager
+  with parent/child nesting (contextvars) and a bounded in-memory buffer
+  of finished spans, instrumented around the host plane's hot protocol
+  paths (probe round, push/pull, gossip drain, query, user event,
+  snapshot compaction, wire encode/decode).
+- :mod:`serf_tpu.obs.flight` — a fixed-size ring of structured protocol
+  events (member state transitions, queue overflows, rejected
+  coordinates, retransmit exhaustion) with a ``dump()`` API: the
+  after-the-fact debugging surface write-only counters cannot be.
+- :mod:`serf_tpu.obs.export` — Prometheus text-format and JSON snapshot
+  renderers over the :mod:`serf_tpu.utils.metrics` sink plus the trace
+  and flight buffers; ``Serf.stats()`` surfaces all three.
+- :mod:`serf_tpu.obs.device` — wall-clock dispatch timers for the JAX
+  device plane with a jit-compile-vs-steady-state split, used by
+  ``serf_tpu/ops/round_kernels.py`` and ``bench.py``; the per-model
+  metric emitters live next to their states (``models/*.emit_*``).
+
+Everything is process-global with swap-out setters, mirroring the
+``metrics`` facade already in place.
+"""
+
+from serf_tpu.obs.trace import (  # noqa: F401
+    Span,
+    TraceBuffer,
+    global_tracer,
+    set_global_tracer,
+    span,
+    trace_dump,
+)
+from serf_tpu.obs.flight import (  # noqa: F401
+    FlightRecorder,
+    flight_dump,
+    global_recorder,
+    record,
+    set_global_recorder,
+)
+from serf_tpu.obs.export import (  # noqa: F401
+    json_snapshot,
+    metrics_snapshot,
+    parse_prometheus_text,
+    prometheus_text,
+)
+from serf_tpu.obs.device import (  # noqa: F401
+    dispatch_summary,
+    dispatch_timer,
+    record_dispatch,
+    reset_dispatch_registry,
+)
+
+__all__ = [
+    "Span", "TraceBuffer", "span", "trace_dump",
+    "global_tracer", "set_global_tracer",
+    "FlightRecorder", "record", "flight_dump",
+    "global_recorder", "set_global_recorder",
+    "prometheus_text", "parse_prometheus_text",
+    "json_snapshot", "metrics_snapshot",
+    "dispatch_timer", "dispatch_summary", "record_dispatch",
+    "reset_dispatch_registry",
+]

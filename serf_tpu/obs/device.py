@@ -1,0 +1,105 @@
+"""Device-plane dispatch timing: compile-vs-steady wall-clock split.
+
+JAX work is opaque to host metrics — a jitted call's first invocation
+pays tracing + XLA compilation, later ones only dispatch.  Benchmarks
+that cannot attribute that split report compile time as throughput
+(round-3 lesson).  ``dispatch_timer(op, signature)`` times the wrapped
+host-side call and classifies it: the first call for a given
+``(op, signature)`` is ``phase=compile`` (tracing/compilation happens
+there), the rest ``phase=steady``.  ``signature`` should carry whatever
+forces recompilation (shapes, static args), so a re-trace at a new shape
+is honestly re-labeled compile.
+
+Timings land in two places: the ``serf.device.dispatch-ms`` histogram
+(labels ``op``/``phase``) on the global sink, and an in-module registry
+``dispatch_summary()`` renders for ``bench.py`` to embed in
+``BENCH_DETAIL.json``.
+
+NOTE: a wall clock around an async dispatch measures host-side cost
+only; for device-complete timings the caller must end with a host
+transfer (see bench.py's ``_time_rounds`` barrier discussion) — which is
+exactly how bench.py drives this module.
+
+This module deliberately imports no JAX: the per-model metric emitters
+that DO touch device arrays live beside their states
+(``serf_tpu/models/*.emit_*_metrics``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Hashable, Optional, Tuple
+
+from serf_tpu.utils import metrics
+
+_lock = threading.Lock()
+#: (op, signature) pairs whose compile call has been observed
+_seen: set = set()
+#: op -> {"compile_ms": float, "steady_ms": [..bounded..], "calls": int}
+_registry: Dict[str, Dict[str, Any]] = {}
+_STEADY_KEEP = 64
+
+
+def reset_dispatch_registry() -> None:
+    with _lock:
+        _seen.clear()
+        _registry.clear()
+
+
+def record_dispatch(op: str, elapsed_ms: float,
+                    signature: Hashable = None,
+                    labels: Optional[Dict[str, str]] = None) -> Tuple[str, float]:
+    """Record one timed dispatch; returns ``(phase, elapsed_ms)``."""
+    key = (op, signature)
+    with _lock:
+        if key not in _seen:
+            _seen.add(key)
+            phase = "compile"
+        else:
+            phase = "steady"
+        ent = _registry.setdefault(
+            op, {"compile_ms": 0.0, "steady_ms": [], "calls": 0})
+        ent["calls"] += 1
+        if phase == "compile":
+            # a re-trace (new signature) accumulates: total compile cost
+            ent["compile_ms"] += elapsed_ms
+        else:
+            ent["steady_ms"].append(elapsed_ms)
+            if len(ent["steady_ms"]) > _STEADY_KEEP:
+                del ent["steady_ms"][0]
+    lab = {"op": op, "phase": phase}
+    if labels:
+        lab.update(labels)
+    metrics.observe("serf.device.dispatch-ms", elapsed_ms, lab)
+    metrics.incr("serf.device.dispatch.calls", 1, {"op": op})
+    return phase, elapsed_ms
+
+
+@contextmanager
+def dispatch_timer(op: str, signature: Hashable = None,
+                   labels: Optional[Dict[str, str]] = None):
+    """Time a device-plane dispatch (or trace) on the host wall clock."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record_dispatch(op, (time.perf_counter() - t0) * 1e3,
+                        signature, labels)
+
+
+def dispatch_summary() -> Dict[str, Dict[str, float]]:
+    """Per-op summary for benchmark artifacts: total compile ms, mean
+    steady ms, call count."""
+    out: Dict[str, Dict[str, float]] = {}
+    with _lock:
+        for op, ent in sorted(_registry.items()):
+            steady = ent["steady_ms"]
+            out[op] = {
+                "compile_ms": round(ent["compile_ms"], 3),
+                "steady_ms_mean": round(sum(steady) / len(steady), 4)
+                if steady else 0.0,
+                "calls": ent["calls"],
+            }
+    return out

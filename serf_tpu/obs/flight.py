@@ -1,0 +1,106 @@
+"""Flight recorder: a fixed-size ring of structured protocol events.
+
+The debugging surface the write-only counters never were: when a round
+misbehaves, ``dump()`` answers *why* — which member flapped, which queue
+overflowed and what it dropped, which coordinate sample was rejected and
+for what reason, which broadcast exhausted its retransmit budget — in
+order, with timestamps, bounded in memory (drop-oldest, like a cockpit
+flight recorder).
+
+Event kinds emitted by the engine (see README "Observability"):
+
+- ``member-state``    serf-level member status transitions
+- ``swim-state``      memberlist-level alive/suspect/dead/left moves
+- ``queue-overflow``  TransmitLimitedQueue prune dropped broadcasts
+- ``subscriber-drop`` event subscriber overflow dropped an event
+- ``coordinate-rejected``  a Vivaldi sample was refused (reason field)
+- ``broadcast-retired``    a broadcast exhausted its transmit budget
+- ``probe-failed``    direct+indirect probe round failed (suspect next)
+- ``packet-dropped``  wire decode/decrypt failure dropped a packet
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+#: events retained (ring, drop-oldest)
+FLIGHT_RING_SIZE = 512
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = FLIGHT_RING_SIZE):
+        self.capacity = max(1, capacity)
+        self._lock = threading.Lock()
+        self._ring: List[Optional[Dict[str, Any]]] = [None] * self.capacity
+        self._pos = 0
+        #: total events ever recorded (``recorded - len(self)`` = dropped)
+        self.recorded = 0
+
+    def record(self, kind: str, node: Optional[str] = None,
+               **fields: Any) -> None:
+        ev = {
+            "seq": 0,                      # patched under the lock below
+            "time": time.time(),
+            "monotonic": time.monotonic(),
+            "kind": kind,
+        }
+        if node is not None:
+            ev["node"] = node
+        ev.update(fields)
+        with self._lock:
+            self.recorded += 1
+            ev["seq"] = self.recorded
+            self._ring[self._pos] = ev
+            self._pos = (self._pos + 1) % self.capacity
+
+    def dump(self, kind: Optional[str] = None, node: Optional[str] = None,
+             last: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Retained events oldest-first, optionally filtered by ``kind``
+        and/or ``node``; ``last`` keeps only the newest N after filtering."""
+        with self._lock:
+            if self.recorded >= self.capacity:
+                ordered = self._ring[self._pos:] + self._ring[:self._pos]
+            else:
+                ordered = self._ring[:self._pos]
+            out = [dict(e) for e in ordered if e is not None]
+        if kind is not None:
+            out = [e for e in out if e["kind"] == kind]
+        if node is not None:
+            out = [e for e in out if e.get("node") == node]
+        return out[-last:] if last is not None else out
+
+    def __len__(self) -> int:
+        return min(self.recorded, self.capacity)
+
+    @property
+    def dropped(self) -> int:
+        return max(0, self.recorded - self.capacity)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring = [None] * self.capacity
+            self._pos = 0
+            self.recorded = 0
+
+
+_global = FlightRecorder()
+
+
+def global_recorder() -> FlightRecorder:
+    return _global
+
+
+def set_global_recorder(rec: FlightRecorder) -> None:
+    global _global
+    _global = rec
+
+
+def record(kind: str, node: Optional[str] = None, **fields: Any) -> None:
+    _global.record(kind, node, **fields)
+
+
+def flight_dump(kind: Optional[str] = None, node: Optional[str] = None,
+                last: Optional[int] = None) -> List[Dict[str, Any]]:
+    return _global.dump(kind, node, last)

@@ -32,6 +32,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from serf_tpu.obs.device import dispatch_timer
+
+
 def _interpret() -> bool:
     return jax.default_backend() == "cpu"
 
@@ -111,24 +114,29 @@ def select_packets(stamp: jnp.ndarray, known: jnp.ndarray,
     grid = (n // BLOCK_N,)
     limit_arr = jnp.asarray(limit, jnp.int32).reshape(1, 1)
     round_arr = (jnp.asarray(round_, jnp.int32) & 0xFF).reshape(1, 1)
-    return pl.pallas_call(
-        _select_kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((BLOCK_N, 1), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((n, w), jnp.uint32),
-        interpret=_interpret(),
-    )(limit_arr, round_arr, stamp, known, alive_u8)
+    # host wall clock only: eager calls time a real dispatch (first call
+    # at a shape = compile), calls inside an outer jit time the trace
+    with dispatch_timer("ops.select_packets", signature=(n, k)):
+        return pl.pallas_call(
+            _select_kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1), lambda i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, 1), lambda i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((BLOCK_N, 1), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((n, w), jnp.uint32),
+            interpret=_interpret(),
+        )(limit_arr, round_arr, stamp, known, alive_u8)
 
 
 # ---------------------------------------------------------------------------
@@ -162,29 +170,31 @@ def merge_incoming(known: jnp.ndarray, incoming: jnp.ndarray,
     BLOCK_N = _block_for(n)
     grid = (n // BLOCK_N,)
     round_arr = (jnp.asarray(next_round, jnp.int32) & 0xFF).reshape(1, 1)
-    return pl.pallas_call(
-        _merge_kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((BLOCK_N, 1), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=[
-            pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((n, w), jnp.uint32),
-            jax.ShapeDtypeStruct((n, k), jnp.uint8),
-        ],
-        interpret=_interpret(),
-    )(round_arr, known, incoming, alive_u8, stamp)
+    with dispatch_timer("ops.merge_incoming", signature=(n, k)):
+        return pl.pallas_call(
+            _merge_kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1), lambda i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((BLOCK_N, 1), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((n, w), jnp.uint32),
+                jax.ShapeDtypeStruct((n, k), jnp.uint8),
+            ],
+            interpret=_interpret(),
+        )(round_arr, known, incoming, alive_u8, stamp)

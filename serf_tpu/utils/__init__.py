@@ -1,6 +1,6 @@
 """Shared utilities: the metrics facade (``serf_tpu.utils.metrics``) and
 the SERF_TPU_LOG logging bootstrap (``serf_tpu.utils.logging``)."""
 
-from serf_tpu.utils.logging import setup_logging
+from serf_tpu.utils.logging import get_logger, setup_logging
 
-__all__ = ["setup_logging"]
+__all__ = ["get_logger", "setup_logging"]

@@ -8,6 +8,7 @@ emits at the same points with the same metric names (``serf.events``,
 
 from __future__ import annotations
 
+import math
 import threading
 from collections import defaultdict
 from typing import Dict, List, Optional, Tuple
@@ -26,24 +27,47 @@ def _labels(labels: Optional[Dict[str, str]]) -> LabelSet:
     return tuple(sorted(labels.items()))
 
 
+def percentile_of(sorted_samples: List[float], p: float) -> float:
+    """Nearest-rank p-th percentile (0..100) of a pre-sorted sample list;
+    0.0 when empty.  Shared by HistogramSummary and the exporters so the
+    JSON snapshot and the Prometheus quantile series always agree."""
+    if not 0.0 <= p <= 100.0:
+        raise ValueError(f"percentile must be in [0, 100], got {p}")
+    if not sorted_samples:
+        return 0.0
+    rank = max(1, math.ceil(p / 100.0 * len(sorted_samples)))
+    return sorted_samples[min(rank, len(sorted_samples)) - 1]
+
+
 class HistogramSummary:
-    __slots__ = ("count", "total", "min", "max", "_ring", "_pos")
+    __slots__ = ("count", "total", "_min", "_max", "_ring", "_pos")
 
     def __init__(self, ring_size: int = HISTOGRAM_RING_SIZE):
         self.count = 0
         self.total = 0.0
-        self.min = float("inf")
-        self.max = float("-inf")
+        self._min = float("inf")
+        self._max = float("-inf")
         self._ring: List[float] = [0.0] * ring_size
         self._pos = 0
 
     def observe(self, value: float) -> None:
         self.count += 1
         self.total += value
-        self.min = min(self.min, value)
-        self.max = max(self.max, value)
+        self._min = min(self._min, value)
+        self._max = max(self._max, value)
         self._ring[self._pos] = value
         self._pos = (self._pos + 1) % len(self._ring)
+
+    @property
+    def min(self) -> float:
+        """Smallest observed sample; 0.0 before any observation (an empty
+        histogram must not leak ±inf into exports/JSON)."""
+        return self._min if self.count else 0.0
+
+    @property
+    def max(self) -> float:
+        """Largest observed sample; 0.0 before any observation."""
+        return self._max if self.count else 0.0
 
     @property
     def mean(self) -> float:
@@ -54,6 +78,12 @@ class HistogramSummary:
         if self.count >= len(self._ring):
             return self._ring[self._pos:] + self._ring[:self._pos]
         return self._ring[:self._pos]
+
+    def percentile(self, p: float) -> float:
+        """p-th percentile (0..100) over the retained sample ring (the
+        last ≤ring_size observations — an approximation of the lifetime
+        distribution, exact while count <= ring_size).  0.0 when empty."""
+        return percentile_of(sorted(self.recent()), p)
 
 
 class MetricsSink:

@@ -2,7 +2,10 @@
 
 import pytest
 
-from serf_tpu.host.keyring import KeyringError, SecretKeyring
+pytest.importorskip(
+    "cryptography", reason="cryptography not installed in this image")
+
+from serf_tpu.host.keyring import KeyringError, SecretKeyring  # noqa: E402
 
 K1, K2, K3 = bytes(range(16)), bytes(range(16, 48)), bytes(range(8, 32))
 

@@ -1,0 +1,491 @@
+"""Observability subsystem tests: trace spans, flight recorder, exporters,
+queue gauges, device-plane emitters, and the metrics/README lint.
+
+The scenario test at the bottom is the acceptance pin: one
+join -> user event -> query -> leave run must leave the documented host
+metric names populated, spans in the trace ring, state transitions in the
+flight recorder, and a Prometheus export that round-trips through the
+bundled parser.
+"""
+
+import asyncio
+import logging
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from serf_tpu import obs
+from serf_tpu.obs.device import (
+    dispatch_summary,
+    dispatch_timer,
+    record_dispatch,
+    reset_dispatch_registry,
+)
+from serf_tpu.obs.export import parse_prometheus_text, prometheus_text
+from serf_tpu.obs.flight import FlightRecorder
+from serf_tpu.obs.trace import TraceBuffer, current_span, span
+from serf_tpu.utils import metrics
+from serf_tpu.utils.logging import ROOT_LOGGER, get_logger, setup_logging
+from serf_tpu.utils.metrics import HistogramSummary, MetricsSink
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def fresh_obs():
+    """Isolate every test: fresh sink, trace ring, flight ring, dispatch
+    registry; restore the previous globals afterwards."""
+    old_sink = metrics.global_sink()
+    old_tracer = obs.global_tracer()
+    old_rec = obs.global_recorder()
+    metrics.set_global_sink(MetricsSink())
+    obs.set_global_tracer(TraceBuffer())
+    obs.set_global_recorder(FlightRecorder())
+    reset_dispatch_registry()
+    yield
+    metrics.set_global_sink(old_sink)
+    obs.set_global_tracer(old_tracer)
+    obs.set_global_recorder(old_rec)
+    reset_dispatch_registry()
+
+
+# -- trace spans -------------------------------------------------------------
+
+
+def test_span_nesting_and_timing():
+    with span("outer", node="a") as outer:
+        assert current_span() is outer
+        with span("inner") as inner:
+            assert inner.parent_id == outer.span_id
+            assert inner.depth == outer.depth + 1
+        # contextvar restored after the child exits
+        assert current_span() is outer
+    assert current_span() is None
+    assert outer.parent_id == 0 and outer.depth == 0
+
+    dump = obs.trace_dump()
+    # children finish (and land in the ring) before their parents
+    names = [d["name"] for d in dump]
+    assert names == ["inner", "outer"]
+    by_name = {d["name"]: d for d in dump}
+    assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+    assert by_name["outer"]["attrs"] == {"node": "a"}
+    assert by_name["outer"]["duration_ms"] >= by_name["inner"]["duration_ms"]
+    assert all(d["duration_ms"] >= 0.0 for d in dump)
+    assert all(d["status"] == "ok" for d in dump)
+
+
+def test_span_error_status_and_histogram_feed():
+    with pytest.raises(RuntimeError):
+        with span("will-fail"):
+            raise RuntimeError("boom")
+    (d,) = obs.trace_dump(name="will-fail")
+    assert d["status"] == "error"
+    # every finished span feeds the aggregate latency histogram
+    h = metrics.global_sink().histogram_summary(
+        "serf.trace.span-ms", {"span": "will-fail"})
+    assert h is not None and h.count == 1
+
+
+def test_trace_buffer_wraparound_drops_oldest():
+    buf = TraceBuffer(capacity=4)
+    obs.set_global_tracer(buf)
+    for i in range(7):
+        with span(f"s{i}"):
+            pass
+    assert len(buf) == 4
+    assert buf.recorded == 7
+    assert [d["name"] for d in buf.dump()] == ["s3", "s4", "s5", "s6"]
+    assert [d["name"] for d in buf.dump(limit=2)] == ["s5", "s6"]
+
+
+def test_spans_nest_per_asyncio_task():
+    async def child(tag):
+        with span(tag) as s:
+            await asyncio.sleep(0)
+            # sibling tasks must not become each other's parents
+            assert current_span() is s
+            return s.parent_id
+
+    async def main():
+        with span("root") as root:
+            pids = await asyncio.gather(child("a"), child("b"))
+        return root.span_id, pids
+
+    root_id, pids = asyncio.run(main())
+    assert pids == [root_id, root_id]
+
+
+# -- flight recorder ---------------------------------------------------------
+
+
+def test_flight_ring_wraparound_and_filters():
+    rec = FlightRecorder(capacity=8)
+    obs.set_global_recorder(rec)
+    for i in range(20):
+        obs.record("member-state", node=f"n{i % 2}", status="ALIVE", i=i)
+    assert len(rec) == 8
+    assert rec.recorded == 20
+    assert rec.dropped == 12
+    dump = obs.flight_dump()
+    assert [e["i"] for e in dump] == list(range(12, 20))   # oldest first
+    assert [e["seq"] for e in dump] == list(range(13, 21))
+    # filters compose: kind, node, last-N
+    assert all(e["kind"] == "member-state" for e in dump)
+    n0 = obs.flight_dump(node="n0")
+    assert all(e["node"] == "n0" for e in n0) and len(n0) == 4
+    assert [e["i"] for e in obs.flight_dump(node="n0", last=2)] == [16, 18]
+    assert obs.flight_dump(kind="no-such-kind") == []
+
+
+# -- metrics sink satellites -------------------------------------------------
+
+
+def test_histogram_empty_min_max_are_zero_not_inf():
+    h = HistogramSummary()
+    assert h.min == 0.0 and h.max == 0.0 and h.mean == 0.0
+    assert h.percentile(50) == 0.0
+    h.observe(3.0)
+    h.observe(1.0)
+    assert h.min == 1.0 and h.max == 3.0
+
+
+def test_histogram_percentiles_from_sample_ring():
+    h = HistogramSummary(ring_size=128)
+    for v in range(1, 101):        # 1..100
+        h.observe(float(v))
+    assert h.percentile(50) == 50.0
+    assert h.percentile(95) == 95.0
+    assert h.percentile(99) == 99.0
+    assert h.percentile(0) == 1.0
+    assert h.percentile(100) == 100.0
+    with pytest.raises(ValueError):
+        h.percentile(101)
+
+
+def test_empty_histogram_never_exports_inf():
+    sink = metrics.global_sink()
+    sink.histograms[("hollow.hist", ())]    # defaultdict: count == 0 entry
+    text = prometheus_text()
+    assert "Inf" not in text
+    parsed = parse_prometheus_text(text)
+    assert parsed[("hollow_hist_min", ())] == 0.0
+    assert parsed[("hollow_hist_max", ())] == 0.0
+
+
+# -- exporters ---------------------------------------------------------------
+
+
+def test_prometheus_text_escaping_label_ordering_roundtrip():
+    metrics.incr("serf.member.join", 2,
+                 {"dc": 'us-"west"\\1', "az": "line1\nline2"})
+    metrics.gauge("serf.queue.event", 5, {"node": "a"})
+    for v in (1.0, 2.0, 3.0, 4.0):
+        metrics.observe("serf.trace.span-ms", v, {"span": "swim.probe"})
+    text = prometheus_text()
+
+    # name sanitization + counter suffix
+    assert "serf_member_join_total{" in text
+    # label keys render in sorted order (the sink stores sorted label sets)
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith("serf_member_join_total"))
+    assert line.index('az="') < line.index('dc="')
+    # escaping: backslash, double-quote, newline
+    assert '\\"west\\"' in line and "\\n" in line and "\\\\1" in line
+
+    parsed = parse_prometheus_text(text)   # raises on any malformed line
+    labels = (("az", "line1\nline2"), ("dc", 'us-"west"\\1'))
+    assert parsed[("serf_member_join_total", labels)] == 2.0
+    assert parsed[("serf_queue_event", (("node", "a"),))] == 5.0
+    q95 = ("serf_trace_span_ms",
+           (("span", "swim.probe"), ("quantile", "0.95")))
+    assert parsed[q95] == 4.0
+    assert parsed[("serf_trace_span_ms_count",
+                   (("span", "swim.probe"),))] == 4.0
+    assert parsed[("serf_trace_span_ms_sum",
+                   (("span", "swim.probe"),))] == 10.0
+
+
+def test_parser_rejects_malformed_lines():
+    with pytest.raises(ValueError):
+        parse_prometheus_text("not a metric line at all }{")
+
+
+def test_json_snapshot_bundles_all_three_surfaces():
+    metrics.incr("serf.events")
+    with span("serf.query"):
+        pass
+    obs.record("probe-failed", node="a", target="b")
+    snap = obs.json_snapshot()
+    assert snap["metrics"]["counters"]["serf.events"] == 1.0
+    assert [s["name"] for s in snap["trace"]] == ["serf.query"]
+    assert [e["kind"] for e in snap["flight"]] == ["probe-failed"]
+    # histogram summaries carry the ring percentiles
+    hist = snap["metrics"]["histograms"]['serf.trace.span-ms{span=serf.query}']
+    assert hist["count"] == 1 and hist["p50"] == hist["max"]
+
+
+# -- logging satellites ------------------------------------------------------
+
+
+def test_setup_logging_idempotent_under_configured_root():
+    parent = logging.getLogger(ROOT_LOGGER)
+    before = list(parent.handlers)
+    try:
+        logging.basicConfig(level="WARNING")   # simulate pytest/app config
+        l1 = setup_logging(level="DEBUG")
+        l2 = setup_logging(level="INFO")
+        assert l1 is l2 is parent
+        ours = [h for h in parent.handlers if h not in before]
+        assert len(ours) == 1                  # repeated calls: one handler
+        assert parent.level == logging.INFO    # level re-applied
+        assert setup_logging(env_var="SERF_TPU_NO_SUCH_VAR") is None
+    finally:
+        for h in [h for h in parent.handlers if h not in before]:
+            parent.removeHandler(h)
+        parent.setLevel(logging.NOTSET)
+
+
+def test_get_logger_hangs_off_serf_tpu_tree():
+    assert get_logger("memberlist").name == "serf_tpu.memberlist"
+    assert get_logger("serf_tpu").name == "serf_tpu"
+    assert get_logger("serf_tpu.codec.native").name == "serf_tpu.codec.native"
+    assert get_logger("memberlist").parent.name == "serf_tpu"
+
+
+# -- queue-depth gauges ------------------------------------------------------
+
+
+def test_named_queue_emits_depth_gauges_and_flight_events():
+    from serf_tpu.host.broadcast import Broadcast, TransmitLimitedQueue
+
+    sink = metrics.global_sink()
+    q = TransmitLimitedQueue(retransmit_mult=1, node_count_fn=lambda: 1,
+                             name="intent")
+    q.queue_broadcast(Broadcast(b"x" * 8, name="a"))
+    q.queue_broadcast(Broadcast(b"y" * 8, name="b"))
+    assert sink.gauge_value("serf.queue.intent") == 2
+    # retransmit_mult=1 @ n=1 -> transmit limit 1: one drain retires all
+    q.get_broadcasts(overhead=0, limit=1000)
+    assert sink.gauge_value("serf.queue.intent") == 0
+    retired = obs.flight_dump(kind="broadcast-retired")
+    assert {e["subject"] for e in retired} == {"a", "b"}
+
+    for i in range(6):
+        q.queue_broadcast(Broadcast(b"z" * 8, name=f"m{i}"))
+    q.prune(max_retained=2)
+    assert sink.gauge_value("serf.queue.intent") == 2
+    (ov,) = obs.flight_dump(kind="queue-overflow")
+    assert ov["queue"] == "intent" and ov["dropped"] == 4
+
+    # unnamed queues stay silent (no gauge family pollution)
+    q2 = TransmitLimitedQueue(retransmit_mult=1, node_count_fn=lambda: 1)
+    q2.queue_broadcast(Broadcast(b"q", name="c"))
+    assert sink.gauge_value("serf.queue.None") is None
+
+
+# -- device-plane dispatch timing --------------------------------------------
+
+
+def test_dispatch_timer_compile_vs_steady_split():
+    assert record_dispatch("op.x", 50.0, signature=(32, 64))[0] == "compile"
+    assert record_dispatch("op.x", 1.0, signature=(32, 64))[0] == "steady"
+    assert record_dispatch("op.x", 2.0, signature=(32, 64))[0] == "steady"
+    # a new signature (shape change) honestly re-labels compile
+    assert record_dispatch("op.x", 40.0, signature=(64, 64))[0] == "compile"
+    with dispatch_timer("op.y"):
+        pass
+    summary = dispatch_summary()
+    assert summary["op.x"]["compile_ms"] == pytest.approx(90.0)
+    assert summary["op.x"]["steady_ms_mean"] == pytest.approx(1.5)
+    assert summary["op.x"]["calls"] == 4
+    assert summary["op.y"]["calls"] == 1
+    sink = metrics.global_sink()
+    assert sink.counter("serf.device.dispatch.calls", {"op": "op.x"}) == 4
+    h = sink.histogram_summary("serf.device.dispatch-ms",
+                               {"op": "op.x", "phase": "steady"})
+    assert h.count == 2
+
+
+def test_pallas_kernel_dispatches_are_timed():
+    jnp = pytest.importorskip("jax.numpy")
+    from serf_tpu.ops.round_kernels import merge_incoming, select_packets
+
+    n, k, w = 32, 32, 1
+    stamp = jnp.zeros((n, k), jnp.uint8)
+    known = jnp.ones((n, w), jnp.uint32)
+    alive = jnp.ones((n, 1), jnp.uint8)
+    packets = select_packets(stamp, known, alive, limit=8, round_=0)
+    assert packets.shape == (n, w)
+    merge_incoming(known, packets, alive, stamp, next_round=1)
+
+    summary = dispatch_summary()
+    assert summary["ops.select_packets"]["calls"] == 1
+    assert summary["ops.merge_incoming"]["calls"] == 1
+    sink = metrics.global_sink()
+    assert sink.counter("serf.device.dispatch.calls",
+                        {"op": "ops.select_packets"}) == 1
+    h = sink.histogram_summary(
+        "serf.device.dispatch-ms",
+        {"op": "ops.select_packets", "phase": "compile"})
+    assert h is not None and h.count == 1
+
+
+# -- device-plane model emitters ---------------------------------------------
+
+
+def test_cluster_emitters_populate_device_metrics():
+    jax = pytest.importorskip("jax")
+    from serf_tpu.models.swim import (
+        ClusterConfig,
+        emit_cluster_metrics,
+        make_cluster,
+        run_cluster,
+    )
+    from serf_tpu.models.dissemination import (
+        GossipConfig,
+        K_USER_EVENT,
+        inject_fact,
+    )
+
+    cfg = ClusterConfig(gossip=GossipConfig(n=64, k_facts=32),
+                        push_pull_every=8)
+    state = make_cluster(cfg, jax.random.key(0))
+    g = inject_fact(state.gossip, cfg.gossip, subject=1, kind=K_USER_EVENT,
+                    incarnation=0, ltime=1, origin=0)
+    g = g._replace(alive=g.alive.at[7].set(False))
+    state = state._replace(gossip=g)
+    state = run_cluster(state, cfg, jax.random.key(1), num_rounds=8)
+
+    vals = emit_cluster_metrics(state, cfg)
+    sink = metrics.global_sink()
+    # >= 3 device-plane names, asserted through the SINK (not the return)
+    assert sink.gauge_value("serf.model.gossip.round") == 8.0
+    assert sink.gauge_value("serf.model.gossip.alive") == 63.0
+    assert sink.gauge_value("serf.model.gossip.coverage") > 0.0
+    assert sink.gauge_value("serf.model.vivaldi.error") is not None
+    assert sink.gauge_value("serf.model.swim.live-suspicions") is not None
+    assert vals["serf.model.gossip.facts-valid"] >= 1.0
+    # the full documented gossip/swim/vivaldi families all emitted
+    families = [n for n in vals if n.startswith("serf.model.")]
+    assert len(families) >= 10
+
+
+def test_traffic_model_emitter():
+    from serf_tpu.models.accounting import emit_traffic_metrics, round_traffic
+    from serf_tpu.models.swim import flagship_config
+
+    report = round_traffic(flagship_config(1024, 64))
+    vals = emit_traffic_metrics(report)
+    sink = metrics.global_sink()
+    assert sink.gauge_value("serf.model.traffic.bytes-per-round") == \
+        pytest.approx(report.total_bytes)
+    assert sink.gauge_value("serf.model.traffic.ceiling-rps") > 0
+    dom = report.dominator()
+    assert sink.gauge_value("serf.model.traffic.plane-bytes",
+                            {"plane": dom}) > 0
+    assert vals["serf.model.traffic.bytes-per-round"] > 0
+
+
+# -- metrics lint (tier-1 fast test) -----------------------------------------
+
+
+def test_metrics_lint_readme_in_sync():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "metrics_lint.py")],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- the full-picture scenario -----------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_join_query_leave_scenario_populates_observability():
+    from serf_tpu.host import (
+        EventSubscriber,
+        LoopbackNetwork,
+        QueryParam,
+        Serf,
+    )
+    from serf_tpu.options import Options
+
+    from serf_tpu.host import QueryEvent
+
+    # gossip chatter emits wire spans continuously; a big ring keeps the
+    # one-shot serf.query span in view for the assertions at the end
+    obs.set_global_tracer(TraceBuffer(capacity=65536))
+    net = LoopbackNetwork()
+    sub = EventSubscriber()
+    bsub = EventSubscriber()
+    a = await Serf.create(net.bind("a"), Options.local(), "node-a",
+                          subscriber=sub)
+    b = await Serf.create(net.bind("b"), Options.local(), "node-b",
+                          subscriber=bsub)
+    c = await Serf.create(net.bind("c"), Options.local(), "node-c")
+    try:
+        await b.join("a")
+        await c.join("a")
+
+        async def converged():
+            end = asyncio.get_running_loop().time() + 7.0
+            while asyncio.get_running_loop().time() < end:
+                if all(len(s.members()) == 3 for s in (a, b, c)):
+                    return True
+                await asyncio.sleep(0.02)
+            return False
+
+        assert await converged()
+        await b.user_event("deploy", b"v2")
+
+        async def responder():
+            while True:
+                ev = await bsub.next()
+                if isinstance(ev, QueryEvent) and ev.name == "status":
+                    await ev.respond(b"pong")
+                    return
+
+        task = asyncio.create_task(responder())
+        resp = await a.query("status", b"ping", QueryParam(timeout=2.0))
+        got = [r async for r in resp.responses()]
+        task.cancel()
+        assert got and got[0].payload == b"pong"
+        await c.leave()
+
+        st = a.stats()
+        counters = st.metrics["counters"]
+        # member lifecycle counters
+        assert counters["serf.member.join"] >= 2.0
+        assert counters.get("serf.queries", 0.0) >= 1.0
+        assert counters.get("serf.query.responses", 0.0) >= 1.0
+        # gossip byte histograms + queue gauges (docstring-promised names)
+        hists = st.metrics["histograms"]
+        assert any(h.startswith("serf.messages.sent") for h in hists)
+        assert any(h.startswith("serf.query.rtt-ms") for h in hists)
+        gauges = st.metrics["gauges"]
+        for qname in ("serf.queue.intent", "serf.queue.event",
+                      "serf.queue.query"):
+            assert qname in gauges, (qname, sorted(gauges))
+
+        # trace ring saw the hot paths
+        span_names = {s["name"] for s in st.trace}
+        assert "serf.broadcast.drain" in span_names
+        assert "serf.query" in span_names
+        assert "wire.encode" in span_names and "wire.decode" in span_names
+
+        # flight recorder reconstructs the membership story
+        transitions = [e for e in st.flight if e["kind"] == "member-state"]
+        assert any(e["member"] == "node-b" and e["status"] == "ALIVE"
+                   for e in transitions)
+        swim_moves = [e for e in st.flight if e["kind"] == "swim-state"]
+        assert any(e["member"] == "node-c" for e in swim_moves)
+
+        # Prometheus export round-trips and carries the counters
+        parsed = parse_prometheus_text(prometheus_text())
+        assert parsed[("serf_member_join_total", ())] >= 2.0
+        assert ("serf_queue_event", ()) in parsed
+    finally:
+        for s in (a, b, c):
+            await s.shutdown()

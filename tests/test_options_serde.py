@@ -77,6 +77,8 @@ def test_json_round_trip():
 
 
 def test_toml_round_trip():
+    pytest.importorskip(
+        "tomllib", reason="tomllib requires Python 3.11+")
     opts = _sample_options()
     text = opts.to_toml()
     assert 'tombstone_timeout = "1h30m"' in text
@@ -86,6 +88,8 @@ def test_toml_round_trip():
 
 
 def test_default_options_round_trip_both_formats():
+    pytest.importorskip(
+        "tomllib", reason="tomllib requires Python 3.11+")
     opts = Options()
     assert Options.from_json(opts.to_json()) == opts
     assert Options.from_toml(opts.to_toml()) == opts
@@ -106,6 +110,8 @@ def test_unknown_keys_fail_loudly():
 
 
 def test_loaded_options_validate_and_run():
+    pytest.importorskip(
+        "tomllib", reason="tomllib requires Python 3.11+")
     """A config file's options must be usable end-to-end."""
     o = Options.from_toml(_sample_options().to_toml())
     o.validate()

@@ -6,7 +6,10 @@ tests.rs:9-40) with real shrinking, complementing the seeded fuzz harness.
 import string
 
 import pytest
-from hypothesis import given, settings, strategies as st
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="hypothesis not installed in this image")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from serf_tpu import codec
 from serf_tpu.host import messages as sm

@@ -434,6 +434,8 @@ def _self_signed_cert(tmp_path, hostname="localhost"):
     import datetime
     import ipaddress as ipa
 
+    pytest.importorskip(
+        "cryptography", reason="cryptography not installed in this image")
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import ec
@@ -557,6 +559,8 @@ async def test_key_manager_cluster_rotation():
     """Cluster-wide keyring orchestration (reference key_manager.rs):
     install a new key everywhere, rotate the primary, remove the old key,
     and keep gossiping through every stage."""
+    pytest.importorskip(
+        "cryptography", reason="cryptography not installed in this image")
     from serf_tpu.host.keyring import SecretKeyring
 
     k1 = bytes(range(16))
