@@ -13,6 +13,8 @@ Quick start::
 """
 
 from serf_tpu.host.serf import Serf, SerfState, Stats
+from serf_tpu.obs.cluster import ClusterSnapshot  # Serf.cluster_stats() result
+from serf_tpu.obs.health import HealthReport      # Serf.health_report() result
 from serf_tpu.host.events import (
     EventSubscriber,
     MemberEvent,
@@ -32,6 +34,8 @@ __all__ = [
     "Serf",
     "SerfState",
     "Stats",
+    "ClusterSnapshot",
+    "HealthReport",
     "EventSubscriber",
     "MemberEvent",
     "MemberEventType",

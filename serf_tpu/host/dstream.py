@@ -57,6 +57,7 @@ from typing import Dict, Optional, Tuple
 
 from serf_tpu.host.net import _resolve_address
 from serf_tpu.host.transport import Stream, Transport
+from serf_tpu.utils import metrics
 
 from serf_tpu.utils.logging import get_logger
 
@@ -168,6 +169,7 @@ class _Conn:
             self._fail(f"retransmit budget exhausted to {self.peer}")
             return
         self.rto = min(self.rto * 2.0, RTO_MAX)
+        metrics.incr("serf.dstream.retransmits", 1)
         # multiplicative decrease: a lost round means we overran the path
         self.cwnd = max(float(CWND_MIN), self.cwnd / 2.0)
         self.cwnd_min_seen = min(self.cwnd_min_seen, self.cwnd)
@@ -205,6 +207,7 @@ class _Conn:
         for s in holes:
             self.fast_retx_done.add(s)
             self.fast_retx_count += 1
+            metrics.incr("serf.dstream.retransmits", 1)
             self.t._sendto(self.inflight[s], self.peer)
         if holes:
             self._arm_retx()
@@ -329,6 +332,13 @@ class _Conn:
                     self.rcv_next += 1
             elif len(self.ooo) < MAX_OOO:
                 self.ooo[seq] = (kind, payload)
+            else:
+                # OOO buffer full: the segment is silently re-sent by the
+                # peer later, but a sustained rate here means a
+                # mixed-version or badly mistuned sender is overrunning
+                # us — keep it visible (advisor finding: this degradation
+                # was invisible before the counter)
+                metrics.incr("serf.dstream.ooo_dropped", 1)
             self._send_segment(K_ACK, self.rcv_next, self._sack_bitmap(),
                                track=False)
 

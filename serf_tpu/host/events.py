@@ -65,6 +65,7 @@ class QueryEvent:
     from_node: Node
     relay_factor: int
     deadline: float            # monotonic
+    tctx: object = field(default=None, repr=False)  # TraceContext | None
     _serf: object = field(default=None, repr=False)
     _responded: bool = field(default=False, repr=False)
 
@@ -77,11 +78,23 @@ class QueryEvent:
         if self.expired():
             raise TimeoutError("query deadline already passed")
         serf = self._serf
+        # echo the query's trace context so the originator's flight
+        # recorder can correlate the response with the scattered query
         msg = QueryResponseMessage(
             ltime=self.ltime, id=self.id, from_node=serf.memberlist.local_node(),
-            flags=QueryFlag.NONE, payload=payload,
+            flags=QueryFlag.NONE, payload=payload, tctx=self.tctx,
         )
         raw = encode_message(msg)
+        if (len(raw) > serf.opts.query_response_size_limit
+                and self.tctx is not None):
+            # the trace echo is best-effort metadata: shed it before
+            # failing a payload that fit the documented budget on its own
+            msg = QueryResponseMessage(
+                ltime=self.ltime, id=self.id,
+                from_node=serf.memberlist.local_node(),
+                flags=QueryFlag.NONE, payload=payload,
+            )
+            raw = encode_message(msg)
         if len(raw) > serf.opts.query_response_size_limit:
             raise ValueError(
                 f"query response is {len(raw)} bytes, limit "
@@ -116,13 +129,18 @@ class EventSubscriber:
     def __init__(self, maxsize: int = 4096, lossless: bool = False):
         self._q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
         self.lossless = lossless
-        #: events discarded by drop-oldest overflow (always 0 in
-        #: lossless mode)
+        #: events discarded by drop-oldest overflow (stays 0 in lossless
+        #: mode unless a sync producer violates the contract — see _push)
         self.dropped = 0
+        #: drop-oldest firings on a lossless subscriber (contract breaks)
+        self.lossless_violations = 0
 
     def _push(self, ev) -> None:
         """Synchronous push: drop-oldest semantics regardless of mode —
-        prefer ``push`` from async producers (it honors lossless)."""
+        prefer ``push`` from async producers (it honors lossless).  A
+        drop on a ``lossless=True`` subscriber is a CONTRACT VIOLATION
+        (some sync producer bypassed the awaiting ``push``): it is
+        logged loudly and flight-recorded rather than silently eaten."""
         while True:
             try:
                 self._q.put_nowait(ev)
@@ -132,10 +150,24 @@ class EventSubscriber:
                     dropped_ev = self._q.get_nowait()  # drop oldest
                     self.dropped += 1
                     metrics.incr("serf.subscriber.dropped", 1)
-                    flight.record("subscriber-drop",
-                                  event=type(dropped_ev).__name__,
-                                  total_dropped=self.dropped)
-                    log.warning("event subscriber overflow: dropping oldest event")
+                    if self.lossless:
+                        self.lossless_violations += 1
+                        metrics.incr("serf.subscriber.lossless_violation", 1)
+                        flight.record("subscriber-drop",
+                                      event=type(dropped_ev).__name__,
+                                      total_dropped=self.dropped,
+                                      contract="lossless")
+                        log.warning(
+                            "LOSSLESS subscriber overflowed: a synchronous "
+                            "producer forced drop-oldest, violating the "
+                            "no-loss contract (%d violations so far)",
+                            self.lossless_violations)
+                    else:
+                        flight.record("subscriber-drop",
+                                      event=type(dropped_ev).__name__,
+                                      total_dropped=self.dropped)
+                        log.warning(
+                            "event subscriber overflow: dropping oldest event")
                 except asyncio.QueueEmpty:
                     pass
 

@@ -4,6 +4,10 @@ application.
 Reference: serf-core/src/serf/internal_query.rs:32-486 — `_serf_ping`,
 `_serf_conflict` (answer with our view of the conflicted id's address), and
 the four keyring ops, with size-aware truncation of key-list responses.
+
+Beyond the reference set, `_serf_stats` (PR 2) answers with this node's
+compact health/stats self-report (``serf_tpu.obs.cluster``) — the
+responder half of ``Serf.cluster_stats()``'s gossip-native aggregation.
 """
 
 from __future__ import annotations
@@ -43,6 +47,8 @@ async def handle_internal_query(serf, ev: QueryEvent) -> None:
             await _handle_key_op(serf, ev, "remove")
         elif ev.name == "_serf_list_keys":
             await _handle_list_keys(serf, ev)
+        elif ev.name == "_serf_stats":
+            await _handle_stats(serf, ev)
         else:
             log.warning("unhandled internal query %r", ev.name)
     except Exception:  # noqa: BLE001
@@ -61,6 +67,16 @@ async def _handle_conflict(serf, ev: QueryEvent) -> None:
     if ms is None:
         return
     await ev.respond(encode_message(ConflictResponseMessage(ms.member)))
+
+
+async def _handle_stats(serf, ev: QueryEvent) -> None:
+    """Answer with this node's health/stats self-report (the scatter half
+    lives in ``serf_tpu.obs.cluster.collect_cluster_stats``)."""
+    from serf_tpu.obs.cluster import node_stats_payload
+    try:
+        await ev.respond(node_stats_payload(serf))
+    except (TimeoutError, ValueError) as e:
+        log.warning("could not respond to %r: %s", ev.name, e)
 
 
 def _keyring_or_error(serf):

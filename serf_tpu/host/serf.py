@@ -19,7 +19,7 @@ import asyncio
 import enum
 import random
 import time
-from dataclasses import dataclass, field as dataclass_field
+from dataclasses import dataclass, field as dataclass_field, replace
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from serf_tpu import codec
@@ -77,7 +77,8 @@ from serf_tpu.types.messages import (
 )
 from serf_tpu.types.tags import Tags
 from serf_tpu import obs
-from serf_tpu.obs.trace import span
+from serf_tpu.obs.health import HealthReport, HealthScorer, serf_sources
+from serf_tpu.obs.trace import new_trace, span, trace_scope
 from serf_tpu.utils import metrics
 
 from serf_tpu.utils.logging import get_logger
@@ -91,7 +92,17 @@ INTERNAL_INSTALL_KEY = "_serf_install_key"
 INTERNAL_USE_KEY = "_serf_use_key"
 INTERNAL_REMOVE_KEY = "_serf_remove_key"
 INTERNAL_LIST_KEYS = "_serf_list_keys"
+INTERNAL_STATS = "_serf_stats"       # cluster stats aggregation (obs.cluster)
 PING_VERSION = 1
+
+#: bound on the event tee queue between the protocol and the delivery
+#: pipeline — a wedged LOSSLESS subscriber backpressures the pipeline
+#: task at this depth instead of growing process memory without limit
+TEE_QUEUE_MAX = 4096
+
+#: bound on user events deferred while a join(ignore_old=True) is still
+#: computing its event-time cutoff (joins are sub-second; this is ample)
+DEFERRED_EVENTS_MAX = 4096
 
 
 class SerfState(enum.IntEnum):
@@ -126,6 +137,8 @@ class Stats:
     trace: list = dataclass_field(default_factory=list)
     #: flight-recorder events, oldest first (obs.flight ring)
     flight: list = dataclass_field(default_factory=list)
+    #: Lifeguard-style node health report (obs.health): score + components
+    health: dict = dataclass_field(default_factory=dict)
 
 
 class _SerfSwimDelegate(SwimDelegate):
@@ -373,6 +386,7 @@ class Serf:
         self._event_buffer: List[Optional[UserEvents]] = [None] * opts.event_buffer_size
         self._event_min_time: LamportTime = 0
         self._event_join_ignore = False
+        self._deferred_events: List[UserEventMessage] = []
         self._query_buffer: List[Optional[Tuple[LamportTime, Set[int]]]] = \
             [None] * opts.query_buffer_size
         self._query_min_time: LamportTime = 0
@@ -411,6 +425,11 @@ class Serf:
         self._subscriber: Optional[EventSubscriber] = None
         self.snapshotter = None  # wired by serf_tpu.host.snapshot
         self._key_manager = None
+
+        # health plane (obs.health): sources read engine state lazily
+        self._tee_queue: Optional[asyncio.Queue] = None
+        self._loop_lag_ewma_ms = 0.0
+        self._health = HealthScorer(serf_sources(self))
 
         self._tasks: List[asyncio.Task] = []
         self._bg: set = set()
@@ -479,6 +498,8 @@ class Serf:
         # background tasks (reference base.rs:284-335)
         s._tasks.append(asyncio.create_task(s._reaper(), name=f"serf-reaper-{node_id}"))
         s._tasks.append(asyncio.create_task(s._reconnector(), name=f"serf-reconnect-{node_id}"))
+        s._tasks.append(asyncio.create_task(
+            s._health_monitor(), name=f"serf-health-{node_id}"))
         for qname, q in (("intent", s.intent_broadcasts),
                          ("event", s.event_broadcasts),
                          ("query", s.query_broadcasts)):
@@ -501,7 +522,20 @@ class Serf:
         # subscriber backpressures the delivery stage — otherwise a
         # stalled consumer would freeze snapshot persistence and a crash
         # in that window would replay a stale alive-set.
-        mid: asyncio.Queue = asyncio.Queue()
+        #
+        # The tee queue is BOUNDED (advisor finding: it was unbounded):
+        # the snapshotter observes each event BEFORE the awaited put, so
+        # everything buffered in the tee is already persisted.  The bound
+        # caps THIS buffer and moves the backpressure point: once a
+        # wedged lossless consumer holds the tee at TEE_QUEUE_MAX, the
+        # tee task blocks and later events wait in ``_event_inbox``
+        # (not yet snapshotter-observed) — which is why the depth gauge
+        # and the health-score ``tee`` component (``event_tee_fill``)
+        # count BOTH stages: the signal saturates while the wedge is
+        # forming instead of after memory is already gone.
+        mid: asyncio.Queue = asyncio.Queue(maxsize=TEE_QUEUE_MAX)
+        self._tee_queue = mid
+        gauge_labels = {**self._labels, "node": self.local_id}
 
         async def tee() -> None:
             while True:
@@ -509,6 +543,9 @@ class Serf:
                 if ev is not None and self.snapshotter is not None:
                     self.snapshotter.observe(ev)
                 await mid.put(ev)
+                metrics.gauge("serf.events.tee_depth",
+                              mid.qsize() + self._event_inbox.qsize(),
+                              gauge_labels)
                 if ev is None:
                     return
 
@@ -516,6 +553,9 @@ class Serf:
         try:
             while True:
                 ev = await mid.get()
+                metrics.gauge("serf.events.tee_depth",
+                              mid.qsize() + self._event_inbox.qsize(),
+                              gauge_labels)
                 if ev is None:
                     return
                 await self._subscriber.push(ev)
@@ -622,6 +662,7 @@ class Serf:
             metrics=obs.metrics_snapshot(),
             trace=obs.trace_dump(),
             flight=obs.flight_dump(),
+            health=self.health_report().to_dict(),
             members=len(self._members),
             failed=len(self._failed),
             left=len(self._left),
@@ -636,6 +677,67 @@ class Serf:
             coordinate_resets=(self.coord_client.stats()["resets"]
                                if self.coord_client else 0),
         )
+
+    # -- health / cluster observability -------------------------------------
+
+    def event_tee_fill(self) -> float:
+        """Fill fraction of the event delivery path: tee queue PLUS the
+        inbox behind it (events the blocked tee has not yet persisted),
+        over the tee bound — so the health signal keeps climbing past
+        1.0-clamp territory while a wedged consumer backs the whole
+        pipeline up.  0.0 when the passthrough pipeline is not running."""
+        q = self._tee_queue
+        if q is None or q.maxsize <= 0:
+            return 0.0
+        return (q.qsize() + self._event_inbox.qsize()) / q.maxsize
+
+    def loop_lag_ms(self) -> float:
+        """EWMA of event-loop scheduling lag (ms), fed by the health
+        monitor — how late our timers fire under load."""
+        return self._loop_lag_ewma_ms
+
+    def health_report(self, consume: bool = False) -> HealthReport:
+        """Sample the Lifeguard-style node health score (obs.health) and
+        export ``serf.health.score`` + per-component load gauges, labeled
+        with the node id so co-located nodes stay distinguishable.
+        Only the periodic monitor passes ``consume=True`` (advancing the
+        counter-delta baselines); on-demand calls observe without
+        shrinking the measurement window."""
+        report = self._health.sample(consume=consume)
+        labels = {**self._labels, "node": self.local_id}
+        metrics.gauge("serf.health.score", report.score, labels)
+        for name, comp in report.components.items():
+            metrics.gauge(f"serf.health.component.{name}", comp.load, labels)
+        return report
+
+    async def cluster_stats(self, params: Optional[QueryParam] = None):
+        """Scatter the ``_serf_stats`` internal query over the cluster and
+        fold every node's health + key metrics into one
+        ``obs.cluster.ClusterSnapshot`` (min/p50/max aggregates,
+        unhealthy-node list, membership-view divergence).  ``params``
+        tunes the underlying query (e.g. a longer timeout for large
+        clusters)."""
+        from serf_tpu.obs.cluster import collect_cluster_stats
+        return await collect_cluster_stats(self, params)
+
+    async def _health_monitor(self) -> None:
+        """Periodic health plane tick: measure event-loop lag (sleep
+        overshoot), refresh the EWMA + gauges, re-sample the health
+        score."""
+        interval = max(0.05, self.opts.health_interval)
+        loop = asyncio.get_running_loop()
+        while not self._shutdown_event.is_set():
+            t0 = loop.time()
+            await asyncio.sleep(interval)
+            lag_ms = max(0.0, loop.time() - t0 - interval) * 1e3
+            self._loop_lag_ewma_ms = (0.8 * self._loop_lag_ewma_ms
+                                      + 0.2 * lag_ms)
+            metrics.gauge("serf.loop.lag-ms", self._loop_lag_ewma_ms,
+                          {**self._labels, "node": self.local_id})
+            try:
+                self.health_report(consume=True)
+            except Exception:  # noqa: BLE001
+                log.exception("health monitor tick failed")
 
     def coordinate(self) -> Optional[Coordinate]:
         return self.coord_client.get_coordinate() if self.coord_client else None
@@ -656,6 +758,7 @@ class Serf:
                 await self._broadcast_join(self.clock.increment())
             finally:
                 self._event_join_ignore = False
+                self._flush_deferred_events()
 
     async def join_many(self, addrs: Sequence, ignore_old: bool = False
                         ) -> Tuple[int, List[Exception]]:
@@ -670,6 +773,19 @@ class Serf:
                 return ok, errs
             finally:
                 self._event_join_ignore = False
+                self._flush_deferred_events()
+
+    def _flush_deferred_events(self) -> None:
+        """Re-run user events deferred during a join(ignore_old=True):
+        ``_event_min_time`` is settled now, so the normal handler drops
+        the pre-join ones and delivers the rest in arrival order.  No
+        rebroadcast — we were not their origin, and the cluster gossiped
+        them while we were joining."""
+        if not self._deferred_events:
+            return
+        pending, self._deferred_events = self._deferred_events, []
+        for msg in pending:
+            self._handle_user_event(msg, rebroadcast=False)
 
     async def _broadcast_join(self, ltime: LamportTime) -> None:
         """(reference base.rs:364-397)"""
@@ -757,14 +873,15 @@ class Serf:
         if size > USER_EVENT_SIZE_LIMIT:
             raise ValueError(f"user event exceeds sane limit of {USER_EVENT_SIZE_LIMIT} bytes")
         ltime = self.event_clock.increment()
-        msg = UserEventMessage(ltime, name, payload, coalesce)
+        tctx = new_trace(self.local_id)
+        msg = UserEventMessage(ltime, name, payload, coalesce, tctx)
         raw = encode_message(msg)
         if len(raw) > USER_EVENT_SIZE_LIMIT:
             raise ValueError(
                 f"encoded user event exceeds sane limit of {USER_EVENT_SIZE_LIMIT} bytes")
         # metrics are counted once, inside the handler (reference base.rs:818)
-        with span("serf.user-event", node=self.local_id, event=name,
-                  bytes=len(raw)):
+        with trace_scope(tctx), span("serf.user-event", node=self.local_id,
+                                     event=name, bytes=len(raw)):
             self._handle_user_event(msg, rebroadcast=False)
             self._queue(self.event_broadcasts, raw)
 
@@ -784,11 +901,13 @@ class Serf:
         flags = QueryFlag.NONE
         if params.request_ack:
             flags |= QueryFlag.ACK
+        tctx = new_trace(self.local_id)
         msg = QueryMessage(
             ltime=ltime, id=qid, from_node=self.memberlist.local_node(),
             filters=tuple(params.filters), flags=flags,
             relay_factor=params.relay_factor,
             timeout_ns=int(timeout * 1e9), name=name, payload=payload,
+            tctx=tctx,
         )
         raw = encode_message(msg)
         if len(raw) > self.opts.query_size_limit:
@@ -797,8 +916,8 @@ class Serf:
                              len(self._members))
         self._query_responses[(ltime, qid)] = resp
         self._spawn(self._expire_query(resp), "serf-query-expire")
-        with span("serf.query", node=self.local_id, query=name,
-                  bytes=len(raw)):
+        with trace_scope(tctx), span("serf.query", node=self.local_id,
+                                     query=name, bytes=len(raw)):
             self._handle_query(msg, rebroadcast=False)
             self._queue(self.query_broadcasts, raw)
         return resp
@@ -833,10 +952,10 @@ class Serf:
                 self._queue(self.intent_broadcasts, raw)
         elif isinstance(msg, UserEventMessage):
             if self._handle_user_event(msg):
-                self._queue(self.event_broadcasts, raw)
+                self._queue(self.event_broadcasts, self._hop_raw(msg, raw))
         elif isinstance(msg, QueryMessage):
             if self._handle_query(msg):
-                self._queue(self.query_broadcasts, raw)
+                self._queue(self.query_broadcasts, self._hop_raw(msg, raw))
         elif isinstance(msg, QueryResponseMessage):
             self._handle_query_response(msg)
         elif isinstance(msg, RelayMessage):
@@ -858,6 +977,17 @@ class Serf:
     def _queue(self, q: TransmitLimitedQueue, raw: bytes,
                notify: Optional[asyncio.Event] = None) -> None:
         q.queue_broadcast(Broadcast(raw, name=None, notify=notify))
+
+    @staticmethod
+    def _hop_raw(msg, raw: bytes) -> bytes:
+        """Bytes to rebroadcast: when the message carries a trace context,
+        re-encode with the hop count bumped so downstream flight events
+        record their dissemination depth; untraced messages forward the
+        original bytes untouched (zero re-encode cost)."""
+        tctx = getattr(msg, "tctx", None)
+        if tctx is None:
+            return raw
+        return encode_message(replace(msg, tctx=tctx.hop()))
 
     # ------------------------------------------------------------------
     # member-event handlers (reference base.rs:1206-1866)
@@ -1053,6 +1183,17 @@ class Serf:
                            rebroadcast: bool = True) -> bool:
         """(reference base.rs:750-837); returns whether to rebroadcast."""
         self.event_clock.witness(msg.ltime)
+        if self._event_join_ignore:
+            # A join(ignore_old=True) is in flight: until its push/pull
+            # merge computes ``_event_min_time`` we cannot tell a
+            # pre-join event (to be ignored) from a concurrent fresh one
+            # — and gossip can beat the merge, leaking "old" events to
+            # the subscriber.  Defer everything (the join-merge replay
+            # included) and re-run against the settled cutoff when the
+            # join finishes (_flush_deferred_events).
+            if len(self._deferred_events) < DEFERRED_EVENTS_MAX:
+                self._deferred_events.append(msg)
+            return False
         if msg.ltime < self._event_min_time:
             return False
         buf_len = len(self._event_buffer)
@@ -1073,6 +1214,14 @@ class Serf:
             self._event_buffer[idx] = UserEvents(msg.ltime, (msg,))
         metrics.incr("serf.events", 1, self._labels)
         metrics.incr(f"serf.events.{msg.name}", 1, self._labels)
+        with trace_scope(msg.tctx):
+            # trace-stamped while the event's context is active: the same
+            # trace id lands in the flight ring of every node that accepts
+            # this event (origin included — user_event() reuses this path)
+            obs.record("user-event", node=self.local_id, event=msg.name,
+                       ltime=msg.ltime,
+                       **({"origin": msg.tctx.origin, "hops": msg.tctx.hops}
+                          if msg.tctx is not None else {}))
         self._emit(UserEvent(msg.ltime, msg.name, msg.payload, msg.cc))
         return True
 
@@ -1100,22 +1249,33 @@ class Serf:
         metrics.incr(f"serf.queries.{msg.name}", 1, self._labels)
         if not should_process_query(msg.filters, self.local_id, self._tags):
             return rebroadcast_out
-        if msg.ack():
-            ack = QueryResponseMessage(
-                ltime=msg.ltime, id=msg.id,
-                from_node=self.memberlist.local_node(), flags=QueryFlag.ACK)
-            raw = encode_message(ack)
-            self._spawn(self._send_and_relay(msg, raw), "serf-query-ack")
-        ev = QueryEvent(
-            ltime=msg.ltime, name=msg.name, payload=msg.payload, id=msg.id,
-            from_node=msg.from_node, relay_factor=msg.relay_factor,
-            deadline=time.monotonic() + msg.timeout_ns / 1e9, _serf=self,
-        )
-        if msg.name.startswith("_serf_"):
-            from serf_tpu.host.internal_query import handle_internal_query
-            self._spawn(handle_internal_query(self, ev), "serf-internal-query")
-        else:
-            self._emit(ev)
+        # the trace scope covers flight recording, the ack send, and —
+        # because create_task snapshots contextvars — the spawned internal
+        # query handler, so responder-side spans carry the query's trace id
+        with trace_scope(msg.tctx):
+            obs.record("query-received", node=self.local_id, query=msg.name,
+                       ltime=msg.ltime, qid=msg.id,
+                       **({"origin": msg.tctx.origin, "hops": msg.tctx.hops}
+                          if msg.tctx is not None else {}))
+            if msg.ack():
+                ack = QueryResponseMessage(
+                    ltime=msg.ltime, id=msg.id,
+                    from_node=self.memberlist.local_node(),
+                    flags=QueryFlag.ACK, tctx=msg.tctx)
+                raw = encode_message(ack)
+                self._spawn(self._send_and_relay(msg, raw), "serf-query-ack")
+            ev = QueryEvent(
+                ltime=msg.ltime, name=msg.name, payload=msg.payload, id=msg.id,
+                from_node=msg.from_node, relay_factor=msg.relay_factor,
+                deadline=time.monotonic() + msg.timeout_ns / 1e9,
+                tctx=msg.tctx, _serf=self,
+            )
+            if msg.name.startswith("_serf_"):
+                from serf_tpu.host.internal_query import handle_internal_query
+                self._spawn(handle_internal_query(self, ev),
+                            "serf-internal-query")
+            else:
+                self._emit(ev)
         return rebroadcast_out
 
     async def _send_and_relay(self, msg: QueryMessage, raw: bytes) -> None:
@@ -1127,6 +1287,11 @@ class Serf:
         resp = self._query_responses.get((msg.ltime, msg.id))
         if resp is None:
             return
+        if msg.tctx is not None:
+            # close the cross-node loop: the responder echoed our trace id
+            obs.record("query-response", node=self.local_id,
+                       responder=msg.from_node.id, ack=msg.ack(),
+                       trace=msg.tctx.hex_id, hops=msg.tctx.hops)
         if msg.ack():
             resp.handle_ack(msg.from_node.id, self._labels)
         else:

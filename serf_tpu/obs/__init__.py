@@ -20,6 +20,15 @@ package is that surface for the reproduction, spanning BOTH planes:
   device plane with a jit-compile-vs-steady-state split, used by
   ``serf_tpu/ops/round_kernels.py`` and ``bench.py``; the per-model
   metric emitters live next to their states (``models/*.emit_*``).
+- :mod:`serf_tpu.obs.health` — Lifeguard-style 0-100 node health score
+  folded from local signals (probe awareness, queue/tee pressure,
+  event-loop lag, flight/transport drop growth); ``serf.health.*`` gauges.
+- :mod:`serf_tpu.obs.cluster` — the cluster plane: the ``_serf_stats``
+  internal query scatters over the gossip fabric and folds every node's
+  health + key metrics into one ``ClusterSnapshot``
+  (``Serf.cluster_stats()``; rendered by ``tools/obstop.py``).  Trace
+  contexts (``obs.trace.TraceContext``) ride query/user-event wire
+  messages so spans and flight events correlate across nodes.
 
 Everything is process-global with swap-out setters, mirroring the
 ``metrics`` facade already in place.
@@ -28,10 +37,14 @@ Everything is process-global with swap-out setters, mirroring the
 from serf_tpu.obs.trace import (  # noqa: F401
     Span,
     TraceBuffer,
+    TraceContext,
+    current_trace,
     global_tracer,
+    new_trace,
     set_global_tracer,
     span,
     trace_dump,
+    trace_scope,
 )
 from serf_tpu.obs.flight import (  # noqa: F401
     FlightRecorder,
@@ -52,14 +65,30 @@ from serf_tpu.obs.device import (  # noqa: F401
     record_dispatch,
     reset_dispatch_registry,
 )
+from serf_tpu.obs.health import (  # noqa: F401
+    HealthReport,
+    HealthScorer,
+    UNHEALTHY_THRESHOLD,
+    serf_sources,
+)
+from serf_tpu.obs.cluster import (  # noqa: F401
+    ClusterSnapshot,
+    STATS_QUERY,
+    collect_cluster_stats,
+    render_table,
+)
 
 __all__ = [
     "Span", "TraceBuffer", "span", "trace_dump",
     "global_tracer", "set_global_tracer",
+    "TraceContext", "new_trace", "current_trace", "trace_scope",
     "FlightRecorder", "record", "flight_dump",
     "global_recorder", "set_global_recorder",
     "prometheus_text", "parse_prometheus_text",
     "json_snapshot", "metrics_snapshot",
     "dispatch_timer", "dispatch_summary", "record_dispatch",
     "reset_dispatch_registry",
+    "HealthScorer", "HealthReport", "UNHEALTHY_THRESHOLD", "serf_sources",
+    "ClusterSnapshot", "STATS_QUERY", "collect_cluster_stats",
+    "render_table",
 ]

@@ -17,6 +17,13 @@ Event kinds emitted by the engine (see README "Observability"):
 - ``broadcast-retired``    a broadcast exhausted its transmit budget
 - ``probe-failed``    direct+indirect probe round failed (suspect next)
 - ``packet-dropped``  wire decode/decrypt failure dropped a packet
+- ``query-received``  a query reached this node (stamped with its trace id)
+- ``query-response``  a response/ack came back to the originating node
+- ``user-event``      a fresh user event was accepted locally
+
+Events recorded while a cross-node trace is active (``obs.trace
+.trace_scope``) carry a ``trace`` field — the hex trace id shared by
+every node the traced operation touched.
 """
 
 from __future__ import annotations
@@ -24,6 +31,8 @@ from __future__ import annotations
 import threading
 import time
 from typing import Any, Dict, List, Optional
+
+from serf_tpu.obs import trace as _trace
 
 #: events retained (ring, drop-oldest)
 FLIGHT_RING_SIZE = 512
@@ -48,6 +57,11 @@ class FlightRecorder:
         }
         if node is not None:
             ev["node"] = node
+        # cross-node correlation: stamp the active trace id (if any) so
+        # flight events on every node a query/event touches share one key
+        tc = _trace.current_trace()
+        if tc is not None and "trace" not in fields:
+            ev["trace"] = tc.hex_id
         ev.update(fields)
         with self._lock:
             self.recorded += 1

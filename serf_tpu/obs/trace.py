@@ -11,17 +11,28 @@ Finished spans land in a bounded :class:`TraceBuffer` (drop-oldest), and
 every finished span also feeds the ``serf.trace.span-ms`` histogram
 (label ``span=<name>``) so aggregate latencies survive after the raw
 spans rotate out of the ring.
+
+Cross-node propagation (PR 2): a :class:`TraceContext` — 16-byte random
+trace id, origin node id, hop count — rides query and user-event wire
+messages (``serf_tpu.types.messages``).  ``trace_scope(ctx)`` installs it
+in a contextvar; while active, every span opened AND every flight-recorder
+event recorded (``obs.flight``) is stamped with the trace id, so one
+query fired on node A produces correlated spans/flight events on every
+node that relays or answers it.  The context is observability metadata
+only: a missing or malformed context never affects protocol behavior.
 """
 
 from __future__ import annotations
 
 import contextvars
 import itertools
+import os
 import threading
 import time
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
+from serf_tpu.types.trace import TRACE_ID_LEN, TraceContext  # noqa: F401
 from serf_tpu.utils import metrics
 
 #: finished spans retained (ring, drop-oldest)
@@ -37,6 +48,33 @@ _ring_counts: Dict[str, int] = {}
 _current_span: contextvars.ContextVar[Optional["Span"]] = \
     contextvars.ContextVar("serf_tpu_current_span", default=None)
 _ids = itertools.count(1)
+
+_current_trace: contextvars.ContextVar[Optional[TraceContext]] = \
+    contextvars.ContextVar("serf_tpu_current_trace", default=None)
+
+
+def new_trace(origin: str) -> TraceContext:
+    """Mint a fresh trace context rooted at ``origin`` (hop 0)."""
+    return TraceContext(os.urandom(TRACE_ID_LEN), origin, 0)
+
+
+def current_trace() -> Optional[TraceContext]:
+    return _current_trace.get()
+
+
+@contextmanager
+def trace_scope(ctx: Optional[TraceContext]):
+    """Install ``ctx`` as the active trace for the block; spans opened and
+    flight events recorded inside are stamped with its trace id.  A None
+    context is a no-op scope (callers never need to branch)."""
+    if ctx is None:
+        yield None
+        return
+    token = _current_trace.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _current_trace.reset(token)
 
 
 class Span:
@@ -157,7 +195,12 @@ class _LiteSpan:
 
 @contextmanager
 def span(name: str, **attrs):
-    """Time a block; nest under the caller's active span (if any)."""
+    """Time a block; nest under the caller's active span (if any).  When a
+    cross-node trace is active (``trace_scope``), the span is stamped with
+    its trace id under the ``trace`` attr."""
+    tc = _current_trace.get()
+    if tc is not None and "trace" not in attrs:
+        attrs["trace"] = tc.hex_id
     every = RING_SAMPLE_EVERY.get(name, 1)
     if every > 1:
         n = _ring_counts.get(name, 0)

@@ -178,6 +178,7 @@ class Options:
     tombstone_timeout: float = 24 * 3600.0
     flap_timeout: float = 60.0
     queue_check_interval: float = 30.0
+    health_interval: float = 5.0          # health-score / loop-lag monitor
     queue_depth_warning: int = 128
     max_queue_depth: int = 4096
     min_queue_depth: int = 0
@@ -217,6 +218,7 @@ class Options:
             reconnect_interval=1.0,
             recent_intent_timeout=5.0,
             queue_check_interval=1.0,
+            health_interval=0.25,
         )
         defaults.update(kw)
         return cls(**defaults)
@@ -310,7 +312,7 @@ _OPTIONS_DURATIONS = frozenset({
     "quiescent_period", "user_coalesce_period", "user_quiescent_period",
     "reap_interval", "reconnect_interval", "reconnect_timeout",
     "tombstone_timeout", "flap_timeout", "queue_check_interval",
-    "recent_intent_timeout",
+    "health_interval", "recent_intent_timeout",
 })
 _ML_DURATIONS = frozenset({
     "gossip_interval", "probe_interval", "probe_timeout",

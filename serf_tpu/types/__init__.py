@@ -25,6 +25,7 @@ from serf_tpu.types.messages import (
     encode_relay_message,
 )
 from serf_tpu.types.filters import Filter, IdFilter, TagFilter
+from serf_tpu.types.trace import TraceContext
 
 __all__ = [
     "LamportClock",
@@ -52,4 +53,5 @@ __all__ = [
     "Filter",
     "IdFilter",
     "TagFilter",
+    "TraceContext",
 ]

@@ -10,12 +10,23 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from serf_tpu import codec
+from serf_tpu.types.trace import TraceContext
 from serf_tpu.types.clock import LamportTime
 from serf_tpu.types.member import Member, Node
 from serf_tpu.types.filters import Filter, decode_filter
+
+
+def _decode_tctx(buf: bytes) -> Optional[TraceContext]:
+    """Trace context is observability metadata: malformed bytes degrade to
+    'no context' instead of failing the whole message (the fail-closed
+    DecodeError contract stays scoped to protocol-bearing fields)."""
+    try:
+        return TraceContext.decode(buf)
+    except (codec.DecodeError, TypeError, ValueError, UnicodeDecodeError):
+        return None
 
 
 class MessageType(enum.IntEnum):
@@ -101,6 +112,7 @@ class UserEventMessage:
     name: str
     payload: bytes = b""
     cc: bool = False  # coalesce-control flag
+    tctx: Optional[TraceContext] = None  # cross-node trace (obs metadata)
 
     TYPE = MessageType.USER_EVENT
 
@@ -111,11 +123,13 @@ class UserEventMessage:
             out += codec.encode_bytes_field(3, self.payload)
         if self.cc:
             out += codec.encode_varint_field(4, 1)
+        if self.tctx is not None:
+            out += codec.encode_bytes_field(5, self.tctx.encode())
         return out
 
     @classmethod
     def decode_body(cls, buf: bytes) -> "UserEventMessage":
-        lt, name, payload, cc = 0, "", b"", False
+        lt, name, payload, cc, tctx = 0, "", b"", False, None
         for f, _wt, v, _p in codec.iter_fields(buf):
             if f == 1:
                 lt = codec.as_uint(v)
@@ -125,7 +139,9 @@ class UserEventMessage:
                 payload = codec.as_bytes(v)
             elif f == 4:
                 cc = bool(codec.as_uint(v))
-        return cls(lt, name, payload, cc)
+            elif f == 5:
+                tctx = _decode_tctx(codec.as_bytes(v))
+        return cls(lt, name, payload, cc, tctx)
 
 
 @dataclass(frozen=True)
@@ -222,6 +238,7 @@ class QueryMessage:
     timeout_ns: int = 0
     name: str = ""
     payload: bytes = b""
+    tctx: Optional[TraceContext] = None  # cross-node trace (obs metadata)
 
     TYPE = MessageType.QUERY
 
@@ -243,12 +260,15 @@ class QueryMessage:
         out += codec.encode_str_field(8, self.name)
         if self.payload:
             out += codec.encode_bytes_field(9, self.payload)
+        if self.tctx is not None:
+            out += codec.encode_bytes_field(10, self.tctx.encode())
         return out
 
     @classmethod
     def decode_body(cls, buf: bytes) -> "QueryMessage":
         kw = dict(ltime=0, id=0, from_node=Node(""), flags=QueryFlag.NONE,
-                  relay_factor=0, timeout_ns=0, name="", payload=b"")
+                  relay_factor=0, timeout_ns=0, name="", payload=b"",
+                  tctx=None)
         filters: List[Filter] = []
         for f, _wt, v, _p in codec.iter_fields(buf):
             if f == 1:
@@ -269,6 +289,8 @@ class QueryMessage:
                 kw["name"] = codec.as_str(v)
             elif f == 9:
                 kw["payload"] = codec.as_bytes(v)
+            elif f == 10:
+                kw["tctx"] = _decode_tctx(codec.as_bytes(v))
         return cls(filters=tuple(filters), **kw)
 
 
@@ -281,6 +303,7 @@ class QueryResponseMessage:
     from_node: Node = field(default_factory=lambda: Node(""))
     flags: QueryFlag = QueryFlag.NONE
     payload: bytes = b""
+    tctx: Optional[TraceContext] = None  # echoed from the query (obs)
 
     TYPE = MessageType.QUERY_RESPONSE
 
@@ -294,11 +317,14 @@ class QueryResponseMessage:
         out += codec.encode_varint_field(4, int(self.flags))
         if self.payload:
             out += codec.encode_bytes_field(5, self.payload)
+        if self.tctx is not None:
+            out += codec.encode_bytes_field(6, self.tctx.encode())
         return out
 
     @classmethod
     def decode_body(cls, buf: bytes) -> "QueryResponseMessage":
-        lt, qid, frm, flags, payload = 0, 0, Node(""), QueryFlag.NONE, b""
+        lt, qid, frm, flags, payload, tctx = (
+            0, 0, Node(""), QueryFlag.NONE, b"", None)
         for f, _wt, v, _p in codec.iter_fields(buf):
             if f == 1:
                 lt = codec.as_uint(v)
@@ -310,7 +336,9 @@ class QueryResponseMessage:
                 flags = QueryFlag(codec.as_uint(v))
             elif f == 5:
                 payload = codec.as_bytes(v)
-        return cls(lt, qid, frm, flags, payload)
+            elif f == 6:
+                tctx = _decode_tctx(codec.as_bytes(v))
+        return cls(lt, qid, frm, flags, payload, tctx)
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,506 @@
+"""Cluster-plane observability (PR 2): cross-node trace propagation, node
+health scoring, and gossip-native `_serf_stats` aggregation.
+
+Acceptance pins:
+
+- on a 3-node in-proc cluster, ``Serf.cluster_stats()`` returns a
+  ``ClusterSnapshot`` covering all 3 nodes with per-node health scores;
+- a query initiated on node A yields flight-recorder entries sharing one
+  trace id on at least 2 nodes;
+- the ``tools/obstop.py --json`` self-check (the tier-1 cluster-plane
+  contract hook) exits 0 and reports a complete snapshot.
+"""
+
+import asyncio
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from serf_tpu import codec, obs
+from serf_tpu.obs.cluster import (
+    ClusterSnapshot,
+    decode_node_stats,
+    fold_snapshot,
+    membership_digest,
+    render_table,
+)
+from serf_tpu.obs.flight import FlightRecorder
+from serf_tpu.obs.health import (
+    DEFAULT_SPECS,
+    HealthScorer,
+    UNHEALTHY_THRESHOLD,
+)
+from serf_tpu.obs.trace import (
+    TraceBuffer,
+    TraceContext,
+    current_trace,
+    new_trace,
+    span,
+    trace_scope,
+)
+from serf_tpu.types.member import Node
+from serf_tpu.types.messages import (
+    QueryFlag,
+    QueryMessage,
+    QueryResponseMessage,
+    UserEventMessage,
+    decode_message,
+    encode_message,
+)
+from serf_tpu.utils import metrics
+from serf_tpu.utils.metrics import MetricsSink
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def fresh_obs():
+    """Isolate every test: fresh sink, trace ring, flight ring; restore
+    the previous globals afterwards."""
+    old_sink = metrics.global_sink()
+    old_tracer = obs.global_tracer()
+    old_rec = obs.global_recorder()
+    metrics.set_global_sink(MetricsSink())
+    obs.set_global_tracer(TraceBuffer())
+    obs.set_global_recorder(FlightRecorder())
+    yield
+    metrics.set_global_sink(old_sink)
+    obs.set_global_tracer(old_tracer)
+    obs.set_global_recorder(old_rec)
+
+
+# -- TraceContext ------------------------------------------------------------
+
+
+def test_trace_context_roundtrip_and_hop():
+    tc = new_trace("node-a")
+    assert len(tc.trace_id) == 16 and tc.hops == 0
+    decoded = TraceContext.decode(tc.encode())
+    assert decoded == tc
+    hopped = tc.hop()
+    assert hopped.trace_id == tc.trace_id
+    assert hopped.hops == 1 and tc.hops == 0  # immutable
+    assert TraceContext.decode(hopped.encode()) == hopped
+
+
+def test_trace_context_rejects_bad_id_length():
+    bad = TraceContext(b"short", "node-a", 0)
+    with pytest.raises(codec.DecodeError):
+        TraceContext.decode(bad.encode())
+
+
+def test_trace_scope_stamps_spans_and_flight_events():
+    tc = new_trace("node-a")
+    assert current_trace() is None
+    with trace_scope(tc):
+        assert current_trace() is tc
+        with span("traced-op"):
+            obs.record("some-event", node="node-a")
+    assert current_trace() is None
+    (d,) = obs.trace_dump(name="traced-op")
+    assert d["attrs"]["trace"] == tc.hex_id
+    (e,) = obs.flight_dump(kind="some-event")
+    assert e["trace"] == tc.hex_id
+    # None scope is a no-op: nothing stamped
+    with trace_scope(None):
+        obs.record("other-event")
+    (e2,) = obs.flight_dump(kind="other-event")
+    assert "trace" not in e2
+
+
+# -- wire carriage -----------------------------------------------------------
+
+
+def test_messages_carry_trace_context():
+    tc = new_trace("origin-node")
+    q = QueryMessage(ltime=7, id=42, from_node=Node("origin-node"),
+                     name="status", payload=b"ping", tctx=tc)
+    assert decode_message(encode_message(q)).tctx == tc
+    ue = UserEventMessage(3, "deploy", b"v2", True, tc)
+    assert decode_message(encode_message(ue)).tctx == tc
+    qr = QueryResponseMessage(7, 42, Node("responder"), QueryFlag.NONE,
+                              b"pong", tc)
+    assert decode_message(encode_message(qr)).tctx == tc
+
+
+def test_messages_without_trace_context_decode_to_none():
+    # pre-PR-2 bytes (no tctx field) must decode cleanly — and a message
+    # encoded without a context round-trips to None, not a fabricated one
+    q = QueryMessage(ltime=7, id=42, from_node=Node("a"), name="status")
+    decoded = decode_message(encode_message(q))
+    assert decoded.tctx is None
+    assert decoded == q
+
+
+# -- health scoring ----------------------------------------------------------
+
+
+def test_health_scorer_perfect_and_saturated():
+    signals = {"probe": 0.0, "queue": 0.0, "tee": 0.0, "loop-lag": 0.0,
+               "flight-drop": 0.0, "transport": 0.0}
+    scorer = HealthScorer({k: (lambda k=k: signals[k]) for k in signals})
+    assert scorer.sample().score == 100
+    # saturate everything: weights sum to 100, so the score bottoms at 0.
+    # counter components need TWO samples (they score growth).
+    signals.update({"probe": 5.0, "queue": 5.0, "tee": 5.0,
+                    "loop-lag": 1e6, "flight-drop": 1e6, "transport": 1e6})
+    scorer.sample()
+    signals.update({"flight-drop": 2e6, "transport": 2e6})
+    assert scorer.sample().score == 0
+
+
+def test_health_scorer_single_component_and_delta_healing():
+    vals = {"transport": 0.0}
+    scorer = HealthScorer({"transport": lambda: vals["transport"]})
+    assert scorer.sample().score == 100
+    spec = DEFAULT_SPECS["transport"]
+    vals["transport"] = spec.saturation  # full burst in one window
+    r = scorer.sample()
+    assert r.score == int(round(100 - spec.weight))
+    assert r.components["transport"].load == 1.0
+    # counter stops growing -> the penalty heals on the next sample
+    assert scorer.sample().score == 100
+    # non-consuming reads (stats(), _serf_stats) observe the growth since
+    # the last monitor tick WITHOUT shrinking the window: polling cannot
+    # flatten a burst
+    vals["transport"] += spec.saturation
+    r1 = scorer.sample(consume=False)
+    r2 = scorer.sample(consume=False)
+    assert r1.score == r2.score == int(round(100 - spec.weight))
+    assert scorer.sample(consume=True).score == r1.score
+    assert scorer.sample().score == 100  # window advanced, burst healed
+
+
+def test_health_scorer_broken_source_contributes_zero():
+    def boom():
+        raise RuntimeError("sensor failed")
+    scorer = HealthScorer({"probe": boom})
+    assert scorer.sample().score == 100
+
+
+def test_unhealthy_threshold_partitions_fold():
+    nodes = {
+        "good": {"v": 1, "id": "good", "health": 100, "hc": {},
+                 "q": [0, 0, 0], "lag": 0.0, "digest": "aaa"},
+        "bad": {"v": 1, "id": "bad", "health": UNHEALTHY_THRESHOLD - 1,
+                "hc": {}, "q": [0, 0, 0], "lag": 0.0, "digest": "bbb"},
+    }
+    snap = fold_snapshot("good", 2, nodes)
+    assert snap.unhealthy == ["bad"]
+    assert snap.divergent  # two distinct digests
+    assert snap.aggregates["health"]["min"] == UNHEALTHY_THRESHOLD - 1
+    assert snap.aggregates["health"]["max"] == 100.0
+
+
+# -- stats payload / fold ----------------------------------------------------
+
+
+def test_membership_digest_is_order_insensitive_and_status_sensitive():
+    a = membership_digest([("n1", "ALIVE"), ("n2", "ALIVE")])
+    b = membership_digest([("n2", "ALIVE"), ("n1", "ALIVE")])
+    c = membership_digest([("n1", "ALIVE"), ("n2", "FAILED")])
+    assert a == b != c
+    assert len(a) == 12
+
+
+def test_decode_node_stats_rejects_garbage():
+    with pytest.raises(ValueError):
+        decode_node_stats(b"\xff\xfenot json")
+    with pytest.raises(ValueError):
+        decode_node_stats(b'{"v": 99, "id": "x", "health": 1}')
+    with pytest.raises(ValueError):
+        decode_node_stats(b'{"v": 1, "health": 1}')
+    with pytest.raises(ValueError):
+        decode_node_stats(b'{"v": 1, "id": "x"}')
+    d = decode_node_stats(b'{"v": 1, "id": "x", "health": 88}')
+    assert d["health"] == 88 and d["q"] == [0, 0, 0]
+
+
+def test_render_table_mentions_every_node():
+    nodes = {f"node-{i}": {"v": 1, "id": f"node-{i}", "health": 100,
+                           "hc": {"probe": 0.0}, "members": 3, "failed": 0,
+                           "q": [1, 2, 3], "lag": 0.5, "digest": "abc"}
+             for i in range(3)}
+    text = render_table(fold_snapshot("node-0", 3, nodes))
+    for nid in nodes:
+        assert nid in text
+    assert "3/3 nodes" in text and "converged" in text
+
+
+# -- in-proc cluster scenarios ----------------------------------------------
+
+
+async def _make_cluster(net, n):
+    from serf_tpu.host import Serf
+    from serf_tpu.options import Options
+
+    nodes = [await Serf.create(net.bind(f"addr-{i}"), Options.local(),
+                               f"node-{i}") for i in range(n)]
+    for s in nodes[1:]:
+        await s.join("addr-0")
+    deadline = asyncio.get_running_loop().time() + 10.0
+    while asyncio.get_running_loop().time() < deadline:
+        if all(len(s.members()) == n for s in nodes):
+            return nodes
+        await asyncio.sleep(0.02)
+    raise AssertionError(
+        f"cluster failed to converge: {[len(s.members()) for s in nodes]}")
+
+
+@pytest.mark.asyncio
+async def test_cluster_stats_covers_every_live_node():
+    from serf_tpu.host import LoopbackNetwork
+    from serf_tpu.host.query import QueryParam
+
+    net = LoopbackNetwork()
+    nodes = await _make_cluster(net, 3)
+    try:
+        snap = await nodes[0].cluster_stats(QueryParam(timeout=3.0))
+        assert isinstance(snap, ClusterSnapshot)
+        assert set(snap.nodes) == {"node-0", "node-1", "node-2"}
+        assert snap.expected == 3 and snap.complete
+        for nid, d in snap.nodes.items():
+            assert 0 <= d["health"] <= 100, (nid, d)
+            assert d["hc"], f"{nid} reported no health components"
+            assert d["members"] == 3
+        assert set(snap.aggregates) == {"health", "members", "queue", "lag"}
+        for agg in snap.aggregates.values():
+            assert agg["min"] <= agg["p50"] <= agg["max"]
+        # the per-node health gauges landed with node labels
+        sink = metrics.global_sink()
+        for nid in snap.nodes:
+            assert sink.gauge_value("serf.health.score",
+                                    {"node": nid}) is not None
+        # round-trips through JSON (the obstop --json contract)
+        assert json.loads(json.dumps(snap.to_dict()))["responders"] == 3
+    finally:
+        for s in nodes:
+            await s.shutdown()
+
+
+@pytest.mark.asyncio
+async def test_query_trace_id_spans_origin_and_responders():
+    from serf_tpu.host import LoopbackNetwork, QueryEvent, EventSubscriber
+    from serf_tpu.host.query import QueryParam
+    from serf_tpu.host import Serf
+    from serf_tpu.options import Options
+
+    net = LoopbackNetwork()
+    sub = EventSubscriber()
+    a = await Serf.create(net.bind("a"), Options.local(), "node-a")
+    b = await Serf.create(net.bind("b"), Options.local(), "node-b",
+                          subscriber=sub)
+    c = await Serf.create(net.bind("c"), Options.local(), "node-c")
+    try:
+        await b.join("a")
+        await c.join("a")
+        deadline = asyncio.get_running_loop().time() + 10.0
+        while asyncio.get_running_loop().time() < deadline:
+            if all(len(s.members()) == 3 for s in (a, b, c)):
+                break
+            await asyncio.sleep(0.02)
+
+        async def responder():
+            while True:
+                ev = await sub.next()
+                if isinstance(ev, QueryEvent) and ev.name == "status":
+                    await ev.respond(b"pong")
+                    return
+
+        task = asyncio.create_task(responder())
+        resp = await a.query("status", b"ping", QueryParam(timeout=1.5))
+        got = [r async for r in resp.responses()]
+        task.cancel()
+        assert got and got[0].payload == b"pong"
+
+        # ACCEPTANCE: one trace id on >= 2 nodes' flight entries
+        received = obs.flight_dump(kind="query-received")
+        ours = [e for e in received if e.get("query") == "status"]
+        assert ours, "no query-received flight events recorded"
+        trace_ids = {e["trace"] for e in ours}
+        assert len(trace_ids) == 1, f"expected one trace id, got {trace_ids}"
+        (tid,) = trace_ids
+        nodes_seen = {e["node"] for e in ours}
+        assert {"node-a", "node-b"} <= nodes_seen, nodes_seen
+        # origin-side correlation: the response echoed the same trace id
+        responses = obs.flight_dump(kind="query-response", node="node-a")
+        assert any(e["trace"] == tid and e["responder"] == "node-b"
+                   for e in responses), responses
+        # origin is hop 0; a node that got it via rebroadcast records >= 0
+        by_node = {e["node"]: e for e in ours}
+        assert by_node["node-a"]["hops"] == 0
+        assert by_node["node-a"]["origin"] == "node-a"
+    finally:
+        for s in (a, b, c):
+            await s.shutdown()
+
+
+@pytest.mark.asyncio
+async def test_user_event_trace_propagates():
+    from serf_tpu.host import LoopbackNetwork
+
+    net = LoopbackNetwork()
+    nodes = await _make_cluster(net, 2)
+    try:
+        await nodes[1].user_event("deploy", b"v2")
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while asyncio.get_running_loop().time() < deadline:
+            evs = [e for e in obs.flight_dump(kind="user-event")
+                   if e.get("event") == "deploy"]
+            if {e["node"] for e in evs} == {"node-0", "node-1"}:
+                break
+            await asyncio.sleep(0.02)
+        evs = [e for e in obs.flight_dump(kind="user-event")
+               if e.get("event") == "deploy"]
+        assert {e["node"] for e in evs} == {"node-0", "node-1"}
+        assert len({e["trace"] for e in evs}) == 1
+        assert all(e["origin"] == "node-1" for e in evs)
+    finally:
+        for s in nodes:
+            await s.shutdown()
+
+
+# -- satellites --------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_passthrough_tee_queue_is_bounded():
+    from serf_tpu.host.serf import TEE_QUEUE_MAX
+    from serf_tpu.host import LoopbackNetwork, Serf, EventSubscriber
+    from serf_tpu.options import Options
+
+    net = LoopbackNetwork()
+    sub = EventSubscriber()
+    s = await Serf.create(net.bind("a"), Options.local(), "node-a",
+                          subscriber=sub)
+    try:
+        # the pipeline task installs the queue on its first scheduling
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while s._tee_queue is None \
+                and asyncio.get_running_loop().time() < deadline:
+            await asyncio.sleep(0.01)
+        assert s._tee_queue is not None
+        assert s._tee_queue.maxsize == TEE_QUEUE_MAX
+        # own-join events may still be draining; fill settles to 0
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while s.event_tee_fill() > 0.0 \
+                and asyncio.get_running_loop().time() < deadline:
+            await asyncio.sleep(0.01)
+        assert s.event_tee_fill() == 0.0
+        # the depth gauge is emitted as events move through the tee
+        await s.user_event("ping", b"")
+        labels = {"node": "node-a"}
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while asyncio.get_running_loop().time() < deadline:
+            if metrics.global_sink().gauge_value(
+                    "serf.events.tee_depth", labels) is not None:
+                break
+            await asyncio.sleep(0.01)
+        assert metrics.global_sink().gauge_value(
+            "serf.events.tee_depth", labels) is not None
+    finally:
+        await s.shutdown()
+
+
+def test_lossless_subscriber_drop_is_loud(caplog):
+    import logging
+
+    from serf_tpu.host.events import EventSubscriber
+
+    sub = EventSubscriber(maxsize=1, lossless=True)
+    sub._push("first")
+    with caplog.at_level(logging.WARNING, logger="serf_tpu.events"):
+        sub._push("second")  # forces drop-oldest on a lossless subscriber
+    assert sub.dropped == 1 and sub.lossless_violations == 1
+    assert any("LOSSLESS" in r.message for r in caplog.records)
+    (e,) = obs.flight_dump(kind="subscriber-drop")
+    assert e["contract"] == "lossless"
+    sink = metrics.global_sink()
+    assert sink.counter("serf.subscriber.lossless_violation") == 1.0
+    # the plain mode stays quiet about contracts
+    plain = EventSubscriber(maxsize=1, lossless=False)
+    plain.lossless_violations == 0
+    plain._push("a")
+    plain._push("b")
+    assert plain.lossless_violations == 0
+
+
+def test_dstream_ooo_drop_counter():
+    from serf_tpu.host.dstream import K_DATA, MAX_OOO, _Conn
+
+    class _StubTransport:
+        def _encode_segment(self, cid, kind, seq, payload):
+            return b""
+
+        def _sendto(self, wire, peer):
+            pass
+
+    conn = _Conn(_StubTransport(), ("127.0.0.1", 1), b"x" * 8)
+    # fill the out-of-order buffer (rcv_next=0 stays the hole)
+    for seq in range(1, MAX_OOO + 1):
+        conn.on_segment(K_DATA, seq, b"p")
+    assert len(conn.ooo) == MAX_OOO
+    assert metrics.global_sink().counter("serf.dstream.ooo_dropped") == 0.0
+    conn.on_segment(K_DATA, MAX_OOO + 1, b"p")  # overflow -> counted drop
+    assert metrics.global_sink().counter("serf.dstream.ooo_dropped") == 1.0
+    assert len(conn.ooo) == MAX_OOO
+
+
+def test_health_in_serf_stats_and_options_serde():
+    from serf_tpu.options import Options
+
+    # health_interval round-trips the serde layer as a duration
+    opts = Options(health_interval=2.5)
+    assert opts.to_dict()["health_interval"] == "2s500ms"
+    assert Options.from_json(opts.to_json()).health_interval == 2.5
+    try:
+        import tomllib  # noqa: F401 - 3.11+ only (test_options_serde skips too)
+    except ModuleNotFoundError:
+        return
+    assert Options.from_toml(opts.to_toml()).health_interval == 2.5
+
+
+# -- tier-1 contract hooks ---------------------------------------------------
+
+
+def test_metrics_lint_covers_cluster_plane_gauges():
+    """The README table documents the new gauges (and nothing stale)."""
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import metrics_lint
+        emitted = metrics_lint.emitted_names(
+            [p for entry in metrics_lint.SCAN
+             for p in (sorted((REPO / entry).rglob("*.py"))
+                       if (REPO / entry).is_dir() else [REPO / entry])])
+        documented = metrics_lint.documented_names(metrics_lint.README)
+        for name in ("serf.health.score", "serf.health.component.<>",
+                     "serf.loop.lag-ms", "serf.events.tee_depth",
+                     "serf.dstream.ooo_dropped", "serf.dstream.retransmits",
+                     "serf.subscriber.lossless_violation"):
+            assert name in emitted, f"{name} not emitted anywhere"
+            assert name in documented, f"{name} missing from README"
+        assert metrics_lint.run() == 0
+    finally:
+        sys.path.remove(str(REPO / "tools"))
+
+
+def test_obstop_json_self_check():
+    """tools/obstop.py --json: the cluster-plane contract can't drift —
+    a complete snapshot with per-node health, as JSON, exit 0."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "obstop.py"), "--json",
+         "--nodes", "3"],
+        capture_output=True, text=True, timeout=120,
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+             "PYTHONPATH": str(REPO)},
+    )
+    assert proc.returncode == 0, proc.stderr
+    snap = json.loads(proc.stdout)
+    assert snap["responders"] == 3 and snap["complete"]
+    assert len(snap["nodes"]) == 3
+    for d in snap["nodes"].values():
+        assert 0 <= d["health"] <= 100
+        assert d["hc"]

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""obstop: render a ClusterSnapshot — fleet health at a glance.
+
+Spins an in-process cluster (LoopbackNetwork), fires one
+``_serf_stats`` scatter/fold from the first node, and renders the
+resulting ``ClusterSnapshot`` as a table (or ``--json`` for machines).
+This doubles as the cluster-plane contract self-check wired into tier-1
+(tests/test_cluster_obs.py): if the aggregation path regresses —
+payloads stop fitting the response budget, a node stops answering, the
+fold drops fields — this exits non-zero.
+
+    python tools/obstop.py                # 3-node demo, table output
+    python tools/obstop.py --nodes 5      # bigger demo cluster
+    python tools/obstop.py --json         # machine-readable snapshot
+
+Embedding against a live cluster is one call on any node:
+``snap = await serf.cluster_stats()``; ``obs.render_table(snap)``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the demo cluster must run on CPU even where a TPU plugin is registered
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+async def _demo_snapshot(n: int, timeout: float):
+    from serf_tpu.host import LoopbackNetwork, Serf
+    from serf_tpu.host.query import QueryParam
+    from serf_tpu.options import Options
+
+    net = LoopbackNetwork()
+    nodes = []
+    try:
+        for i in range(n):
+            nodes.append(await Serf.create(
+                net.bind(f"n{i}"), Options.local(), f"node-{i}"))
+        for s in nodes[1:]:
+            await s.join("n0")
+
+        deadline = asyncio.get_running_loop().time() + 10.0
+        while asyncio.get_running_loop().time() < deadline:
+            if all(len(s.members()) == n for s in nodes):
+                break
+            await asyncio.sleep(0.02)
+
+        return await nodes[0].cluster_stats(QueryParam(timeout=timeout))
+    finally:
+        for s in nodes:
+            await s.shutdown()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--nodes", type=int, default=3,
+                    help="demo cluster size (default 3)")
+    ap.add_argument("--timeout", type=float, default=2.0,
+                    help="stats query timeout in seconds (default 2.0)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the snapshot as JSON instead of a table")
+    args = ap.parse_args(argv)
+
+    from serf_tpu.obs.cluster import render_table
+
+    snap = asyncio.run(_demo_snapshot(args.nodes, args.timeout))
+    if args.json:
+        print(json.dumps(snap.to_dict(), indent=2, sort_keys=True))
+    else:
+        print(render_table(snap))
+
+    # self-check: the contract the tier-1 hook pins
+    if snap.responders < args.nodes:
+        print(f"obstop: FAIL — only {snap.responders}/{args.nodes} nodes "
+              "answered _serf_stats", file=sys.stderr)
+        return 1
+    for nid, d in snap.nodes.items():
+        if not isinstance(d.get("health"), (int, float)) or not d.get("hc"):
+            print(f"obstop: FAIL — node {nid} report missing health fields",
+                  file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
