@@ -313,6 +313,33 @@ def main() -> None:
         detail["device_metrics_error"] = repr(e)[:300]
     detail["dispatch"] = dispatch_summary()
 
+    # --- per-phase round profile (tools/roundprof.py method): every bench
+    # artifact doubles as a profile (VERDICT r5: "no profile exists that
+    # explains where the time goes").  Profiled at a bounded N by default
+    # so the profile never eats the driver window (override with
+    # SERF_TPU_BENCH_PROFILE_N); the anomalous-phase flag is what the
+    # measured-vs-roofline hunt needs.
+    try:
+        from serf_tpu.models.swim import flagship_config as _fc
+        from serf_tpu.obs.profile import profile_round
+        prof_n = int(os.environ.get("SERF_TPU_BENCH_PROFILE_N",
+                                    min(N_NODES, 65536)))
+        prof = profile_round(_fc(prof_n, k_facts=K_FACTS),
+                             events_per_round=EVENTS_PER_ROUND,
+                             timed_calls=1, warm_rounds=10)
+        detail["profile"] = prof
+        slowest = sorted(prof["phases"], key=lambda r: -r["wall_ms"])[:2]
+        sys.stderr.write(
+            "profile top-2 slowest phases @n=%d: %s; attributed %s of "
+            "compiled round bytes\n" % (
+                prof_n,
+                ", ".join(f"{r['phase']} {r['wall_ms']:.2f} ms "
+                          f"(roofline {r['roofline_frac']:.4f})"
+                          for r in slowest),
+                prof.get("attributed_bytes_frac")))
+    except Exception as e:  # noqa: BLE001 - the profile is best-effort
+        detail["profile_error"] = repr(e)[:300]
+
     detail["platform"] = platform
     sys.stderr.write(json.dumps(detail) + "\n")
     # Only ORCHESTRATED runs write the committed artifact: ad-hoc

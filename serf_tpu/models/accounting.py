@@ -136,7 +136,7 @@ def round_traffic(cfg, regime: str = "sustained",
     w = g.words
     d = cfg.vivaldi.dimensionality
 
-    stamp = float(n * k)            # u8[N, K]
+    stamp = float(n * g.stamp_cols)  # u8[N, K/2] packed (u8[N, K] A/B)
     known = float(n * w * 4)        # u32[N, W]
     alive = float(n)                # bool[N]
     vec = float(n * d * 4)          # f32[N, D]
@@ -158,26 +158,27 @@ def round_traffic(cfg, regime: str = "sustained",
 
     if sustained_rate > 0 and regime in ("sustained", "detection"):
         # inject_facts_batch: retirement clears known bits everywhere
-        # (R+W the word plane); the per-fact scatters are O(m) cells;
-        # the sendable cache mirrors the same passes
-        add(Entry("inject", "known", "RW",
-                  (4 if g.use_sendable_cache else 2) * known, 1.0,
+        # (R+W the word plane); the per-fact fact/stamp/sendable scatters
+        # are O(m) cells (the cache mirror no longer pays a plane pass —
+        # selection ANDs `known`, see GossipState.sendable_round)
+        add(Entry("inject", "known", "RW", 2 * known, 1.0,
                   "dissemination.inject_facts_batch"))
-        # tombstone fold at retirement: m known-plane COLUMN gathers (u32
-        # words, 4 bytes/cell) + alive read + incarnation lookups +
-        # the bool[N] plane R+W
-        add(Entry("inject", "tombstone", "RW",
-                  sustained_rate * 4 * n + 3 * alive, 1.0,
-                  "dissemination.inject_facts_batch tombstone fold"))
+        # tombstone fold at retirement: skip-gated on a retiring DEAD
+        # fact — user-event churn never opens it, so the fold's coverage
+        # gathers bill only the detection regime (below)
 
     if gossip_on:
         if cache_hot:
-            # selection: alive-masked read of the packed cache — the
-            # stamp plane is NOT touched (the 64 MB/round saving at 1M)
+            # selection: alive-masked `sendable & known` — the stamp
+            # plane is NOT touched (32 MB/round saved at 1M); the known
+            # read is what masks stale cache bits for retired slots
+            # (the trade that deleted inject's second plane pass)
             add(Entry("selection", "sendable", "R", known, 1.0,
-                      "dissemination.round_step cached selection"))
+                      "dissemination.select_phase cached"))
+            add(Entry("selection", "known", "R", known, 1.0,
+                      "dissemination.select_phase cached (stale mask)"))
             add(Entry("selection", "alive", "R", alive, 1.0,
-                      "dissemination.round_step cached selection"))
+                      "dissemination.select_phase cached"))
         else:
             # selection fallback: sending_mask + pack — one fused read
             # pass over the stamp plane + known words + alive
@@ -188,35 +189,39 @@ def round_traffic(cfg, regime: str = "sustained",
             add(Entry("selection", "alive", "R", alive, 1.0,
                       "dissemination.sending_mask"))
         add(Entry("selection", "packets", "W", known, 1.0,
-                  "dissemination.round_step phase 1"))
+                  "dissemination.select_phase pack"))
         # exchange (rotation): ONE doubled copy of packets (hoisted by
-        # construction in round_step and sliced per fanout via
+        # construction in exchange_phase and sliced per fanout via
         # rolled_rows(doubled=...)), then per-fanout a contiguous slice
         # read OR-accumulated into incoming
         add(Entry("exchange", "packets", "RW", 3 * known, 1.0,
-                  "dissemination.round_step hoisted double"))
+                  "dissemination.exchange_phase hoisted double"))
         add(Entry("exchange", "packets", "R",
                   known * g.fanout, 1.0,
-                  "dissemination.round_step phase 3 slices"))
+                  "dissemination.exchange_phase slices"))
         add(Entry("exchange", "packets", "W", known, 1.0,
-                  "dissemination.round_step incoming accum"))
+                  "dissemination.exchange_phase incoming accum"))
         # merge: one fused pass over incoming+known -> known
         add(Entry("merge", "known", "RW", 3 * known, 1.0,
-                  "dissemination.round_step phase 4"))
+                  "dissemination.merge_phase learn"))
         if learns:
             # stamp learn pass (gated on learned_any; in the sustained
             # regime fresh facts spread every round so it runs); the
-            # sendable-cache recompute rides the same fusion (+1 packed
-            # write)
+            # wrap clamp AND the sendable-cache recompute ride the same
+            # fusion (+1 packed write)
             add(Entry("merge", "stamp", "RW", 2 * stamp, 1.0,
-                      "dissemination.round_step phase 5"))
+                      "dissemination.merge_phase stamp+clamp"))
             if g.use_sendable_cache:
                 add(Entry("merge", "sendable", "W", known, 1.0,
-                          "dissemination.round_step cache recompute"))
+                          "dissemination.merge_phase cache recompute"))
 
-    # amortized wraparound clamp (both branches)
-    add(Entry("clamp", "stamp", "RW", 2 * stamp + known,
-              1.0 / CLAMP_EVERY, "dissemination.clamp_stamps"))
+    if not learns:
+        # standalone wraparound clamp: only fires when no learn pass has
+        # streamed (and clamped) the stamp plane for CLAMP_EVERY rounds —
+        # i.e. never under sustained load or detection bursts, amortized
+        # in the no-learn/quiescent regimes
+        add(Entry("clamp", "stamp", "RW", 2 * stamp,
+                  1.0 / CLAMP_EVERY, "dissemination.clamp_stamps"))
 
     if cfg.with_failure:
         # probe sweep (round_robin rotation): alive rolls for target +
@@ -237,19 +242,30 @@ def round_traffic(cfg, regime: str = "sustained",
             # refute: accusation scan over the unpacked known plane
             add(Entry("refute", "known", "R", known, 1.0,
                       "failure.refute_round body"))
-            # declare: the expiry scan derives ages — a full stamp-plane
-            # read (the reason the active window runs ~4x slower)
-            add(Entry("declare", "stamp", "R", stamp, 1.0,
+            # declare: the expiry scan derives q-ages — a full
+            # stamp-plane read, now HALVED (packed) and riding the probe
+            # cadence (cluster_round gates declare on probe_tick)
+            add(Entry("declare", "stamp", "R", stamp,
+                      1.0 / cfg.probe_every,
                       "failure._declare_round_body mod_age scan"))
-            add(Entry("declare", "known", "R", known, 1.0,
+            add(Entry("declare", "known", "R", known,
+                      1.0 / cfg.probe_every,
                       "failure._declare_round_body"))
-            # up to three bounded injections (suspect/alive/dead):
-            # pick_bounded score passes + batch scatters + retirement
-            # passes (cache mirror only when the flag is on)
-            inj_known = (4 if g.use_sendable_cache else 2) * known
+            # up to three bounded injections: refute's alive-inject runs
+            # every round; the suspect (probe) and dead (declare)
+            # injections ride the probe cadence — pick_bounded score
+            # passes + batch scatters + retirement passes
+            inj_known = 2 * known
             add(Entry("detect-inj", "known", "RW",
-                      3 * (inj_known + 4 * n + 3 * alive), 1.0,
-                      "failure._bounded_inject x3"))
+                      3 * (inj_known + 4 * n + 3 * alive),
+                      (1.0 + 2.0 / cfg.probe_every) / 3.0,
+                      "failure._bounded_inject x3 (2 on probe cadence)"))
+            # tombstone fold: detection bursts retire dead facts, which
+            # opens the skip-gate — m known-plane COLUMN gathers (u32
+            # words, 4 bytes/cell) + alive reads + the bool[N] plane R+W
+            add(Entry("detect-inj", "tombstone", "RW",
+                      sustained_rate * 4 * n + 3 * alive, 1.0,
+                      "dissemination.inject_facts_batch tombstone fold"))
 
     if cfg.push_pull_every > 0:
         # partner roll of known (concat + slice) + merge pass; stamp
@@ -257,9 +273,11 @@ def round_traffic(cfg, regime: str = "sustained",
         # the sustained regime; skipped when converged)
         pp_bytes = 3 * known + 3 * known + 3 * alive
         if learns:
-            pp_bytes += 2 * stamp
             if g.use_sendable_cache:
                 pp_bytes += 2 * known   # sendable OR of the learn bits
+            add(Entry("push_pull", "stamp", "RW", 2 * stamp,
+                      1.0 / cfg.push_pull_every,
+                      "antientropy.push_pull_round stamp+clamp"))
         add(Entry("push_pull", "known", "RW", pp_bytes,
                   1.0 / cfg.push_pull_every,
                   "antientropy.push_pull_round"))

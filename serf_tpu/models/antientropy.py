@@ -21,8 +21,10 @@ from serf_tpu.models.dissemination import (
     GossipConfig,
     GossipState,
     bump_last_learn,
+    clamp_learn_bytes,
+    clamp_nibbles,
     rolled_rows,
-    round_u8,
+    round_q,
     sample_offsets,
     unpack_bits,
 )
@@ -57,16 +59,27 @@ def push_pull_round(state: GossipState, cfg: GossipConfig, key: jax.Array,
     known = state.known | new_words
     learned_any = jnp.any(new_words != 0)
 
-    # a fresh stamp = age 0 = fresh transmit budget for newly synced facts.
-    # Gated on learned_any: a fully in-sync pair exchange learns nothing
-    # and the stamp where-pass (R+W the whole N×K plane) is a bit-exact
-    # identity — skipping it makes the periodic sync of a converged
-    # cluster cost only the known-word merge (accounting.py quantifies).
+    # a fresh stamp = q-age 0 = fresh transmit budget for newly synced
+    # facts.  Gated on learned_any: a fully in-sync pair exchange learns
+    # nothing and the stamp where-pass (R+W the whole stamp plane) is a
+    # bit-exact identity — skipping it makes the periodic sync of a
+    # converged cluster cost only the known-word merge (accounting.py
+    # quantifies).  When the pass DOES run it streams the plane, so the
+    # wrap clamp rides it for free (last_clamp bumped below).
     def stamp_learns(s):
+        if cfg.pack_stamp:
+            # the shared clamp+learn byte pass (dissemination.
+            # clamp_learn_bytes — one copy of the nibble arithmetic);
+            # push_pull keeps its own OR-based cache handling outside
+            return clamp_learn_bytes(s, new_words, state.round, k)[0]
+        nib = clamp_nibbles(s, state.round)
         new_mask = unpack_bits(new_words, k)
-        return jnp.where(new_mask, round_u8(state.round), s)
+        return jnp.where(new_mask, round_q(state.round), nib)
 
     stamp = jax.lax.cond(learned_any, stamp_learns, lambda s: s, state.stamp)
+    last_clamp = jnp.where(learned_any,
+                           jnp.asarray(state.round, jnp.int32),
+                           state.last_clamp)
     # sendable cache (flag-gated at trace time): the newly synced facts
     # are age-0 sendable — OR-ing their packed bits preserves the cache
     # invariant for the round the plane is valid for (round_step's merge
@@ -83,7 +96,7 @@ def push_pull_round(state: GossipState, cfg: GossipConfig, key: jax.Array,
     last_learn = bump_last_learn(learned_any, state.round, state.last_learn)
     return state._replace(known=known, stamp=stamp, sendable=sendable,
                           sendable_round=sendable_round,
-                          last_learn=last_learn)
+                          last_learn=last_learn, last_clamp=last_clamp)
 
 
 def make_partition(n: int, split: float = 0.5) -> jnp.ndarray:

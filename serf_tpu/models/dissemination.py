@@ -8,15 +8,19 @@ This is the TPU-native re-design of serf's dissemination machinery
   facts ``(subject, kind, incarnation, ltime)``.  New facts overwrite ring
   slots, exactly like the reference's ``buffer[ltime % len]`` dedup cells.
 - each simulated node's state is a row: a packed bitset of which facts it
-  knows (``known``: N×W uint32) and a **learn-round stamp** (``stamp``:
-  N×K uint8 — the round mod 256 at which the fact became known, valid only
-  where the known bit is set).  A fact's knowledge age and its remaining
-  transmit budget (the TransmitLimitedQueue, vectorized) are DERIVED:
-  ``age = (round - stamp) mod 256`` (``age_of``) and ``budget =
-  max(0, transmit_limit - age)`` (``budgets_of``).  Stamps are written
-  once per LEARN event, never ticked — so neither the per-round budget
-  decrement nor fact retirement rewrites the N×K plane (see
-  ``GossipState``).
+  knows (``known``: N×W uint32) and a **nibble-packed learn stamp**
+  (``stamp``: N×K/2 uint8 — two 4-bit stamps per byte; each nibble is the
+  learn round divided by ``STAMP_UNIT`` (=4) mod 16, valid only where the
+  known bit is set).  A fact's knowledge age and its remaining transmit
+  budget (the TransmitLimitedQueue, vectorized) are DERIVED in
+  quarter-round ticks: ``q_age = (round//4 - stamp) mod 16`` (``mod_age``)
+  and ``budget_ticks = max(0, transmit_limit//4 - q_age)``
+  (``budgets_of``).  Stamps are written once per LEARN event, never
+  ticked — so neither the per-round budget decrement nor fact retirement
+  rewrites the stamp plane (see ``GossipState``).  Protocol windows are
+  thereby quantized to ``STAMP_UNIT`` rounds (a fact learned mid-quarter
+  expires up to 3 rounds early) — the deliberate trade that halves the
+  round's dominant HBM plane.
 - a gossip round = sample ``fanout`` peers per node, gather their packed
   packet words, bitwise-OR, then a masked Lamport-style merge — pure
   elementwise math plus one gather, which is exactly what the MXU-era memory
@@ -66,23 +70,37 @@ class GossipState(NamedTuple):
     """The whole simulated cluster, struct-of-arrays.
 
     There is deliberately no transmit-budget plane and no stored age plane:
-    a fact's knowledge age is fully determined by its learn-round stamp —
-    ``age = (round - stamp) mod 256`` where the known bit is set (garbage
-    where it isn't) — and its remaining transmit budget by that age:
-    ``budget = max(0, transmit_limit - age)`` (learn: budget=limit, age=0;
-    each round: one transmit as long as age < limit).  Deriving both
-    (``age_of``/``budgets_of``) means the u8[N, K] plane is written only
-    on LEARN events (one full-plane select in the round's merge) — the
+    a fact's knowledge age is fully determined by its learn stamp —
+    ``q_age = (round >> STAMP_SHIFT) - stamp mod 16`` quarter-round ticks
+    where the known bit is set (garbage where it isn't) — and its
+    remaining transmit budget by that age: ``budget_ticks =
+    max(0, transmit_limit_q - q_age)`` (learn: full budget, q_age=0; one
+    transmit per round as long as q_age < limit_q).  Deriving both
+    (``age_of``/``budgets_of``) means the stamp plane is written only on
+    LEARN events (one full-plane select in the round's merge) — the
     round-1 stored-budget plane's decrement pass AND the stored-age
     plane's saturating tick AND the per-injection full-plane retirement
     rewrite (64 MB × 3-4 injections/round at 1M) are all gone; retirement
     is just the known-bit clear.
 
-    The mod-256 stamp wraps; ``round_step`` re-pins stale stamps to
-    ``AGE_PIN`` every ``CLAMP_EVERY`` rounds (an amortized full-plane
-    pass) so a fact's derived age can never wrap back under
-    ``transmit_limit``/``suspicion_rounds`` — both of which config
-    validation bounds to ``AGE_PIN``.
+    The stamp plane itself is nibble-packed when ``cfg.pack_stamp`` (the
+    default): u8[N, ⌈K/2⌉], fact ``k`` in byte ``k//2`` (even ``k`` = low
+    nibble) — 32 MB instead of 64 MB at 1M×64, halving the round's
+    dominant HBM pass.  ``pack_stamp=False`` stores the same 4-bit values
+    un-packed in u8[N, K]; the two flavors are bit-exact in every
+    protocol output (tests/test_stamp_packing.py pins it) — the flag
+    exists for that A/B and as an escape hatch.  (Round-2 rejected u4
+    packing because round-granular thresholds like transmit_limit=28
+    exceed 15; quarter-round ticks are what make 4 bits sufficient:
+    every threshold lives in q-units ≤ AGE_PIN_Q.)
+
+    The mod-16 q-stamp wraps every 64 rounds; every pass that streams
+    the stamp plane re-pins stale stamps to ``AGE_PIN_Q`` (the merge's
+    learn pass does it for free), and ``round_step`` runs a standalone
+    clamp pass only when no streaming pass has run for ``CLAMP_EVERY``
+    rounds (``GossipState.last_clamp``) — so a fact's derived q-age can
+    never wrap back under ``transmit_limit_q``/the suspicion window,
+    both of which config validation bounds to ``AGE_PIN_Q``.
 
     One semantic consequence, closer to the reference than the stored
     budget plane was: a node that is down ages past its budgets, so a
@@ -93,8 +111,10 @@ class GossipState(NamedTuple):
 
     facts: FactTable
     known: jnp.ndarray          # u32[N, W]  packed known-fact bitset
-    stamp: jnp.ndarray          # u8[N, K]   round mod 256 when learned
-                                #            (valid only where known)
+    stamp: jnp.ndarray          # u8[N, K/2] (packed) or u8[N, K]: 4-bit
+                                #            learn-quarter stamps, valid
+                                #            only where known (see
+                                #            stamp_nibbles/pack_stamp)
     alive: jnp.ndarray          # bool[N]    ground-truth liveness
     incarnation: jnp.ndarray    # u32[N]     ground-truth own incarnation
     round: jnp.ndarray          # i32 scalar
@@ -130,29 +150,45 @@ class GossipState(NamedTuple):
                                 # evidence is gone; the detector will
                                 # re-suspect such a subject).
     sendable: jnp.ndarray       # u32[N, W]  packed CACHE of the selection
-                                # predicate `known & (mod_age < limit)`
+                                # predicate `known & (mod_age < limit_q)`
                                 # (alive NOT folded in — liveness changes
                                 # externally).  Valid ONLY when
                                 # sendable_round == round; see below.
     sendable_round: jnp.ndarray  # i32 scalar: the round `sendable` is
                                 # valid for (-1 = never).  INVARIANT:
-                                # sendable_round == R implies sendable ==
-                                # pack(known & (mod_age(R) < limit)).
+                                # sendable_round == R implies
+                                # sendable & known ==
+                                # pack(known & (mod_age(R) < limit_q)) —
+                                # the cache may hold STALE bits for
+                                # retired ring slots; readers AND with
+                                # `known` (whose retirement clear is
+                                # mandatory anyway), which is why inject
+                                # no longer pays a second full-plane
+                                # retirement pass on this cache.
                                 # Writers: the merge's learn pass
                                 # recomputes the full plane for round+1
                                 # (the only place the validity round
                                 # advances — expiry transitions are only
                                 # visible while the stamp plane is being
                                 # streamed anyway); inject/push_pull OR
-                                # their age-0 learn bits in and clear
-                                # retired slots, which preserves validity
-                                # for the SAME round (and is harmless on
-                                # a stale plane — a stale plane is never
-                                # read).  Selection uses the cache only
-                                # when valid, else falls back to the
-                                # stamp-plane recompute (accounting.py
-                                # quantifies the 64 MB/round this saves
-                                # in the sustained regime at 1M).
+                                # their age-0 learn bits in, which
+                                # preserves validity for the SAME round
+                                # (and is harmless on a stale plane — a
+                                # stale plane is never read).  Selection
+                                # uses the cache only when valid, else
+                                # falls back to the stamp-plane recompute
+                                # (accounting.py quantifies the
+                                # 32 MB/round this saves in the sustained
+                                # regime at 1M).
+    last_clamp: jnp.ndarray     # i32 scalar: last round a pass streamed
+                                # (and therefore clamped) the stamp
+                                # plane.  The merge/push learn passes
+                                # fold the wrap clamp in for free and
+                                # bump this; round_step runs a standalone
+                                # clamp pass only when
+                                # round - last_clamp >= CLAMP_EVERY, so
+                                # under sustained load the standalone
+                                # pass never fires.
 
 
 @dataclasses.dataclass(frozen=True)
@@ -178,23 +214,30 @@ class GossipConfig:
     peer_sampling: str = "iid"
     #: use the packed ``sendable`` cache for packet selection when valid
     #: (GossipState.sendable_round): saves the selection's full stamp-
-    #: plane read (64 MB/round at 1M) whenever the previous round's merge
+    #: plane read (32 MB/round at 1M) whenever the previous round's merge
     #: learned anything — i.e. nearly always under sustained load.
     #: Bit-exact either way (tests/test_sendable_cache.py pins it);
     #: the flag exists for that A/B and as an escape hatch.
     use_sendable_cache: bool = True
+    #: nibble-pack the stamp plane (u8[N, K/2], two 4-bit stamps/byte)
+    #: instead of one byte per fact.  Same 4-bit quarter-round semantics
+    #: either way; bit-exact protocol outputs pinned by
+    #: tests/test_stamp_packing.py.  Default ON: it halves the round's
+    #: dominant HBM plane (accounting.py).
+    pack_stamp: bool = True
 
     def __post_init__(self):
         if self.peer_sampling not in ("iid", "rotation"):
             raise ValueError(
                 f"unknown peer_sampling {self.peer_sampling!r}")
-        if self.transmit_limit > AGE_PIN:
-            # derived ages are pinned at AGE_PIN by the periodic stamp
-            # clamp; a limit above the pin would let pinned (very old)
-            # facts re-enter the sending set
+        if self.transmit_limit_q > AGE_PIN_Q:
+            # derived q-ages are pinned at AGE_PIN_Q by the stamp clamp;
+            # a limit above the pin would let pinned (very old) facts
+            # re-enter the sending set
             raise ValueError(
-                f"transmit_limit {self.transmit_limit} exceeds the stamp "
-                f"age pin {AGE_PIN} (lower retransmit_mult)")
+                f"transmit_limit {self.transmit_limit} exceeds "
+                f"{AGE_PIN_Q * STAMP_UNIT} (the 4-bit stamp age pin; "
+                f"lower retransmit_mult)")
 
     @property
     def words(self) -> int:
@@ -206,17 +249,53 @@ class GossipConfig:
         import math
         return self.retransmit_mult * max(1, math.ceil(math.log10(self.n + 1)))
 
+    @property
+    def transmit_limit_q(self) -> int:
+        """The transmit window in quarter-round stamp ticks (the unit
+        every age predicate compares in).  Exact when ``transmit_limit``
+        is a multiple of STAMP_UNIT (the default retransmit_mult=4
+        always is); otherwise it rounds UP — which is why every
+        round-unit consumer must gate on :attr:`transmit_window_rounds`,
+        not ``transmit_limit``."""
+        return -(-self.transmit_limit // STAMP_UNIT)
 
-#: derived ages are pinned here by the periodic stamp clamp; must exceed
-#: every age threshold the protocol compares against (transmit_limit,
-#: suspicion_rounds — both config-validated against it)
-AGE_PIN = 200
-#: rounds between stamp-clamp passes.  Correctness bound: a known fact's
-#: derived age is ≤ AGE_PIN right after a clamp, so it reaches at most
-#: AGE_PIN + CLAMP_EVERY < 256 before the next one — it can never wrap
-#: back under the thresholds.  Cost: one full-plane pass per CLAMP_EVERY
-#: rounds (amortized ~2 MB/round at 1M×64).
-CLAMP_EVERY = 32
+    @property
+    def transmit_window_rounds(self) -> int:
+        """Upper bound of the q-quantized send window in ROUNDS
+        (= STAMP_UNIT * transmit_limit_q ≥ transmit_limit).  THE value
+        round-unit logic must use: a fact learned at round L satisfies
+        ``q_age >= transmit_limit_q`` for every round ≥ L +
+        transmit_window_rounds, so the quiet gate keyed on this bound is
+        provably empty-safe for ANY retransmit_mult (gating on the raw
+        ``transmit_limit`` would close the gate up to 3 rounds early
+        when the limit is not a multiple of STAMP_UNIT, silently
+        dropping still-budgeted transmissions)."""
+        return STAMP_UNIT * self.transmit_limit_q
+
+    @property
+    def stamp_cols(self) -> int:
+        """Byte columns of the stamp plane for this flavor."""
+        return self.k_facts // 2 if self.pack_stamp else self.k_facts
+
+
+#: log2 of the stamp resolution: stamps record the learn round in units
+#: of STAMP_UNIT = 1 << STAMP_SHIFT rounds.  Protocol windows quantize
+#: to this unit (a fact learned mid-quarter expires up to STAMP_UNIT-1
+#: rounds early); in exchange every age threshold fits a 4-bit nibble.
+STAMP_SHIFT = 2
+STAMP_UNIT = 1 << STAMP_SHIFT
+#: derived q-ages are pinned here by the stamp clamp; must be >= every
+#: q-age threshold the protocol compares against (transmit_limit_q, the
+#: suspicion window in q-units — both config-validated against it)
+AGE_PIN_Q = 8
+#: max rounds between stamp-clamping passes (GossipState.last_clamp).
+#: Correctness bound: a known fact's derived q-age is <= AGE_PIN_Q right
+#: after a clamp, so it reaches at most AGE_PIN_Q + CLAMP_EVERY/STAMP_UNIT
+#: = 12 < 16 before the next one — it can never wrap back under the
+#: thresholds.  Cost: free under sustained load (the merge learn pass
+#: clamps while it streams); one standalone half-plane pass per
+#: CLAMP_EVERY rounds otherwise (amortized ~2 MB/round at 1M×64).
+CLAMP_EVERY = 16
 
 
 def make_state(cfg: GossipConfig) -> GossipState:
@@ -231,7 +310,7 @@ def make_state(cfg: GossipConfig) -> GossipState:
     return GossipState(
         facts=facts,
         known=jnp.zeros((n, w), jnp.uint32),
-        stamp=jnp.zeros((n, k), jnp.uint8),
+        stamp=jnp.zeros((n, cfg.stamp_cols), jnp.uint8),
         alive=jnp.ones((n,), bool),
         incarnation=jnp.ones((n,), jnp.uint32),
         round=jnp.asarray(0, jnp.int32),
@@ -240,34 +319,124 @@ def make_state(cfg: GossipConfig) -> GossipState:
         tombstone=jnp.zeros((n,), bool),
         sendable=jnp.zeros((n, w), jnp.uint32),
         sendable_round=jnp.asarray(-1, jnp.int32),
+        last_clamp=jnp.asarray(0, jnp.int32),
     )
 
 
-def round_u8(round_) -> jnp.ndarray:
-    """The stamp-plane representation of a round counter: its low byte."""
-    return (jnp.asarray(round_, jnp.int32) & 0xFF).astype(jnp.uint8)
+def round_q(round_) -> jnp.ndarray:
+    """u8 scalar in [0, 16): the 4-bit stamp value for a round counter —
+    the round's quarter index mod 16."""
+    return ((jnp.asarray(round_, jnp.int32) >> STAMP_SHIFT) & 0xF
+            ).astype(jnp.uint8)
 
 
-def mod_age(state: GossipState, round_=None) -> jnp.ndarray:
-    """u8[N, K]: rounds since learned via wrapping u8 subtraction.
-    VALID ONLY where the known bit is set — callers must gate on the
-    ``known`` bitset (every protocol predicate already does)."""
+def stamp_nibbles(stamp: jnp.ndarray, k: int, packed: bool) -> jnp.ndarray:
+    """u8[..., K] of 4-bit stamp values, whatever the storage flavor.
+    Packed: byte ``k//2`` holds fact ``k`` (even = low nibble)."""
+    if not packed:
+        return stamp
+    lo = stamp & jnp.uint8(0xF)
+    hi = stamp >> 4
+    *lead, cols = stamp.shape
+    return jnp.stack([lo, hi], axis=-1).reshape(*lead, k)
+
+
+def pack_stamp_nibbles(nib: jnp.ndarray, packed: bool) -> jnp.ndarray:
+    """Inverse of :func:`stamp_nibbles`: u8[..., K] 4-bit values back to
+    the storage flavor."""
+    if not packed:
+        return nib
+    lo = nib[..., 0::2]
+    hi = nib[..., 1::2]
+    return (lo & jnp.uint8(0xF)) | (hi << 4)
+
+
+def learn_pairs_words(new_words: jnp.ndarray, k: int):
+    """u32[..., W] per-fact bits -> (lo, hi) bool[..., K/2] per BYTE
+    column of the packed stamp plane: byte ``c`` holds facts ``2c`` (low
+    nibble) and ``2c+1`` (high) = bits ``2*(c%16)`` / ``2*(c%16)+1`` of
+    word ``c//16``.  A contiguous ``repeat`` + elementwise shifts — the
+    byte-space bridge that lets every packed-plane pass avoid the
+    K-order interleave (a layout shuffle XLA materializes; measured ~1.5×
+    on the CPU round before this path existed)."""
+    c = k // 2
+    rep = jnp.repeat(new_words, 16, axis=-1)              # (..., K/2)
+    shifts = 2 * (jnp.arange(c, dtype=jnp.uint32) % 16)
+    pair = (rep >> shifts) & jnp.uint32(3)
+    return (pair & 1).astype(bool), (pair >> 1).astype(bool)
+
+
+def pack_pred_words(ok_lo: jnp.ndarray, ok_hi: jnp.ndarray) -> jnp.ndarray:
+    """Inverse bridge: per-nibble predicate bits bool[..., K/2] ->
+    u32[..., W] per-fact words (fact ``2c+p`` = bit ``2*(c%16)+p`` of
+    word ``c//16``) — weighted shifts + a contiguous group sum."""
+    *lead, c = ok_lo.shape
+    p = jnp.arange(c, dtype=jnp.uint32) % 16
+    weighted = ((ok_lo.astype(jnp.uint32) << (2 * p))
+                + (ok_hi.astype(jnp.uint32) << (2 * p + 1)))
+    return jnp.sum(weighted.reshape(*lead, c // 16, 16), axis=-1,
+                   dtype=jnp.uint32)
+
+
+def nibble_age_pred_words(lo: jnp.ndarray, hi: jnp.ndarray, round_,
+                          threshold, ge: bool = False) -> jnp.ndarray:
+    """u32[..., W] of per-fact ``q_age < threshold`` (or ``>=`` with
+    ``ge=True``) bits from the packed plane's nibble halves — THE one
+    definition of the wrapping 4-bit age compare for every packed-flavor
+    XLA site (selection, the learn pass's cache recompute, declare's
+    expiry scan); the pallas kernels carry the same arithmetic in their
+    own fused form."""
+    rq = round_q(round_)
+    q_lo = (rq - lo) & jnp.uint8(0xF)
+    q_hi = (rq - hi) & jnp.uint8(0xF)
+    t = jnp.uint8(threshold)
+    if ge:
+        return pack_pred_words(q_lo >= t, q_hi >= t)
+    return pack_pred_words(q_lo < t, q_hi < t)
+
+
+def clamp_learn_bytes(stamp: jnp.ndarray, new_words: jnp.ndarray, round_,
+                      k: int):
+    """Packed-flavor clamp + learn-write, per byte column: re-pin
+    wrap-stale nibbles and stamp newly learned facts (``new_words``)
+    with ``round_``'s quarter.  Returns ``(bytes', lo', hi')`` — callers
+    derive cache predicates from the nibble halves.  THE one copy of the
+    streaming-pass arithmetic (learn_stamp_pass and push_pull's reduced
+    variant both route through it)."""
+    rq = round_q(round_)
+    lo = clamp_nibbles(stamp & jnp.uint8(0xF), round_)
+    hi = clamp_nibbles(stamp >> 4, round_)
+    lo_learn, hi_learn = learn_pairs_words(new_words, k)
+    lo = jnp.where(lo_learn, rq, lo)
+    hi = jnp.where(hi_learn, rq, hi)
+    return lo | (hi << 4), lo, hi
+
+
+def mod_age(state: GossipState, cfg: GossipConfig, round_=None
+            ) -> jnp.ndarray:
+    """u8[N, K]: quarter-round ticks since learned via wrapping 4-bit
+    subtraction.  VALID ONLY where the known bit is set — callers must
+    gate on the ``known`` bitset (every protocol predicate already
+    does)."""
     r = state.round if round_ is None else round_
-    return round_u8(r) - state.stamp
+    nib = stamp_nibbles(state.stamp, cfg.k_facts, cfg.pack_stamp)
+    return (round_q(r) - nib) & jnp.uint8(0xF)
 
 
 def age_of(state: GossipState, cfg: GossipConfig) -> jnp.ndarray:
-    """u8[N, K]: knowledge age with the round-1 stored-plane convention
-    (255 = never/unknown) — the gated, allocation-honest view for metrics
-    and tests; the round kernels use ``mod_age`` + known-gating inline."""
+    """u8[N, K]: knowledge age in quarter-round ticks, 255 = never/
+    unknown — the gated, allocation-honest view for metrics and tests;
+    the round kernels use ``mod_age`` + known-gating inline.  Multiply by
+    ``STAMP_UNIT`` for (quantized) rounds."""
     known = unpack_bits(state.known, cfg.k_facts)
-    return jnp.where(known, mod_age(state), jnp.uint8(255))
+    return jnp.where(known, mod_age(state, cfg), jnp.uint8(255))
 
 
 def budgets_of(state: GossipState, cfg: GossipConfig) -> jnp.ndarray:
-    """u8[N, K]: remaining transmit budget, derived from knowledge age
-    (see the GossipState docstring for the invariant)."""
-    limit = jnp.uint8(cfg.transmit_limit)
+    """u8[N, K]: remaining transmit budget in quarter-round ticks,
+    derived from knowledge age (see the GossipState docstring for the
+    invariant)."""
+    limit = jnp.uint8(cfg.transmit_limit_q)
     age = age_of(state, cfg)
     return jnp.where(age < limit, limit - age, jnp.uint8(0))
 
@@ -279,7 +448,7 @@ def sending_mask(state: GossipState, cfg: GossipConfig) -> jnp.ndarray:
     push_round_step, ring.round_step_ring); keep in sync with
     ``budgets_of``."""
     known = unpack_bits(state.known, cfg.k_facts)
-    return (known & (mod_age(state) < jnp.uint8(cfg.transmit_limit))
+    return (known & (mod_age(state, cfg) < jnp.uint8(cfg.transmit_limit_q))
             & state.alive[:, None])
 
 
@@ -293,20 +462,54 @@ def bump_last_learn(learned_any, learn_round, prev) -> jnp.ndarray:
     return jnp.where(learned_any, jnp.asarray(learn_round, jnp.int32), prev)
 
 
-def clamp_stamps(known: jnp.ndarray, stamp: jnp.ndarray, round_,
-                 k_facts: int) -> jnp.ndarray:
-    """Re-pin stale stamps so derived ages can never wrap (see AGE_PIN/
-    CLAMP_EVERY).  Rides a lax.cond in the round kernels: the full-plane
-    pass runs once per CLAMP_EVERY rounds."""
-    def clamp(s):
-        kb = unpack_bits(known, k_facts)
-        r8 = round_u8(round_)
-        stale = kb & ((r8 - s) > jnp.uint8(AGE_PIN))
-        return jnp.where(stale, r8 - jnp.uint8(AGE_PIN), s)
+def clamp_nibbles(nib: jnp.ndarray, round_) -> jnp.ndarray:
+    """Re-pin stale 4-bit stamps at q-age ``AGE_PIN_Q`` so derived ages
+    can never wrap back under the thresholds (see AGE_PIN_Q/CLAMP_EVERY).
+    Applied INLINE by every pass that already streams the stamp plane
+    (the merge/push-pull learn passes, the standalone clamp) — zero extra
+    HBM traffic on learn rounds.  No ``known`` gate: stamps under cleared
+    bits are garbage that is never read, so clamping them is harmless and
+    saves the word-plane read the old mod-256 clamp paid."""
+    rq = round_q(round_)
+    qage = (rq - nib) & jnp.uint8(0xF)
+    return jnp.where(qage > jnp.uint8(AGE_PIN_Q),
+                     (rq - jnp.uint8(AGE_PIN_Q)) & jnp.uint8(0xF), nib)
 
-    return jax.lax.cond(
-        jnp.asarray(round_, jnp.int32) % CLAMP_EVERY == 0,
-        clamp, lambda s: s, stamp)
+
+def clamp_stamps(stamp: jnp.ndarray, round_, last_clamp, cfg: GossipConfig):
+    """Standalone wrap-guard pass, run only when no stamp-streaming pass
+    has clamped for ``CLAMP_EVERY`` rounds (quiet/no-learn windows —
+    under sustained load the merge learn pass clamps for free every
+    round).  Returns ``(stamp, last_clamp)``."""
+    def clamp(s):
+        if cfg.pack_stamp:
+            # per-nibble clamp is independent, so work on the byte
+            # halves directly — no K-order interleave
+            lo = clamp_nibbles(s & jnp.uint8(0xF), round_)
+            hi = clamp_nibbles(s >> 4, round_)
+            return lo | (hi << 4)
+        return clamp_nibbles(s, round_)
+
+    due = jnp.asarray(round_, jnp.int32) - last_clamp >= CLAMP_EVERY
+    stamp = jax.lax.cond(due, clamp, lambda s: s, stamp)
+    return stamp, jnp.where(due, jnp.asarray(round_, jnp.int32), last_clamp)
+
+
+def select_words(state: GossipState, cfg: GossipConfig) -> jnp.ndarray:
+    """u32[N, W]: ``pack_bits(sending_mask(...))`` without ever widening
+    to K lanes on the packed flavor — the age predicate is evaluated per
+    byte column and woven straight into fact words (the same trick the
+    pallas select kernel uses).  The recompute path of
+    :func:`select_phase` and the ring kernel use this; ``sending_mask``
+    remains the bool[N, K] semantic oracle."""
+    if cfg.pack_stamp:
+        b = state.stamp
+        age_ok = nibble_age_pred_words(b & jnp.uint8(0xF), b >> 4,
+                                       state.round, cfg.transmit_limit_q)
+        alive_words = jnp.where(state.alive[:, None],
+                                jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+        return state.known & age_ok & alive_words
+    return pack_bits(sending_mask(state, cfg))
 
 
 # -- rotation addressing -----------------------------------------------------
@@ -406,16 +609,26 @@ def inject_fact(state: GossipState, cfg: GossipConfig, subject, kind,
     # set at origin with a fresh stamp
     known = state.known.at[:, word].set(state.known[:, word] & ~bitmask)
     known = known.at[origin, word].set(known[origin, word] | bitmask)
-    stamp = state.stamp.at[origin, slot].set(round_u8(state.round))
+    rq = round_q(state.round).astype(jnp.int32)
+    if cfg.pack_stamp:
+        # read-modify-write ONE byte: fact `slot` is nibble slot%2 of
+        # byte slot//2 (arithmetic in i32 — traced shifts on u8 promote)
+        byte, sh = slot // 2, (slot % 2) * 4
+        old = state.stamp[origin, byte].astype(jnp.int32)
+        newb = (old & ~(15 << sh)) | (rq << sh)
+        stamp = state.stamp.at[origin, byte].set(newb.astype(jnp.uint8))
+    else:
+        stamp = state.stamp.at[origin, slot].set(round_q(state.round))
     # mirror on the sendable cache (flag-gated at trace time — the
     # escape-hatch config must not pay maintenance): the fresh fact is
-    # age-0 sendable at the origin, the retired slot is sendable nowhere
-    # — preserves the cache invariant for whatever round the cache is
-    # valid for (and is harmless on a stale plane, which is never read)
+    # age-0 sendable at the origin.  The retired slot's stale cache bits
+    # are NOT cleared — selection ANDs the cache with `known` (whose
+    # retirement clear is mandatory anyway), which is what lets inject
+    # skip the second full-plane pass the round-5 mirror paid
+    # (accounting.py).
     sendable = state.sendable
     sendable_round = state.sendable_round
     if cfg.use_sendable_cache:
-        sendable = sendable.at[:, word].set(sendable[:, word] & ~bitmask)
         sendable = sendable.at[origin, word].set(
             sendable[origin, word] | bitmask)
     else:
@@ -463,21 +676,34 @@ def inject_facts_batch(state: GossipState, cfg: GossipConfig, subjects,
     # durable death record (see GossipState.tombstone): retiring,
     # fully-disseminated K_DEAD facts fold in; K_ALIVE injections clear
     # their subjects.  Per retired slot, "covered" = every alive node
-    # holds the known bit (m columns of the packed plane).
+    # holds the known bit (m columns of the packed plane).  Skip-gated
+    # on an M-sized predicate: the coverage gather + alive reads only
+    # run when a retiring slot actually holds a live death declaration —
+    # under sustained USER-EVENT load the ring recycles events, the gate
+    # stays closed, and the fold's ~11 MB/round at 1M is not paid
+    # (accounting.py); detection bursts open it.
     r_slots = jnp.clip(slots, 0, k - 1)
-    r_words, r_bits = r_slots // 32, (r_slots % 32).astype(jnp.uint32)
-    cols = ((state.known[:, r_words] >> r_bits[None, :]) & 1).astype(bool)
-    covered = (jnp.all(cols | ~state.alive[:, None], axis=0)
-               & jnp.any(state.alive))                        # bool[M]
     r_subj = jnp.clip(state.facts.subject[r_slots], 0)
-    # supersession check (see inject_fact): refuted deaths must not fold
-    not_superseded = (state.facts.incarnation[r_slots]
-                      >= state.incarnation[r_subj])
-    dead_retired = (state.facts.valid[r_slots]
-                    & (state.facts.kind[r_slots] == K_DEAD)
-                    & covered & not_superseded & active)
-    old_subjects = jnp.where(dead_retired, r_subj, n)
-    tombstone = state.tombstone.at[old_subjects].max(True, mode="drop")
+    maybe_dead = (state.facts.valid[r_slots]
+                  & (state.facts.kind[r_slots] == K_DEAD) & active)
+
+    def fold(ts):
+        r_words = r_slots // 32
+        r_bits = (r_slots % 32).astype(jnp.uint32)
+        cols = ((state.known[:, r_words] >> r_bits[None, :]) & 1
+                ).astype(bool)
+        covered = (jnp.all(cols | ~state.alive[:, None], axis=0)
+                   & jnp.any(state.alive))                    # bool[M]
+        # supersession check (see inject_fact): refuted deaths must not
+        # fold
+        not_superseded = (state.facts.incarnation[r_slots]
+                          >= state.incarnation[r_subj])
+        dead_retired = maybe_dead & covered & not_superseded
+        old_subjects = jnp.where(dead_retired, r_subj, n)
+        return ts.at[old_subjects].max(True, mode="drop")
+
+    tombstone = jax.lax.cond(jnp.any(maybe_dead), fold,
+                             lambda ts: ts, state.tombstone)
     if kind == K_ALIVE:
         tombstone = tombstone.at[
             jnp.where(active, jnp.clip(subjects, 0), n)].set(
@@ -508,17 +734,53 @@ def inject_facts_batch(state: GossipState, cfg: GossipConfig, subjects,
     known = known.at[worigins, jnp.where(active, words, 0)].add(
         bitmasks, mode="drop")
 
-    stamp = state.stamp.at[worigins, wslots].set(
-        round_u8(state.round), mode="drop")
+    rq = round_q(state.round).astype(jnp.int32)
+    if cfg.pack_stamp:
+        # nibble scatter with duplicate-byte resolution: consecutive
+        # slots mean two batch entries can share a (origin, byte) pair —
+        # one per nibble (same origin, slots 2j and 2j+1).  A scatter-set
+        # with duplicate indices is order-undefined, so each entry
+        # computes the byte's FINAL value (folding every same-byte
+        # partner over the gathered old byte, an M×M trace-time-tiny
+        # reduction) — duplicates then write identical bytes and any
+        # winner is correct.
+        cols = cfg.stamp_cols
+        b = wslots // 2                                       # i32[M]
+        sh = (wslots % 2) * 4                                 # i32[M]
+        gb = state.stamp[jnp.clip(worigins, 0, n - 1),
+                         jnp.clip(b, 0, cols - 1)].astype(jnp.int32)
+        same = ((worigins[:, None] == worigins[None, :])
+                & (b[:, None] == b[None, :])
+                & active[:, None] & active[None, :])          # bool[M, M]
+        clear = jnp.sum(jnp.where(same, 15 << sh[None, :], 0), axis=1)
+        val = jnp.sum(jnp.where(same, rq << sh[None, :], 0), axis=1)
+        newb = ((gb & ~clear) | val).astype(jnp.uint8)
+        stamp = state.stamp.at[worigins, jnp.where(active, b, cols)].set(
+            newb, mode="drop")
+    else:
+        stamp = state.stamp.at[worigins, wslots].set(
+            round_q(state.round), mode="drop")
 
     # sendable cache mirror (see inject_fact; flag-gated at trace time):
-    # retire everywhere, age-0 bits at the origins
+    # age-0 bits at the origins only — retired slots' stale cache bits
+    # are masked by `known` at selection, so the full-plane clear the
+    # round-5 mirror paid is gone.  Because stale bits may remain, the
+    # scatter must be an OR, not an add: gather the old words, fold every
+    # same-(origin, word) partner's bit in (distinct slots = distinct
+    # bits, so the sum IS the OR), and set identical finals (duplicate
+    # set indices with equal payloads are well-defined).
     sendable = state.sendable
     sendable_round = state.sendable_round
     if cfg.use_sendable_cache:
-        sendable = sendable & ~clear_words[None, :]
-        sendable = sendable.at[worigins, jnp.where(active, words, 0)].add(
-            bitmasks, mode="drop")
+        gw = sendable[jnp.clip(worigins, 0, n - 1),
+                      jnp.clip(words, 0, cfg.words - 1)]
+        same_w = ((worigins[:, None] == worigins[None, :])
+                  & (words[:, None] == words[None, :])
+                  & active[:, None] & active[None, :])
+        orv = jnp.sum(jnp.where(same_w, bitmasks[None, :],
+                                jnp.uint32(0)), axis=1, dtype=jnp.uint32)
+        sendable = sendable.at[worigins, jnp.where(active, words, 0)].set(
+            gw | orv, mode="drop")
     else:
         sendable_round = jnp.asarray(-1, jnp.int32)
 
@@ -626,9 +888,191 @@ def pick_bounded(candidates: jnp.ndarray, max_events: int, key: jax.Array):
 
 # -- the gossip round kernel -------------------------------------------------
 
+def _use_pallas(cfg: GossipConfig) -> bool:
+    """Trace-time pallas gate; an unsupported shape records a flight
+    event (obs) instead of silently falling back."""
+    if not cfg.use_pallas:
+        return False
+    from serf_tpu.ops import round_kernels
+    if round_kernels.pallas_ok(cfg.n, cfg.k_facts):
+        return True
+    from serf_tpu import obs
+    obs.record("pallas-fallback", op="round_step", n=cfg.n,
+               k=cfg.k_facts, reason="pallas_ok rejected shape")
+    return False
+
+
+def select_phase(state: GossipState, cfg: GossipConfig) -> jnp.ndarray:
+    """Phase 1 — packet selection: u32[N, W] of sending bits.
+
+    Cached path: ``sendable & known`` under the alive mask — the AND
+    with ``known`` is what masks stale cache bits for retired ring slots
+    (see GossipState.sendable_round), trading an N×W read here for the
+    inject path's second full-plane retirement pass.  Falls back to the
+    stamp-plane recompute when the cache is stale; the pallas flavor is
+    a fused single pass that never touches the cache."""
+    if _use_pallas(cfg):
+        from serf_tpu.ops import round_kernels
+        return round_kernels.select_packets(
+            state.stamp, state.known,
+            state.alive[:, None].astype(jnp.uint8),
+            cfg.transmit_limit_q, state.round, packed=cfg.pack_stamp,
+            k_facts=cfg.k_facts)
+    if cfg.use_sendable_cache:
+        return jax.lax.cond(
+            state.sendable_round == state.round,
+            lambda s: jnp.where(s.alive[:, None],
+                                s.sendable & s.known, jnp.uint32(0)),
+            lambda s: select_words(s, cfg),
+            state)
+    return select_words(state, cfg)
+
+
+def exchange_phase(packets: jnp.ndarray, cfg: GossipConfig,
+                   key: jax.Array, group=None) -> jnp.ndarray:
+    """Phase 3 — pull-exchange: each node ORs ``fanout`` peers' packets.
+
+    Rotation mode: fanout random rotations shared by all nodes — peer
+    reads are contiguous slices, no gather (GossipConfig.peer_sampling);
+    the doubled array is hoisted across the fanout slices, ONE
+    materialization by construction (the byte model's "concat once"
+    term, accounting.py).  ``group`` masks cross-partition flow."""
+    n = packets.shape[0]
+    if cfg.peer_sampling == "rotation":
+        offs = sample_offsets(key, cfg.fanout, n)
+        doubled = jnp.concatenate([packets, packets], axis=0)
+        dgroup = (jnp.concatenate([group, group], axis=0)
+                  if group is not None else None)
+        incoming = jnp.zeros_like(packets)
+        for f in range(cfg.fanout):
+            contrib = rolled_rows(packets, offs[f], doubled=doubled)
+            if group is not None:
+                allowed = rolled_rows(group, offs[f],
+                                      doubled=dgroup) == group
+                contrib = jnp.where(allowed[:, None], contrib,
+                                    jnp.uint32(0))
+            incoming = incoming | contrib
+        return incoming
+    srcs = jax.random.randint(key, (n, cfg.fanout), 0, n)
+    gathered = packets[srcs]                          # u32[N, F, W]
+    if group is not None:
+        allowed = (group[srcs] == group[:, None])     # bool[N, F]
+        gathered = jnp.where(allowed[:, :, None], gathered,
+                             jnp.uint32(0))
+    return jax.lax.reduce(gathered, jnp.uint32(0),
+                          jnp.bitwise_or, (1,))       # u32[N, W]
+
+
+def learn_stamp_pass(stamp: jnp.ndarray, known: jnp.ndarray,
+                     new_words: jnp.ndarray, next_round,
+                     cfg: GossipConfig, fallback_sendable: jnp.ndarray):
+    """THE stamp learn pass: one streaming read+write of the stamp plane
+    that (a) re-pins wrap-stale stamps (clamp_nibbles — free while the
+    plane streams), (b) stamps newly learned facts (``new_words``) with
+    ``next_round``'s quarter, and (c) recomputes the sendable cache for
+    ``next_round`` in the same fusion (or invalidates it when the cache
+    flag is off).  Packed flavor works entirely in BYTE space — no
+    K-order interleave (a layout shuffle XLA materializes; it cost ~1.5×
+    on the CPU round) and no known-plane unpack (the cache is
+    ``known & woven-age-words`` directly).
+
+    Returns ``(stamp', sendable', sendable_round')``.  The single
+    definition shared by :func:`merge_phase` and
+    ``parallel.ring.round_step_ring`` — the two exchange schedules must
+    stay bit-identical, so there is deliberately exactly one copy of
+    this arithmetic (``antientropy.push_pull_round`` has a reduced
+    stamp-only variant with its own cache semantics)."""
+    k = cfg.k_facts
+    rq = round_q(next_round)
+    limit_q = jnp.uint8(cfg.transmit_limit_q)
+    if cfg.pack_stamp:
+        stamp2, lo, hi = clamp_learn_bytes(stamp, new_words, next_round, k)
+        if cfg.use_sendable_cache:
+            age_ok = nibble_age_pred_words(lo, hi, next_round, limit_q)
+            return (stamp2, known & age_ok,
+                    jnp.asarray(next_round, jnp.int32))
+        return stamp2, fallback_sendable, jnp.asarray(-1, jnp.int32)
+    nib = clamp_nibbles(stamp, next_round)
+    new_mask = unpack_bits(new_words, k)              # bool[N, K]
+    stamp2 = jnp.where(new_mask, rq, nib)
+    if cfg.use_sendable_cache:
+        kb = unpack_bits(known, k)
+        q_next = (rq - stamp2) & jnp.uint8(0xF)
+        return (stamp2, pack_bits(kb & (q_next < limit_q)),
+                jnp.asarray(next_round, jnp.int32))
+    # learned without mirroring: mixed-flag hygiene
+    return stamp2, fallback_sendable, jnp.asarray(-1, jnp.int32)
+
+
+def merge_phase(state: GossipState, incoming: jnp.ndarray,
+                cfg: GossipConfig) -> GossipState:
+    """Phases 4+5 — Lamport merge + the stamp learn pass.
+
+    Learn facts we did not know (dead learn nothing), then the round's
+    only stamp-plane write: stamp newly learned facts with the
+    post-increment round's quarter — their derived q-age is 0 at the
+    next round's selection; everyone else's age advances for free
+    because ``round`` advanced.  Gated on ``learned_any``: with zero
+    learns the where is a bit-exact identity, and skipping it saves the
+    round's biggest single pass (stamp R+W, 64 MB at 1M×64 packed)
+    during the fully-disseminated window the gossip gate hasn't closed
+    yet (see serf_tpu/models/accounting.py).  While the stamp plane is
+    streaming through this pass anyway, two more jobs ride the same
+    fusion for free: the wrap clamp (``clamp_nibbles`` — so the
+    standalone clamp pass never fires under sustained load) and the
+    sendable-cache recompute for round+1 (expiry transitions included —
+    the only place the cache's validity round advances).
+
+    Does NOT increment ``state.round`` (the caller owns the round
+    counter and the standalone clamp)."""
+    k = cfg.k_facts
+    if _use_pallas(cfg):
+        from serf_tpu.ops import round_kernels
+        alive_u8 = state.alive[:, None].astype(jnp.uint8)
+        # fused kernel: learn + stamp + inline clamp.  "learned
+        # anything" is definitional (output vs input known) so it can
+        # never desync from the kernel's learn semantics.
+        known, stamp = round_kernels.merge_incoming(
+            state.known, incoming, alive_u8, state.stamp,
+            state.round + 1, packed=cfg.pack_stamp, k_facts=k)
+        learned_any = jnp.any(known != state.known)
+        # the kernel learns without maintaining the cache — a later
+        # cached selection on this state would miss those learns, so
+        # invalidate (the pallas path always selects from stamps)
+        sendable = state.sendable
+        sendable_round = jnp.asarray(-1, jnp.int32)
+        last_clamp = jnp.asarray(state.round + 1, jnp.int32)
+    else:
+        alive_col = state.alive[:, None]
+        new_words = incoming & ~state.known & jnp.where(
+            alive_col, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+        known = state.known | new_words
+        learned_any = jnp.any(new_words != 0)
+
+        def stamp_learns(_):
+            stamp2, send2, sr2 = learn_stamp_pass(
+                state.stamp, known, new_words, state.round + 1, cfg,
+                state.sendable)
+            return (stamp2, send2, sr2,
+                    jnp.asarray(state.round + 1, jnp.int32))
+
+        stamp, sendable, sendable_round, last_clamp = jax.lax.cond(
+            learned_any, stamp_learns,
+            lambda _: (state.stamp, state.sendable,
+                       state.sendable_round, state.last_clamp), None)
+    last_learn = bump_last_learn(learned_any, state.round + 1,
+                                 state.last_learn)
+    return state._replace(known=known, stamp=stamp, last_learn=last_learn,
+                          sendable=sendable, sendable_round=sendable_round,
+                          last_clamp=last_clamp)
+
+
 def round_step(state: GossipState, cfg: GossipConfig,
                key: jax.Array, group=None) -> GossipState:
-    """One gossip round: select packets, pull-exchange, Lamport-merge.
+    """One gossip round: select packets, pull-exchange, Lamport-merge
+    (the :func:`select_phase`/:func:`exchange_phase`/:func:`merge_phase`
+    composition — the profiler jits the same phases in isolation,
+    serf_tpu/obs/profile.py).
 
     Vectorized translation of the reference hot path: `get_broadcasts` drain
     (budget decrement) + `SerfDelegate::broadcast_messages` piggybacking +
@@ -639,155 +1083,40 @@ def round_step(state: GossipState, cfg: GossipConfig,
     between nodes in the same group — the device analog of the reference's
     block-diagonal adjacency partition (SURVEY.md §7 stage 6).
 
-    Skip-gated on ``round - last_learn < transmit_limit``: past that,
-    every knower's derived age is >= the limit, the sending set is
-    provably empty, and the whole select/exchange/merge is a bit-exact
-    identity — a fully quiescent cluster (serf with an empty broadcast
+    Skip-gated on ``round - last_learn < transmit_window_rounds`` (the
+    q-window's round-unit upper bound): past that, every knower's
+    derived q-age is >= transmit_limit_q, the sending set is provably
+    empty, and the whole select/exchange/merge is a bit-exact identity — a fully quiescent cluster (serf with an empty broadcast
     queue) pays only the round increment and the amortized clamp.  A new
     injection or merge bumps ``last_learn`` and re-opens the gate.
     """
-    n, k, w = cfg.n, cfg.k_facts, cfg.words
-
-    use_pallas = cfg.use_pallas
-    if use_pallas:
-        from serf_tpu.ops import round_kernels
-        use_pallas = round_kernels.pallas_ok(n, k)
-
     def active(state):
-        if use_pallas:
-            alive_u8 = state.alive[:, None].astype(jnp.uint8)
-            # phase 1: pack sending bits — one read-only pass over the
-            # stamp plane + known words (derived age, no tick anywhere).
-            # The pallas path neither reads nor maintains the sendable
-            # cache (it leaves sendable_round stale, which is safe).
-            packets = round_kernels.select_packets(
-                state.stamp, state.known, alive_u8, cfg.transmit_limit,
-                state.round)
-        elif cfg.use_sendable_cache:
-            # 1. packet selection: use the cached predicate when valid
-            #    (one 8 MB word-plane read at 1M instead of the 64 MB
-            #    stamp-plane pass), else recompute from stamps
-            packets = jax.lax.cond(
-                state.sendable_round == state.round,
-                lambda s: jnp.where(s.alive[:, None], s.sendable,
-                                    jnp.uint32(0)),
-                lambda s: pack_bits(sending_mask(s, cfg)),
-                state)
-        else:
-            # 1. packet selection: known facts with remaining transmit
-            #    budget (derived age < limit), from alive nodes
-            sending = sending_mask(state, cfg)
-            packets = pack_bits(sending)                      # u32[N, W]
-
-        # 3. pull-exchange: each alive node samples `fanout` peers and
-        #    ORs their packet words
-        if cfg.peer_sampling == "rotation":
-            # fanout random rotations shared by all nodes: peer reads are
-            # contiguous slices, no gather (GossipConfig.peer_sampling).
-            # The doubled arrays are hoisted across the fanout slices —
-            # ONE materialization by construction (the byte model's
-            # "concat once" term, accounting.py)
-            offs = sample_offsets(key, cfg.fanout, n)
-            doubled = jnp.concatenate([packets, packets], axis=0)
-            dgroup = (jnp.concatenate([group, group], axis=0)
-                      if group is not None else None)
-            incoming = jnp.zeros_like(packets)
-            for f in range(cfg.fanout):
-                contrib = rolled_rows(packets, offs[f], doubled=doubled)
-                if group is not None:
-                    allowed = rolled_rows(group, offs[f],
-                                          doubled=dgroup) == group
-                    contrib = jnp.where(allowed[:, None], contrib,
-                                        jnp.uint32(0))
-                incoming = incoming | contrib
-        else:
-            srcs = jax.random.randint(key, (n, cfg.fanout), 0, n)
-            gathered = packets[srcs]                          # u32[N, F, W]
-            if group is not None:
-                allowed = (group[srcs] == group[:, None])     # bool[N, F]
-                gathered = jnp.where(allowed[:, :, None], gathered,
-                                     jnp.uint32(0))
-            incoming = jax.lax.reduce(gathered, jnp.uint32(0),
-                                      jnp.bitwise_or, (1,))   # u32[N, W]
-
-        if use_pallas:
-            # phases 4+5 fused: learn — set known bits and stamp newly
-            # learned facts with the post-increment round (first visible
-            # at age 0 next round); nothing ticks.  "learned anything" is
-            # definitional (output vs input known) so it can never desync
-            # from whatever the kernel's learn semantics are.
-            known, stamp = round_kernels.merge_incoming(
-                state.known, incoming, alive_u8, state.stamp,
-                state.round + 1)
-            learned_any = jnp.any(known != state.known)
-            # the kernel learns without maintaining the cache — a later
-            # cached selection on this state would miss those learns, so
-            # invalidate (the pallas path always selects from stamps)
-            sendable = state.sendable
-            sendable_round = jnp.asarray(-1, jnp.int32)
-        else:
-            # 4. merge: learn facts we did not know; dead learn nothing
-            alive_col = state.alive[:, None]
-            new_words = incoming & ~state.known & jnp.where(
-                alive_col, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
-            known = state.known | new_words
-            learned_any = jnp.any(new_words != 0)
-
-            # 5. the round's only N×K write: stamp newly learned facts
-            #    with the post-increment round — their derived age is 0
-            #    at the next round's selection, exactly the old age-plane
-            #    reset; everyone else's age advances for free because
-            #    `round` advanced.  Gated on learned_any: with zero learns
-            #    the where is a bit-exact identity, and skipping it saves
-            #    the round's biggest single pass (stamp R+W, 128 MB at
-            #    1M×64) during the fully-disseminated window the gossip
-            #    gate hasn't closed yet (see serf_tpu/models/accounting.py).
-            #    While the stamp plane is streaming through this pass
-            #    anyway, the sendable cache for round+1 is recomputed in
-            #    the same fusion — expiry transitions included — which is
-            #    the only place the cache's validity round advances.
-            def stamp_learns(_):
-                new_mask = unpack_bits(new_words, k)          # bool[N, K]
-                stamp2 = jnp.where(new_mask, round_u8(state.round + 1),
-                                   state.stamp)
-                if cfg.use_sendable_cache:
-                    kb = unpack_bits(known, k)
-                    age_next = round_u8(state.round + 1) - stamp2
-                    send2 = pack_bits(
-                        kb & (age_next < jnp.uint8(cfg.transmit_limit)))
-                    sr2 = jnp.asarray(state.round + 1, jnp.int32)
-                else:
-                    # learned without mirroring: mixed-flag hygiene
-                    send2 = state.sendable
-                    sr2 = jnp.asarray(-1, jnp.int32)
-                return stamp2, send2, sr2
-
-            stamp, sendable, sendable_round = jax.lax.cond(
-                learned_any, stamp_learns,
-                lambda _: (state.stamp, state.sendable,
-                           state.sendable_round), None)
-        last_learn = bump_last_learn(learned_any, state.round + 1,
-                                     state.last_learn)
-        return known, stamp, last_learn, sendable, sendable_round
+        packets = select_phase(state, cfg)
+        incoming = exchange_phase(packets, cfg, key, group=group)
+        st = merge_phase(state, incoming, cfg)
+        return (st.known, st.stamp, st.last_learn, st.sendable,
+                st.sendable_round, st.last_clamp)
 
     def quiet(state):
         return (state.known, state.stamp, state.last_learn,
-                state.sendable, state.sendable_round)
+                state.sendable, state.sendable_round, state.last_clamp)
 
-    known, stamp, last_learn, sendable, sendable_round = jax.lax.cond(
-        state.round - state.last_learn < cfg.transmit_limit,
-        active, quiet, state)
+    known, stamp, last_learn, sendable, sendable_round, last_clamp = \
+        jax.lax.cond(state.round - state.last_learn
+                     < cfg.transmit_window_rounds,
+                     active, quiet, state)
 
-    # amortized wraparound guard (full-plane pass 1/CLAMP_EVERY rounds);
-    # runs in BOTH branches — the clamp is what keeps mod-256 stamp ages
-    # from wrapping back under the thresholds while the cluster is quiet.
-    # Cache-safe: the clamp only re-pins stamps whose derived age exceeds
-    # AGE_PIN (> transmit_limit by config validation), i.e. cells that
-    # are non-sendable before AND after — the sendable invariant holds.
-    stamp = clamp_stamps(known, stamp, state.round + 1, k)
+    # standalone wraparound guard: runs only when no streaming pass has
+    # clamped for CLAMP_EVERY rounds (quiet/no-learn windows — the merge
+    # learn pass clamps for free otherwise).  Cache-safe: the clamp only
+    # re-pins stamps whose derived q-age exceeds AGE_PIN_Q
+    # (>= transmit_limit_q by config validation), i.e. cells that are
+    # non-sendable before AND after — the sendable invariant holds.
+    stamp, last_clamp = clamp_stamps(stamp, state.round + 1, last_clamp,
+                                     cfg)
     return state._replace(known=known, stamp=stamp, last_learn=last_learn,
                           sendable=sendable, sendable_round=sendable_round,
-                          round=state.round + 1)
+                          last_clamp=last_clamp, round=state.round + 1)
 
 
 def run_rounds(state: GossipState, cfg: GossipConfig, key: jax.Array,
@@ -834,8 +1163,11 @@ def push_round_step(state: GossipState, cfg: GossipConfig,
     alive_col = state.alive[:, None]
     new_mask = incoming & ~unpack_bits(state.known, k) & alive_col
     known = state.known | pack_bits(new_mask)
-    stamp = jnp.where(new_mask, round_u8(state.round + 1), state.stamp)
-    stamp = clamp_stamps(known, stamp, state.round + 1, k)
+    # unconditional stamp pass (conformance mode): clamp rides it free
+    nib = clamp_nibbles(stamp_nibbles(state.stamp, k, cfg.pack_stamp),
+                        state.round + 1)
+    nib = jnp.where(new_mask, round_q(state.round + 1), nib)
+    stamp = pack_stamp_nibbles(nib, cfg.pack_stamp)
     last_learn = bump_last_learn(jnp.any(new_mask), state.round + 1,
                                  state.last_learn)
     # this conformance-mode kernel learns without maintaining the
@@ -843,6 +1175,8 @@ def push_round_step(state: GossipState, cfg: GossipConfig,
     # a plane that misses these learns
     return state._replace(known=known, stamp=stamp, last_learn=last_learn,
                           sendable_round=jnp.asarray(-1, jnp.int32),
+                          last_clamp=jnp.asarray(state.round + 1,
+                                                 jnp.int32),
                           round=state.round + 1)
 
 

@@ -21,7 +21,8 @@ import jax
 import jax.numpy as jnp
 
 from serf_tpu.models.dissemination import (
-    AGE_PIN,
+    AGE_PIN_Q,
+    STAMP_UNIT,
     GossipConfig,
     GossipState,
     K_ALIVE,
@@ -29,6 +30,8 @@ from serf_tpu.models.dissemination import (
     K_SUSPECT,
     inject_facts_batch,
     mod_age,
+    nibble_age_pred_words,
+    pack_bits,
     pick_bounded,
     rolled_rows,
     round_step,
@@ -54,12 +57,22 @@ class FailureConfig:
         if self.probe_schedule not in ("random", "round_robin"):
             raise ValueError(
                 f"unknown probe_schedule {self.probe_schedule!r}")
-        # knowledge ages derive from mod-256 learn-round stamps pinned at
-        # AGE_PIN, so windows beyond the pin are unrepresentable
-        if not (0 < self.suspicion_rounds <= AGE_PIN):
+        # knowledge ages derive from 4-bit quarter-round stamps pinned at
+        # AGE_PIN_Q q-ticks, so windows beyond the pin are unrepresentable
+        if not (0 < self.suspicion_rounds <= AGE_PIN_Q * STAMP_UNIT):
             raise ValueError(
-                f"suspicion_rounds must be in [1, {AGE_PIN}] (stamp age "
-                f"pin), got {self.suspicion_rounds}")
+                f"suspicion_rounds must be in [1, "
+                f"{AGE_PIN_Q * STAMP_UNIT}] (stamp age pin), got "
+                f"{self.suspicion_rounds}")
+
+    @property
+    def suspicion_q(self) -> int:
+        """The suspicion window in quarter-round stamp ticks — the unit
+        the expiry scan compares in.  Windows quantize to STAMP_UNIT
+        rounds: a suspicion learned mid-quarter expires up to
+        STAMP_UNIT-1 rounds early (the reference's suspicion timeout is
+        wall-clock-approximate anyway)."""
+        return -(-self.suspicion_rounds // STAMP_UNIT)
 
 
 def rotation_offset(round_, n: int) -> jnp.ndarray:
@@ -304,14 +317,26 @@ def _declare_round_body(state: GossipState, cfg: GossipConfig,
                         fcfg: FailureConfig, suspect: jnp.ndarray,
                         key: jax.Array) -> GossipState:
     n, k = cfg.n, cfg.k_facts
-    known = unpack_bits(state.known, k)
-    # mod_age is garbage where the known bit is clear; `expired` below
-    # ANDs with `known`, which gates it
-    aged = mod_age(state) >= fcfg.suspicion_rounds
     refuted = jnp.any(_refutation_matrix(state), axis=1)
-
-    expired = known & suspect[None, :] & aged & ~refuted[None, :] \
-        & state.alive[:, None]
+    # K-sized fact filter, packed once (suspicions that could declare)
+    fact_words = pack_bits(suspect & ~refuted)                # u32[W]
+    # the expiry scan is the detection regime's biggest plane read —
+    # evaluate the q-age predicate in BYTE space on the packed flavor
+    # (per-nibble compares woven straight into fact words, no K-order
+    # interleave; see dissemination.pack_pred_words) and gate with the
+    # packed known/alive planes before ONE contiguous unpack.  mod_age
+    # is garbage where the known bit is clear; the known AND gates it.
+    sq = jnp.uint8(fcfg.suspicion_q)
+    if cfg.pack_stamp:
+        b = state.stamp
+        aged_words = nibble_age_pred_words(b & jnp.uint8(0xF), b >> 4,
+                                           state.round, sq, ge=True)
+    else:
+        aged_words = pack_bits(mod_age(state, cfg) >= sq)
+    alive_words = jnp.where(state.alive[:, None],
+                            jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+    expired = unpack_bits(state.known & aged_words & fact_words[None, :]
+                          & alive_words, k)                   # bool[N, K]
     # subjects with at least one expired suspicion at some knower
     subj = jnp.clip(state.facts.subject, 0)
     subject_expired = jnp.zeros((n,), bool).at[subj].max(jnp.any(expired, axis=0))
@@ -362,7 +387,7 @@ def believed_dead(state: GossipState, cfg: GossipConfig,
     known = unpack_bits(state.known, k)
     dead_fact = _facts_about(state, (K_DEAD,))
     aged_suspect = _facts_about(state, (K_SUSPECT,))
-    aged = mod_age(state) >= fcfg.suspicion_rounds  # gated by `known` below
+    aged = mod_age(state, cfg) >= fcfg.suspicion_q  # gated by `known` below
     evidence = known & (dead_fact[None, :] | (aged_suspect[None, :] & aged))
     # refutation: knower also knows an alive fact about the same subject
     # with strictly higher incarnation

@@ -59,9 +59,14 @@ class ClusterConfig:
     #: default every detection test assumes).  The reference LAN profile
     #: is gossip_interval=200ms / probe_interval=1s — i.e. probe_every=5
     #: is the FAITHFUL cadence mapping; suspicion windows stay measured
-    #: in gossip rounds either way.  refute/declare stay every round
-    #: (they are driven by gossiped facts, not probes, and their
-    #: could-still-act gates make them free when idle).
+    #: in gossip rounds either way.  refute stays every round (driven by
+    #: gossiped facts, and its could-still-act gate makes it free when
+    #: idle); declare rides the probe cadence — its expiry scan re-reads
+    #: the whole stamp plane (the detection regime's biggest read,
+    #: accounting.py), and the reference's suspicion timers are likewise
+    #: checked on the probe/reap cadence, not per gossip tick.  A
+    #: declaration can land up to probe_every-1 rounds late; the
+    #: suspicion window itself is unchanged.
     probe_every: int = 1
     with_failure: bool = True
     with_vivaldi: bool = True
@@ -124,13 +129,21 @@ def cluster_round(state: ClusterState, cfg: ClusterConfig,
     if cfg.with_failure:
         if probe_tick is None:
             g = probe_round(g, cfg.gossip, cfg.failure, k_probe)
+            g = refute_round(g, cfg.gossip, cfg.failure, k_refute)
+            g = declare_round(g, cfg.gossip, cfg.failure, k_declare)
         else:
             g = jax.lax.cond(
                 probe_tick,
                 lambda s: probe_round(s, cfg.gossip, cfg.failure, k_probe),
                 lambda s: s, g)
-        g = refute_round(g, cfg.gossip, cfg.failure, k_refute)
-        g = declare_round(g, cfg.gossip, cfg.failure, k_declare)
+            g = refute_round(g, cfg.gossip, cfg.failure, k_refute)
+            # declare rides the probe cadence: its expiry scan re-reads
+            # the stamp plane (see ClusterConfig.probe_every)
+            g = jax.lax.cond(
+                probe_tick,
+                lambda s: declare_round(s, cfg.gossip, cfg.failure,
+                                        k_declare),
+                lambda s: s, g)
     if cfg.push_pull_every > 0:
         g = jax.lax.cond(
             g.round % cfg.push_pull_every == 0,
@@ -139,27 +152,9 @@ def cluster_round(state: ClusterState, cfg: ClusterConfig,
             g)
     viv = state.vivaldi
     if cfg.with_vivaldi:
-        n = cfg.n
-
         def viv_step(viv):
-            if cfg.gossip.peer_sampling == "rotation":
-                # one rotation pairs every node with a pseudo-random RTT
-                # partner; every peer read (liveness, group, hidden
-                # position, coordinate state) is a contiguous roll, no
-                # 1M-row gather
-                voff = sample_offsets(k_peer, 1, n)[0]
-                same_group = state.group == rolled_rows(state.group, voff)
-                reachable = g.alive & rolled_rows(g.alive, voff) & same_group
-                rtt = ground_truth_rtt_rolled(state.positions, voff)
-                return vivaldi_update(viv, cfg.vivaldi, None, rtt, k_viv,
-                                      active=reachable, peer_roll=voff)
-            peers = jax.random.randint(k_peer, (n,), 0, n)
-            same_group = state.group == state.group[peers]
-            reachable = g.alive & g.alive[peers] & same_group \
-                & (peers != jnp.arange(n))
-            rtt = ground_truth_rtt(state.positions, jnp.arange(n), peers)
-            return vivaldi_update(viv, cfg.vivaldi, peers, rtt, k_viv,
-                                  active=reachable)
+            return vivaldi_phase(state._replace(gossip=g, vivaldi=viv),
+                                 cfg, k_peer, k_viv)
 
         if probe_tick is None:
             viv = viv_step(viv)
@@ -168,6 +163,34 @@ def cluster_round(state: ClusterState, cfg: ClusterConfig,
             # ping payloads), so they follow the probe cadence
             viv = jax.lax.cond(probe_tick, viv_step, lambda v: v, viv)
     return ClusterState(g, viv, state.positions, state.group)
+
+
+def vivaldi_phase(state: ClusterState, cfg: ClusterConfig, k_peer,
+                  k_viv) -> VivaldiState:
+    """One Vivaldi co-training step on the current liveness/partition
+    state — the coordinate phase of :func:`cluster_round`, module-level
+    so the per-phase profiler (serf_tpu/obs/profile.py) jits exactly the
+    production code path in isolation."""
+    n = cfg.n
+    g = state.gossip
+    viv = state.vivaldi
+    if cfg.gossip.peer_sampling == "rotation":
+        # one rotation pairs every node with a pseudo-random RTT
+        # partner; every peer read (liveness, group, hidden position,
+        # coordinate state) is a contiguous roll, no 1M-row gather
+        voff = sample_offsets(k_peer, 1, n)[0]
+        same_group = state.group == rolled_rows(state.group, voff)
+        reachable = g.alive & rolled_rows(g.alive, voff) & same_group
+        rtt = ground_truth_rtt_rolled(state.positions, voff)
+        return vivaldi_update(viv, cfg.vivaldi, None, rtt, k_viv,
+                              active=reachable, peer_roll=voff)
+    peers = jax.random.randint(k_peer, (n,), 0, n)
+    same_group = state.group == state.group[peers]
+    reachable = g.alive & g.alive[peers] & same_group \
+        & (peers != jnp.arange(n))
+    rtt = ground_truth_rtt(state.positions, jnp.arange(n), peers)
+    return vivaldi_update(viv, cfg.vivaldi, peers, rtt, k_viv,
+                          active=reachable)
 
 
 def run_cluster(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
@@ -203,6 +226,19 @@ def sustained_round(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
     fractions this is noise.
     """
     m = events_per_round
+    # fact-lifetime headroom (ADVICE r5): each fact lives
+    # k_facts/events_per_round rounds before its ring slot recycles; at
+    # or below transmit_limit the ring cycles faster than facts can
+    # disseminate, silently churning suspect/declare forever.  Static
+    # shapes make this a trace-time check, so it costs nothing per round.
+    window = cfg.gossip.transmit_window_rounds
+    if m and cfg.gossip.k_facts / m <= window:
+        raise ValueError(
+            f"sustained_round ring churn: k_facts/events_per_round = "
+            f"{cfg.gossip.k_facts}/{m} = {cfg.gossip.k_facts / m:.0f} "
+            f"rounds per fact <= the {window}-round transmit window — "
+            f"facts retire before they can disseminate (raise k_facts "
+            f"or lower events_per_round)")
     k_org, k_rnd = jax.random.split(key)
     g = state.gossip
     # unique, monotonically increasing event ids double as ltimes

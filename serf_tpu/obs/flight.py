@@ -20,6 +20,9 @@ Event kinds emitted by the engine (see README "Observability"):
 - ``query-received``  a query reached this node (stamped with its trace id)
 - ``query-response``  a response/ack came back to the originating node
 - ``user-event``      a fresh user event was accepted locally
+- ``pallas-fallback`` use_pallas requested but ``pallas_ok`` rejected the
+  shape — the round silently used the XLA path (r5 TPU_PROOF lesson:
+  invisible fallbacks hid MosaicErrors)
 
 Events recorded while a cross-node trace is active (``obs.trace
 .trace_scope``) carry a ``trace`` field — the hex trace id shared by

@@ -1,0 +1,293 @@
+"""Per-phase round profiler: where does the flagship round's time go?
+
+Round-5's verdict was that the 1M-node bench ran ~450× below the HBM
+roofline with "no profile that explains where the time goes".  This
+module is that profile: it jits each ``cluster_round`` phase IN
+ISOLATION (inject, gossip select/exchange/merge, probe, refute, declare,
+push-pull, vivaldi — the same module-level phase functions the
+production round composes, so there is nothing to drift), times each
+with a device→host transfer barrier (the only trustworthy completion
+barrier on this tunnel — see bench.py), pulls XLA's own
+``cost_analysis()`` bytes/flops for the compiled phase, and cross-checks
+against the analytic byte model (``accounting.round_traffic`` — whose
+entries cite the same code paths).
+
+Per phase it reports wall-clock, compiled bytes/flops, modeled bytes,
+achieved GB/s, and the achieved-vs-roofline fraction; for the whole
+round it reports how much of the compiled bytes the named phases
+attribute (the tier-1 self-check pins ≥ 90% — an unattributed byte
+blob is exactly the "no profile exists" failure mode recurring), and it
+flags the ANOMALOUS phase: the one whose share of wall time most
+exceeds its share of bytes — time a bandwidth model cannot explain
+(dispatch overhead, serial lowering, host sync) and therefore the first
+place to look when measured rps sits far under the byte ceiling.
+
+Used by ``tools/roundprof.py`` (CLI, ``--json`` contract) and embedded
+in ``BENCH_DETAIL.json`` by bench.py on every run (CPU fallback
+included).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Dict, List, Optional
+
+#: phases profiled, in protocol order (names match accounting.by_phase)
+PHASE_NAMES = ("inject", "selection", "exchange", "merge", "probe",
+               "refute", "declare", "push_pull", "vivaldi")
+
+
+def _sync(out) -> None:
+    """Device→host transfer of one element — the completion barrier."""
+    import jax
+    import numpy as np
+
+    leaves = jax.tree_util.tree_leaves(out)
+    np.asarray(jax.device_get(leaves[0]))
+
+
+def _cost(compiled) -> Dict[str, float]:
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 - backend-dependent surface
+        return {}
+    if isinstance(ca, list):
+        ca = ca[0] if ca else {}
+    return ca or {}
+
+
+def _seeded_cluster(cfg, key, events_per_round: int, warm_rounds: int):
+    """A populated steady-ish state: seeded facts + churn, then a warm
+    sustained scan (compiles once; plays the detection cycle out)."""
+    import jax
+    import jax.numpy as jnp
+
+    from serf_tpu.models.dissemination import K_USER_EVENT, inject_fact
+    from serf_tpu.models.swim import make_cluster, run_cluster_sustained
+
+    n = cfg.n
+    state = make_cluster(cfg, key)
+    g = state.gossip
+    spacing = max(1, n // 8)
+    for i in range(8):
+        g = inject_fact(g, cfg.gossip, subject=(i * spacing) % n,
+                        kind=K_USER_EVENT, incarnation=0, ltime=i + 1,
+                        origin=(i * spacing) % n)
+    n_dead = min(8, n // 100)
+    if n_dead:
+        ids = [(i * (n // n_dead) + 1) % n for i in range(n_dead)]
+        g = g._replace(alive=g.alive.at[jnp.asarray(ids)].set(False))
+    state = state._replace(gossip=g)
+    run = jax.jit(functools.partial(run_cluster_sustained, cfg=cfg,
+                                    events_per_round=events_per_round),
+                  static_argnames=("num_rounds",))
+    state = run(state, key=jax.random.key(7), num_rounds=warm_rounds)
+    _sync(state.gossip.round)
+    return state
+
+
+def _phase_callables(state, cfg, events_per_round: int):
+    """(name, jitted_fn, args) per phase — each jits EXACTLY the
+    production phase function on the warmed state."""
+    import jax
+    import jax.numpy as jnp
+
+    from serf_tpu.models import antientropy, dissemination, failure
+    from serf_tpu.models.swim import vivaldi_phase
+
+    gcfg, fcfg = cfg.gossip, cfg.failure
+    g = state.gossip
+    key = jax.random.key(11)
+    m = events_per_round
+    eids = (g.round * m + jnp.arange(m, dtype=jnp.int32) + 1)
+    origins = jax.random.randint(jax.random.key(12), (m,), 0, cfg.n,
+                                 dtype=jnp.int32)
+
+    def inject(g, key):
+        return dissemination.inject_facts_batch(
+            g, gcfg, eids, dissemination.K_USER_EVENT,
+            incarnations=jnp.zeros((m,), jnp.uint32),
+            ltimes=eids.astype(jnp.uint32), origins=origins,
+            active=jnp.ones((m,), bool))
+
+    # phase inputs are materialized once so each phase is timed alone
+    packets = jax.jit(functools.partial(dissemination.select_phase,
+                                        cfg=gcfg))(g)
+    incoming = jax.jit(functools.partial(dissemination.exchange_phase,
+                                         cfg=gcfg))(packets, key=key)
+    _sync(incoming)
+
+    phases = [
+        ("inject", inject, (g,)),
+        ("selection",
+         lambda g, key: dissemination.select_phase(g, gcfg), (g,)),
+        ("exchange",
+         lambda p, key: dissemination.exchange_phase(p, gcfg, key),
+         (packets,)),
+        ("merge",
+         lambda g, key: dissemination.merge_phase(g, incoming, gcfg),
+         (g,)),
+        ("probe",
+         lambda g, key: failure.probe_round(g, gcfg, fcfg, key), (g,)),
+        ("refute",
+         lambda g, key: failure.refute_round(g, gcfg, fcfg, key), (g,)),
+        ("declare",
+         lambda g, key: failure.declare_round(g, gcfg, fcfg, key), (g,)),
+        ("push_pull",
+         lambda g, key: antientropy.push_pull_round(g, gcfg, key), (g,)),
+        ("vivaldi",
+         lambda s, key: vivaldi_phase(s, cfg, key, key), (state,)),
+    ]
+    return [(name, jax.jit(fn), args) for name, fn, args in phases]
+
+
+def profile_round(cfg, events_per_round: int = 2, timed_calls: int = 3,
+                  warm_rounds: int = 24,
+                  hbm_bytes_per_s: Optional[float] = None
+                  ) -> Dict[str, Any]:
+    """Profile one sustained flagship round phase-by-phase.
+
+    Returns the JSON-ready dict documented in the module docstring
+    (``tools/roundprof.py --json`` prints it verbatim)."""
+    import jax
+
+    from serf_tpu.models.accounting import (
+        V5E_HBM_BYTES_PER_S,
+        round_traffic,
+    )
+    from serf_tpu.models.swim import sustained_round
+    from serf_tpu.obs.device import dispatch_timer
+
+    if hbm_bytes_per_s is None:
+        hbm_bytes_per_s = V5E_HBM_BYTES_PER_S
+    key = jax.random.key(5)
+    state = _seeded_cluster(cfg, jax.random.key(0), events_per_round,
+                            warm_rounds)
+
+    # analytic model, per-OCCURRENCE bytes per phase (isolated phase
+    # calls pay the full occurrence; the amortized column is what one
+    # average round pays at the configured cadences)
+    report = round_traffic(cfg, regime="sustained",
+                           sustained_rate=events_per_round)
+    model_occur: Dict[str, float] = {}
+    model_amort: Dict[str, float] = {}
+    for e in report.entries:
+        model_occur[e.phase] = model_occur.get(e.phase, 0.0) + e.nbytes
+        model_amort[e.phase] = model_amort.get(e.phase, 0.0) + e.amortized
+
+    rows: List[Dict[str, Any]] = []
+    for name, jfn, args in _phase_callables(state, cfg, events_per_round):
+        lowered = jfn.lower(*args, key=key)
+        compiled = lowered.compile()
+        ca = _cost(compiled)
+        with dispatch_timer(f"profile.{name}", signature=cfg.n):
+            _sync(compiled(*args, key=key))          # warm dispatch
+        t0 = time.perf_counter()
+        for _ in range(timed_calls):
+            _sync(compiled(*args, key=key))
+        wall_ms = (time.perf_counter() - t0) * 1e3 / timed_calls
+        xla_bytes = float(ca.get("bytes accessed", 0.0))
+        rows.append({
+            "phase": name,
+            "wall_ms": round(wall_ms, 4),
+            "xla_bytes": xla_bytes,
+            "xla_flops": float(ca.get("flops", 0.0)),
+            "model_bytes": round(model_occur.get(name, 0.0), 1),
+            "model_amortized_bytes": round(model_amort.get(name, 0.0), 1),
+            "achieved_gbps": round(xla_bytes / max(wall_ms, 1e-9) / 1e6,
+                                   3),
+            "roofline_frac": round(
+                xla_bytes / max(wall_ms, 1e-9) * 1e3 / hbm_bytes_per_s,
+                6),
+        })
+
+    # the whole compiled round, same workload (inject + cluster_round)
+    whole = jax.jit(functools.partial(
+        sustained_round, cfg=cfg, events_per_round=events_per_round))
+    lowered = whole.lower(state, key=key)
+    compiled = lowered.compile()
+    wca = _cost(compiled)
+    _sync(compiled(state, key=key))
+    t0 = time.perf_counter()
+    for _ in range(timed_calls):
+        _sync(compiled(state, key=key))
+    whole_wall = (time.perf_counter() - t0) * 1e3 / timed_calls
+    whole_bytes = float(wca.get("bytes accessed", 0.0))
+
+    total_phase_ms = sum(r["wall_ms"] for r in rows) or 1e-9
+    total_phase_bytes = sum(r["xla_bytes"] for r in rows) or 1e-9
+    anomaly = None
+    for r in rows:
+        r["wall_share"] = round(r["wall_ms"] / total_phase_ms, 4)
+        byte_share = r["xla_bytes"] / total_phase_bytes
+        r["byte_share"] = round(byte_share, 4)
+        # time a bandwidth model cannot explain: wall share far above
+        # byte share — dispatch/serialization, not HBM streaming
+        r["excess"] = round(r["wall_share"] / max(byte_share, 1e-4), 2)
+        if anomaly is None or r["excess"] > anomaly["excess"]:
+            anomaly = r
+
+    out = {
+        "n": cfg.n,
+        "k": cfg.gossip.k_facts,
+        "regime": "sustained",
+        "events_per_round": events_per_round,
+        "backend": jax.default_backend(),
+        "pack_stamp": cfg.gossip.pack_stamp,
+        "hbm_bytes_per_s": hbm_bytes_per_s,
+        "phases": rows,
+        "whole_round": {
+            "wall_ms": round(whole_wall, 4),
+            "xla_bytes": whole_bytes,
+            "model_amortized_bytes": round(report.total_bytes, 1),
+            "roofline_frac": round(
+                whole_bytes / max(whole_wall, 1e-9) * 1e3
+                / hbm_bytes_per_s, 6),
+            "measured_rps_bound": round(1e3 / max(whole_wall, 1e-9), 2),
+            "model_ceiling_rps": round(
+                report.ceiling_rounds_per_sec(hbm_bytes_per_s), 1),
+        },
+        # the acceptance metric: how much of the whole round's compiled
+        # bytes the named phases explain (≥ 0.9 pinned in tier-1)
+        "attributed_bytes_frac": round(
+            total_phase_bytes / whole_bytes, 4) if whole_bytes else None,
+        "anomalous_phase": {
+            "phase": anomaly["phase"], "excess": anomaly["excess"],
+            "reason": "wall share exceeds byte share by this factor — "
+                      "time HBM streaming cannot explain",
+        } if anomaly else None,
+    }
+    return out
+
+
+def profile_table(profile: Dict[str, Any]) -> str:
+    """Human rendering of a :func:`profile_round` result."""
+    lines = [
+        f"per-phase round profile: n={profile['n']} k={profile['k']} "
+        f"backend={profile['backend']} regime={profile['regime']} "
+        f"pack_stamp={profile['pack_stamp']}",
+        f"{'phase':<10} {'wall ms':>9} {'XLA MB':>9} {'model MB':>9} "
+        f"{'GB/s':>8} {'roofline':>9} {'excess':>7}",
+    ]
+    for r in profile["phases"]:
+        lines.append(
+            f"{r['phase']:<10} {r['wall_ms']:>9.3f} "
+            f"{r['xla_bytes'] / 1e6:>9.2f} "
+            f"{r['model_bytes'] / 1e6:>9.2f} {r['achieved_gbps']:>8.2f} "
+            f"{r['roofline_frac']:>9.4f} {r.get('excess', 0):>7.2f}")
+    w = profile["whole_round"]
+    lines.append(
+        f"{'ROUND':<10} {w['wall_ms']:>9.3f} {w['xla_bytes'] / 1e6:>9.2f} "
+        f"{w['model_amortized_bytes'] / 1e6:>9.2f} — roofline "
+        f"{w['roofline_frac']:.4f}, bound {w['measured_rps_bound']} rps "
+        f"(model ceiling {w['model_ceiling_rps']})")
+    frac = profile.get("attributed_bytes_frac")
+    lines.append(f"attributed bytes: "
+                 f"{'n/a' if frac is None else f'{frac:.1%}'} of the "
+                 f"compiled round explained by named phases")
+    an = profile.get("anomalous_phase")
+    if an:
+        lines.append(f"anomalous phase: {an['phase']} "
+                     f"(excess ×{an['excess']}) — {an['reason']}")
+    return "\n".join(lines)

@@ -2,25 +2,32 @@
 
 The round kernel (serf_tpu/models/dissemination.py) has three phases:
 
-1. packet selection: pack ``known & (derived age < transmit_limit) &
-   alive`` into uint32 words (a fact's age derives from its learn-round
-   stamp — see ``GossipState``; nothing ticks),
+1. packet selection: pack ``known & (derived q-age < transmit_limit_q) &
+   alive`` into uint32 words (a fact's age derives from its 4-bit
+   learn-quarter stamp — see ``GossipState``; nothing ticks),
 2. pull-exchange: peer read + OR-reduce (left to XLA — rolls/gathers are
    already bandwidth-optimal and fuse with the RNG),
-3. merge: learn new facts (bit ops over N×W) and stamp them with the
-   post-increment round (N×K) — a fresh stamp is a fresh budget.
+3. merge: learn new facts (bit ops over N×W), stamp them with the
+   post-increment round's quarter, and re-pin wrap-stale stamps
+   (``clamp_nibbles`` folded in — a fresh stamp is a fresh budget, and
+   the standalone clamp pass never needs to fire after a merge).
 
-Phases 1 and 3 each touch the N×K uint8 stamp plane plus the N×W word
-plane; under plain XLA they materialize several N×K intermediates (the
-sending mask, the unpacked known/new-fact masks).  These kernels fuse each
-phase into a single pass: one read and one write per array, everything
-else in VMEM registers.  The XLA path in ``dissemination.py`` remains the
-semantic oracle; parity is pinned by tests (interpret mode on CPU,
-compiled on TPU).
+Phases 1 and 3 each touch the stamp plane (u8[N, K/2] nibble-packed by
+default, u8[N, K] for the unpacked A/B flavor) plus the N×W word plane;
+under plain XLA they materialize several N×K intermediates (the sending
+mask, the unpacked known/new-fact masks).  These kernels fuse each phase
+into a single pass: one read and one write per array, everything else in
+VMEM registers.  The packed flavor never widens to K lanes at all: both
+nibbles' age predicates are evaluated per BYTE column and woven straight
+into u32 words (fact ``2c+p`` of byte ``c`` is bit ``2*(c%16)+p`` of
+word ``c//16``), so selection is pure word-plane arithmetic.  The XLA
+path in ``dissemination.py`` remains the semantic oracle; parity is
+pinned by tests (interpret mode on CPU, compiled on TPU).
 
-Layout notes (pallas_guide.md): blocks are (BLOCK_N, K) uint8 / (BLOCK_N, W)
-uint32 in VMEM; scalars ride SMEM as (1, 1); iota is 2-D broadcasted_iota;
-unpacking uses a static repeat + per-lane shift, no gathers.
+Layout notes (pallas_guide.md): blocks are (BLOCK_N, C) uint8 / (BLOCK_N,
+W) uint32 in VMEM; scalars ride SMEM as (1, 1); iota is 2-D
+broadcasted_iota; unpacking uses a static repeat + per-lane shift, no
+gathers.
 """
 
 from __future__ import annotations
@@ -49,7 +56,8 @@ def _block_for(n: int) -> int:
 
 def pallas_ok(n: int, k_facts: int) -> bool:
     """Shapes the kernels support: a node block divides N, K is a multiple
-    of 32 (the word size)."""
+    of 32 (the word size — which also keeps the nibble-packed plane at a
+    whole number of 16-byte word groups)."""
     return _block_for(n) > 0 and k_facts % 32 == 0
 
 
@@ -82,50 +90,104 @@ def _pack_bits(mask: jnp.ndarray, k: int) -> jnp.ndarray:
         jnp.concatenate(words, axis=1), jnp.uint32)
 
 
+def _nibble_pred_words(stamp_i32: jnp.ndarray, rq, limit_q,
+                       k: int) -> jnp.ndarray:
+    """(B, K/2) i32 packed-stamp bytes -> (B, W) u32 of per-fact
+    ``q-age < limit_q`` bits, without ever widening to K lanes: evaluate
+    both nibbles per byte column, then weave fact ``2c+p`` into bit
+    ``2*(c%16)+p`` of word ``c//16`` with a weighted i32 sum (each weight
+    used once per word — representable, never overflows)."""
+    c = stamp_i32.shape[1]
+    w = k // 32
+    lo = stamp_i32 & 0xF
+    hi = (stamp_i32 >> 4) & 0xF
+    ok_lo = (((rq - lo) & 0xF) < limit_q).astype(jnp.int32)
+    ok_hi = (((rq - hi) & 0xF) < limit_q).astype(jnp.int32)
+    bytepos = (jax.lax.broadcasted_iota(jnp.int32, (1, c), 1) % 16)
+    weighted = (ok_lo * (jnp.int32(1) << (2 * bytepos))
+                + ok_hi * (jnp.int32(1) << (2 * bytepos + 1)))
+    words = []
+    for wi in range(w):
+        words.append(jnp.sum(weighted[:, wi * 16:(wi + 1) * 16], axis=1,
+                             keepdims=True, dtype=jnp.int32))
+    return jax.lax.bitcast_convert_type(
+        jnp.concatenate(words, axis=1), jnp.uint32)
+
+
+def _learn_pairs(new_words: jnp.ndarray, c: int) -> Tuple[jnp.ndarray,
+                                                          jnp.ndarray]:
+    """(B, W) u32 learn bits -> two (B, C) bools: did the byte column's
+    low/high nibble fact just get learned (byte c holds facts 2c, 2c+1 =
+    bits 2*(c%16), 2*(c%16)+1 of word c//16)."""
+    w = new_words.shape[1]
+    groups = [pltpu.repeat(new_words[:, wi:wi + 1], 16, axis=1)
+              for wi in range(w)]
+    repeated = jnp.concatenate(groups, axis=1)                 # (B, C)
+    shifts = 2 * (jax.lax.broadcasted_iota(jnp.uint32, (1, c), 1) % 16)
+    pair = (repeated >> shifts) & 3
+    return (pair & 1) > 0, (pair & 2) > 0
+
+
+def _clamped(nib: jnp.ndarray, rq, pin) -> jnp.ndarray:
+    """Inline wrap clamp on i32 nibble values (clamp_nibbles, in-kernel)."""
+    qage = (rq - nib) & 0xF
+    return jnp.where(qage > pin, (rq - pin) & 0xF, nib)
+
+
 # ---------------------------------------------------------------------------
 # phase 1: packet selection
 # ---------------------------------------------------------------------------
 
 
-def _select_kernel(limit_ref, round_ref, stamp_ref, known_ref, alive_ref,
-                   packets_ref):
-    stamp = stamp_ref[:]                           # (B, K) u8
-    known = known_ref[:]                           # (B, W) u32
-    alive = alive_ref[:]                           # (B, 1) u8
-    k = stamp.shape[1]
-    limit = limit_ref[0, 0]                        # i32
-    rnd = round_ref[0, 0]                          # i32
-    # derived age in i32 (mod-256 wrap): valid only where the known bit is
-    # set — the AND below gates it
-    age = (rnd - stamp.astype(jnp.int32)) & 0xFF   # (B, K)
-    known_bits = _unpack_words(known, k)           # (B, K) bool
-    sending = known_bits & (age < limit) & (alive > 0)
-    packets_ref[:] = _pack_bits(sending, k)
+def _make_select_kernel(packed: bool, k: int):
+    def kernel(limit_ref, round_ref, stamp_ref, known_ref, alive_ref,
+               packets_ref):
+        known = known_ref[:]                       # (B, W) u32
+        alive = alive_ref[:]                       # (B, 1) u8
+        limit_q = limit_ref[0, 0]                  # i32
+        rq = round_ref[0, 0]                       # i32, already mod 16
+        # derived q-age predicate (mod-16 wrap): valid only where the
+        # known bit is set — the AND below gates it
+        if packed:
+            age_ok = _nibble_pred_words(stamp_ref[:].astype(jnp.int32),
+                                        rq, limit_q, k)
+        else:
+            nib = stamp_ref[:].astype(jnp.int32)   # (B, K)
+            ok = ((rq - nib) & 0xF) < limit_q
+            age_ok = _pack_bits(ok, k)
+        alive_words = jnp.where(alive > 0, jnp.uint32(0xFFFFFFFF),
+                                jnp.uint32(0))
+        packets_ref[:] = known & age_ok & alive_words
+
+    return kernel
 
 
 def select_packets(stamp: jnp.ndarray, known: jnp.ndarray,
-                   alive_u8: jnp.ndarray, limit: int, round_
-                   ) -> jnp.ndarray:
+                   alive_u8: jnp.ndarray, limit_q: int, round_, *,
+                   packed: bool, k_facts: int) -> jnp.ndarray:
     """packets u32[N,W]: one read-only pass over the stamp plane + known
-    words (ages derive from stamps; nothing is ticked anywhere)."""
-    n, k = stamp.shape
+    words (q-ages derive from stamps; nothing is ticked anywhere)."""
+    n, c = stamp.shape
+    k = k_facts
     w = k // 32
     BLOCK_N = _block_for(n)
     grid = (n // BLOCK_N,)
-    limit_arr = jnp.asarray(limit, jnp.int32).reshape(1, 1)
-    round_arr = (jnp.asarray(round_, jnp.int32) & 0xFF).reshape(1, 1)
+    from serf_tpu.models.dissemination import round_q
+
+    limit_arr = jnp.asarray(limit_q, jnp.int32).reshape(1, 1)
+    round_arr = round_q(round_).astype(jnp.int32).reshape(1, 1)
     # host wall clock only: eager calls time a real dispatch (first call
     # at a shape = compile), calls inside an outer jit time the trace
-    with dispatch_timer("ops.select_packets", signature=(n, k)):
+    with dispatch_timer("ops.select_packets", signature=(n, k, packed)):
         return pl.pallas_call(
-            _select_kernel,
+            _make_select_kernel(packed, k),
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, 1), lambda i: (0, 0),
                              memory_space=pltpu.SMEM),
                 pl.BlockSpec((1, 1), lambda i: (0, 0),
                              memory_space=pltpu.SMEM),
-                pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
+                pl.BlockSpec((BLOCK_N, c), lambda i: (i, 0),
                              memory_space=pltpu.VMEM),
                 pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
                              memory_space=pltpu.VMEM),
@@ -144,35 +206,54 @@ def select_packets(stamp: jnp.ndarray, known: jnp.ndarray,
 # ---------------------------------------------------------------------------
 
 
-def _merge_kernel(round_ref, known_ref, incoming_ref, alive_ref, stamp_ref,
-                  known_out_ref, stamp_out_ref):
-    known = known_ref[:]                           # (B, W) u32
-    incoming = incoming_ref[:]                     # (B, W) u32
-    alive = alive_ref[:]                           # (B, 1) u8
-    stamp = stamp_ref[:]                           # (B, K) u8
-    k = stamp.shape[1]
-    alive_words = jnp.where(alive > 0, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
-    new_words = incoming & ~known & alive_words    # (B, W)
-    known_out_ref[:] = known | new_words
-    new_mask = _unpack_words(new_words, k)         # (B, K) bool
-    r8 = round_ref[0, 0].astype(jnp.uint8)
-    stamp_out_ref[:] = jnp.where(new_mask, r8, stamp)
+def _make_merge_kernel(packed: bool, k: int, pin: int):
+    def kernel(round_ref, known_ref, incoming_ref, alive_ref, stamp_ref,
+               known_out_ref, stamp_out_ref):
+        known = known_ref[:]                       # (B, W) u32
+        incoming = incoming_ref[:]                 # (B, W) u32
+        alive = alive_ref[:]                       # (B, 1) u8
+        rq = round_ref[0, 0]                       # i32, already mod 16
+        alive_words = jnp.where(alive > 0, jnp.uint32(0xFFFFFFFF),
+                                jnp.uint32(0))
+        new_words = incoming & ~known & alive_words    # (B, W)
+        known_out_ref[:] = known | new_words
+        if packed:
+            b = stamp_ref[:].astype(jnp.int32)     # (B, C)
+            lo = _clamped(b & 0xF, rq, pin)
+            hi = _clamped((b >> 4) & 0xF, rq, pin)
+            lo_learn, hi_learn = _learn_pairs(new_words, b.shape[1])
+            nlo = jnp.where(lo_learn, rq, lo)
+            nhi = jnp.where(hi_learn, rq, hi)
+            stamp_out_ref[:] = (nlo | (nhi << 4)).astype(jnp.uint8)
+        else:
+            nib = _clamped(stamp_ref[:].astype(jnp.int32), rq, pin)
+            new_mask = _unpack_words(new_words, k)     # (B, K) bool
+            stamp_out_ref[:] = jnp.where(new_mask, rq,
+                                         nib).astype(jnp.uint8)
+
+    return kernel
 
 
 def merge_incoming(known: jnp.ndarray, incoming: jnp.ndarray,
-                   alive_u8: jnp.ndarray, stamp: jnp.ndarray, next_round
+                   alive_u8: jnp.ndarray, stamp: jnp.ndarray, next_round,
+                   *, packed: bool, k_facts: int
                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(known', stamp') in one fused pass: learn new facts and stamp them
-    with ``next_round`` (the post-increment round — first visible at
-    derived age 0 in the next round's selection)."""
-    n, k = stamp.shape
+    """(known', stamp') in one fused pass: learn new facts, stamp them
+    with ``next_round``'s quarter (the post-increment round — first
+    visible at derived q-age 0 in the next round's selection), and re-pin
+    wrap-stale stamps while the plane streams (clamp_nibbles inline —
+    callers may bump ``last_clamp``)."""
+    from serf_tpu.models.dissemination import AGE_PIN_Q, round_q
+
+    n, c = stamp.shape
+    k = k_facts
     w = k // 32
     BLOCK_N = _block_for(n)
     grid = (n // BLOCK_N,)
-    round_arr = (jnp.asarray(next_round, jnp.int32) & 0xFF).reshape(1, 1)
-    with dispatch_timer("ops.merge_incoming", signature=(n, k)):
+    round_arr = round_q(next_round).astype(jnp.int32).reshape(1, 1)
+    with dispatch_timer("ops.merge_incoming", signature=(n, k, packed)):
         return pl.pallas_call(
-            _merge_kernel,
+            _make_merge_kernel(packed, k, AGE_PIN_Q),
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, 1), lambda i: (0, 0),
@@ -183,18 +264,18 @@ def merge_incoming(known: jnp.ndarray, incoming: jnp.ndarray,
                              memory_space=pltpu.VMEM),
                 pl.BlockSpec((BLOCK_N, 1), lambda i: (i, 0),
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
+                pl.BlockSpec((BLOCK_N, c), lambda i: (i, 0),
                              memory_space=pltpu.VMEM),
             ],
             out_specs=[
                 pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((BLOCK_N, k), lambda i: (i, 0),
+                pl.BlockSpec((BLOCK_N, c), lambda i: (i, 0),
                              memory_space=pltpu.VMEM),
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((n, w), jnp.uint32),
-                jax.ShapeDtypeStruct((n, k), jnp.uint8),
+                jax.ShapeDtypeStruct((n, c), jnp.uint8),
             ],
             interpret=_interpret(),
         )(round_arr, known, incoming, alive_u8, stamp)

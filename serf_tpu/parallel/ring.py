@@ -38,10 +38,8 @@ from serf_tpu.models.dissemination import (
     GossipState,
     bump_last_learn,
     clamp_stamps,
-    pack_bits,
-    round_u8,
-    sending_mask,
-    unpack_bits,
+    learn_stamp_pass,
+    select_words,
 )
 from serf_tpu.parallel.mesh import NODE_AXIS
 
@@ -101,15 +99,17 @@ def round_step_ring(state: GossipState, cfg: GossipConfig, key: jax.Array,
 
     # phases 1+2 exactly as round_step (elementwise; GSPMD shards freely),
     # including the cached selection when the sendable plane is valid
+    # (AND `known` — stale cache bits for retired slots, see
+    # GossipState.sendable_round)
     if cfg.use_sendable_cache:
         packets = jax.lax.cond(
             state.sendable_round == state.round,
-            lambda s: jnp.where(s.alive[:, None], s.sendable,
-                                jnp.uint32(0)),
-            lambda s: pack_bits(sending_mask(s, cfg)),
+            lambda s: jnp.where(s.alive[:, None],
+                                s.sendable & s.known, jnp.uint32(0)),
+            lambda s: select_words(s, cfg),
             state)
     else:
-        packets = pack_bits(sending_mask(state, cfg))         # u32[N, W]
+        packets = select_words(state, cfg)                    # u32[N, W]
 
     srcs = jax.random.randint(key, (n, cfg.fanout), 0, n)     # i32[N, F]
     if group is not None:
@@ -143,27 +143,23 @@ def round_step_ring(state: GossipState, cfg: GossipConfig, key: jax.Array,
     # gets the same cached-selection saving (without this the ring leg
     # of any A/B pays the full stamp-plane selection read every round)
     def stamp_learns(_):
-        new_mask = unpack_bits(new_words, k)
-        stamp2 = jnp.where(new_mask, round_u8(state.round + 1),
-                           state.stamp)
-        if cfg.use_sendable_cache:
-            kb = unpack_bits(known, k)
-            age_next = round_u8(state.round + 1) - stamp2
-            send2 = pack_bits(
-                kb & (age_next < jnp.uint8(cfg.transmit_limit)))
-            sr2 = jnp.asarray(state.round + 1, jnp.int32)
-        else:
-            send2 = state.sendable
-            sr2 = jnp.asarray(-1, jnp.int32)
-        return stamp2, send2, sr2
+        # THE shared learn/clamp/cache pass (dissemination.
+        # learn_stamp_pass) — one definition keeps the ring leg
+        # bit-identical to round_step's merge by construction
+        stamp2, send2, sr2 = learn_stamp_pass(
+            state.stamp, known, new_words, state.round + 1, cfg,
+            state.sendable)
+        return stamp2, send2, sr2, jnp.asarray(state.round + 1, jnp.int32)
 
-    stamp, sendable, sendable_round = jax.lax.cond(
+    stamp, sendable, sendable_round, last_clamp = jax.lax.cond(
         learned_any, stamp_learns,
-        lambda _: (state.stamp, state.sendable, state.sendable_round),
+        lambda _: (state.stamp, state.sendable, state.sendable_round,
+                   state.last_clamp),
         None)
-    stamp = clamp_stamps(known, stamp, state.round + 1, k)
+    stamp, last_clamp = clamp_stamps(stamp, state.round + 1, last_clamp,
+                                     cfg)
     last_learn = bump_last_learn(learned_any, state.round + 1,
                                  state.last_learn)
     return state._replace(known=known, stamp=stamp, last_learn=last_learn,
                           sendable=sendable, sendable_round=sendable_round,
-                          round=state.round + 1)
+                          last_clamp=last_clamp, round=state.round + 1)

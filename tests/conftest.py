@@ -31,6 +31,13 @@ import pytest  # noqa: E402
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: run async test via asyncio.run")
+    # tier-1 runs `-m 'not slow'` (ROADMAP.md).  `slow` marks REDUNDANT
+    # heavy parametrizations only (extra seeds of an already-covered code
+    # path) — never the sole test of a distinct path — to keep tier-1
+    # inside its runtime budget with >=10% headroom.
+    config.addinivalue_line(
+        "markers", "slow: heavy redundant parametrization; excluded from "
+                   "tier-1 (-m 'not slow'), run explicitly with -m slow")
 
 
 @pytest.hookimpl(tryfirst=True)

@@ -20,15 +20,19 @@ from serf_tpu.models.swim import (
 
 #: the tracked byte budget for one sustained flagship round @1M (bytes).
 #: Computed 352.6 MB mid round 5; 313.6 MB after the sendable-bitset
-#: cache landed (selection's stamp read → one packed word-plane read);
-#: 324.6 MB after the tombstone fold (durable death records cost ~11 MB
-#: of retirement-coverage reads — paid deliberately: without them the
-#: cluster forgets deaths when the ring recycles AND wastes ring slots
-#: re-declaring them forever).  A kernel change that pushes past the
-#: budget must either be paid for deliberately (raise this with a note)
-#: or fixed.  Floor guards against the model silently dropping terms.
-SUSTAINED_BUDGET_1M = 330e6
-SUSTAINED_FLOOR_1M = 250e6
+#: cache landed; 324.6 MB after the tombstone fold; 233.4 MB after the
+#: round-6 stamp work (nibble-packed quarter-round stamps halve the
+#: merge's learn pass 128→64 MB; the wrap clamp rides the learn pass so
+#: the standalone clamp never fires under load; selection ANDs `known`
+#: so inject drops its second retirement plane pass; the tombstone fold
+#: skip-gates on retiring DEAD facts, which user-event churn never
+#: opens).  A kernel change that pushes past the budget must either be
+#: paid for deliberately (raise this with a note) or fixed.  Floor
+#: guards against the model silently dropping terms.
+SUSTAINED_BUDGET_1M = 240e6
+SUSTAINED_FLOOR_1M = 190e6
+#: the pre-round-6 sustained total the ≥25% reduction is judged against
+ROUND5_SUSTAINED_1M = 313.6e6
 
 
 def test_sustained_budget_at_1m():
@@ -36,12 +40,18 @@ def test_sustained_budget_at_1m():
     assert SUSTAINED_FLOOR_1M < r.total_bytes <= SUSTAINED_BUDGET_1M, (
         f"sustained round moved {r.total_bytes / 1e6:.1f} MB, budget "
         f"{SUSTAINED_BUDGET_1M / 1e6:.0f} MB\n{r.table()}")
-    # the stamp plane is still the dominator, but the sendable cache cut
-    # its share from 56% to ~42% (selection no longer reads it); if the
-    # dominator flips, the optimization target has moved — update
-    # STATUS.md
+    # round-6 acceptance: ≥25% below the round-5 sustained total
+    assert r.total_bytes <= 0.75 * ROUND5_SUSTAINED_1M, (
+        f"stamp-plane halving regressed: {r.total_bytes / 1e6:.1f} MB "
+        f"vs required ≤ {0.75 * ROUND5_SUSTAINED_1M / 1e6:.1f} MB")
+    # the (halved) stamp plane is still the dominator, now nearly tied
+    # with the packet plane (selection+exchange passes); if the order
+    # flips, the optimization target has moved — update STATUS.md
+    by_plane = r.by_plane()
     assert r.dominator() == "stamp"
-    assert 0.35 < r.by_plane()["stamp"] / r.total_bytes < 0.5
+    assert list(by_plane)[1] == "packets"
+    assert 0.22 < by_plane["stamp"] / r.total_bytes < 0.36
+    assert 0.22 < by_plane["packets"] / r.total_bytes < 0.33
 
 
 def test_regime_ordering_matches_gate_design():
@@ -51,7 +61,9 @@ def test_regime_ordering_matches_gate_design():
     sus = round_traffic(cfg, regime="sustained").total_bytes
     act = round_traffic(cfg, regime="active").total_bytes
     qui = round_traffic(cfg, regime="quiescent").total_bytes
-    assert qui < 0.15 * sus, "quiescent regime must be >85% cheaper"
+    # the bar tightened from 0.15 when the sustained denominator dropped
+    # 28% in round 6 — quiescent itself is unchanged (vivaldi-bound)
+    assert qui < 0.2 * sus, "quiescent regime must be >80% cheaper"
     assert act < sus, "no-learn active rounds skip the stamp learn pass"
     det = round_traffic(cfg, regime="detection").total_bytes
     assert det > sus, "detection bursts must cost more than sustained"

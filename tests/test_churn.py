@@ -13,6 +13,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from serf_tpu.models.churn import ChurnConfig, churn_round, run_cluster_churn
 from serf_tpu.models.dissemination import (
@@ -96,6 +97,7 @@ def test_leave_announcement_disseminates_before_leaver_goes_dark():
             f"leave fact in slot {int(sl)} did not disseminate"
 
 
+@pytest.mark.slow  # scale variant; churn semantics are tier-1 at small n
 def test_poisson_churn_100k_detection_and_no_false_deaths():
     """Baseline config #3 at its stated scale (run once per session: ~1 min
     CPU).  30 churned rounds then a settle window; the detector must catch

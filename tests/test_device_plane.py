@@ -268,7 +268,10 @@ def test_sustained_load_keeps_gate_open_and_disseminates():
     full dissemination work every round AND that work completes."""
     from serf_tpu.models.swim import run_cluster_sustained
 
-    cfg = ClusterConfig(gossip=GossipConfig(n=1024, k_facts=32,
+    # k_facts=64: each fact lives 32 rounds, above the 16-round transmit
+    # limit at n=1024 (the ADVICE-r5 lifetime headroom sustained_round
+    # now enforces at trace time)
+    cfg = ClusterConfig(gossip=GossipConfig(n=1024, k_facts=64,
                                             peer_sampling="rotation"),
                         probe_every=5)
     state = make_cluster(cfg, jax.random.key(0))
@@ -286,8 +289,8 @@ def test_sustained_load_keeps_gate_open_and_disseminates():
     k = cfg.gossip.k_facts
     oldest = [(int(g.next_slot) + i) % k for i in range(4)]
     newest = (int(g.next_slot) - 1) % k
-    # oldest surviving facts (injected k/rate = 16 = transmit_limit rounds
-    # ago) fully disseminated; the fact injected THIS round has not
+    # oldest surviving facts (injected k/rate = 32 > transmit_limit
+    # rounds ago) fully disseminated; the fact injected THIS round has not
     for s in oldest:
         assert float(cov[s]) == 1.0, f"old fact {s} never fully spread"
     assert float(cov[newest]) < 1.0, "a fresh fact cannot be everywhere"
@@ -418,12 +421,13 @@ def test_composed_views_none_stays_none():
 
 
 def test_failure_config_rejects_oversized_suspicion_window():
-    """Derived ages are pinned at AGE_PIN; windows beyond it are
-    unrepresentable."""
-    from serf_tpu.models.dissemination import AGE_PIN
+    """Derived q-ages are pinned at AGE_PIN_Q quarter-ticks; windows
+    beyond AGE_PIN_Q * STAMP_UNIT rounds are unrepresentable."""
+    from serf_tpu.models.dissemination import AGE_PIN_Q, STAMP_UNIT
+    bound = AGE_PIN_Q * STAMP_UNIT
     with pytest.raises(ValueError):
-        FailureConfig(suspicion_rounds=AGE_PIN + 1)
-    FailureConfig(suspicion_rounds=AGE_PIN)  # boundary ok
+        FailureConfig(suspicion_rounds=bound + 1)
+    FailureConfig(suspicion_rounds=bound)  # boundary ok
 
 
 def test_hybrid_multihost_mesh_runs():
@@ -605,11 +609,19 @@ def test_declare_round_attributes_declarer_per_subject():
                     ltime=1, origin=20)
     s = inject_fact(s, cfg, subject=11, kind=K_SUSPECT, incarnation=1,
                     ltime=1, origin=30)
-    # age both past the suspicion window at their origins only
-    # back-date the learn stamps so the derived ages are 10
-    from serf_tpu.models.dissemination import round_u8
-    aged = round_u8(s.round) - jnp.uint8(10)
-    s = s._replace(stamp=s.stamp.at[20, 0].set(aged).at[30, 1].set(aged),
+    # age both past the suspicion window at their origins only:
+    # back-date the learn stamps so the derived q-ages are 3 quarters
+    # (= 12 rounds, past suspicion_rounds=4).  Slots 0 and 1 share a
+    # packed byte, so edit through the nibble view.
+    from serf_tpu.models.dissemination import (
+        pack_stamp_nibbles,
+        round_q,
+        stamp_nibbles,
+    )
+    aged = (round_q(s.round) - jnp.uint8(3)) & jnp.uint8(0xF)
+    nib = stamp_nibbles(s.stamp, cfg.k_facts, cfg.pack_stamp)
+    nib = nib.at[20, 0].set(aged).at[30, 1].set(aged)
+    s = s._replace(stamp=pack_stamp_nibbles(nib, cfg.pack_stamp),
                    alive=s.alive.at[10].set(False).at[11].set(False))
     out = declare_round(s, cfg, fcfg, jax.random.key(0))
     dead_slots = jnp.nonzero((out.facts.kind == K_DEAD) & out.facts.valid)[0]
@@ -735,11 +747,19 @@ def test_checkpoint_resume_mid_query_bit_exact():
                              eligible=no_filter_mask(cfg.n))
     state = state._replace(gossip=g)
 
+    # one jitted composed step, shared by the unbroken and resumed runs
+    # (eager per-round dispatch made this the slowest test in the suite
+    # for no extra coverage; the SAME compiled step on both sides is the
+    # stronger bit-exactness statement anyway)
+    @jax.jit
+    def step(st, qs, k1, k2):
+        st = cluster_round(st, cfg, k1)
+        return st, query_round(st.gossip, qs, cfg.gossip, qcfg, k2)
+
     def advance(st, qs, key, rounds):
         for _ in range(rounds):
             key, k1, k2 = jax.random.split(key, 3)
-            st = cluster_round(st, cfg, k1)
-            qs = query_round(st.gossip, qs, cfg.gossip, qcfg, k2)
+            st, qs = step(st, qs, k1, k2)
         return st, qs
 
     # run 5 rounds, checkpoint mid-query, run 5 more
@@ -760,6 +780,7 @@ def test_checkpoint_resume_mid_query_bit_exact():
     assert int(qs_a.next_q) == int(qs_b.next_q)
 
 
+@pytest.mark.slow  # scale variant; vivaldi co-training is tier-1 at 512
 def test_vivaldi_cotrained_with_gossip_at_100k():
     """Baseline config #5 accuracy at scale: Vivaldi co-trained inside the
     full flagship round (gossip + failure detection + anti-entropy sharing
@@ -851,14 +872,15 @@ def test_pick_bounded_grouped_none_and_all():
 
 
 # ---------------------------------------------------------------------------
-# stamp-plane wraparound (the mod-256 learn-round representation)
+# stamp-plane wraparound (the 4-bit quarter-round representation)
 # ---------------------------------------------------------------------------
 
 def test_stamp_wrap_never_resends_old_facts():
-    """The mod-256 stamp wraps every 256 rounds; without the periodic
-    clamp, a fully disseminated fact's derived age would wrap back under
-    transmit_limit around round ~256+learn and the whole cluster would
-    re-send it.  The clamp must keep budgets at zero forever."""
+    """The mod-16 quarter stamp wraps every 64 rounds; without the
+    clamp (riding the learn passes, standalone via last_clamp when
+    quiet), a fully disseminated fact's derived age would wrap back
+    under transmit_limit and the whole cluster would re-send it.  The
+    clamp must keep budgets at zero forever."""
     from serf_tpu.models.dissemination import budgets_of
 
     cfg = GossipConfig(n=64, k_facts=32)
@@ -876,9 +898,14 @@ def test_stamp_wrap_never_resends_old_facts():
 
 
 def test_stamp_wrap_age_of_view():
-    """age_of: derived ages track rounds-since-learn, 255 where unknown,
-    and stay pinned (>= thresholds) across the wrap."""
-    from serf_tpu.models.dissemination import AGE_PIN, age_of
+    """age_of: derived ages track quarters-since-learn, 255 where
+    unknown, and stay pinned (>= thresholds) across the wrap."""
+    from serf_tpu.models.dissemination import (
+        AGE_PIN_Q,
+        CLAMP_EVERY,
+        STAMP_UNIT,
+        age_of,
+    )
 
     cfg = GossipConfig(n=64, k_facts=32)
     s = inject_fact(make_state(cfg), cfg, 5, K_USER_EVENT, 0, 1, origin=5)
@@ -888,11 +915,12 @@ def test_stamp_wrap_age_of_view():
     run = jax.jit(functools.partial(run_rounds, cfg=cfg),
                   static_argnames=("num_rounds",))
     s2 = run(s, key=jax.random.key(1), num_rounds=7)
-    assert int(age_of(s2, cfg)[5, 0]) == 7
+    assert int(age_of(s2, cfg)[5, 0]) == 7 // STAMP_UNIT
     # far past the wrap the origin's age reads pinned-high, never young
     s3 = run(s2, key=jax.random.key(2), num_rounds=600)
     a = int(age_of(s3, cfg)[5, 0])
-    assert AGE_PIN - 32 <= a <= AGE_PIN + 32 and a >= cfg.transmit_limit
+    assert AGE_PIN_Q <= a <= AGE_PIN_Q + CLAMP_EVERY // STAMP_UNIT
+    assert a >= cfg.transmit_limit_q
 
 
 def test_pick_bounded_adversarial_drain():
@@ -1028,13 +1056,13 @@ def test_quiet_round_gate_fixed_point_and_reopen():
     s2 = run(s, key=jax.random.key(2), num_rounds=40)
     assert bool(jnp.all(s2.known == s.known))
     assert int(s2.last_learn) == int(s.last_learn)
-    # stamps may only change via the clamp re-pin; derived ages must
-    # still read >= the pin for every known fact
-    from serf_tpu.models.dissemination import AGE_PIN, age_of
+    # stamps may only change via the clamp re-pin; derived q-ages must
+    # still read >= the transmit window for every known fact
+    from serf_tpu.models.dissemination import age_of
     ages = age_of(s2, cfg)
     known = unpack_bits(s2.known, cfg.k_facts)
     assert int(jnp.min(jnp.where(known, ages, jnp.uint8(255)))) \
-        >= cfg.transmit_limit
+        >= cfg.transmit_limit_q
     # re-open: a new fact injected into the quiet cluster disseminates
     s3 = inject_fact(s2, cfg, 9, K_USER_EVENT, 0, 2, origin=9)
     assert int(s3.last_learn) == int(s3.round)

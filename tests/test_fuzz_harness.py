@@ -16,7 +16,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
 from fuzz_messages import arbitrary_message, encode_any, run  # noqa: E402
 
 
-@pytest.mark.parametrize("seed", [0, 7])
+@pytest.mark.parametrize(
+    "seed", [0, pytest.param(7, marks=pytest.mark.slow)])
 def test_fuzz_slice_no_contract_violations(seed):
     stats = run(seed=seed, seconds=4.0, cases=None)
     assert stats["cases"] > 500, f"fuzzer too slow: {stats['cases']} cases"

@@ -314,12 +314,14 @@ def test_pallas_kernel_dispatches_are_timed():
     from serf_tpu.ops.round_kernels import merge_incoming, select_packets
 
     n, k, w = 32, 32, 1
-    stamp = jnp.zeros((n, k), jnp.uint8)
+    stamp = jnp.zeros((n, k), jnp.uint8)     # unpacked nibble flavor
     known = jnp.ones((n, w), jnp.uint32)
     alive = jnp.ones((n, 1), jnp.uint8)
-    packets = select_packets(stamp, known, alive, limit=8, round_=0)
+    packets = select_packets(stamp, known, alive, limit_q=2, round_=0,
+                             packed=False, k_facts=k)
     assert packets.shape == (n, w)
-    merge_incoming(known, packets, alive, stamp, next_round=1)
+    merge_incoming(known, packets, alive, stamp, next_round=1,
+                   packed=False, k_facts=k)
 
     summary = dispatch_summary()
     assert summary["ops.select_packets"]["calls"] == 1

@@ -1,19 +1,24 @@
 """Pallas round kernels: parity with the XLA oracle (interpret mode on CPU,
-compiled on TPU)."""
+compiled on TPU), for BOTH stamp-plane flavors (nibble-packed and the
+unpacked A/B), plus the pallas_ok flight-recorder breadcrumb."""
 
 import dataclasses
 import functools
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from serf_tpu.models.dissemination import (
     GossipConfig,
     K_USER_EVENT,
     inject_fact,
     make_state,
+    mod_age,
     round_step,
     run_rounds,
+    unpack_bits,
+    AGE_PIN_Q,
 )
 from serf_tpu.ops import round_kernels
 
@@ -22,40 +27,58 @@ def _rand_state(cfg, key):
     k2, k3, k4 = jax.random.split(key, 3)
     s = make_state(cfg)
     known = jax.random.bits(k2, (cfg.n, cfg.words), jnp.uint32)
-    # random stamps spanning the full wrap range, incl. values "newer"
-    # than the round (garbage under cleared known bits is legal)
-    stamp = jax.random.randint(k3, (cfg.n, cfg.k_facts), 0, 256
+    # random stamp bytes spanning the full range, incl. nibble values
+    # "newer" than the round (garbage under cleared known bits is legal)
+    stamp = jax.random.randint(k3, (cfg.n, cfg.stamp_cols), 0, 256
                                ).astype(jnp.uint8)
+    if not cfg.pack_stamp:
+        stamp = stamp & 0xF           # unpacked flavor stores nibbles
     alive = jax.random.bernoulli(k4, 0.9, (cfg.n,))
     return s._replace(known=known, stamp=stamp, alive=alive,
                       round=jnp.asarray(7, jnp.int32))
 
 
-def test_select_packets_matches_oracle():
-    cfg = GossipConfig(n=512, k_facts=64, use_pallas=True)
+@pytest.mark.parametrize("packed", [True, False])
+def test_select_packets_matches_oracle(packed):
+    cfg = GossipConfig(n=512, k_facts=64, use_pallas=True,
+                       pack_stamp=packed)
     s = _rand_state(cfg, jax.random.key(0))
     from serf_tpu.models.dissemination import pack_bits, sending_mask
     want_packets = pack_bits(sending_mask(s, cfg))
     packets = round_kernels.select_packets(
         s.stamp, s.known, s.alive[:, None].astype(jnp.uint8),
-        cfg.transmit_limit, s.round)
+        cfg.transmit_limit_q, s.round, packed=packed, k_facts=64)
     assert bool(jnp.all(packets == want_packets))
 
 
-def test_full_round_parity_pallas_vs_xla():
-    base = GossipConfig(n=512, k_facts=64, use_pallas=False)
+@pytest.mark.parametrize("packed", [True, False])
+def test_full_round_parity_pallas_vs_xla(packed):
+    base = GossipConfig(n=512, k_facts=64, use_pallas=False,
+                        pack_stamp=packed)
     fast = dataclasses.replace(base, use_pallas=True)
     s0 = _rand_state(base, jax.random.key(1))
     key = jax.random.key(2)
     a = jax.jit(functools.partial(round_step, cfg=base))(s0, key=key)
     b = jax.jit(functools.partial(round_step, cfg=fast))(s0, key=key)
-    # protocol state must be bit-identical; the sendable CACHE fields
-    # legitimately diverge (the XLA path maintains the cache, the pallas
-    # path invalidates it — dissemination.GossipState.sendable_round)
-    a_cmp = a._replace(sendable=b.sendable, sendable_round=b.sendable_round)
+    # protocol state must be bit-identical EXCEPT two documented fields:
+    # the sendable CACHE legitimately diverges (the XLA path maintains
+    # it, the pallas path invalidates — GossipState.sendable_round), and
+    # the stamp plane may differ ONLY in clamp timing — the pallas merge
+    # clamps while it streams every active round, the XLA path only on
+    # learn rounds, so wrap-stale cells can pin at different rounds.
+    # Their semantic content is identical: every threshold lives at or
+    # below AGE_PIN_Q, so q-ages saturated at the pin must agree wherever
+    # a known bit could expose them.
+    a_cmp = a._replace(sendable=b.sendable, sendable_round=b.sendable_round,
+                       stamp=b.stamp, last_clamp=b.last_clamp)
     for la, lb in zip(jax.tree_util.tree_leaves(a_cmp),
                       jax.tree_util.tree_leaves(b)):
         assert bool(jnp.all(la == lb))
+    kb = unpack_bits(a.known, 64)
+    qa = jnp.minimum(mod_age(a, base), AGE_PIN_Q)
+    qb = jnp.minimum(mod_age(b, base), AGE_PIN_Q)
+    assert bool(jnp.all(jnp.where(kb, qa == qb, True))), \
+        "pinned q-ages diverged under known bits"
     assert int(b.sendable_round) == -1, \
         "pallas path must invalidate the cache it does not maintain"
 
@@ -74,3 +97,25 @@ def test_pallas_ok_guard():
     assert round_kernels.pallas_ok(1_000_000, 64)
     assert not round_kernels.pallas_ok(1000, 64)   # no supported block divides 1000
     assert not round_kernels.pallas_ok(512, 48)    # K not a multiple of 32
+
+
+def test_pallas_fallback_records_flight_event():
+    """An unsupported shape with use_pallas=True must leave a flight
+    breadcrumb (r5 TPU_PROOF lesson: silent fallbacks made MosaicErrors
+    invisible) — and still produce a correct round via the XLA path."""
+    from serf_tpu import obs
+
+    rec = obs.FlightRecorder(capacity=64)
+    old = obs.global_recorder()
+    obs.set_global_recorder(rec)
+    try:
+        cfg = GossipConfig(n=100, k_facts=32, use_pallas=True)
+        s = inject_fact(make_state(cfg), cfg, 0, K_USER_EVENT, 0, 1, 0)
+        s = jax.jit(functools.partial(round_step, cfg=cfg))(
+            s, key=jax.random.key(0))
+        assert int(s.round) == 1
+        events = rec.dump(kind="pallas-fallback")
+        assert events, "pallas_ok rejection must record a flight event"
+        assert events[0]["n"] == 100 and events[0]["op"] == "round_step"
+    finally:
+        obs.set_global_recorder(old)

@@ -125,16 +125,18 @@ def test_rotation_push_pull_heals_partition():
     group = make_partition(cfg.n)
     key = jax.random.key(5)
     from serf_tpu.models.dissemination import round_step
+    step_part = jax.jit(lambda s, k: round_step(s, cfg, k, group=group))
     for _ in range(30):  # spread within the partition only
         key, k = jax.random.split(key)
-        st = round_step(st, cfg, k, group=group)
+        st = step_part(st, k)
     cov_partitioned = float(coverage(st, cfg)[0])
     assert cov_partitioned <= 0.55  # other half never saw it
     # heal: no group mask; a few push/pull syncs + rounds finish the job
+    heal = jax.jit(lambda s, k1, k2: round_step(
+        push_pull_round(s, cfg, k1), cfg, k2))
     for _ in range(20):
         key, k1, k2 = jax.random.split(key, 3)
-        st = push_pull_round(st, cfg, k1)
-        st = round_step(st, cfg, k2)
+        st = heal(st, k1, k2)
     assert float(coverage(st, cfg)[0]) == 1.0
     assert float(knowledge_agreement(st, cfg)) == 1.0
 
@@ -185,10 +187,15 @@ def test_rotation_query_gathers_all_responses():
                                  origin=3, eligible=no_filter_mask(cfg.n))
     key = jax.random.key(6)
     from serf_tpu.models.dissemination import round_step
+
+    @jax.jit
+    def step(g, qstate, k1, k2):
+        g = round_step(g, cfg, k1)
+        return g, query_round(g, qstate, cfg, qcfg, k2)
+
     for _ in range(30):
         key, k1, k2 = jax.random.split(key, 3)
-        g = round_step(g, cfg, k1)
-        qstate = query_round(g, qstate, cfg, qcfg, k2)
+        g, qstate = step(g, qstate, k1, k2)
     assert int(num_responses(qstate)[qi]) == cfg.n  # everyone responded
 
 

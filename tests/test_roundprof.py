@@ -1,0 +1,75 @@
+"""tools/roundprof.py tier-1 self-check: the per-phase profiler runs end
+to end on the CPU backend, honors its --json contract, attributes >= 90%
+of the whole compiled round's bytes to named phases (the acceptance bar —
+an unattributed byte blob is the round-5 "no profile exists" failure mode
+recurring), and its byte numbers stay tethered to the analytic model."""
+
+import json
+
+import jax
+
+from serf_tpu.obs.profile import PHASE_NAMES, profile_round, profile_table
+
+
+def _small_profile():
+    # module-level cache: one profile serves every assertion below
+    if not hasattr(_small_profile, "prof"):
+        from serf_tpu.models.swim import flagship_config
+        _small_profile.prof = profile_round(
+            flagship_config(2048, k_facts=64), events_per_round=2,
+            timed_calls=1, warm_rounds=10)
+    return _small_profile.prof
+
+
+def test_roundprof_cli_json_contract(capsys):
+    import tools.roundprof as roundprof
+
+    rc = roundprof.main(["--n", "2048", "--calls", "1", "--warm", "6",
+                         "--json"])
+    assert rc == 0
+    out = capsys.readouterr()
+    prof = json.loads(out.out)
+    assert prof["n"] == 2048 and prof["backend"] == jax.default_backend()
+    assert [r["phase"] for r in prof["phases"]] == list(PHASE_NAMES)
+    for r in prof["phases"]:
+        for field in ("wall_ms", "xla_bytes", "model_bytes",
+                      "achieved_gbps", "roofline_frac", "wall_share",
+                      "byte_share", "excess"):
+            assert field in r, f"{r['phase']} missing {field}"
+    assert "whole_round" in prof and "anomalous_phase" in prof
+    # the human table goes to stderr (stdout stays machine-clean)
+    assert "per-phase round profile" in out.err
+
+
+def test_roundprof_attributes_90_percent_of_round_bytes():
+    prof = _small_profile()
+    frac = prof["attributed_bytes_frac"]
+    assert frac is not None, "backend exposed no cost analysis"
+    assert frac >= 0.9, (
+        f"named phases attribute only {frac:.1%} of the compiled round's "
+        f"bytes — a phase is missing from the profile:\n"
+        + profile_table(prof))
+
+
+def test_roundprof_phase_bytes_track_model():
+    """Phases the analytic model prices must show compiled bytes within
+    an order of magnitude of the per-occurrence model (fusion slack) —
+    the cross-check that keeps entries citing real code paths."""
+    prof = _small_profile()
+    for r in prof["phases"]:
+        if r["model_bytes"] <= 0 or r["xla_bytes"] <= 0:
+            continue  # gated-off phases (refute/declare) price at 0
+        ratio = r["xla_bytes"] / r["model_bytes"]
+        assert 0.1 < ratio < 30.0, (
+            f"phase {r['phase']}: compiled {r['xla_bytes'] / 1e6:.2f} MB "
+            f"vs model {r['model_bytes'] / 1e6:.2f} MB (x{ratio:.1f})")
+
+
+def test_roundprof_anomaly_flags_low_roofline_phase():
+    """The anomaly is by construction the phase with the worst
+    wall-share-to-byte-share excess; sanity-pin the arithmetic."""
+    prof = _small_profile()
+    an = prof["anomalous_phase"]
+    worst = max(prof["phases"], key=lambda r: r["excess"])
+    assert an["phase"] == worst["phase"]
+    assert an["excess"] == worst["excess"]

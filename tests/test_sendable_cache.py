@@ -38,8 +38,11 @@ def _gossip_equal(a, b):
 
 
 def _cluster_cfg(cache: bool) -> ClusterConfig:
+    # k_facts=64: at n=2048 the transmit limit is 16 rounds, and
+    # sustained_round's fact-lifetime headroom check (ADVICE r5) requires
+    # k_facts/events_per_round > transmit_limit
     return ClusterConfig(
-        gossip=GossipConfig(n=2048, k_facts=32, peer_sampling="rotation",
+        gossip=GossipConfig(n=2048, k_facts=64, peer_sampling="rotation",
                             use_sendable_cache=cache),
         failure=FailureConfig(suspicion_rounds=8, max_new_facts=8,
                               probe_schedule="round_robin"),
@@ -170,8 +173,11 @@ def test_stale_cache_falls_back_after_nonmaintaining_kernel():
         outs[cache] = g
     _gossip_equal(outs[True], outs[False])
     # and wherever the cache re-armed, it matches the semantic predicate
+    # through the `& known` stale-bit mask selection applies
+    # (GossipState.sendable_round invariant)
     g = outs[True]
     cfg = GossipConfig(n=256, k_facts=32)
     if int(g.sendable_round) == int(g.round):
-        have = jnp.where(g.alive[:, None], g.sendable, jnp.uint32(0))
+        have = jnp.where(g.alive[:, None], g.sendable & g.known,
+                         jnp.uint32(0))
         assert bool(jnp.all(pack_bits(sending_mask(g, cfg)) == have))

@@ -44,7 +44,11 @@ async def _assert_converges(nodes, live, want, deadline_s, label):
         assert v >= want, f"{label}: survivor view {v} missing {want - v}"
 
 
-@pytest.mark.parametrize("seed", [1, 2, 7, 8])
+#: seed 1 stays tier-1 (the randomized API-storm loop is a distinct code
+#: path); the extra seeds are redundancy and ride `-m slow`
+@pytest.mark.parametrize(
+    "seed", [1] + [pytest.param(s, marks=pytest.mark.slow)
+                   for s in (2, 7, 8)])
 async def test_randomized_soak(seed):
     from tests.storm_ops import run_api_storm
 
@@ -75,7 +79,8 @@ async def test_randomized_soak(seed):
                 await s.shutdown()
 
 
-@pytest.mark.parametrize("seed", [402, 403])
+@pytest.mark.parametrize(
+    "seed", [402, pytest.param(403, marks=pytest.mark.slow)])
 async def test_partition_churn_storm(seed):
     """Churn storm with a mid-run bisection and heal.  Rejoins retry until
     they land (agent behavior — a node whose only join attempt failed

@@ -1,0 +1,197 @@
+"""The nibble-packed stamp plane must be a pure representation change:
+every protocol output (membership views, coverage trajectories, known/
+facts/tombstones, detection outcomes, the sendable cache) bit-identical
+with ``pack_stamp`` on or off, under the compositions the flagship
+actually runs — sustained injection, churn + failure detection,
+push/pull anti-entropy, and the quiescent gate.  This is the semantic
+A/B that gates the traffic halving (ISSUE 3 tentpole)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from serf_tpu.models.dissemination import (
+    AGE_PIN_Q,
+    CLAMP_EVERY,
+    GossipConfig,
+    K_USER_EVENT,
+    STAMP_UNIT,
+    budgets_of,
+    coverage,
+    inject_fact,
+    inject_facts_batch,
+    make_state,
+    mod_age,
+    run_rounds,
+    stamp_nibbles,
+    unpack_bits,
+)
+from serf_tpu.models.failure import FailureConfig, run_swim
+from serf_tpu.models.swim import (
+    ClusterConfig,
+    make_cluster,
+    run_cluster_sustained,
+)
+
+
+def _flavors(n=512, k=64):
+    return {pk: GossipConfig(n=n, k_facts=k, peer_sampling="rotation",
+                             pack_stamp=pk) for pk in (True, False)}
+
+
+def _semantically_equal(a, b, cfg_a, cfg_b):
+    """Every protocol field bit-identical; the stamp planes identical
+    through their nibble view (the only semantic content they have)."""
+    for name in ("known", "round", "last_learn", "next_slot", "alive",
+                 "incarnation", "tombstone", "sendable",
+                 "sendable_round", "last_clamp"):
+        assert bool(jnp.all(getattr(a, name) == getattr(b, name))), name
+    for name in ("subject", "kind", "incarnation", "ltime", "valid"):
+        assert bool(jnp.all(getattr(a.facts, name)
+                            == getattr(b.facts, name))), f"facts.{name}"
+    na = stamp_nibbles(a.stamp, cfg_a.k_facts, cfg_a.pack_stamp)
+    nb = stamp_nibbles(b.stamp, cfg_b.k_facts, cfg_b.pack_stamp)
+    assert bool(jnp.all(na == nb)), "stamp nibble values diverged"
+
+
+def test_packed_shapes_and_layout():
+    cfgs = _flavors(n=256, k=64)
+    assert make_state(cfgs[True]).stamp.shape == (256, 32)
+    assert make_state(cfgs[False]).stamp.shape == (256, 64)
+    # layout: fact k lives in byte k//2, even k = low nibble
+    s = make_state(cfgs[True])
+    s = inject_fact(s, cfgs[True], 5, K_USER_EVENT, 0, 1, 0)
+    s = inject_fact(s, cfgs[True], 6, K_USER_EVENT, 0, 2, 0)
+    nib = stamp_nibbles(s.stamp, 64, True)
+    assert nib.shape == (256, 64)
+    # round 0 -> quarter 0 stamps; the known bits gate their validity
+    assert bool(unpack_bits(s.known, 64)[0, 0])
+    assert bool(unpack_bits(s.known, 64)[0, 1])
+
+
+def test_gossip_trajectory_bit_exact_packed_vs_unpacked():
+    """40 plain gossip rounds from one injected fact: coverage at every
+    checkpoint and the final state must match bit-for-bit."""
+    outs, covs = {}, {}
+    for pk, cfg in _flavors(n=512, k=32).items():
+        g = inject_fact(make_state(cfg), cfg, 3, K_USER_EVENT, 0, 1, 0)
+        run = jax.jit(functools.partial(run_rounds, cfg=cfg),
+                      static_argnames=("num_rounds",))
+        traj = []
+        for seg in range(4):
+            g = run(g, key=jax.random.key(100 + seg), num_rounds=10)
+            traj.append(coverage(g, cfg))
+        outs[pk], covs[pk] = g, jnp.stack(traj)
+    assert bool(jnp.all(covs[True] == covs[False])), \
+        "coverage trajectories diverged"
+    cfgs = _flavors(n=512, k=32)
+    _semantically_equal(outs[True], outs[False], cfgs[True], cfgs[False])
+
+
+def test_flagship_sustained_churn_bit_exact_packed_vs_unpacked():
+    """The full flagship composition (sustained events + probes + refute
+    + declare-at-probe-cadence + push/pull + vivaldi cadence) with
+    external churn between scan segments: identical membership views and
+    coverage trajectories — the ISSUE-3 acceptance A/B."""
+    from serf_tpu.models.views import cluster_stats
+
+    gcfgs = _flavors(n=512, k=64)
+    cfgs = {pk: ClusterConfig(
+        gossip=g,
+        failure=FailureConfig(suspicion_rounds=8, max_new_facts=8,
+                              probe_schedule="round_robin"),
+        push_pull_every=8, probe_every=5) for pk, g in gcfgs.items()}
+    runs = {pk: jax.jit(functools.partial(run_cluster_sustained, cfg=cfg,
+                                          events_per_round=2),
+                        static_argnames=("num_rounds",))
+            for pk, cfg in cfgs.items()}
+    states = {pk: make_cluster(cfg, jax.random.key(0))
+              for pk, cfg in cfgs.items()}
+
+    for seg in range(3):
+        views = {}
+        for pk in (True, False):
+            states[pk] = runs[pk](states[pk], key=jax.random.key(10 + seg),
+                                  num_rounds=25)
+            g = states[pk].gossip
+            # churn: kill two nodes, revive one, inject out-of-band
+            g = g._replace(alive=g.alive.at[
+                jnp.asarray([17 + seg, 400 + seg])].set(False))
+            g = g._replace(alive=g.alive.at[9].set(True))
+            g = inject_facts_batch(
+                g, cfgs[pk].gossip,
+                subjects=jnp.asarray([450 + seg], jnp.int32),
+                kind=K_USER_EVENT,
+                incarnations=jnp.zeros((1,), jnp.uint32),
+                ltimes=jnp.asarray([900 + seg], jnp.uint32),
+                origins=jnp.asarray([11], jnp.int32),
+                active=jnp.ones((1,), bool))
+            states[pk] = states[pk]._replace(gossip=g)
+            views[pk] = jax.device_get(cluster_stats(g, cfgs[pk].gossip))
+        for fa, fb in zip(views[True], views[False]):
+            assert bool(jnp.all(fa == fb)), "membership views diverged"
+    _semantically_equal(states[True].gossip, states[False].gossip,
+                        gcfgs[True], gcfgs[False])
+
+
+def test_swim_detection_bit_exact_packed_vs_unpacked():
+    """Failure-detection outcomes (suspicion aging through declaration,
+    refutation, tombstones) identical across flavors — 60 rounds crosses
+    several clamp boundaries and a stamp wrap (16 quarters = 64 rounds
+    at the margin the clamp protects)."""
+    outs = {}
+    for pk, gcfg in _flavors(n=512, k=32).items():
+        fcfg = FailureConfig(suspicion_rounds=8,
+                             probe_schedule="round_robin")
+        g = make_state(gcfg)
+        g = inject_fact(g, gcfg, subject=3, kind=K_USER_EVENT,
+                        incarnation=0, ltime=1, origin=0)
+        g = g._replace(alive=g.alive.at[jnp.asarray([17, 300])].set(False))
+        run = jax.jit(functools.partial(run_swim, cfg=gcfg, fcfg=fcfg),
+                      static_argnames=("num_rounds",))
+        outs[pk] = run(g, key=jax.random.key(1), num_rounds=48)
+    cfgs = _flavors(n=512, k=32)
+    _semantically_equal(outs[True], outs[False], cfgs[True], cfgs[False])
+    assert bool(jnp.any(~outs[True].alive)), "churn must have happened"
+
+
+def test_quarter_age_derivation_and_budgets():
+    """q-ages advance one tick per STAMP_UNIT rounds, budgets derive in
+    q-units, and a fact stops sending within (limit-4, limit] rounds —
+    the documented quantization."""
+    cfg = GossipConfig(n=64, k_facts=32)           # transmit_limit = 8
+    assert cfg.transmit_limit == 8 and cfg.transmit_limit_q == 2
+    s = inject_fact(make_state(cfg), cfg, 1, K_USER_EVENT, 0, 1, 0)
+    assert int(mod_age(s, cfg)[0, 0]) == 0
+    assert int(budgets_of(s, cfg)[0, 0]) == cfg.transmit_limit_q
+    # age advances only when the round crosses a quarter boundary
+    for r in range(1, 12):
+        ages = mod_age(s._replace(round=jnp.asarray(r, jnp.int32)), cfg)
+        assert int(ages[0, 0]) == r // STAMP_UNIT
+    # budget exhausts at q_age == limit_q, i.e. exactly round limit
+    # (learn happened at a quarter boundary here)
+    s8 = s._replace(round=jnp.asarray(cfg.transmit_limit, jnp.int32))
+    assert int(budgets_of(s8, cfg)[0, 0]) == 0
+
+
+def test_clamp_pins_and_never_wraps_under_thresholds():
+    """A known fact left un-restamped for hundreds of rounds must always
+    read as at-least-pin age (never wrap back under transmit/suspicion
+    thresholds), in both flavors, with the clamp riding learn passes or
+    the standalone pass (last_clamp)."""
+    for pk, cfg in _flavors(n=256, k=32).items():
+        g = inject_fact(make_state(cfg), cfg, 1, K_USER_EVENT, 0, 1, 0)
+        run = jax.jit(functools.partial(run_rounds, cfg=cfg),
+                      static_argnames=("num_rounds",))
+        g = run(g, key=jax.random.key(2), num_rounds=260)
+        known = unpack_bits(g.known, cfg.k_facts)
+        ages = jnp.where(known, mod_age(g, cfg), jnp.uint8(255))
+        covered_age = int(jnp.min(jnp.where(known, ages, jnp.uint8(255))))
+        # after 260 quiet-ish rounds every stamp is pinned: q-age in
+        # [AGE_PIN_Q, AGE_PIN_Q + CLAMP_EVERY/STAMP_UNIT], never < limit
+        assert covered_age >= cfg.transmit_limit_q
+        assert covered_age >= AGE_PIN_Q
+        assert covered_age <= AGE_PIN_Q + CLAMP_EVERY // STAMP_UNIT
+        # and the gossip gate is closed (nothing sendable anywhere)
+        assert int(jnp.sum(budgets_of(g, cfg))) == 0

@@ -37,10 +37,13 @@ def test_detection_survives_ring_recycling_under_sustained_load():
     dead = [101, 700, 1500]
     g = g._replace(alive=g.alive.at[jnp.asarray(dead)].set(False))
     st = st._replace(gossip=g)
+    # 1 event/round: slot lifetime 32 rounds stays above the 16-round
+    # transmit limit (the ADVICE-r5 headroom check sustained_round
+    # enforces) while the ring still recycles many times below
     run = jax.jit(functools.partial(run_cluster_sustained, cfg=cfg,
-                                    events_per_round=2),
+                                    events_per_round=1),
                   static_argnames=("num_rounds",))
-    # 200 rounds at 2 events/round cycles the 32-slot ring ~12 times:
+    # 200 rounds at 1 event/round cycles the 32-slot ring ~6 times:
     # every detection-era fact has long been retired
     st = run(st, key=jax.random.key(1), num_rounds=200)
     g = st.gossip
@@ -63,8 +66,9 @@ def test_rejoin_clears_tombstone():
     st = make_cluster(cfg, jax.random.key(0))
     g = st.gossip._replace(alive=st.gossip.alive.at[77].set(False))
     st = st._replace(gossip=g)
+    # 1 event/round: lifetime headroom over the transmit limit (see above)
     run = jax.jit(functools.partial(run_cluster_sustained, cfg=cfg,
-                                    events_per_round=2),
+                                    events_per_round=1),
                   static_argnames=("num_rounds",))
     st = run(st, key=jax.random.key(1), num_rounds=120)
     g = st.gossip

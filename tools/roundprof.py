@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Per-phase profiler for the flagship ``cluster_round`` (CLI).
+
+Jits every round phase in isolation on a warmed sustained-load state,
+times each behind a device→host barrier, pulls XLA ``cost_analysis()``
+bytes/flops, cross-checks the analytic byte model, and flags the phase
+whose wall share its bytes cannot explain — the localization tool for
+any measured-vs-roofline gap (serf_tpu/obs/profile.py has the method).
+
+Usage:
+
+    python tools/roundprof.py [--n 65536] [--k 64] [--calls 3] [--json]
+
+``--json`` prints the machine contract on stdout (one JSON object:
+``n/k/backend/phases[]/whole_round/attributed_bytes_frac/
+anomalous_phase``; each phase row carries ``wall_ms``, ``xla_bytes``,
+``model_bytes``, ``achieved_gbps``, ``roofline_frac``, ``wall_share``,
+``byte_share``, ``excess``); the human table always goes to stderr.
+Runs on whatever backend JAX resolves — on the CPU fallback the
+roofline fractions are still computed against the v5e HBM constant and
+labeled via ``backend``.  Tier-1 runs this as a self-check
+(tests/test_roundprof.py): the contract keys and the ≥90% byte
+attribution are pinned there.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n", type=int, default=65536)
+    ap.add_argument("--k", type=int, default=64)
+    ap.add_argument("--events", type=int, default=2,
+                    help="user events injected per round (sustained load)")
+    ap.add_argument("--calls", type=int, default=3,
+                    help="timed steady calls per phase")
+    ap.add_argument("--warm", type=int, default=24,
+                    help="sustained warmup rounds before profiling")
+    ap.add_argument("--json", action="store_true",
+                    help="print the JSON contract on stdout")
+    args = ap.parse_args(argv)
+
+    from serf_tpu.models.swim import flagship_config
+    from serf_tpu.obs.profile import profile_round, profile_table
+
+    cfg = flagship_config(args.n, k_facts=args.k)
+    prof = profile_round(cfg, events_per_round=args.events,
+                         timed_calls=args.calls, warm_rounds=args.warm)
+    sys.stderr.write(profile_table(prof) + "\n")
+    if args.json:
+        print(json.dumps(prof))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,13 +122,13 @@ def isolated_only(g0):
         return _anchor(s, chosen, subjects, active)
     scan_timer("pick_bounded (production) x1", pick_prod, g0)
 
-    # a full N*K u8 plane select+rewrite alone (what the old stored-age
-    # tick cost every round; the stamp plane now pays this only on the
-    # merge's learn write — this isolates that traffic)
+    # a full stamp-plane select+rewrite alone (what the old stored-age
+    # tick cost every round; the nibble-packed plane now pays this only
+    # on the merge's learn write — this isolates that traffic)
     def plane_body(s, k):
         bumped = jnp.where(s.stamp < 255, s.stamp + 1, s.stamp)
         return s._replace(stamp=bumped, round=s.round + 1)
-    scan_timer("N*K u8 plane rewrite", plane_body, g0)
+    scan_timer("stamp plane rewrite", plane_body, g0)
 
     # rolled_rows of the packet plane alone (summed so all three rolls
     # materialize; a masked-to-zero merge would be folded away entirely)
