@@ -51,12 +51,31 @@ TARGET_ROUNDS_PER_SEC = 10_000.0  # BASELINE.json north star (v5e-8)
 # Budget discipline (round-3 lesson: 1500+900 s exceeded the driver's own
 # timeout, which killed the orchestrator mid-fallback and recorded NOTHING
 # — rc=124 in BENCH_r03.json).  A cheap liveness probe decides TPU-vs-CPU
-# up front.  Worst case INCLUDING the 20 s SIGINT-grace each timed-out
-# child gets: (60+20) + (510+20) + (450+20) = 1080 s, inside the window
-# round 2 proved the driver allows (480 + ~400 s completed).
+# up front.  The probe gets 3 SPACED attempts with backoff (VERDICT
+# next-3: a transient tunnel wedge should not condemn a whole round to
+# CPU), but retries only when the outcome is retryable — a clean "CPU
+# only" verdict (rc 3) is deterministic and never retried, and retry
+# attempts run under the shorter RETRY timeout.  Worst case INCLUDING the
+# 20 s SIGINT-grace each timed-out child gets:
+# (60+20) + 3 + (25+20) + 6 + (25+20) + (510+20) + (450+20) ≈ 1180 s on
+# the pathological wedge-probe-then-TPU-headline-fails path — within the
+# window the round-2/round-3 history shows the driver allows, and the
+# realistic paths (probe ok first try, or deterministic CPU-only) are
+# unchanged.
 PROBE_TIMEOUT_S = int(os.environ.get("SERF_TPU_BENCH_PROBE_TIMEOUT", "60"))
+PROBE_RETRY_TIMEOUT_S = int(os.environ.get(
+    "SERF_TPU_BENCH_PROBE_RETRY_TIMEOUT", "25"))
+PROBE_ATTEMPTS = int(os.environ.get("SERF_TPU_BENCH_PROBE_ATTEMPTS", "3"))
+PROBE_BACKOFF_S = (3, 6)
 TPU_TIMEOUT_S = int(os.environ.get("SERF_TPU_BENCH_TIMEOUT", "510"))
 CPU_TIMEOUT_S = int(os.environ.get("SERF_TPU_BENCH_CPU_TIMEOUT", "450"))
+#: rolling record of the last successful TPU measurement (timestamp +
+#: headline numbers).  Written after every TPU-backed headline; embedded
+#: as a ``tpu_last_good`` block in any CPU-fallback headline so a
+#: BENCH_r*.json produced during a tunnel outage still carries the last
+#: real accelerator numbers alongside the honestly-labeled CPU ones.
+TPU_LAST_GOOD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "TPU_LAST_GOOD.json")
 
 
 def _round_scalar(state):
@@ -404,14 +423,62 @@ def _run_child(args, timeout_s: int, env=None):
         return None, out or "", err or ""
 
 
+def _save_tpu_last_good(headline_json: str) -> None:
+    try:
+        headline = json.loads(headline_json)
+    except ValueError:
+        return
+    try:
+        with open(TPU_LAST_GOOD_PATH, "w") as f:
+            json.dump({"ts": time.time(),
+                       "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                            time.gmtime()),
+                       "headline": headline}, f, indent=1)
+    except OSError:
+        pass
+
+
+def _load_tpu_last_good():
+    try:
+        with open(TPU_LAST_GOOD_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _probe_tunnel(me: str) -> bool:
+    """Tunnel-liveness with bounded retries: up to PROBE_ATTEMPTS spaced
+    attempts.  rc 0 = accelerator proven; rc 3 = CPU-only, deterministic
+    (no retry); anything else (wedge/timeout/crash) retries after a
+    backoff — a transiently stuck allocator grant often clears in
+    seconds once the dead client's grip is released."""
+    for attempt in range(PROBE_ATTEMPTS):
+        timeout = PROBE_TIMEOUT_S if attempt == 0 else PROBE_RETRY_TIMEOUT_S
+        rc, _, perr = _run_child([sys.executable, me, "--probe"], timeout)
+        sys.stderr.write(perr[-500:] + "\n")
+        if rc == 0:
+            return True
+        if rc == 3:
+            sys.stderr.write("probe: CPU-only backend (deterministic); "
+                             "not retrying\n")
+            return False
+        if attempt < PROBE_ATTEMPTS - 1:
+            delay = PROBE_BACKOFF_S[min(attempt, len(PROBE_BACKOFF_S) - 1)]
+            sys.stderr.write("probe attempt %d/%d failed (rc=%s); "
+                             "retrying in %ds\n"
+                             % (attempt + 1, PROBE_ATTEMPTS, rc, delay))
+            time.sleep(delay)
+    sys.stderr.write("tunnel probe failed after %d attempts\n"
+                     % PROBE_ATTEMPTS)
+    return False
+
+
 def orchestrate() -> None:
-    """Probe the tunnel (~seconds, 60 s cap), then run the measurement on
-    whichever backend the probe proved; never exceed the driver window."""
+    """Probe the tunnel (retried with backoff), then run the measurement
+    on whichever backend the probe proved; never exceed the driver
+    window."""
     me = os.path.abspath(__file__)
-    rc, _, perr = _run_child([sys.executable, me, "--probe"],
-                             PROBE_TIMEOUT_S)
-    sys.stderr.write(perr[-500:] + "\n")
-    tpu_alive = rc == 0
+    tpu_alive = _probe_tunnel(me)
 
     record_env = dict(os.environ, SERF_TPU_BENCH_RECORD="1")
     if tpu_alive:
@@ -425,12 +492,11 @@ def orchestrate() -> None:
             if rc is None:
                 sys.stderr.write("TPU bench timed out after the headline; "
                                  "keeping the measured headline\n")
+            _save_tpu_last_good(out)
             print(out)
             return
         sys.stderr.write("TPU bench produced no headline (probe had "
                          "passed); falling back to CPU\n")
-    else:
-        sys.stderr.write("tunnel probe failed (rc=%s); CPU fallback\n" % rc)
 
     env = dict(record_env, SERF_TPU_BENCH_CPU="1")
     rc, out_s, err_s = _run_child([sys.executable, me, "--run"],
@@ -438,6 +504,17 @@ def orchestrate() -> None:
     sys.stderr.write(err_s[-2000:] + "\n")
     out = _last_json_line(out_s)
     if out is not None and "ERROR" not in out:
+        # embed the last KNOWN-GOOD TPU numbers beside the CPU fallback:
+        # the artifact stays honest (platform says CPU) but the round
+        # record keeps the accelerator's last measured reality
+        last_good = _load_tpu_last_good()
+        if last_good is not None:
+            try:
+                merged = json.loads(out)
+                merged["tpu_last_good"] = last_good
+                out = json.dumps(merged)
+            except ValueError:
+                pass
         print(out)
         return
     if rc is None:

@@ -1,0 +1,23 @@
+"""Unified chaos plane: seeded fault schedules across host + device.
+
+One declarative, seeded :class:`FaultPlan` (``faults.plan``) drives both
+planes — the host executor (``faults.host``) compiles phases to
+transport-level :class:`~serf_tpu.host.transport.ChaosRule` objects and
+runs loopback clusters through them; the device executor
+(``faults.device``) lowers the same plan to per-round partition/loss/
+liveness masks consumed inside the jitted scan.  ``faults.invariants``
+judges convergence, false-death, clock-monotonicity and crash-restart
+correctness afterwards.  ``tools/chaos.py`` is the operator CLI.
+"""
+
+from serf_tpu.faults.plan import (  # noqa: F401
+    EdgeFault,
+    FaultPhase,
+    FaultPlan,
+    named_plan,
+    plan_names,
+)
+from serf_tpu.faults.invariants import (  # noqa: F401
+    InvariantReport,
+    InvariantResult,
+)

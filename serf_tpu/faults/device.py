@@ -1,0 +1,217 @@
+"""Device-plane fault executor: FaultPlan -> per-round masks in the scan.
+
+``lower_plan`` compiles the SAME :class:`~serf_tpu.faults.plan.FaultPlan`
+the host executor runs into a :class:`DeviceFaultSchedule` — per-phase
+partition-group vectors (``i32[P, N]``), loss rates (``f32[P]``) and
+down-node masks (``bool[P, N]``) — and ``run_device_plan`` drives the
+flagship ``cluster_round`` through the plan phase by phase, with the
+masks consumed INSIDE the jitted scan (``models/swim.cluster_round``:
+gossip exchange, probe adjacency, push/pull and Vivaldi all read them).
+
+Lowering semantics (device deviations are explicit, not silent):
+
+- partitions/crash/pause/restart/drop lower exactly;
+- ``pause`` lowers like ``crash`` (the model's liveness bit IS its
+  network presence — there is no separate process-alive state);
+- ``corrupt`` folds into ``drop`` (a corrupted packet is quarantined by
+  the receiver's wire pipeline — same observable outcome: not learned);
+- ``duplicate``/``reorder``/``delay`` are no-ops under round-synchronous
+  idempotent OR-merge delivery and lower to nothing;
+- per-edge faults do not lower (no O(N^2) edge state on device);
+  plans carrying them still run, with a note in the schedule.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from serf_tpu.faults.plan import FaultPlan
+from serf_tpu.models.swim import (
+    ClusterConfig,
+    ClusterState,
+    cluster_round,
+    make_cluster,
+)
+
+
+class DeviceFaultSchedule(NamedTuple):
+    """Per-phase fault tensors (P = number of phases, N = nodes)."""
+
+    rounds: Tuple[int, ...]       # static per-phase round counts
+    group: jnp.ndarray            # i32[P, N] partition id per node
+    drop: jnp.ndarray             # f32[P]    per-delivery loss rate
+    down: jnp.ndarray             # bool[P, N] nodes off the network
+    notes: Tuple[str, ...] = ()   # lowering caveats (e.g. edges skipped)
+
+
+def lower_plan(plan: FaultPlan, n: Optional[int] = None
+               ) -> DeviceFaultSchedule:
+    """Compile ``plan`` to per-phase device masks.  ``n`` overrides the
+    plan's node count (a plan written for 6 hosts can drive a 4096-node
+    sim: groups/crash sets given as fractions of the plan's n scale by
+    index stretching — node i of the plan covers indices
+    ``[i*n/plan.n, (i+1)*n/plan.n)`` of the sim)."""
+    plan.validate()
+    sim_n = n or plan.n
+    scale = sim_n / plan.n
+
+    def span(i: int) -> range:
+        return range(int(i * scale), max(int(i * scale) + 1,
+                                         int((i + 1) * scale)))
+
+    notes: List[str] = []
+    p = len(plan.phases)
+    group = np.zeros((p, sim_n), np.int32)
+    drop = np.zeros((p,), np.float32)
+    down = np.zeros((p, sim_n), bool)
+    cur_down = np.zeros((sim_n,), bool)
+    for pi, phase in enumerate(plan.phases):
+        if phase.partitions:
+            # nodes not listed in any group share one implicit extra
+            # group (same rule as faults.host.compile_phase)
+            for gi, g in enumerate(phase.partitions, start=1):
+                for node in g:
+                    for j in span(node):
+                        group[pi, j] = gi
+        eff_drop = phase.drop + phase.corrupt * (1.0 - phase.drop)
+        drop[pi] = min(1.0, eff_drop)
+        if phase.corrupt:
+            notes.append(f"phase {pi}: corrupt folded into drop")
+        if phase.edges:
+            notes.append(f"phase {pi}: {len(phase.edges)} edge fault(s) "
+                         "not lowered (host-plane only)")
+        for node in (*phase.crash, *phase.pause):
+            for j in span(node):
+                cur_down[j] = True
+        for node in phase.restart:
+            for j in span(node):
+                cur_down[j] = False
+        down[pi] = cur_down
+    return DeviceFaultSchedule(
+        rounds=tuple(ph.rounds for ph in plan.phases),
+        group=jnp.asarray(group),
+        drop=jnp.asarray(drop),
+        down=jnp.asarray(down),
+        notes=tuple(notes),
+    )
+
+
+def run_phase(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
+              num_rounds: int, group: jnp.ndarray, drop,
+              init_alive: jnp.ndarray, down: jnp.ndarray) -> ClusterState:
+    """Scan ``num_rounds`` chaos rounds with one phase's masks applied.
+    Jit with ``num_rounds`` static; group/drop/down are traced, so equal-
+    length phases reuse the compiled executable."""
+    alive = init_alive & ~down
+    st = state._replace(gossip=state.gossip._replace(alive=alive),
+                        group=group)
+
+    def body(carry, subkey):
+        return cluster_round(carry, cfg, subkey, drop_rate=drop), ()
+
+    keys = jax.random.split(key, num_rounds)
+    final, _ = jax.lax.scan(body, st, keys)
+    return final
+
+
+@dataclass
+class DeviceChaosResult:
+    plan: FaultPlan
+    schedule: DeviceFaultSchedule
+    state: ClusterState
+    report: object                 # invariants.InvariantReport
+    rounds_run: int = 0
+    notes: Tuple[str, ...] = ()
+    injected: List[int] = field(default_factory=list)
+
+
+def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
+                    key: Optional[jax.Array] = None,
+                    state: Optional[ClusterState] = None,
+                    events_per_phase: int = 2) -> DeviceChaosResult:
+    """Run ``plan`` against the flagship device cluster and check the
+    invariants.  Injects ``events_per_phase`` fresh user events at the
+    start of every phase (plus the settle window) so there is always
+    knowledge whose post-heal convergence the checker can judge."""
+    import functools
+
+    from serf_tpu.faults import invariants as inv
+    from serf_tpu.models.dissemination import (
+        K_USER_EVENT,
+        inject_facts_batch,
+    )
+
+    plan.validate()
+    sched = lower_plan(plan, cfg.n)
+    key = key if key is not None else jax.random.key(plan.seed)
+    if state is None:
+        key, k0 = jax.random.split(key)
+        state = make_cluster(cfg, k0)
+    init_alive = state.gossip.alive
+    run = jax.jit(functools.partial(run_phase, cfg=cfg),
+                  static_argnames=("num_rounds",))
+
+    injected: List[int] = []
+    next_eid = 1
+
+    def inject(st: ClusterState, origins_key) -> ClusterState:
+        nonlocal next_eid
+        m = events_per_phase
+        if m <= 0:
+            return st
+        eids = jnp.arange(next_eid, next_eid + m, dtype=jnp.int32)
+        injected.extend(range(next_eid, next_eid + m))
+        next_eid += m
+        origins = jax.random.randint(origins_key, (m,), 0, cfg.n,
+                                     dtype=jnp.int32)
+        g = inject_facts_batch(
+            st.gossip, cfg.gossip, eids, K_USER_EVENT,
+            incarnations=jnp.zeros((m,), jnp.uint32),
+            ltimes=eids.astype(jnp.uint32),
+            origins=origins, active=jnp.ones((m,), bool))
+        return st._replace(gossip=g)
+
+    total = 0
+    no_group = jnp.zeros((cfg.n,), jnp.int32)
+    no_down = jnp.zeros((cfg.n,), bool)
+    for pi, num_rounds in enumerate(sched.rounds):
+        if num_rounds <= 0:
+            continue
+        key, k_inj, k_run = jax.random.split(key, 3)
+        state = inject(state, k_inj)
+        state = run(state, key=k_run, num_rounds=num_rounds,
+                    group=sched.group[pi], drop=sched.drop[pi],
+                    init_alive=init_alive, down=sched.down[pi])
+        total += num_rounds
+    # settle: fault-free rounds for re-convergence (drop 0, no partition,
+    # everyone the plan restarted is back up).  Chunked to the phases'
+    # common round count when possible so the whole run reuses ONE
+    # compiled phase scan (the named plans are authored for this).
+    if plan.settle_rounds > 0:
+        lens = {r for r in sched.rounds if r > 0}
+        if len(lens) == 1 and plan.settle_rounds % next(iter(lens)) == 0:
+            chunk = next(iter(lens))
+        else:
+            chunk = plan.settle_rounds
+        key, k_inj, _ = jax.random.split(key, 3)
+        state = inject(state, k_inj)
+        left = plan.settle_rounds
+        while left > 0:
+            step = min(chunk, left)
+            key, k_run = jax.random.split(key)
+            state = run(state, key=k_run, num_rounds=step,
+                        group=no_group, drop=jnp.float32(0.0),
+                        init_alive=init_alive, down=no_down)
+            left -= step
+        total += plan.settle_rounds
+
+    report = inv.check_device(plan, state, cfg, init_alive,
+                              rounds_run=total)
+    return DeviceChaosResult(plan=plan, schedule=sched, state=state,
+                             report=report, rounds_run=total,
+                             notes=sched.notes, injected=injected)

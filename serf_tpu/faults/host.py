@@ -1,0 +1,367 @@
+"""Host-plane fault executor: FaultPlan -> LoopbackNetwork / transports.
+
+Two entry points:
+
+- :class:`HostFaultExecutor` compiles :class:`~serf_tpu.faults.plan
+  .FaultPlan` phases into :class:`serf_tpu.host.transport.ChaosRule`
+  objects and installs them on a ``LoopbackNetwork`` (the one fault API
+  the legacy ``partition``/``set_drop_rate`` knobs also delegate to).
+  For clusters on REAL transports (net/dstream), ``wrap_transport``
+  injects the same phase faults at the sender seam — drop, blocked
+  edges/partitions, corruption — which is how the transport-storm tests
+  drive TCP/TLS/udpstream clusters from a plan.
+
+- :func:`run_host_plan` stands up an in-process loopback cluster, runs
+  the plan end to end (crash = Serf shutdown, restart = re-create on the
+  OLD address with the node's snapshot), keeps background traffic
+  flowing, samples Lamport clocks throughout, then heals, waits the
+  settle budget, and hands everything to the invariant checker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from serf_tpu.faults.plan import FaultPhase, FaultPlan
+from serf_tpu.host.transport import (
+    ChaosRule,
+    EdgeRates,
+    LoopbackNetwork,
+    apply_edge_faults,
+)
+from serf_tpu.obs import flight
+from serf_tpu.utils import metrics
+from serf_tpu.utils.logging import get_logger
+
+log = get_logger("faults")
+
+
+def compile_phase(phase: FaultPhase, addr_of) -> ChaosRule:
+    """Lower one plan phase to a transport-level chaos rule.
+    ``addr_of(i)`` maps plan node indices to transport addresses."""
+    groups: Optional[List[set]] = None
+    if phase.partitions:
+        groups = [set(addr_of(i) for i in g) for g in phase.partitions]
+        listed = set().union(*groups) if groups else set()
+        # unlisted nodes form one implicit extra group (plan semantics,
+        # identical on the device plane)
+        rest = {addr_of(i) for i in range(_plan_n(addr_of))} - listed
+        if rest:
+            groups.append(rest)
+    edges: Dict[Tuple[object, object], EdgeRates] = {}
+    for e in phase.edges:
+        rates = EdgeRates(drop=e.drop, delay=e.delay, duplicate=e.duplicate,
+                          reorder=e.reorder, corrupt=e.corrupt)
+        edges[(addr_of(e.src), addr_of(e.dst))] = rates
+        if e.bidirectional:
+            edges[(addr_of(e.dst), addr_of(e.src))] = rates
+    return ChaosRule(
+        groups=groups,
+        drop=phase.drop,
+        delay=phase.delay,
+        jitter=phase.jitter,
+        duplicate=phase.duplicate,
+        reorder=phase.reorder,
+        corrupt=phase.corrupt,
+        edges=edges,
+    )
+
+
+def _plan_n(addr_of) -> int:
+    n = getattr(addr_of, "plan_n", None)
+    if n is None:
+        raise ValueError("addr_of must carry a .plan_n attribute "
+                         "(use HostFaultExecutor or make_addr_of)")
+    return n
+
+
+def make_addr_of(n: int, mapping=None):
+    """Index -> address mapper for ``compile_phase``.  Default address
+    scheme is ``"n{i}"`` (the loopback runner's node names)."""
+    def addr_of(i: int):
+        return mapping[i] if mapping is not None else f"n{i}"
+    addr_of.plan_n = n
+    return addr_of
+
+
+class HostFaultExecutor:
+    """Drives a plan's phases against a ``LoopbackNetwork`` (and any
+    wrapped real transports registered via :meth:`wrap_transport`)."""
+
+    def __init__(self, plan: FaultPlan, net: Optional[LoopbackNetwork] = None,
+                 mapping: Optional[Dict[int, object]] = None):
+        plan.validate()
+        self.plan = plan
+        self.net = net
+        self.addr_of = make_addr_of(plan.n, mapping)
+        self.rng = random.Random(plan.seed)
+        self.phase_index: Optional[int] = None
+        self._down: set = set()          # node indices currently down
+        self._paused: set = set()
+        self._wrapped: List[object] = []
+
+    # -- phase stepping ------------------------------------------------------
+
+    def apply_phase(self, index: int) -> FaultPhase:
+        """Install phase ``index``'s faults (and update the down/pause
+        bookkeeping).  Crash/restart of real processes is the caller's
+        job (run_host_plan does it); pause is enforced at the network."""
+        phase = self.plan.phases[index]
+        self._down |= set(phase.crash)
+        self._paused |= set(phase.pause)
+        self._down -= set(phase.restart)
+        self._paused -= set(phase.restart)
+        rule = compile_phase(phase, self.addr_of)
+        rule.paused = frozenset(self.addr_of(i) for i in self._paused)
+        self._install(rule)
+        self.phase_index = index
+        metrics.gauge("serf.faults.phase", index)
+        flight.record("fault-phase", plan=self.plan.name, phase=index,
+                      name=phase.name)
+        return phase
+
+    def clear(self) -> None:
+        """Heal everything (end of plan): no partitions, no rates; nodes
+        the plan left paused stay paused only if never restarted — the
+        plan validator forbids that, so clear really is clear."""
+        self._install(None)
+        self.phase_index = None
+        metrics.gauge("serf.faults.phase", -1)
+        flight.record("fault-phase", plan=self.plan.name, phase=-1,
+                      name="healed")
+
+    def _install(self, rule: Optional[ChaosRule]) -> None:
+        if self.net is not None:
+            if rule is not None:
+                self.net.rng = random.Random(
+                    self.rng.randrange(1 << 30))
+            self.net.apply_faults(rule)
+        for t in self._wrapped:
+            t._chaos_rule = rule
+
+    def down_nodes(self) -> frozenset:
+        return frozenset(self._down | self._paused)
+
+    # -- real-transport seam -------------------------------------------------
+
+    def wrap_transport(self, transport, node_index: int, addr_key=None):
+        """Sender-side fault injection for a REAL transport against the
+        CURRENT phase rule (see :func:`attach_transport_chaos`).
+        ``addr_key(addr) -> plan address`` normalizes destination
+        addresses to the plan's node addresses (default: identity)."""
+        attach_transport_chaos(
+            transport, self.addr_of(node_index), addr_key=addr_key,
+            rng=random.Random(self.rng.randrange(1 << 30)))
+        if transport not in self._wrapped:
+            self._wrapped.append(transport)
+        return transport
+
+
+def attach_transport_chaos(transport, src, addr_key=None,
+                           rng: Optional[random.Random] = None):
+    """Idempotently wrap a REAL transport's sender seam with chaos-rule
+    enforcement: ``send_packet`` (and dstream's segment-level
+    ``_sendto``) gets probabilistic drop / bit-flip corruption plus
+    partition/blackhole blocking, ``dial`` refuses partitioned or
+    blackholed destinations.  The active rule lives in
+    ``transport._chaos_rule`` (a :class:`ChaosRule` or None) — swap it
+    per phase; the legacy storm helpers and ``HostFaultExecutor`` both
+    drive this one seam."""
+    if getattr(transport, "_chaos_wrapped", False):
+        return transport
+    transport._chaos_wrapped = True
+    transport._chaos_rule = None
+    keyfn = addr_key or (lambda a: a)
+    rng = rng or random.Random(0)
+
+    orig_send_packet = transport.send_packet
+    orig_dial = transport.dial
+
+    async def send_packet(addr, buf):
+        rule: Optional[ChaosRule] = transport._chaos_rule
+        if rule is not None:
+            buf = apply_edge_faults(rule, rng, src, keyfn(addr), buf)
+            if buf is None:
+                return
+        await orig_send_packet(addr, buf)
+
+    async def dial(addr, timeout=None):
+        rule: Optional[ChaosRule] = transport._chaos_rule
+        if rule is not None:
+            dst = keyfn(addr)
+            if rule.group_blocked(src, dst) or rule.blackholed(src, dst):
+                raise ConnectionError(
+                    f"chaos: no route to {addr!r} (partition)")
+        return await orig_dial(addr, timeout=timeout)
+
+    transport.send_packet = send_packet
+    transport.dial = dial
+    # dstream sends segments through _sendto, not send_packet — fault
+    # the segment plane too (same shared decision: drop AND corruption,
+    # so the ARQ + keyring recovery paths see chaos under cluster load)
+    orig_sendto = getattr(transport, "_sendto", None)
+    if orig_sendto is not None:
+        def _sendto(wire, addr):
+            rule: Optional[ChaosRule] = transport._chaos_rule
+            if rule is not None:
+                wire = apply_edge_faults(rule, rng, src, keyfn(addr), wire)
+                if wire is None:
+                    return
+            orig_sendto(wire, addr)
+        transport._sendto = _sendto
+    return transport
+
+
+# ---------------------------------------------------------------------------
+# loopback chaos runner
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ClockSample:
+    mono: float
+    generation: int
+    clock: int
+    event: int
+    query: int
+
+
+@dataclass
+class HostChaosResult:
+    plan: FaultPlan
+    report: object                      # invariants.InvariantReport
+    clock_samples: Dict[str, List[ClockSample]] = field(default_factory=dict)
+    counters: Dict[str, float] = field(default_factory=dict)
+    events_sent: int = 0
+
+
+def degradation_counters() -> Dict[str, float]:
+    """Sum every ``serf.faults.*`` / ``serf.degraded.*`` counter in the
+    global sink across label sets — the CLI's degradation report."""
+    sink = metrics.global_sink()
+    out: Dict[str, float] = {}
+    for (name, _labels), v in sink.counters.items():
+        if name.startswith(("serf.faults.", "serf.degraded.")):
+            out[name] = out.get(name, 0.0) + v
+    return out
+
+
+async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
+                        opts=None,
+                        traffic_period: float = 0.08) -> HostChaosResult:
+    """Run ``plan`` against a fresh in-process loopback cluster and check
+    the invariants.  ``tmp_dir`` enables per-node snapshots (crash →
+    restart replays them); without it restarts come back cold."""
+    import os
+
+    from serf_tpu.faults import invariants as inv
+    from serf_tpu.host.serf import Serf, SerfState
+    from serf_tpu.options import Options
+
+    plan.validate()
+    n = plan.n
+    base_opts = opts or Options.local()
+    net = LoopbackNetwork()
+    ex = HostFaultExecutor(plan, net)
+
+    def node_opts(i: int):
+        if tmp_dir is None:
+            return base_opts
+        return base_opts.replace(
+            snapshot_path=os.path.join(tmp_dir, f"chaos-n{i}.snap"))
+
+    generation = {i: 0 for i in range(n)}
+    nodes: Dict[int, Serf] = {}
+    for i in range(n):
+        nodes[i] = await Serf.create(net.bind(f"n{i}"), node_opts(i),
+                                     f"n{i}")
+    samples: Dict[str, List[ClockSample]] = {f"n{i}": [] for i in range(n)}
+    events_sent = 0
+    down: frozenset = frozenset()
+    rng = random.Random(plan.seed ^ 0x5EED)
+    stop = asyncio.Event()
+
+    def sample_clocks() -> None:
+        for i, s in nodes.items():
+            if i in down or s.state == SerfState.SHUTDOWN:
+                continue
+            samples[s.local_id].append(ClockSample(
+                mono=time.monotonic(), generation=generation[i],
+                clock=int(s.clock.time()), event=int(s.event_clock.time()),
+                query=int(s.query_clock.time())))
+
+    async def background() -> None:
+        nonlocal events_sent
+        while not stop.is_set():
+            await asyncio.sleep(traffic_period)
+            sample_clocks()
+            live = [i for i in nodes
+                    if i not in down
+                    and nodes[i].state == SerfState.ALIVE]
+            if live:
+                src = rng.choice(live)
+                try:
+                    await nodes[src].user_event(
+                        f"chaos-{events_sent}", b"x", coalesce=False)
+                    events_sent += 1
+                except Exception:  # noqa: BLE001 - traffic is best-effort
+                    pass
+
+    bg = asyncio.create_task(background())
+    try:
+        for i in range(1, n):
+            await nodes[i].join("n0")
+        await inv.wait_host_convergence(
+            [nodes[i] for i in range(n)], deadline_s=plan.settle_s)
+
+        for pi, phase in enumerate(plan.phases):
+            # crash BEFORE installing the phase rule so the rule never
+            # references a half-dead node's traffic
+            for i in phase.crash:
+                if nodes[i].state != SerfState.SHUTDOWN:
+                    await nodes[i].shutdown()
+            ex.apply_phase(pi)
+            down = ex.down_nodes()
+            for i in phase.restart:
+                if nodes[i].state == SerfState.SHUTDOWN:
+                    generation[i] += 1
+                    nodes[i] = await Serf.create(
+                        net.bind(f"n{i}"), node_opts(i), f"n{i}")
+                    seeds = [j for j in nodes if j not in down and j != i
+                             and nodes[j].state == SerfState.ALIVE]
+                    if seeds:
+                        try:
+                            await nodes[i].join(f"n{rng.choice(seeds)}")
+                        except (ConnectionError, TimeoutError, OSError):
+                            pass
+            down = ex.down_nodes()
+            await asyncio.sleep(phase.duration_s)
+
+        ex.clear()
+        down = frozenset()
+        live = [nodes[i] for i in nodes
+                if nodes[i].state == SerfState.ALIVE]
+        await inv.wait_host_convergence(live, deadline_s=plan.settle_s)
+        sample_clocks()
+        report = inv.check_host(plan, nodes, samples, generation,
+                                snapshots=tmp_dir is not None)
+        return HostChaosResult(plan=plan, report=report,
+                               clock_samples=samples,
+                               counters=degradation_counters(),
+                               events_sent=events_sent)
+    finally:
+        stop.set()
+        bg.cancel()
+        try:
+            await bg
+        except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            pass
+        # the cluster must die on EVERY path — a raise mid-plan must not
+        # leave n gossiping nodes running for the rest of the process
+        for s in nodes.values():
+            if s.state != SerfState.SHUTDOWN:
+                await s.shutdown()

@@ -1,0 +1,233 @@
+"""Convergence invariants judged after a chaos run — on either plane.
+
+The checks encode what SWIM + Lifeguard actually promise under faults
+(PAPERS.md): bounded-time convergence after heal, no false DEAD verdicts
+for responsive nodes, Lamport-clock monotonicity, and crash-restart
+rejoin correctness.  ``tools/chaos.py`` prints the report;
+``tests/test_faults.py`` pins the acceptance plan green on both planes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from serf_tpu.faults.plan import FaultPlan
+from serf_tpu.utils.logging import get_logger
+
+log = get_logger("faults.invariants")
+
+
+@dataclass
+class InvariantResult:
+    name: str
+    ok: bool
+    detail: str = ""
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "ok": self.ok, "detail": self.detail}
+
+
+@dataclass
+class InvariantReport:
+    plane: str
+    plan: str
+    results: List[InvariantResult] = field(default_factory=list)
+
+    def add(self, name: str, ok: bool, detail: str = "") -> None:
+        self.results.append(InvariantResult(name, bool(ok), detail))
+
+    @property
+    def ok(self) -> bool:
+        return all(r.ok for r in self.results)
+
+    def to_dict(self) -> dict:
+        return {"plane": self.plane, "plan": self.plan, "ok": self.ok,
+                "invariants": [r.to_dict() for r in self.results]}
+
+    def format(self) -> str:
+        lines = [f"[{self.plane}] plan {self.plan!r}: "
+                 f"{'GREEN' if self.ok else 'RED'}"]
+        for r in self.results:
+            mark = "ok " if r.ok else "FAIL"
+            lines.append(f"  {mark}  {r.name}"
+                         + (f" — {r.detail}" if r.detail else ""))
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# host plane
+# ---------------------------------------------------------------------------
+
+
+def _alive_view(serf) -> set:
+    from serf_tpu.types.member import MemberStatus
+    return {m.node.id for m in serf.members()
+            if m.status == MemberStatus.ALIVE}
+
+
+async def wait_host_convergence(nodes, deadline_s: float,
+                                poll_s: float = 0.05) -> bool:
+    """Poll until every given node's ALIVE view covers all given nodes
+    (or the deadline passes).  Returns whether convergence was reached —
+    the caller's invariant check renders the verdict either way."""
+    want = {s.local_id for s in nodes}
+    loop = asyncio.get_running_loop()
+    end = loop.time() + deadline_s
+    while loop.time() < end:
+        if all(_alive_view(s) >= want for s in nodes):
+            return True
+        await asyncio.sleep(poll_s)
+    return all(_alive_view(s) >= want for s in nodes)
+
+
+def check_host(plan: FaultPlan, nodes: Dict[int, object],
+               samples: Dict[str, List], generation: Dict[int, int],
+               snapshots: bool = False) -> InvariantReport:
+    """Judge the host-plane invariants on a finished chaos run.
+
+    ``nodes``: index -> Serf (some possibly SHUTDOWN); ``samples``:
+    node id -> ClockSample list (faults.host); ``generation``: restart
+    count per node index.
+    """
+    from serf_tpu.host.serf import SerfState
+    from serf_tpu.types.member import MemberStatus
+
+    rep = InvariantReport(plane="host", plan=plan.name)
+    live = {i: s for i, s in nodes.items() if s.state == SerfState.ALIVE}
+    live_ids = {s.local_id for s in live.values()}
+
+    # 1. post-heal membership convergence: every live node sees every
+    # live node ALIVE (bounded by the runner's settle deadline)
+    missing = {}
+    for i, s in live.items():
+        lack = live_ids - _alive_view(s)
+        if lack:
+            missing[s.local_id] = sorted(lack)
+    rep.add("membership-convergence", not missing,
+            f"views missing: {missing}" if missing
+            else f"{len(live)} live nodes agree")
+
+    # 2. no false DEAD: a node the plan never crashed/paused stayed
+    # responsive throughout — no live view may hold it FAILED now
+    ever_down = {f"n{i}" for i in plan.ever_down()}
+    false_dead = {}
+    for i, s in live.items():
+        bad = sorted(m.node.id for m in s.members()
+                     if m.status == MemberStatus.FAILED
+                     and m.node.id in live_ids
+                     and m.node.id not in ever_down)
+        if bad:
+            false_dead[s.local_id] = bad
+    rep.add("no-false-dead", not false_dead,
+            f"responsive nodes held FAILED: {false_dead}" if false_dead
+            else f"{len(ever_down)} plan-downed nodes exempt")
+
+    # 3. Lamport/event/query clock monotonicity per node per generation
+    regressions = []
+    for nid, series in samples.items():
+        prev = None
+        for s in series:
+            if prev is not None and s.generation == prev.generation:
+                if (s.clock < prev.clock or s.event < prev.event
+                        or s.query < prev.query):
+                    regressions.append(
+                        (nid, s.generation,
+                         (prev.clock, prev.event, prev.query),
+                         (s.clock, s.event, s.query)))
+            prev = s
+    rep.add("clock-monotonicity", not regressions,
+            f"regressions: {regressions[:3]}" if regressions
+            else f"{sum(len(v) for v in samples.values())} samples")
+
+    # 4. snapshot crash-restart rejoin: a restarted node came back into
+    # the converged view (covered by invariant 1 — re-assert narrowly)
+    # and, when snapshots persisted its clocks, did not regress them
+    # across the restart boundary
+    restarted = [i for i, g in generation.items() if g > 0]
+    rejoin_ok = True
+    detail = "no restarts in plan"
+    if restarted:
+        problems = []
+        for i in restarted:
+            s = nodes[i]
+            nid = f"n{i}"
+            if s.state != SerfState.ALIVE or not any(
+                    nid in _alive_view(other) for other in live.values()):
+                problems.append(f"{nid} did not rejoin")
+                continue
+            if snapshots:
+                series = samples.get(nid, [])
+                for g in range(1, generation[i] + 1):
+                    before = [x for x in series if x.generation == g - 1]
+                    after = [x for x in series if x.generation == g]
+                    if before and after and (
+                            after[0].clock < before[-1].clock
+                            or after[0].event < before[-1].event):
+                        problems.append(
+                            f"{nid} gen{g} clock regressed across "
+                            f"restart ({before[-1].clock},"
+                            f"{before[-1].event}) -> ({after[0].clock},"
+                            f"{after[0].event})")
+        rejoin_ok = not problems
+        detail = ("; ".join(problems) if problems
+                  else f"{len(restarted)} restart(s), "
+                       f"snapshots={'on' if snapshots else 'off'}")
+    rep.add("crash-restart-rejoin", rejoin_ok, detail)
+    return rep
+
+
+# ---------------------------------------------------------------------------
+# device plane
+# ---------------------------------------------------------------------------
+
+
+def check_device(plan: FaultPlan, state, cfg, init_alive,
+                 rounds_run: int) -> InvariantReport:
+    """Judge the device-plane invariants on a finished chaos scan."""
+    import jax
+    import jax.numpy as jnp
+
+    from serf_tpu.models.antientropy import knowledge_agreement
+    from serf_tpu.models.dissemination import ltime_window_violation
+    from serf_tpu.models.failure import believed_dead
+
+    rep = InvariantReport(plane="device", plan=plan.name)
+    g = state.gossip
+    false_dead = believed_dead(g, cfg.gossip, cfg.failure) & g.alive
+    vals = jax.device_get({
+        "agreement": knowledge_agreement(g, cfg.gossip),
+        "false_dead": jnp.sum(false_dead),
+        "ltime_violation": ltime_window_violation(g.facts),
+        "round": g.round,
+        "alive": jnp.sum(g.alive),
+        "expected_alive": jnp.sum(init_alive),
+    })
+
+    # 1. post-heal convergence within the settle bound: every alive node
+    # holds every valid fact (dissemination + anti-entropy healed)
+    rep.add("membership-convergence",
+            float(vals["agreement"]) >= 1.0,
+            f"knowledge agreement {float(vals['agreement']):.4f}")
+
+    # 2. no false DEAD: no alive node is believed dead (Lifeguard's
+    # refutation path must win once the partition heals)
+    rep.add("no-false-dead", int(vals["false_dead"]) == 0,
+            f"{int(vals['false_dead'])} alive node(s) believed dead")
+
+    # 3. Lamport window: u32 ltimes still comparable under the windowed
+    # two's-complement rule (fail-loud guard for the wrap story)
+    rep.add("ltime-window", not bool(vals["ltime_violation"]),
+            "valid fact ltimes within the 2^31 window"
+            if not bool(vals["ltime_violation"])
+            else "ltime span >= 2^31: windowed comparison unsound")
+
+    # 4. round accounting: the scan ran exactly the planned rounds and
+    # every plan-restarted node is back (liveness restored)
+    ok_rounds = int(vals["round"]) == rounds_run
+    ok_alive = int(vals["alive"]) == int(vals["expected_alive"])
+    rep.add("round-advance", ok_rounds and ok_alive,
+            f"round={int(vals['round'])}/{rounds_run}, "
+            f"alive={int(vals['alive'])}/{int(vals['expected_alive'])}")
+    return rep

@@ -1,0 +1,252 @@
+"""FaultPlan: one seeded, declarative chaos schedule for BOTH planes.
+
+A plan is a timeline of :class:`FaultPhase` entries.  Each phase can
+partition the cluster into groups, impose per-edge or global
+drop/delay/duplicate/reorder rates, corrupt payloads (bit flips), and
+crash/pause/restart nodes.  The SAME plan object drives:
+
+- the host plane (``faults.host``): phases run for ``duration_s`` wall
+  seconds against a ``LoopbackNetwork`` cluster (or wrapped real
+  transports), compiled to :class:`serf_tpu.host.transport.ChaosRule`;
+- the device plane (``faults.device``): phases run for ``rounds``
+  protocol rounds, lowered to per-round group/drop/liveness masks
+  consumed by ``models/swim.cluster_round`` inside the scan.
+
+Node references are integer indices ``0..n-1`` on both planes; the host
+runner maps index ``i`` to cluster node ``n{i}``.  Everything is seeded
+(``FaultPlan.seed``) so a chaos run is reproducible end to end —
+Jepsen-style schedules, not dice rolls (PAPERS.md: Lifeguard;
+SNIPPETS/Jepsen discipline).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class EdgeFault:
+    """Fault rates on the directed edge ``src -> dst`` (indices).
+    ``bidirectional=True`` mirrors the rates onto ``dst -> src``."""
+
+    src: int
+    dst: int
+    drop: float = 0.0        # 1.0 = blackhole (also refuses stream dials)
+    delay: float = 0.0       # seconds, host plane only
+    duplicate: float = 0.0
+    reorder: float = 0.0
+    corrupt: float = 0.0
+    bidirectional: bool = False
+
+
+@dataclass(frozen=True)
+class FaultPhase:
+    """One segment of the chaos timeline.
+
+    ``partitions``: groups of node indices; nodes in different groups
+    cannot communicate.  Nodes not listed in any group form one implicit
+    extra group together (consistent across planes).  Empty = no
+    partition.  ``crash``/``pause`` take nodes down at phase entry
+    (crash = process death: the host runner shuts the Serf down; pause =
+    network silence, process alive); ``restart`` brings previously
+    crashed/paused nodes back.  Down-ness persists across phases until
+    restarted.
+    """
+
+    name: str = ""
+    duration_s: float = 0.5          # host-plane phase length
+    rounds: int = 8                  # device-plane phase length
+    partitions: Tuple[Sequence[int], ...] = ()
+    drop: float = 0.0                # global per-packet loss
+    delay: float = 0.0               # host: fixed extra latency
+    jitter: float = 0.0              # host: uniform extra latency
+    duplicate: float = 0.0
+    reorder: float = 0.0
+    corrupt: float = 0.0             # per-packet bit-flip probability
+    edges: Tuple[EdgeFault, ...] = ()
+    crash: Tuple[int, ...] = ()
+    pause: Tuple[int, ...] = ()
+    restart: Tuple[int, ...] = ()
+
+    def validate(self, n: int) -> None:
+        if self.duration_s < 0 or self.rounds < 0:
+            raise ValueError(f"phase {self.name!r}: negative length")
+        for rate in (self.drop, self.duplicate, self.reorder, self.corrupt):
+            if not 0.0 <= rate <= 1.0:
+                raise ValueError(
+                    f"phase {self.name!r}: rate {rate} outside [0, 1]")
+        seen: set = set()
+        for g in self.partitions:
+            for node in g:
+                if not 0 <= node < n:
+                    raise ValueError(
+                        f"phase {self.name!r}: node {node} outside 0..{n - 1}")
+                if node in seen:
+                    raise ValueError(
+                        f"phase {self.name!r}: node {node} in two groups")
+                seen.add(node)
+        for nodes in (self.crash, self.pause, self.restart):
+            for node in nodes:
+                if not 0 <= node < n:
+                    raise ValueError(
+                        f"phase {self.name!r}: node {node} outside 0..{n - 1}")
+        for e in self.edges:
+            if not (0 <= e.src < n and 0 <= e.dst < n):
+                raise ValueError(
+                    f"phase {self.name!r}: edge ({e.src},{e.dst}) "
+                    f"outside 0..{n - 1}")
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """A named, seeded chaos schedule over ``n`` nodes."""
+
+    name: str
+    n: int
+    phases: Tuple[FaultPhase, ...]
+    seed: int = 0
+    #: settle budget after the last phase: host seconds / device rounds
+    #: the cluster gets to re-converge before invariants are judged
+    settle_s: float = 8.0
+    settle_rounds: int = 40
+
+    def validate(self) -> None:
+        if self.n < 2:
+            raise ValueError("a chaos plan needs at least 2 nodes")
+        if not self.phases:
+            raise ValueError("a chaos plan needs at least one phase")
+        for ph in self.phases:
+            ph.validate(self.n)
+        down: set = set()
+        for ph in self.phases:
+            down |= set(ph.crash) | set(ph.pause)
+            down -= set(ph.restart)
+        if down:
+            # invariants judge post-heal convergence of RESPONSIVE nodes;
+            # a plan that ends with nodes still down is judging a cluster
+            # that is legitimately still degraded
+            raise ValueError(
+                f"plan {self.name!r} ends with nodes still down: "
+                f"{sorted(down)} (add them to a later phase's restart)")
+
+    def total_rounds(self) -> int:
+        return sum(ph.rounds for ph in self.phases)
+
+    def ever_down(self) -> frozenset:
+        """Nodes the plan crashes or pauses at any point — exempt from
+        the no-false-DEAD invariant while they were genuinely down."""
+        out: set = set()
+        for ph in self.phases:
+            out |= set(ph.crash) | set(ph.pause)
+        return frozenset(out)
+
+
+# ---------------------------------------------------------------------------
+# named plans (tools/chaos.py and the tier-1 acceptance tests run these)
+# ---------------------------------------------------------------------------
+
+
+def _partition_heal_loss(n: int = 6) -> FaultPlan:
+    """THE acceptance scenario (ISSUE 4): bisect the cluster, keep 5%
+    loss on every edge, heal, and require full re-convergence with zero
+    false deaths among responsive nodes."""
+    half = n // 2
+    # phases share one round count (and settle is a multiple of it) so
+    # the device executor's phase scan compiles exactly ONCE per run
+    return FaultPlan(
+        name="partition-heal-loss",
+        n=n,
+        seed=7,
+        phases=(
+            FaultPhase(name="warm", duration_s=0.6, rounds=12),
+            FaultPhase(name="bisect+loss", duration_s=1.0, rounds=12,
+                       partitions=(tuple(range(half)),
+                                   tuple(range(half, n))),
+                       drop=0.05),
+            FaultPhase(name="heal+loss", duration_s=0.8, rounds=12,
+                       drop=0.05),
+        ),
+        settle_s=10.0,
+        settle_rounds=48,
+    )
+
+
+def _crash_restart(n: int = 5) -> FaultPlan:
+    """Kill one node mid-run (no leave), then restart it: exercises
+    snapshot crash-restart rejoin + refutation of its death story."""
+    return FaultPlan(
+        name="crash-restart",
+        n=n,
+        seed=11,
+        phases=(
+            FaultPhase(name="warm", duration_s=0.6, rounds=12),
+            FaultPhase(name="crash", duration_s=1.0, rounds=12,
+                       crash=(n - 1,)),
+            FaultPhase(name="restart", duration_s=0.8, rounds=12,
+                       restart=(n - 1,)),
+        ),
+        settle_s=10.0,
+        settle_rounds=48,
+    )
+
+
+def _flaky_edges(n: int = 5) -> FaultPlan:
+    """Asymmetric edge faults + duplication/reorder/corruption: the
+    graceful-degradation gauntlet (every packet effect at once)."""
+    return FaultPlan(
+        name="flaky-edges",
+        n=n,
+        seed=13,
+        phases=(
+            FaultPhase(name="warm", duration_s=0.5, rounds=12),
+            FaultPhase(name="flaky", duration_s=1.2, rounds=12,
+                       drop=0.05, duplicate=0.05, reorder=0.10,
+                       corrupt=0.02, jitter=0.002,
+                       edges=(EdgeFault(src=0, dst=1, drop=0.5),
+                              EdgeFault(src=2, dst=3, drop=1.0,
+                                        bidirectional=True))),
+        ),
+        settle_s=8.0,
+        settle_rounds=48,
+    )
+
+
+def _self_check(n: int = 4) -> FaultPlan:
+    """Tiny fast plan for ``tools/chaos.py --self-check`` (tier-1)."""
+    return FaultPlan(
+        name="self-check",
+        n=n,
+        seed=3,
+        phases=(
+            FaultPhase(name="warm", duration_s=0.4, rounds=10),
+            FaultPhase(name="split", duration_s=0.6, rounds=10,
+                       partitions=((0, 1), (2, 3)), drop=0.05),
+        ),
+        settle_s=8.0,
+        settle_rounds=40,
+    )
+
+
+_PLANS: Dict[str, object] = {
+    "partition-heal-loss": _partition_heal_loss,
+    "crash-restart": _crash_restart,
+    "flaky-edges": _flaky_edges,
+    "self-check": _self_check,
+}
+
+
+def named_plan(name: str, n: int = 0) -> FaultPlan:
+    """Look up a built-in plan by name (optionally resized to ``n``)."""
+    try:
+        factory = _PLANS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown plan {name!r}; have {sorted(_PLANS)}") from None
+    plan = factory(n) if n else factory()
+    plan.validate()
+    return plan
+
+
+def plan_names() -> Tuple[str, ...]:
+    return tuple(sorted(_PLANS))

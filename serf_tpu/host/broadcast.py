@@ -23,7 +23,7 @@ from serf_tpu.utils import metrics
 class Broadcast:
     """One queued message."""
 
-    __slots__ = ("msg", "name", "transmits", "notify", "_seq")
+    __slots__ = ("msg", "name", "transmits", "notify", "_seq", "decoded")
 
     def __init__(self, msg: bytes, name: Optional[str] = None,
                  notify: Optional[asyncio.Event] = None):
@@ -32,6 +32,11 @@ class Broadcast:
         self.transmits = 0
         self.notify = notify
         self._seq = 0
+        #: consumer-owned memo of the decoded message (``msg`` is
+        #: immutable, so decoding once is enough — the reaper's pending-
+        #: leave index uses this to stop re-decoding every queued intent
+        #: broadcast on every tick)
+        self.decoded = None
 
     def finished(self) -> None:
         if self.notify is not None:
@@ -62,6 +67,11 @@ class TransmitLimitedQueue:
         self.labels = labels
         self._items: List[Broadcast] = []
         self._seq = 0
+        #: bumped whenever queue MEMBERSHIP changes (queue/invalidate/
+        #: retire/prune) — cheap change detection for derived indexes
+        #: (transmit-count bumps alone don't count: they change no
+        #: membership-derived answer)
+        self.mutations = 0
 
     def __len__(self) -> int:
         return len(self._items)
@@ -83,6 +93,7 @@ class TransmitLimitedQueue:
         self._seq += 1
         b._seq = self._seq
         self._items.append(b)
+        self.mutations += 1
         self._gauge_depth()
 
     def get_broadcasts(self, overhead: int, limit: int) -> List[bytes]:
@@ -106,6 +117,8 @@ class TransmitLimitedQueue:
             b.transmits += 1
             if b.transmits >= transmit_max:
                 retired.append(b)
+        if retired:
+            self.mutations += 1
         for b in retired:
             self._items.remove(b)
             b.finished()
@@ -127,6 +140,7 @@ class TransmitLimitedQueue:
         for b in self._items[max_retained:]:
             b.finished()
         del self._items[max_retained:]
+        self.mutations += 1
         if self.name is not None:
             flight.record("queue-overflow", queue=self.name,
                           dropped=dropped, retained=max_retained)

@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from serf_tpu import codec
 from serf_tpu.host import messages as sm
 from serf_tpu.host.broadcast import Broadcast, TransmitLimitedQueue
+from serf_tpu.host.degrade import Backoff, CircuitBreaker
 from serf_tpu.host.delegate import SwimDelegate
 from serf_tpu.host.keyring import KeyringError, SecretKeyring
 from serf_tpu.host.messages import SwimState
@@ -174,6 +175,11 @@ class Memberlist:
         self._suspicions: Dict[str, _Suspicion] = {}
         self._probing: set = set()  # node ids with an in-flight probe
         self._awareness = _Awareness(opts.awareness_max_multiplier)
+        # graceful degradation (host/degrade.py): dead/unreachable peers
+        # must not eat a full dial timeout on every stream operation
+        self._breaker = CircuitBreaker(
+            opts.breaker_threshold, opts.breaker_cooldown,
+            labels=opts.metric_labels, node=node_id)
         self.broadcasts = TransmitLimitedQueue(
             opts.retransmit_mult, lambda: max(1, self.num_online_members())
         )
@@ -291,9 +297,34 @@ class Memberlist:
         """Push/pull state sync with a seed node (reference join path,
         SURVEY.md §3.2).  The target goes through the transport's resolver
         first, so joins accept unresolved names (reference
-        MaybeResolvedAddress)."""
+        MaybeResolvedAddress).
+
+        Bounded retry with jittered backoff (``opts.join_retries``): a
+        seed node mid-restart or a lossy path must not fail the whole
+        join on one refused dial.  Version incompatibility never
+        retries — the peer will not become compatible by waiting."""
         addr = await self.transport.resolve(addr)
-        await self._push_pull_with(addr, join=True)
+        backoff = Backoff(self.opts.dial_backoff_base,
+                          self.opts.dial_backoff_max, rng=self.rng)
+        last: Optional[Exception] = None
+        for attempt in range(1 + self.opts.join_retries):
+            if attempt:
+                metrics.incr("serf.degraded.join_retry", 1,
+                             self.opts.metric_labels)
+                flight.record("dial-retry", node=self.local.id,
+                              target=str(addr), op="join", attempt=attempt)
+                await asyncio.sleep(backoff.next_delay())
+            try:
+                await self._push_pull_with(addr, join=True)
+                return
+            except VersionError:
+                raise
+            except (ConnectionError, TimeoutError, OSError) as e:
+                last = e
+            if self._shutdown:
+                break
+        raise last if last is not None else ConnectionError(
+            f"join {addr!r} failed")
 
     async def join_many(self, addrs: Sequence) -> Tuple[int, List[Exception]]:
         ok, errs = 0, []
@@ -792,6 +823,13 @@ class Memberlist:
             if not peers:
                 continue
             peer = self.rng.choice(peers)
+            if self._breaker.is_open(str(peer.addr)):
+                # degraded peer: skip this tick instead of burning a dial
+                # timeout (the breaker admits a half-open trial after its
+                # cooldown, so recovery is still discovered)
+                metrics.incr("serf.degraded.pushpull_skipped", 1,
+                             self.opts.metric_labels)
+                continue
             try:
                 await self._push_pull_with(peer.addr, join=False)
             except asyncio.CancelledError:
@@ -810,8 +848,54 @@ class Memberlist:
                   target=str(addr)):
             await self._push_pull_with_inner(addr, join)
 
+    async def _dial_stream(self, addr):
+        """Stream dial with jittered exponential backoff and the per-peer
+        circuit breaker: an OPEN circuit fast-fails (no timeout burned);
+        transient refusals retry up to ``opts.dial_retries`` times.
+
+        The dial alone never marks the circuit HEALTHY — a half-dead
+        peer can accept connections and then fail every sync, and a
+        dial-time reset would erase the mid-sync failure count forever.
+        The caller reports the outcome of the WHOLE operation
+        (``_push_pull_with_inner``); a failed dial still counts against
+        the circuit here."""
+        key = str(addr)
+        if not self._breaker.allow(key):
+            raise ConnectionError(f"circuit open for {addr!r}")
+        backoff = Backoff(self.opts.dial_backoff_base,
+                          self.opts.dial_backoff_max, rng=self.rng)
+        last: Optional[Exception] = None
+        for attempt in range(1 + self.opts.dial_retries):
+            if attempt:
+                if self._breaker.is_open(key):
+                    # our own failures just opened (or re-opened) the
+                    # circuit: stop burning timeouts mid-loop
+                    break
+                metrics.incr("serf.degraded.dial_retry", 1,
+                             self.opts.metric_labels)
+                flight.record("dial-retry", node=self.local.id,
+                              target=key, op="dial", attempt=attempt)
+                await asyncio.sleep(backoff.next_delay())
+            try:
+                return await self.transport.dial(
+                    addr, timeout=self.opts.timeout)
+            except (ConnectionError, TimeoutError, OSError) as e:
+                last = e
+                self._breaker.failure(key)
+            except BaseException:
+                # cancellation/unexpected errors judge neither the peer
+                # nor the circuit — but an abandoned half-open trial
+                # must be released or the peer is wedged out forever
+                self._breaker.release(key)
+                raise
+            if self._shutdown:
+                break
+        raise last if last is not None else ConnectionError(
+            f"dial {addr!r} failed")
+
     async def _push_pull_with_inner(self, addr, join: bool) -> None:
-        stream = await self.transport.dial(addr, timeout=self.opts.timeout)
+        key = str(addr)
+        stream = await self._dial_stream(addr)
         try:
             out = sm.PushPull(join, tuple(self._local_push_states()),
                               self.delegate.local_state(join))
@@ -820,13 +904,51 @@ class Memberlist:
             reply = self._decode_stream_msg(reply_raw)
             if isinstance(reply, sm.ErrorResp):
                 # the server refused before replying (today: version
-                # incompatibility) — surface its reason directly
+                # incompatibility) — surface its reason directly; a
+                # refusal is still a LIVE, responsive peer
+                self._breaker.success(key)
                 raise VersionError(f"refused by {addr}: {reply.error}")
             if not isinstance(reply, sm.PushPull):
                 raise codec.DecodeError("expected push/pull reply")
             self._merge_remote(reply, join)
+            # the WHOLE sync succeeded — only now is the peer healthy
+            self._breaker.success(key)
+        except (ConnectionError, TimeoutError):
+            # a peer dying MID-sync counts against its circuit too — the
+            # dial succeeded, but the sync did not
+            self._breaker.failure(key)
+            raise
+        except VersionError:
+            # incompatible but alive; a no-op after the ErrorResp path's
+            # success(), and frees any half-open trial on the
+            # _merge_remote verification path
+            self._breaker.release(key)
+            raise
+        except (codec.DecodeError, KeyringError) as e:
+            # garbled peer: quarantined, and an abandoned half-open
+            # trial must not wedge the circuit in the half-open state
+            self._breaker.release(key)
+            self._quarantine_frame(addr, e)
+            raise
+        except BaseException:
+            # cancellation or an unexpected error (delegate callbacks in
+            # the merge path can raise anything): the trial is abandoned,
+            # not judged — release so the circuit can re-trial later
+            # instead of staying wedged half-open forever
+            self._breaker.release(key)
+            raise
         finally:
             await stream.close()
+
+    def _quarantine_frame(self, src, err) -> None:
+        """Corrupt-frame quarantine: an undecodable stream frame is logged,
+        counted and flight-recorded — never a task death, never a retry
+        loop on garbage."""
+        metrics.incr("serf.degraded.corrupt_frame", 1,
+                     self.opts.metric_labels)
+        flight.record("corrupt-frame", node=self.local.id, peer=str(src),
+                      error=str(err)[:200])
+        log.warning("quarantined corrupt stream frame from %r: %s", src, err)
 
     async def _stream_loop(self) -> None:
         while not self._shutdown:
@@ -867,8 +989,9 @@ class Memberlist:
             log.warning("refusing push/pull from %r: %s", src, e)
             metrics.incr("memberlist.node.version_rejected", 1,
                          self.opts.metric_labels)
-        except (codec.DecodeError, ConnectionError, TimeoutError,
-                KeyringError) as e:
+        except (codec.DecodeError, KeyringError) as e:
+            self._quarantine_frame(src, e)
+        except (ConnectionError, TimeoutError) as e:
             log.debug("stream from %r failed: %s", src, e)
         except Exception:  # noqa: BLE001
             log.exception("stream handler error from %r", src)

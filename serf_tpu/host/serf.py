@@ -435,6 +435,11 @@ class Serf:
         self._bg: set = set()
         self._shutdown_event = asyncio.Event()
         self._conflict_resolving = False
+        # reaper-tick cache of the pending-leave index (see
+        # _pending_leave_ltimes): recomputed only when the intent queue's
+        # membership actually changed
+        self._leave_index: Dict[str, LamportTime] = {}
+        self._leave_index_version = -1
 
     def _spawn(self, coro, name: str) -> asyncio.Task:
         t = asyncio.create_task(coro, name=f"{name}-{self.local_id}")
@@ -1407,16 +1412,32 @@ class Serf:
 
     def _pending_leave_ltimes(self) -> Dict[str, LamportTime]:
         """node id -> highest leave-intent ltime still sitting in the
-        local intent queue (decoded once per sweep; the queue is
-        depth-bounded by QueueChecker, so this scan is cheap)."""
+        local intent queue.
+
+        Two-level cache so the reaper tick stops re-decoding every
+        queued intent broadcast: the queue's ``mutations`` counter
+        short-circuits the whole scan while membership is unchanged, and
+        each broadcast memoizes its own decode (``Broadcast.decoded`` —
+        the bytes are immutable) so even a membership change only
+        decodes the broadcasts it added."""
+        q = self.intent_broadcasts
+        if q.mutations == self._leave_index_version:
+            return self._leave_index
         pending: Dict[str, LamportTime] = {}
-        for b in self.intent_broadcasts._items:
-            try:
-                msg = decode_message(b.msg)
-            except codec.DecodeError:
-                continue
-            if isinstance(msg, LeaveMessage):
-                pending[msg.id] = max(pending.get(msg.id, 0), msg.ltime)
+        for b in q._items:
+            dec = b.decoded
+            if dec is None:
+                try:
+                    msg = decode_message(b.msg)
+                except codec.DecodeError:
+                    msg = None
+                dec = b.decoded = ((msg.id, msg.ltime)
+                                   if isinstance(msg, LeaveMessage) else ())
+            if dec:
+                node_id, lt = dec
+                pending[node_id] = max(pending.get(node_id, 0), lt)
+        self._leave_index = pending
+        self._leave_index_version = q.mutations
         return pending
 
     def _sweep_dangling_leaving(self, leaving_since: Dict[str, list],

@@ -49,6 +49,16 @@ def _record(ty: int, payload: bytes = b"") -> bytes:
 
 
 def _iter_records(buf: bytes):
+    """Yield ``(ty, payload, end_pos)`` for each complete record.
+
+    A crash during append leaves a TORN TAIL — a record whose header or
+    payload is cut mid-write.  Replay tolerates it: the torn bytes are
+    skipped with a warning + ``serf.snapshot.torn_tail`` counter (never
+    an exception — boot must succeed on the complete prefix), and the
+    yielded ``end_pos`` lets the writer truncate the file back to the
+    last complete record so post-restart appends never interleave with
+    garbage.
+    """
     pos = 0
     n = len(buf)
     while pos < n:
@@ -56,13 +66,23 @@ def _iter_records(buf: bytes):
         try:
             ln, p = codec.decode_varint(buf, pos + 1)
         except codec.DecodeError:
-            log.warning("truncated snapshot record; stopping replay")
+            _report_torn_tail(pos, n, "record header")
             return
         if p + ln > n:
-            log.warning("truncated snapshot payload; stopping replay")
+            _report_torn_tail(pos, n, "record payload")
             return
-        yield ty, buf[p : p + ln]
+        yield ty, buf[p : p + ln], p + ln
         pos = p + ln
+
+
+def _report_torn_tail(pos: int, total: int, what: str) -> None:
+    log.warning("snapshot torn tail: %s cut at byte %d/%d; skipping "
+                "%d trailing bytes (crash during append)",
+                what, pos, total, total - pos)
+    metrics.incr("serf.snapshot.torn_tail", 1)
+    from serf_tpu.obs import flight
+    flight.record("snapshot-torn-tail", offset=pos,
+                  dropped_bytes=total - pos, what=what)
 
 
 def _safe_varint(payload: bytes, fallback: int) -> int:
@@ -82,6 +102,9 @@ class ReplayResult:
     last_event_clock: int = 0
     last_query_clock: int = 0
     left_before: bool = False
+    #: bytes of the file covered by COMPLETE records; anything past this
+    #: is a torn tail (crash mid-append) the writer truncates on reopen
+    valid_length: int = 0
 
 
 def open_and_replay_snapshot(path: str, rejoin_after_leave: bool = False) -> ReplayResult:
@@ -92,7 +115,8 @@ def open_and_replay_snapshot(path: str, rejoin_after_leave: bool = False) -> Rep
     with open(path, "rb") as f:
         buf = f.read()
     alive: Dict[str, Node] = {}
-    for ty, payload in _iter_records(buf):
+    for ty, payload, end in _iter_records(buf):
+        res.valid_length = end
         if ty == R_ALIVE:
             try:
                 node = Node.decode(payload)
@@ -137,6 +161,19 @@ class Snapshotter:
         self._alive: Dict[str, Node] = {n.id: n for n in replay.alive_nodes}
         self._last_clocks = (replay.last_clock, replay.last_event_clock,
                              replay.last_query_clock)
+        # torn-tail repair: a crash mid-append left bytes past the last
+        # complete record — truncate them BEFORE appending, so the new
+        # records never interleave with garbage (a later replay would
+        # otherwise stop at the tear and silently drop everything after)
+        try:
+            size = os.path.getsize(path) if os.path.exists(path) else 0
+        except OSError:
+            size = 0
+        if replay.valid_length < size:
+            log.warning("truncating snapshot %s torn tail: %d -> %d bytes",
+                        path, size, replay.valid_length)
+            with open(path, "r+b") as f:
+                f.truncate(replay.valid_length)
         self._f = open(path, "ab")
         self._dirty = False
         self._stopped = False

@@ -21,9 +21,115 @@ from __future__ import annotations
 import asyncio
 import random
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, FrozenSet, List, Optional, Tuple
+
+from serf_tpu.utils import metrics
 
 PACKET_BUDGET = 1400  # UDP-safe payload budget per gossip packet (bytes)
+
+
+# ---------------------------------------------------------------------------
+# Chaos rules (the unified fault surface — built by serf_tpu.faults)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class EdgeRates:
+    """Per-directed-edge fault rates, overriding/adding to the rule's
+    base rates on that edge."""
+
+    drop: float = 0.0
+    delay: float = 0.0
+    duplicate: float = 0.0
+    reorder: float = 0.0
+    corrupt: float = 0.0
+
+
+@dataclass
+class ChaosRule:
+    """One compiled fault state for the loopback fabric.
+
+    THE fault-injection surface of the host plane: the legacy
+    ``partition``/``set_drop_rate`` knobs delegate onto the network's
+    internal legacy rule, and ``serf_tpu.faults.host`` compiles
+    ``FaultPlan`` phases into rules installed via
+    :meth:`LoopbackNetwork.apply_faults`.  All rates are probabilities
+    per packet; delays are seconds.
+
+    ``groups``: only nodes sharing a group communicate (None = no
+    partition).  ``paused``: nodes delivering/receiving nothing (process
+    alive, network gone).  ``edges``: per-directed-edge overrides ADDED
+    to the base rates.  ``drop >= 1.0`` on an edge also refuses stream
+    dials (a blackholed edge carries nothing).
+    """
+
+    groups: Optional[List[set]] = None
+    paused: FrozenSet = frozenset()
+    drop: float = 0.0
+    delay: float = 0.0
+    jitter: float = 0.0
+    duplicate: float = 0.0
+    reorder: float = 0.0
+    reorder_window: float = 0.01
+    corrupt: float = 0.0
+    edges: Dict[Tuple[object, object], EdgeRates] = field(default_factory=dict)
+
+    def group_blocked(self, src, dst) -> bool:
+        if src in self.paused or dst in self.paused:
+            return True
+        if self.groups is None:
+            return False
+        for g in self.groups:
+            if src in g and dst in g:
+                return False
+        return True
+
+    def edge_rates(self, src, dst) -> EdgeRates:
+        e = self.edges.get((src, dst))
+        if e is None:
+            return EdgeRates(self.drop, self.delay, self.duplicate,
+                             self.reorder, self.corrupt)
+        return EdgeRates(min(1.0, self.drop + e.drop),
+                         self.delay + e.delay,
+                         min(1.0, self.duplicate + e.duplicate),
+                         min(1.0, self.reorder + e.reorder),
+                         min(1.0, self.corrupt + e.corrupt))
+
+    def blackholed(self, src, dst) -> bool:
+        if not self.edges and self.drop < 1.0:
+            return False
+        return self.edge_rates(src, dst).drop >= 1.0
+
+    def any_effects(self) -> bool:
+        return bool(self.edges) or any(
+            r > 0 for r in (self.drop, self.delay, self.duplicate,
+                            self.reorder, self.corrupt, self.jitter))
+
+
+def apply_edge_faults(rule: ChaosRule, rng: random.Random, src, dst,
+                      buf: bytes) -> Optional[bytes]:
+    """THE per-packet drop/corrupt decision for one directed edge —
+    shared by every real-transport chaos seam (``serf_tpu.faults.host.
+    attach_transport_chaos`` wraps both ``send_packet`` and dstream's
+    ``_sendto`` with it) so the FaultPlan's 'same scenario on every
+    transport' promise cannot drift between copies.  Returns None when
+    the packet is dropped/blocked, else the (possibly bit-flipped)
+    payload.  The loopback fabric's own ``_plan_delivery`` additionally
+    models duplicate/reorder/delay, which have no sender-side analog."""
+    if rule.group_blocked(src, dst):
+        return None
+    er = rule.edge_rates(src, dst)
+    if er.drop > 0 and rng.random() < er.drop:
+        metrics.incr("serf.faults.dropped", 1)
+        return None
+    if er.corrupt > 0 and rng.random() < er.corrupt:
+        b = bytearray(buf)
+        if b:
+            i = rng.randrange(len(b))
+            b[i] ^= 1 << rng.randrange(8)
+            metrics.incr("serf.faults.corrupted", 1)
+            return bytes(b)
+    return buf
 
 
 class Stream:
@@ -111,16 +217,24 @@ class _LoopbackStream(Stream):
 class LoopbackNetwork:
     """Shared in-memory fabric.  Addresses are plain strings/ints.
 
-    ``drop_fn(src, dst, buf) -> bool`` returning True drops the packet;
-    ``latency_fn(src, dst) -> float`` delays delivery.  Partitions are a
-    convenience wrapper over ``drop_fn`` affecting packets AND streams.
+    Fault injection goes through ONE surface — :class:`ChaosRule`
+    (``apply_faults``; built from a declarative ``FaultPlan`` by
+    ``serf_tpu.faults.host``).  The legacy knobs remain as sugar:
+    ``partition``/``heal``/``set_drop_rate`` delegate onto an internal
+    legacy rule composed with the executor-applied one, and
+    ``drop_message_types`` still compiles to ``drop_fn`` (a manual
+    ``drop_fn(src, dst, buf) -> bool`` / ``latency_fn(src, dst) ->
+    float`` keep working and compose with both rules).
     """
 
     transports: Dict[object, "LoopbackTransport"] = field(default_factory=dict)
     drop_fn: Optional[Callable[[object, object, bytes], bool]] = None
     latency_fn: Optional[Callable[[object, object], float]] = None
-    _partitions: Optional[List[set]] = None
+    #: executor-installed rule (serf_tpu.faults.host.HostFaultExecutor)
+    chaos: Optional[ChaosRule] = None
     rng: random.Random = field(default_factory=lambda: random.Random(0))
+    #: knob-driven rule (partition/set_drop_rate delegate here)
+    _legacy: ChaosRule = field(default_factory=ChaosRule)
 
     def bind(self, addr) -> "LoopbackTransport":
         if addr in self.transports:
@@ -134,16 +248,23 @@ class LoopbackNetwork:
 
     # fault injection -------------------------------------------------------
 
+    def apply_faults(self, rule: Optional[ChaosRule]) -> None:
+        """Install (or clear, with None) the active chaos rule — the one
+        API every fault source compiles to."""
+        self.chaos = rule
+
     def partition(self, *groups: set) -> None:
-        """Only nodes within the same group can communicate."""
-        self._partitions = [set(g) for g in groups]
+        """Only nodes within the same group can communicate
+        (delegates onto the unified chaos rule)."""
+        self._legacy.groups = [set(g) for g in groups]
 
     def heal(self) -> None:
-        self._partitions = None
+        self._legacy.groups = None
 
     def set_drop_rate(self, p: float, seed: int = 0) -> None:
-        rng = random.Random(seed)
-        self.drop_fn = (lambda s, d, b: rng.random() < p) if p > 0 else None
+        self._legacy.drop = max(0.0, p)
+        if p > 0:
+            self.rng = random.Random(seed)
 
     def drop_message_types(self, serf_types=(), swim_types=(),
                            keyring=None, opts=None) -> None:
@@ -223,20 +344,68 @@ class LoopbackNetwork:
 
         self.drop_fn = _drop
 
+    def _rules(self):
+        if self.chaos is not None:
+            yield self._legacy
+            yield self.chaos
+        else:
+            yield self._legacy
+
     def _blocked(self, src, dst) -> bool:
-        if self._partitions is not None:
-            for g in self._partitions:
-                if src in g and dst in g:
-                    return False
-            return True
+        """Deterministically unreachable (partition / pause / blackholed
+        edge) — blocks packets AND stream dials."""
+        for rule in self._rules():
+            if rule.group_blocked(src, dst) or rule.blackholed(src, dst):
+                return True
         return False
 
     def _should_drop(self, src, dst, buf: bytes) -> bool:
         if self._blocked(src, dst):
             return True
+        for rule in self._rules():
+            if rule.drop == 0.0 and not rule.edges:
+                continue
+            p = rule.edge_rates(src, dst).drop
+            if p > 0 and self.rng.random() < p:
+                metrics.incr("serf.faults.dropped", 1)
+                return True
         if self.drop_fn is not None and self.drop_fn(src, dst, buf):
             return True
         return False
+
+    def _plan_delivery(self, src, dst, buf: bytes) -> List[Tuple[float, bytes]]:
+        """Apply non-drop chaos effects: [(delay_s, payload), ...] —
+        normally one entry; duplication adds a second, corruption flips
+        a bit, reorder/delay/jitter stretch the delay."""
+        delay = 0.0
+        if self.latency_fn is not None:
+            delay += self.latency_fn(src, dst)
+        copies = 1
+        for rule in self._rules():
+            if not rule.any_effects():
+                continue
+            er = rule.edge_rates(src, dst)
+            if er.delay > 0 or rule.jitter > 0:
+                delay += er.delay + rule.jitter * self.rng.random()
+                metrics.incr("serf.faults.delayed", 1)
+            if er.reorder > 0 and self.rng.random() < er.reorder:
+                # a reordered packet arrives later than its successors
+                delay += self.rng.uniform(0.0, rule.reorder_window)
+                metrics.incr("serf.faults.reordered", 1)
+            if er.corrupt > 0 and self.rng.random() < er.corrupt:
+                b = bytearray(buf)
+                if b:
+                    i = self.rng.randrange(len(b))
+                    b[i] ^= 1 << self.rng.randrange(8)
+                    buf = bytes(b)
+                    metrics.incr("serf.faults.corrupted", 1)
+            if er.duplicate > 0 and self.rng.random() < er.duplicate:
+                copies += 1
+                metrics.incr("serf.faults.duplicated", 1)
+        out = [(delay, buf)]
+        for _ in range(copies - 1):
+            out.append((delay + self.rng.uniform(0.0, 0.002), buf))
+        return out
 
 
 class LoopbackTransport(Transport):
@@ -260,14 +429,19 @@ class LoopbackTransport(Transport):
         target = net.transports.get(addr)
         if target is None or target._shut:
             return  # unreachable, like UDP
-        if net.latency_fn is not None:
-            delay = net.latency_fn(self._addr, addr)
+        for delay, payload in net._plan_delivery(self._addr, addr, buf):
             if delay > 0:
                 asyncio.get_running_loop().call_later(
-                    delay, target._packets.put_nowait, (self._addr, buf)
-                )
-                return
-        target._packets.put_nowait((self._addr, buf))
+                    delay, target._deliver_packet, (self._addr, payload))
+            else:
+                target._packets.put_nowait((self._addr, payload))
+
+    def _deliver_packet(self, item) -> None:
+        """Delayed-delivery sink: a transport shut down while the packet
+        was in flight swallows it (UDP semantics) instead of waking a
+        dead queue."""
+        if not self._shut:
+            self._packets.put_nowait(item)
 
     async def recv_packet(self) -> Tuple[object, bytes]:
         item = await self._packets.get()

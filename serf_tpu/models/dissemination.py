@@ -929,20 +929,31 @@ def select_phase(state: GossipState, cfg: GossipConfig) -> jnp.ndarray:
 
 
 def exchange_phase(packets: jnp.ndarray, cfg: GossipConfig,
-                   key: jax.Array, group=None) -> jnp.ndarray:
+                   key: jax.Array, group=None,
+                   drop_rate=None) -> jnp.ndarray:
     """Phase 3 — pull-exchange: each node ORs ``fanout`` peers' packets.
 
     Rotation mode: fanout random rotations shared by all nodes — peer
     reads are contiguous slices, no gather (GossipConfig.peer_sampling);
     the doubled array is hoisted across the fanout slices, ONE
     materialization by construction (the byte model's "concat once"
-    term, accounting.py).  ``group`` masks cross-partition flow."""
+    term, accounting.py).  ``group`` masks cross-partition flow.
+
+    ``drop_rate`` (optional f32 scalar, may be traced) is the chaos
+    plane's per-round delivery mask (serf_tpu.faults.device): each
+    (receiver, peer) exchange is independently lost with that
+    probability — the device analog of per-edge UDP loss.  None (the
+    default) compiles the fault path out entirely."""
     n = packets.shape[0]
+    if drop_rate is not None:
+        key, k_drop = jax.random.split(key)
     if cfg.peer_sampling == "rotation":
         offs = sample_offsets(key, cfg.fanout, n)
         doubled = jnp.concatenate([packets, packets], axis=0)
         dgroup = (jnp.concatenate([group, group], axis=0)
                   if group is not None else None)
+        lost = (jax.random.bernoulli(k_drop, drop_rate, (cfg.fanout, n))
+                if drop_rate is not None else None)
         incoming = jnp.zeros_like(packets)
         for f in range(cfg.fanout):
             contrib = rolled_rows(packets, offs[f], doubled=doubled)
@@ -951,6 +962,9 @@ def exchange_phase(packets: jnp.ndarray, cfg: GossipConfig,
                                       doubled=dgroup) == group
                 contrib = jnp.where(allowed[:, None], contrib,
                                     jnp.uint32(0))
+            if lost is not None:
+                contrib = jnp.where(lost[f][:, None], jnp.uint32(0),
+                                    contrib)
             incoming = incoming | contrib
         return incoming
     srcs = jax.random.randint(key, (n, cfg.fanout), 0, n)
@@ -959,6 +973,9 @@ def exchange_phase(packets: jnp.ndarray, cfg: GossipConfig,
         allowed = (group[srcs] == group[:, None])     # bool[N, F]
         gathered = jnp.where(allowed[:, :, None], gathered,
                              jnp.uint32(0))
+    if drop_rate is not None:
+        lost = jax.random.bernoulli(k_drop, drop_rate, (n, cfg.fanout))
+        gathered = jnp.where(lost[:, :, None], jnp.uint32(0), gathered)
     return jax.lax.reduce(gathered, jnp.uint32(0),
                           jnp.bitwise_or, (1,))       # u32[N, W]
 
@@ -1068,7 +1085,7 @@ def merge_phase(state: GossipState, incoming: jnp.ndarray,
 
 
 def round_step(state: GossipState, cfg: GossipConfig,
-               key: jax.Array, group=None) -> GossipState:
+               key: jax.Array, group=None, drop_rate=None) -> GossipState:
     """One gossip round: select packets, pull-exchange, Lamport-merge
     (the :func:`select_phase`/:func:`exchange_phase`/:func:`merge_phase`
     composition — the profiler jits the same phases in isolation,
@@ -1092,7 +1109,8 @@ def round_step(state: GossipState, cfg: GossipConfig,
     """
     def active(state):
         packets = select_phase(state, cfg)
-        incoming = exchange_phase(packets, cfg, key, group=group)
+        incoming = exchange_phase(packets, cfg, key, group=group,
+                                  drop_rate=drop_rate)
         st = merge_phase(state, incoming, cfg)
         return (st.known, st.stamp, st.last_learn, st.sendable,
                 st.sendable_round, st.last_clamp)
@@ -1178,6 +1196,59 @@ def push_round_step(state: GossipState, cfg: GossipConfig,
                           last_clamp=jnp.asarray(state.round + 1,
                                                  jnp.int32),
                           round=state.round + 1)
+
+
+# -- Lamport-time wrap window ------------------------------------------------
+#
+# FactTable.ltime is u32.  A long-lived cluster's event clock WILL cross
+# 2^32 (at the reference's continuous-broadcast rates, ~2 events/round,
+# that is ~2^31 rounds — far, but a restart-with-snapshot cluster's clock
+# is cumulative, and wrapping silently inverts every supersession
+# decision).  The wrap story: comparisons are WINDOWED two's-complement —
+# ``a`` supersedes ``b`` iff ``int32(a - b) > 0`` — which is exact as long
+# as all live ltimes span < 2^31 (the "window").  Where windowing cannot
+# save us (live ltimes genuinely spanning >= 2^31, i.e. facts retained for
+# ~half the clock space) the guard below fails LOUD instead of silently
+# mis-ordering; the invariant checker (faults/invariants.py) asserts it
+# after every chaos run.
+
+LTIME_WINDOW = 1 << 31
+
+
+def ltime_newer(a, b) -> jnp.ndarray:
+    """Wrap-safe ``a`` strictly supersedes ``b`` for u32 Lamport times
+    (windowed two's-complement; exact while |true distance| < 2^31)."""
+    return (jnp.asarray(a, jnp.uint32)
+            - jnp.asarray(b, jnp.uint32)).astype(jnp.int32) > 0
+
+
+def ltime_rel(ltimes, pivot) -> jnp.ndarray:
+    """Signed i32 offsets of u32 ``ltimes`` relative to ``pivot`` — the
+    order-preserving embedding a windowed max/argmax runs in.  Sound
+    while every value is within 2^31 of ``pivot`` (guard below)."""
+    return (jnp.asarray(ltimes, jnp.uint32)
+            - jnp.asarray(pivot, jnp.uint32)).astype(jnp.int32)
+
+
+def ltime_window_violation(facts: FactTable) -> jnp.ndarray:
+    """Scalar bool: the valid facts' ltimes span >= 2^31, so windowed
+    comparison can no longer order them — fail loud (the host callers
+    raise; under jit, reduce and check after device_get).
+
+    Computed on the u32 circle (no 64-bit arithmetic — the test harness
+    runs with x64 disabled): sort the valid ltimes, take circular gaps
+    between consecutive points; the occupied span is ``2^32 - max_gap``.
+    The window holds iff the span is < 2^31, i.e. ``max_gap > 2^31``.
+    All-equal ltimes make every gap 0 (span 0 — never a violation).
+    """
+    valid = facts.valid
+    pivot = facts.ltime[jnp.argmax(valid)]
+    pts = jnp.where(valid, facts.ltime, pivot)        # u32[K]
+    s = jnp.sort(pts)
+    gaps = jnp.roll(s, -1) - s                        # u32 circular diffs
+    max_gap = jnp.max(gaps)
+    return (jnp.any(valid) & (max_gap != 0)
+            & (max_gap <= jnp.uint32(LTIME_WINDOW)))
 
 
 # -- metrics -----------------------------------------------------------------

@@ -83,24 +83,32 @@ def rotation_offset(round_, n: int) -> jnp.ndarray:
                             * jnp.uint32(2654435761)) % jnp.uint32(max(1, n - 1))
 
 
-def _facts_about(state: GossipState, kinds, min_inc_of_subject=None):
-    """bool[K]: table slots that are valid facts of one of ``kinds``."""
+def _facts_about(state: GossipState, kinds, inc_current: bool = False):
+    """bool[K]: table slots that are valid facts of one of ``kinds``.
+
+    ``inc_current=True`` additionally requires the fact's incarnation to
+    be >= its subject's current ground-truth incarnation — THE
+    staleness gate (single definition): a fact whose subject has since
+    bumped past it (a refutation happened, even if the K_ALIVE fact was
+    recycled out of the ring) is no longer current evidence."""
     m = jnp.zeros_like(state.facts.valid)
     for k in kinds:
         m = m | (state.facts.kind == k)
-    return m & state.facts.valid
+    m = m & state.facts.valid
+    if inc_current:
+        subj = jnp.clip(state.facts.subject, 0)
+        m = m & (state.facts.incarnation >= state.incarnation[subj])
+    return m
 
 
 def _subject_covered(state: GossipState, cfg: GossipConfig,
                      kinds) -> jnp.ndarray:
     """bool[N]: subject already has a valid fact of ``kinds`` with
     incarnation >= the subject's current ground-truth incarnation."""
-    k_mask = _facts_about(state, kinds)
-    subj = state.facts.subject
-    inc_ok = state.facts.incarnation >= state.incarnation[jnp.clip(subj, 0)]
-    active = k_mask & inc_ok
+    active = _facts_about(state, kinds, inc_current=True)
+    subj = jnp.clip(state.facts.subject, 0)
     covered = jnp.zeros((cfg.n,), bool)
-    covered = covered.at[jnp.clip(subj, 0)].max(active)
+    covered = covered.at[subj].max(active)
     return covered
 
 
@@ -110,10 +118,8 @@ def accusations_pending(state: GossipState) -> jnp.ndarray:
     alive.  The refute_round skip-gate: all-False means the phase is a
     bit-exact identity (retired-but-valid ring facts fail this, so the
     gate switches OFF again in the post-detection steady state)."""
-    accusation = _facts_about(state, (K_SUSPECT, K_DEAD))
     subj = jnp.clip(state.facts.subject, 0)
-    return (accusation
-            & (state.facts.incarnation >= state.incarnation[subj])
+    return (_facts_about(state, (K_SUSPECT, K_DEAD), inc_current=True)
             & state.alive[subj])
 
 
@@ -138,11 +144,9 @@ def live_suspicions(state: GossipState) -> jnp.ndarray:
     all-False makes the phase a bit-exact identity."""
     suspect = _facts_about(state, (K_SUSPECT,))
     refuted = jnp.any(_refutation_matrix(state), axis=1)
-    subj = jnp.clip(state.facts.subject, 0)
     same_subject = (state.facts.subject[:, None]
                     == state.facts.subject[None, :])
-    dead_slot = (_facts_about(state, (K_DEAD,))
-                 & (state.facts.incarnation >= state.incarnation[subj]))
+    dead_slot = _facts_about(state, (K_DEAD,), inc_current=True)
     dead_covered = jnp.any(same_subject & dead_slot[None, :], axis=1)
     return suspect & ~refuted & ~dead_covered
 
@@ -181,7 +185,8 @@ def _bounded_inject(state: GossipState, cfg: GossipConfig, candidates,
 
 
 def probe_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
-                key: jax.Array) -> GossipState:
+                key: jax.Array, group=None,
+                drop_override=None) -> GossipState:
     """Probe + indirect probes + suspicion injection.
 
     SWIM semantics: a missed direct ack falls back to ``indirect_probes``
@@ -190,10 +195,18 @@ def probe_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
     suspicion probability ~drop^(1+k) per probe — without it, realistic
     packet loss at 100k nodes floods the fact ring with false suspicions
     every round and starves real death declarations of ring residency.
+
+    Chaos-plane inputs (serf_tpu.faults.device): ``group`` (i32[N])
+    makes cross-partition targets unreachable — an unreachable-but-alive
+    node IS suspected, exactly as SWIM would (the post-heal refutation
+    path then clears it); ``drop_override`` (f32 scalar, may be traced)
+    replaces ``fcfg.probe_drop_rate`` for this round.
     """
     n = cfg.n
     k_target, k_drop, k_help, k_hdrop, k_pick = jax.random.split(key, 5)
-    dropped = jax.random.bernoulli(k_drop, fcfg.probe_drop_rate, (n,))
+    p_drop = (drop_override if drop_override is not None
+              else fcfg.probe_drop_rate)
+    dropped = jax.random.bernoulli(k_drop, p_drop, (n,))
     prober_ok = state.alive
     if fcfg.probe_schedule == "round_robin":
         # one pseudo-random nonzero rotation per round: node i probes
@@ -206,6 +219,12 @@ def probe_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
         offset = rotation_offset(state.round, n).astype(jnp.int32)
         dalive = jnp.concatenate([state.alive, state.alive], axis=0)
         target_up = rolled_rows(state.alive, offset, doubled=dalive)
+        if group is not None:
+            dgroup = jnp.concatenate([group, group], axis=0)
+            target_up = target_up & (
+                rolled_rows(group, offset, doubled=dgroup) == group)
+        else:
+            dgroup = None
         ack = target_up & ~dropped
         if fcfg.indirect_probes > 0:
             # helpers are per-round random rotations too (the reference
@@ -213,10 +232,17 @@ def probe_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
             # path keeps the drop paths independent where it matters)
             h_offs = sample_offsets(k_help, fcfg.indirect_probes, n)
             h_drop = jax.random.bernoulli(
-                k_hdrop, fcfg.probe_drop_rate, (n, fcfg.indirect_probes))
+                k_hdrop, p_drop, (n, fcfg.indirect_probes))
             for h in range(fcfg.indirect_probes):
                 helper_ok = rolled_rows(state.alive, h_offs[h],
                                         doubled=dalive)
+                if group is not None:
+                    # groups are equivalence classes: helper reachable
+                    # from the prober implies helper↔target reachability
+                    # whenever the target is in the prober's group
+                    helper_ok = helper_ok & (
+                        rolled_rows(group, h_offs[h],
+                                    doubled=dgroup) == group)
                 ack = ack | (target_up & helper_ok & ~h_drop[:, h])
         # offset ∈ [1, n-1] means never self-probe — except n == 1, where
         # every rotation is the identity and the lone node must not be
@@ -228,13 +254,17 @@ def probe_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
     else:
         targets = jax.random.randint(k_target, (n,), 0, n)
         target_up = state.alive[targets]
+        if group is not None:
+            target_up = target_up & (group[targets] == group)
         ack = target_up & ~dropped
         if fcfg.indirect_probes > 0:
             ki = fcfg.indirect_probes
             helpers = jax.random.randint(k_help, (n, ki), 0, n)
             helper_ok = state.alive[helpers]                   # bool[N, ki]
+            if group is not None:
+                helper_ok = helper_ok & (group[helpers] == group[:, None])
             h_drop = jax.random.bernoulli(
-                k_hdrop, fcfg.probe_drop_rate, (n, ki))
+                k_hdrop, p_drop, (n, ki))
             ack_indirect = target_up[:, None] & helper_ok & ~h_drop
             ack = ack | jnp.any(ack_indirect, axis=1)
         detected = prober_ok & ~ack & (targets != jnp.arange(n))
@@ -272,9 +302,21 @@ def refute_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
     AND the subject is alive.  Retired-but-valid ring facts (a declared
     death, a refuted suspicion) fail the predicate, so the gate switches
     the phase OFF again in the post-detection steady state — with it the
-    N×K accusation scan and the inject are bit-exact identities."""
+    N×K accusation scan and the inject are bit-exact identities.
+
+    A TOMBSTONED subject that is actually alive also refutes: its death
+    declaration fully disseminated and retired into the durable record
+    while it was down (crash → restart), so no ring fact remains to
+    accuse it and nothing else would ever clear the tombstone.  This is
+    the device analog of the reference's gossip-to-dead refutation
+    window (a restarted node learns it is believed dead through any
+    interaction and re-broadcasts alive); the K_ALIVE injection clears
+    the tombstone (inject_facts_batch).  ``tombstone & alive`` is empty
+    for every genuinely dead subject, so the steady-state gate stays
+    closed and the phase stays free."""
     n, k = cfg.n, cfg.k_facts
     could_accuse = accusations_pending(state)
+    tomb_alive = state.tombstone & state.alive
 
     def do(state):
         # single-source with the gate: per-fact pending already encodes
@@ -283,7 +325,8 @@ def refute_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
         # diverge from the gate it runs under
         known = unpack_bits(state.known, k)                  # bool[N, K]
         about_me = state.facts.subject[None, :] == jnp.arange(n)[:, None]
-        accused = jnp.any(known & could_accuse[None, :] & about_me, axis=1)
+        accused = jnp.any(known & could_accuse[None, :] & about_me,
+                          axis=1) | tomb_alive
         new_inc = jnp.where(accused, state.incarnation + 1,
                             state.incarnation)
         state = state._replace(incarnation=new_inc)
@@ -291,7 +334,8 @@ def refute_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
                                jnp.arange(n, dtype=jnp.int32),
                                fcfg.max_new_facts, key)
 
-    return jax.lax.cond(jnp.any(could_accuse), do, lambda st: st, state)
+    return jax.lax.cond(jnp.any(could_accuse) | jnp.any(tomb_alive),
+                        do, lambda st: st, state)
 
 
 def declare_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
@@ -385,8 +429,13 @@ def believed_dead(state: GossipState, cfg: GossipConfig,
     bool[N_subjects] 'every alive node believes subject dead'."""
     n, k = cfg.n, cfg.k_facts
     known = unpack_bits(state.known, k)
-    dead_fact = _facts_about(state, (K_DEAD,))
-    aged_suspect = _facts_about(state, (K_SUSPECT,))
+    # an accusation stale w.r.t. the subject's CURRENT incarnation is no
+    # evidence: the incarnation plane is the durable record of a
+    # refutation (the K_ALIVE fact itself may have been recycled out of
+    # the ring — the dual of the tombstone plane for deaths; reference
+    # member tables ignore stale-incarnation dead messages forever)
+    dead_fact = _facts_about(state, (K_DEAD,), inc_current=True)
+    aged_suspect = _facts_about(state, (K_SUSPECT,), inc_current=True)
     aged = mod_age(state, cfg) >= fcfg.suspicion_q  # gated by `known` below
     evidence = known & (dead_fact[None, :] | (aged_suspect[None, :] & aged))
     # refutation: knower also knows an alive fact about the same subject

@@ -29,6 +29,7 @@ from serf_tpu.models.dissemination import (
     GossipState,
     K_JOIN,
     K_LEAVE,
+    ltime_rel,
     unpack_bits,
 )
 
@@ -55,17 +56,31 @@ def intent_views(state: GossipState, cfg: GossipConfig,
     is_leave = (facts.kind == K_LEAVE) & facts.valid
     # [S, K] fact-about-subject masks
     about = facts.subject[None, :] == subjects[:, None]
-    ltime = facts.ltime.astype(jnp.uint32)
+    # wrap-safe supersession (ltime is u32 and a long-lived cluster's
+    # clock wraps): compare in the windowed two's-complement embedding —
+    # signed offsets relative to any intent fact's ltime preserve order
+    # while the live ltimes span < 2^31 (``ltime_window_violation`` is
+    # the fail-loud guard for when they don't).  A plain u32 max would
+    # make a pre-wrap intent (huge) supersede a post-wrap one (small)
+    # forever.
+    pivot = facts.ltime[jnp.argmax(is_join | is_leave)]
+    rel = ltime_rel(facts.ltime, pivot)                       # i32[K]
+    sentinel = jnp.iinfo(jnp.int32).min
 
     def per_knower(known_row):
         # known_row: bool[K]
         jmask = known_row[None, :] & about & is_join[None, :]     # [S, K]
         lmask = known_row[None, :] & about & is_leave[None, :]
-        jbest = jnp.max(jnp.where(jmask, ltime[None, :], 0), axis=1)
-        lbest = jnp.max(jnp.where(lmask, ltime[None, :], 0), axis=1)
+        jany = jnp.any(jmask, axis=1)
+        lany = jnp.any(lmask, axis=1)
+        jbest = jnp.max(jnp.where(jmask, rel[None, :], sentinel), axis=1)
+        lbest = jnp.max(jnp.where(lmask, rel[None, :], sentinel), axis=1)
+        # highest ltime wins; ties (and join-vs-leave at equal rel)
+        # prefer LEAVE — the conservative choice (module docstring)
         status = jnp.where(
-            (jbest == 0) & (lbest == 0), V_NONE,
-            jnp.where(jbest > lbest, V_ALIVE, V_LEAVING))
+            ~jany & ~lany, V_NONE,
+            jnp.where(jany & (~lany | (jbest > lbest)),
+                      V_ALIVE, V_LEAVING))
         return status.astype(jnp.uint8)
 
     return jax.vmap(per_knower)(known)                        # u8[N, S]

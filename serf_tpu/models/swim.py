@@ -118,23 +118,36 @@ def make_cluster(cfg: ClusterConfig, key: jax.Array) -> ClusterState:
 
 
 def cluster_round(state: ClusterState, cfg: ClusterConfig,
-                  key: jax.Array) -> ClusterState:
-    """One full protocol round for every simulated node."""
+                  key: jax.Array, drop_rate=None) -> ClusterState:
+    """One full protocol round for every simulated node.
+
+    ``drop_rate`` (optional f32 scalar, may be traced) is the chaos
+    plane's per-round loss input (serf_tpu.faults.device): it masks the
+    gossip exchange AND overrides the probe-path drop rate, so the same
+    FaultPlan loss phase degrades dissemination and pressures the
+    failure detector exactly like host-plane UDP loss.  ``state.group``
+    is the per-round partition/adjacency mask throughout (gossip,
+    probes, push/pull, Vivaldi)."""
     k_gossip, k_probe, k_refute, k_declare, k_pp, k_viv, k_peer = \
         jax.random.split(key, 7)
     g = state.gossip
     probe_tick = (g.round % cfg.probe_every == 0) \
         if cfg.probe_every > 1 else None
-    g = round_step(g, cfg.gossip, k_gossip, group=state.group)
+    chaos_group = state.group if drop_rate is not None else None
+    g = round_step(g, cfg.gossip, k_gossip, group=state.group,
+                   drop_rate=drop_rate)
     if cfg.with_failure:
         if probe_tick is None:
-            g = probe_round(g, cfg.gossip, cfg.failure, k_probe)
+            g = probe_round(g, cfg.gossip, cfg.failure, k_probe,
+                            group=chaos_group, drop_override=drop_rate)
             g = refute_round(g, cfg.gossip, cfg.failure, k_refute)
             g = declare_round(g, cfg.gossip, cfg.failure, k_declare)
         else:
             g = jax.lax.cond(
                 probe_tick,
-                lambda s: probe_round(s, cfg.gossip, cfg.failure, k_probe),
+                lambda s: probe_round(s, cfg.gossip, cfg.failure, k_probe,
+                                      group=chaos_group,
+                                      drop_override=drop_rate),
                 lambda s: s, g)
             g = refute_round(g, cfg.gossip, cfg.failure, k_refute)
             # declare rides the probe cadence: its expiry scan re-reads

@@ -23,6 +23,11 @@ Event kinds emitted by the engine (see README "Observability"):
 - ``pallas-fallback`` use_pallas requested but ``pallas_ok`` rejected the
   shape — the round silently used the XLA path (r5 TPU_PROOF lesson:
   invisible fallbacks hid MosaicErrors)
+- ``fault-phase``      a chaos-plan phase was installed/healed (faults)
+- ``circuit-breaker``  a per-peer circuit opened/reopened/closed
+- ``dial-retry``       a stream dial / join retried after backoff
+- ``corrupt-frame``    an undecodable stream frame was quarantined
+- ``snapshot-torn-tail``  snapshot replay skipped a torn tail
 
 Events recorded while a cross-node trace is active (``obs.trace
 .trace_scope``) carry a ``trace`` field — the hex trace id shared by

@@ -98,6 +98,16 @@ class MemberlistOptions:
     checksum: Optional[str] = None           # None | crc32/adler32/xxhash32/murmur3
     protocol_version: int = 1                # advertised on the wire (vsn)
     delegate_version: int = 1                # reference version.rs:9-43
+    # graceful degradation (host/degrade.py): stream dials and push/pull
+    # retry with jittered exponential backoff; a peer failing
+    # breaker_threshold consecutive times opens a circuit that fast-fails
+    # further attempts for breaker_cooldown (then one half-open trial)
+    dial_backoff_base: float = 0.05          # first-retry backoff (jittered)
+    dial_backoff_max: float = 1.0            # backoff growth cap
+    dial_retries: int = 2                    # extra dial attempts per op
+    join_retries: int = 2                    # extra join (push/pull) attempts
+    breaker_threshold: int = 4               # consecutive failures to open
+    breaker_cooldown: float = 2.0            # open-circuit fast-fail window
     metric_labels: Dict[str, str] = field(default_factory=dict)
 
     def validate(self) -> None:
@@ -120,6 +130,13 @@ class MemberlistOptions:
             raise ValueError(
                 f"delegate_version {self.delegate_version} outside supported "
                 f"[{DELEGATE_VERSION_MIN}, {DELEGATE_VERSION_MAX}]")
+        if self.dial_backoff_base <= 0 or self.dial_backoff_max <= 0:
+            raise ValueError("dial backoff durations must be positive")
+        if self.dial_retries < 0 or self.join_retries < 0:
+            raise ValueError("retry counts must be >= 0")
+        if self.breaker_threshold < 1 or self.breaker_cooldown < 0:
+            raise ValueError("breaker_threshold >= 1 and "
+                             "breaker_cooldown >= 0 required")
 
     @classmethod
     def lan(cls) -> "MemberlistOptions":
@@ -159,6 +176,9 @@ class MemberlistOptions:
                                      # hotter rates saturate big in-process
                                      # clusters (every sync is O(N) decode)
             timeout=2.0,
+            dial_backoff_base=0.01,
+            dial_backoff_max=0.08,
+            breaker_cooldown=0.25,
         )
 
 
@@ -317,6 +337,7 @@ _OPTIONS_DURATIONS = frozenset({
 _ML_DURATIONS = frozenset({
     "gossip_interval", "probe_interval", "probe_timeout",
     "push_pull_interval", "timeout",
+    "dial_backoff_base", "dial_backoff_max", "breaker_cooldown",
 })
 
 

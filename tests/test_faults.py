@@ -1,0 +1,390 @@
+"""Unified chaos plane: FaultPlan on both planes + degradation hardening.
+
+Acceptance pins (ISSUE 4):
+
+- ONE FaultPlan (partition -> heal + 5% loss) runs on BOTH the host
+  loopback cluster and the device-plane sim from the same plan object,
+  with the invariant checker green on both;
+- killing a peer mid-push/pull degrades gracefully: backoff +
+  circuit-breaker counters fire, no unhandled task death, and the
+  cluster converges after the peer restarts;
+- the legacy ``LoopbackNetwork`` knobs delegate onto the unified chaos
+  rule (nothing breaks);
+- ``tools/chaos.py --self-check`` exits 0 (tier-1 CLI hook).
+"""
+
+import asyncio
+import random
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from serf_tpu.faults.plan import (
+    EdgeFault,
+    FaultPhase,
+    FaultPlan,
+    named_plan,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.asyncio
+
+
+# ---------------------------------------------------------------------------
+# plan validation
+# ---------------------------------------------------------------------------
+
+
+def test_plan_validation_rejects_bad_plans():
+    with pytest.raises(ValueError):  # overlapping groups
+        FaultPlan("x", n=4, phases=(
+            FaultPhase(partitions=((0, 1), (1, 2))),)).validate()
+    with pytest.raises(ValueError):  # rate outside [0, 1]
+        FaultPlan("x", n=4, phases=(FaultPhase(drop=1.5),)).validate()
+    with pytest.raises(ValueError):  # node out of range
+        FaultPlan("x", n=4, phases=(FaultPhase(crash=(7,),
+                                               restart=(7,)),)).validate()
+    with pytest.raises(ValueError):  # ends with a node still down
+        FaultPlan("x", n=4, phases=(FaultPhase(crash=(1,)),)).validate()
+    with pytest.raises(ValueError):  # edge out of range
+        FaultPlan("x", n=4, phases=(
+            FaultPhase(edges=(EdgeFault(src=0, dst=9),)),)).validate()
+    named_plan("partition-heal-loss").validate()  # built-ins are valid
+
+
+def test_named_plan_registry():
+    from serf_tpu.faults.plan import plan_names
+    assert "partition-heal-loss" in plan_names()
+    with pytest.raises(KeyError):
+        named_plan("no-such-plan")
+
+
+# ---------------------------------------------------------------------------
+# device plane: the acceptance plan, lowered into the scan
+# ---------------------------------------------------------------------------
+
+
+def _device_cfg(n=128, k_facts=32):
+    from serf_tpu.models.dissemination import GossipConfig
+    from serf_tpu.models.failure import FailureConfig
+    from serf_tpu.models.swim import ClusterConfig
+
+    return ClusterConfig(
+        gossip=GossipConfig(n=n, k_facts=k_facts,
+                            peer_sampling="rotation"),
+        failure=FailureConfig(suspicion_rounds=8, max_new_facts=8,
+                              probe_schedule="round_robin"),
+        push_pull_every=8)
+
+
+def test_partition_heal_loss_device_plane():
+    """The acceptance FaultPlan, device flavor: the plan lowers to
+    per-round group/drop/liveness masks consumed inside the jitted scan,
+    and every invariant is green after the settle window."""
+    from serf_tpu.faults.device import lower_plan, run_device_plan
+
+    plan = named_plan("partition-heal-loss")
+    cfg = _device_cfg()
+    sched = lower_plan(plan, cfg.n)
+    # the bisection lowered to two real groups + loss only in its phase
+    assert int(sched.group[1].max()) == 2 and int(sched.group[0].max()) == 0
+    assert float(sched.drop[1]) == pytest.approx(0.05)
+    assert float(sched.drop[0]) == 0.0
+    result = run_device_plan(plan, cfg)
+    assert result.report.ok, result.report.format()
+    assert result.rounds_run == plan.total_rounds() + plan.settle_rounds
+    names = [r.name for r in result.report.results]
+    assert {"membership-convergence", "no-false-dead",
+            "ltime-window"} <= set(names)
+
+
+@pytest.mark.slow
+def test_crash_restart_device_plane():
+    """Crash + restart lowered to liveness masks, end to end (heavier
+    sibling of the tier-1 host crash-restart run + the direct
+    tombstone-refute unit below): the restarted node's death story is
+    refuted and no alive node stays believed-dead."""
+    from serf_tpu.faults.device import run_device_plan
+
+    result = run_device_plan(named_plan("crash-restart"), _device_cfg())
+    assert result.report.ok, result.report.format()
+
+
+def test_tombstoned_alive_subject_refutes():
+    """The device model gap the crash-restart plan exposed, pinned
+    directly: a tombstoned subject that is actually alive (restart after
+    its death record folded durable) refutes — incarnation bump +
+    K_ALIVE fact + tombstone cleared — instead of staying believed-dead
+    forever with no ring fact left to accuse it."""
+    import jax
+    import jax.numpy as jnp
+
+    from serf_tpu.models.dissemination import (
+        GossipConfig,
+        K_ALIVE,
+        make_state,
+    )
+    from serf_tpu.models.failure import (
+        FailureConfig,
+        believed_dead,
+        refute_round,
+    )
+
+    cfg = GossipConfig(n=64, k_facts=32)
+    fcfg = FailureConfig(suspicion_rounds=8)
+    g = make_state(cfg)
+    g = g._replace(tombstone=g.tombstone.at[5].set(True))
+    assert bool(believed_dead(g, cfg, fcfg)[5])
+    g2 = refute_round(g, cfg, fcfg, jax.random.key(0))
+    assert int(g2.incarnation[5]) == int(g.incarnation[5]) + 1
+    assert not bool(g2.tombstone[5])
+    has_alive_fact = jnp.any((g2.facts.kind == K_ALIVE) & g2.facts.valid
+                             & (g2.facts.subject == 5))
+    assert bool(has_alive_fact)
+    assert not bool(believed_dead(g2, cfg, fcfg)[5])
+    # genuinely dead subjects stay tombstoned (the gate is alive-only)
+    g3 = g._replace(alive=g.alive.at[5].set(False))
+    g4 = refute_round(g3, cfg, fcfg, jax.random.key(1))
+    assert bool(g4.tombstone[5])
+
+
+async def test_crash_restart_host_plane(tmp_path):
+    """Crash + restart on the host plane (wall-clock phases, snapshots
+    on): the restarted node replays its snapshot, rejoins, and the
+    crash-restart-rejoin invariant — clocks not regressed across the
+    restart — is green."""
+    from serf_tpu.faults.host import run_host_plan
+
+    plan = named_plan("crash-restart")
+    result = await run_host_plan(plan, tmp_dir=str(tmp_path))
+    assert result.report.ok, result.report.format()
+    rejoin = [r for r in result.report.results
+              if r.name == "crash-restart-rejoin"][0]
+    assert "1 restart(s)" in rejoin.detail and "snapshots=on" in rejoin.detail
+
+
+# ---------------------------------------------------------------------------
+# host plane: same plan object on a loopback cluster
+# ---------------------------------------------------------------------------
+
+
+async def test_partition_heal_loss_host_plane(tmp_path):
+    """The SAME acceptance plan object on the host plane: loopback
+    cluster, partition + loss phases from the executor, snapshots on,
+    invariants green (the tier-1 both-planes pin with the device test
+    above)."""
+    from serf_tpu.faults.host import run_host_plan
+
+    plan = named_plan("partition-heal-loss")
+    result = await run_host_plan(plan, tmp_dir=str(tmp_path))
+    assert result.report.ok, result.report.format()
+    assert result.events_sent > 0
+    # the checker saw real clock samples from every node
+    assert all(result.clock_samples[f"n{i}"] for i in range(plan.n))
+
+
+async def test_dial_pushpull_kill_mid_sync_degrades_gracefully(tmp_path):
+    """Acceptance: kill a peer mid-sync; dial/push-pull paths must
+    degrade measurably (backoff retries + circuit breaker opening), no
+    task dies unhandled, and the cluster re-converges after restart."""
+    from serf_tpu.faults import invariants as inv
+    from serf_tpu.host.serf import Serf, SerfState
+    from serf_tpu.host.transport import LoopbackNetwork
+    from serf_tpu.options import Options
+    from serf_tpu.utils import metrics
+
+    def degraded(name):
+        sink = metrics.global_sink()
+        return sum(v for (n, _l), v in sink.counters.items() if n == name)
+
+    base_retry = degraded("serf.degraded.dial_retry")
+    base_opened = degraded("serf.degraded.breaker_opened")
+
+    net = LoopbackNetwork()
+    opts = Options.local()
+    nodes = {i: await Serf.create(net.bind(f"k{i}"), opts, f"k{i}")
+             for i in range(3)}
+    died = []
+    loop = asyncio.get_running_loop()
+    prev_handler = None
+
+    def exc_handler(lp, ctx):
+        died.append(ctx.get("exception") or ctx.get("message"))
+
+    prev_handler = loop.get_exception_handler()
+    loop.set_exception_handler(exc_handler)
+    try:
+        for i in (1, 2):
+            await nodes[i].join("k0")
+        assert await inv.wait_host_convergence(list(nodes.values()), 5.0)
+
+        # kill node 2 abruptly (no leave) and hammer its stream plane:
+        # every push/pull from 0/1 now dials a dead address
+        await nodes[2].shutdown()
+        for _ in range(8):
+            try:
+                await nodes[0].memberlist._push_pull_with("k2", join=False)
+            except (ConnectionError, TimeoutError):
+                pass
+        assert degraded("serf.degraded.dial_retry") > base_retry
+        assert degraded("serf.degraded.breaker_opened") > base_opened
+        # circuit now open: the next attempt fast-fails without retries
+        with pytest.raises(ConnectionError):
+            await nodes[0].memberlist._dial_stream("k2")
+
+        # restart the peer on its old address; breaker half-open trial
+        # must rediscover it and the cluster must re-converge
+        nodes[2] = await Serf.create(net.bind("k2"), opts, "k2")
+        await asyncio.sleep(opts.memberlist.breaker_cooldown + 0.05)
+        await nodes[2].join("k0")
+        live = [s for s in nodes.values() if s.state == SerfState.ALIVE]
+        assert await inv.wait_host_convergence(live, 8.0)
+        # no unhandled task death reached the event loop
+        assert not died, died
+    finally:
+        loop.set_exception_handler(prev_handler)
+        for s in nodes.values():
+            if s.state != SerfState.SHUTDOWN:
+                await s.shutdown()
+
+
+async def test_corrupt_frame_quarantine():
+    """A garbage stream frame is quarantined (counter + flight event),
+    never a task death: the server keeps serving afterwards."""
+    from serf_tpu import obs
+    from serf_tpu.host.serf import Serf
+    from serf_tpu.host.transport import LoopbackNetwork
+    from serf_tpu.options import Options
+    from serf_tpu.utils import metrics
+
+    def counter():
+        sink = metrics.global_sink()
+        return sum(v for (n, _l), v in sink.counters.items()
+                   if n == "serf.degraded.corrupt_frame")
+
+    base = counter()
+    net = LoopbackNetwork()
+    a = await Serf.create(net.bind("c0"), Options.local(), "c0")
+    b = await Serf.create(net.bind("c1"), Options.local(), "c1")
+    try:
+        await b.join("c0")
+        # hand-dial and send garbage where a push/pull frame belongs
+        stream = await b.memberlist.transport.dial("c0")
+        await stream.send_frame(b"\xff\xfe not a frame \x00\x01")
+        await asyncio.sleep(0.1)
+        await stream.close()
+        assert counter() > base
+        assert any(e["kind"] == "corrupt-frame"
+                   for e in obs.flight_dump(kind="corrupt-frame"))
+        # the server still serves real syncs (no task death)
+        await b.memberlist._push_pull_with("c0", join=False)
+    finally:
+        await a.shutdown()
+        await b.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# legacy knobs delegate onto the unified rule
+# ---------------------------------------------------------------------------
+
+
+def test_legacy_knobs_delegate_to_chaos_rule():
+    from serf_tpu.host.transport import ChaosRule, LoopbackNetwork
+
+    net = LoopbackNetwork()
+    net.partition({"a", "b"}, {"c"})
+    assert net._legacy.groups is not None
+    assert not net._blocked("a", "b") and net._blocked("a", "c")
+    net.heal()
+    assert not net._blocked("a", "c")
+    net.set_drop_rate(1.0)
+    assert net._legacy.drop == 1.0
+    assert net._should_drop("a", "c", b"x")
+    net.set_drop_rate(0.0)
+    assert not net._should_drop("a", "c", b"x")
+    # executor rule composes with (not replaces) the legacy rule
+    net.partition({"a"}, {"b", "c"})
+    net.apply_faults(ChaosRule(drop=1.0))
+    assert net._blocked("b", "a")          # legacy partition still holds
+    assert net._should_drop("b", "c", b"x")  # executor drop applies
+    net.apply_faults(None)
+    assert not net._should_drop("b", "c", b"x")
+
+
+async def test_chaos_effects_duplicate_and_corrupt():
+    """Duplicate/corrupt/delay effects actually happen on the loopback
+    fabric (counter-verified; receiver sees >= 2 copies, one possibly
+    bit-flipped)."""
+    from serf_tpu.host.transport import ChaosRule, LoopbackNetwork
+    from serf_tpu.utils import metrics
+
+    net = LoopbackNetwork()
+    t0, t1 = net.bind("x0"), net.bind("x1")
+    net.apply_faults(ChaosRule(duplicate=1.0, corrupt=1.0))
+    sink = metrics.global_sink()
+    base_dup = sink.counter("serf.faults.duplicated")
+    base_cor = sink.counter("serf.faults.corrupted")
+    await t0.send_packet("x1", b"\x00" * 8)
+    got = []
+    for _ in range(2):
+        src, buf = await asyncio.wait_for(t1.recv_packet(), 1.0)
+        got.append(buf)
+    assert sink.counter("serf.faults.duplicated") == base_dup + 1
+    assert sink.counter("serf.faults.corrupted") == base_cor + 1
+    assert len(got) == 2
+    assert any(b != b"\x00" * 8 for b in got)  # the bit flip landed
+    await t0.shutdown()
+    await t1.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# CLI self-check (tier-1 hook)
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_cli_self_check():
+    """tools/chaos.py --self-check: the chaos-plane contract cannot
+    drift — both planes run the self-check plan green, exit 0."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "chaos.py"),
+         "--self-check", "--json"],
+        capture_output=True, text=True, timeout=300,
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+             "PYTHONPATH": str(REPO),
+             "XLA_FLAGS": "--xla_backend_optimization_level=0"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    import json
+    out = json.loads(proc.stdout)
+    assert out["ok"] is True
+    assert {r["plane"] for r in out["reports"]} == {"host", "device"}
+
+
+# ---------------------------------------------------------------------------
+# heavy chaos soak (redundant parametrization — slow, not tier-1)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+async def test_flaky_edges_host_soak(tmp_path):
+    """The full flaky-edges gauntlet (drop+dup+reorder+corrupt+jitter+
+    asymmetric edges) on the host plane — heavier sibling of the tier-1
+    partition plan."""
+    from serf_tpu.faults.host import run_host_plan
+
+    result = await run_host_plan(named_plan("flaky-edges"),
+                                 tmp_dir=str(tmp_path))
+    assert result.report.ok, result.report.format()
+
+
+@pytest.mark.slow
+def test_partition_heal_loss_device_large():
+    """Scale variant of the device acceptance run (1024 nodes)."""
+    from serf_tpu.faults.device import run_device_plan
+
+    result = run_device_plan(named_plan("partition-heal-loss"),
+                             _device_cfg(n=1024))
+    assert result.report.ok, result.report.format()

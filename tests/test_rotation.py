@@ -113,6 +113,12 @@ def test_rotation_flagship_round_runs_and_vivaldi_learns():
 
 
 def test_rotation_push_pull_heals_partition():
+    """Partition setup comes from a FaultPlan lowered by the device
+    executor (the unified chaos plane) — the same plan object a host
+    cluster would run; ``make_partition`` remains as sugar and must
+    agree with the lowering."""
+    from serf_tpu.faults.device import lower_plan
+    from serf_tpu.faults.plan import FaultPhase, FaultPlan
     from serf_tpu.models.antientropy import (
         knowledge_agreement,
         make_partition,
@@ -122,7 +128,19 @@ def test_rotation_push_pull_heals_partition():
     cfg = GossipConfig(n=1024, k_facts=32, peer_sampling="rotation")
     st = inject_fact(make_state(cfg), cfg, subject=1, kind=K_USER_EVENT,
                      incarnation=0, ltime=1, origin=1)
-    group = make_partition(cfg.n)
+    plan = FaultPlan(
+        name="rotation-bisect", n=cfg.n,
+        phases=(FaultPhase(name="bisect", rounds=30,
+                           partitions=(range(0, cfg.n // 2),
+                                       range(cfg.n // 2, cfg.n))),))
+    group = lower_plan(plan).group[0]
+    # the legacy helper builds the same equivalence classes (sampled
+    # across the bisection boundary)
+    legacy = make_partition(cfg.n)
+    idx = jnp.asarray([0, 1, cfg.n // 2 - 1, cfg.n // 2, cfg.n - 1])
+    assert bool(jnp.all(
+        (group[idx][:, None] == group[idx][None, :])
+        == (legacy[idx][:, None] == legacy[idx][None, :])))
     key = jax.random.key(5)
     from serf_tpu.models.dissemination import round_step
     step_part = jax.jit(lambda s, k: round_step(s, cfg, k, group=group))

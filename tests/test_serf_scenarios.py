@@ -561,9 +561,15 @@ async def test_dangling_leaving_restored_by_reaper():
     try:
         for s in nodes[1:]:
             await s.join("dl0")
-        await wait_until(lambda: all(s.num_members() == 3 for s in nodes),
-                         msg="3-node convergence")
         s0 = nodes[0]
+        # wait for dl-2's REAL join intent (ltime >= 2) to land at s0,
+        # not just SWIM-level membership: sampling status_time before it
+        # arrives makes the synthetic ltimes below collide with the late
+        # intent, which then flips the member ALIVE at a higher ltime
+        # and invalidates the final newer-leave assertion (rare race)
+        await wait_until(lambda: all(s.num_members() == 3 for s in nodes)
+                         and s0._members["dl-2"].status_time > 0,
+                         msg="3-node convergence incl. dl-2 join intent")
         ms = s0._members["dl-2"]
         lt = ms.status_time + 1
         # the losing arrival order: leave(t) first ...

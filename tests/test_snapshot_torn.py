@@ -1,0 +1,123 @@
+"""Snapshot torn-tail tolerance: a crash during append leaves a
+truncated record at the end of the log.  Replay must skip the torn tail
+with a warning (never raise), report the valid prefix length, and the
+writer must truncate the tail on reopen so post-restart appends never
+interleave with garbage — pinned with a byte-level truncation sweep.
+"""
+
+from serf_tpu.host.snapshot import (
+    R_ALIVE,
+    R_CLOCK,
+    R_EVENT_CLOCK,
+    Snapshotter,
+    _record,
+    open_and_replay_snapshot,
+)
+from serf_tpu import codec
+from serf_tpu.types.member import Node
+
+
+def _make_log(path) -> bytes:
+    recs = [
+        _record(R_CLOCK, codec.encode_varint(17)),
+        _record(R_ALIVE, Node("alpha", "addr-a").encode()),
+        _record(R_ALIVE, Node("beta", "addr-b").encode()),
+        _record(R_EVENT_CLOCK, codec.encode_varint(9)),
+        _record(R_ALIVE, Node("gamma-with-a-longer-id", "addr-c").encode()),
+    ]
+    buf = b"".join(recs)
+    path.write_bytes(buf)
+    return buf
+
+
+def _prefix_lengths(buf: bytes):
+    """Byte offsets at complete-record boundaries."""
+    out = [0]
+    pos = 0
+    while pos < len(buf):
+        ln, p = codec.decode_varint(buf, pos + 1)
+        pos = p + ln
+        out.append(pos)
+    return out
+
+
+def test_truncation_sweep_never_raises_and_matches_prefix(tmp_path):
+    """For EVERY truncation point, replay (a) does not raise, (b) equals
+    the replay of the longest complete-record prefix, and (c) reports
+    that prefix as valid_length."""
+    path = tmp_path / "s.snap"
+    buf = _make_log(path)
+    boundaries = _prefix_lengths(buf)
+    for cut in range(len(buf) + 1):
+        path.write_bytes(buf[:cut])
+        res = open_and_replay_snapshot(str(path))
+        want_valid = max(b for b in boundaries if b <= cut)
+        assert res.valid_length == want_valid, cut
+        ref = open_and_replay_snapshot(str(path))  # idempotent
+        assert {n.id for n in res.alive_nodes} == \
+            {n.id for n in ref.alive_nodes}
+        # the replayed state equals the clean prefix's
+        path.write_bytes(buf[:want_valid])
+        clean = open_and_replay_snapshot(str(path))
+        assert {n.id for n in res.alive_nodes} == \
+            {n.id for n in clean.alive_nodes}, cut
+        assert (res.last_clock, res.last_event_clock) == \
+            (clean.last_clock, clean.last_event_clock), cut
+
+
+def test_torn_tail_truncated_on_reopen_and_appends_stay_clean(tmp_path):
+    """Crash-mid-append then restart: the writer truncates the torn
+    bytes before appending, so a LATER replay reads both the old prefix
+    and the new records (without the repair, everything after the tear
+    would be silently dropped)."""
+    path = tmp_path / "s.snap"
+    buf = _make_log(path)
+    # tear mid-way through the last record
+    torn = buf[: len(buf) - 7]
+    path.write_bytes(torn)
+
+    replay = open_and_replay_snapshot(str(path))
+    assert replay.valid_length < len(torn)
+    snap = Snapshotter(str(path), replay)
+    try:
+        # the reopen repaired the file down to the valid prefix
+        assert path.stat().st_size == replay.valid_length
+        snap._append(R_ALIVE, Node("delta", "addr-d").encode())
+        snap._f.flush()
+    finally:
+        import asyncio
+        asyncio.run(snap.shutdown())
+
+    final = open_and_replay_snapshot(str(path))
+    ids = {n.id for n in final.alive_nodes}
+    assert "delta" in ids           # the post-restart append is readable
+    assert "beta" in ids            # the old complete prefix survived
+    assert "gamma-with-a-longer-id" not in ids  # the torn record is gone
+    assert final.valid_length == path.stat().st_size
+
+
+def test_torn_tail_metric_fires(tmp_path):
+    from serf_tpu.utils import metrics
+
+    sink = metrics.global_sink()
+    base = sink.counter("serf.snapshot.torn_tail")
+    path = tmp_path / "s.snap"
+    buf = _make_log(path)
+    path.write_bytes(buf[:-3])
+    open_and_replay_snapshot(str(path))
+    assert sink.counter("serf.snapshot.torn_tail") == base + 1
+
+
+def test_fully_torn_file_boots_empty(tmp_path):
+    """A file with no single complete record (e.g. crash on first-ever
+    append) boots as empty and is truncated to zero on reopen."""
+    path = tmp_path / "s.snap"
+    path.write_bytes(b"\x01")      # type byte only, header torn
+    res = open_and_replay_snapshot(str(path))
+    assert res.valid_length == 0 and not res.alive_nodes
+    snap = Snapshotter(str(path), res)
+    try:
+        assert path.stat().st_size == 0
+    finally:
+        import asyncio
+        asyncio.run(snap.shutdown())

@@ -7,9 +7,12 @@ churn; these runs pin the TRANSPORTS — every stream plane the framework
 ships (the reference's NetTransport / TLS / QUIC feature split,
 serf/Cargo.toml:24-56) must carry the same cluster through loss,
 partition, and key rotation.  Loss/partition are injected at the sender
-seam (``send_packet`` for the UDP gossip plane of every transport;
-``_sendto`` additionally for dstream so stream SEGMENTS drop too —
-exercising the ARQ under cluster load, not just unit frames).
+seam through the unified chaos surface
+(``serf_tpu.faults.host.attach_transport_chaos`` + ``ChaosRule`` — the
+same rules a ``FaultPlan`` phase compiles to): ``send_packet`` for the
+UDP gossip plane of every transport; ``_sendto`` additionally for
+dstream so stream SEGMENTS drop too — exercising the ARQ under cluster
+load, not just unit frames.
 """
 
 import asyncio
@@ -18,9 +21,11 @@ import random
 
 import pytest
 
+from serf_tpu.faults.host import attach_transport_chaos
 from serf_tpu.host import Serf, SerfState
 from serf_tpu.host.dstream import DatagramStreamTransport
 from serf_tpu.host.net import NetTransport, TlsNetTransport, make_tls_contexts
+from serf_tpu.host.transport import ChaosRule, EdgeRates
 from serf_tpu.options import Options
 from serf_tpu.types.member import MemberStatus
 
@@ -49,48 +54,19 @@ async def _bind(stream, tmp_path, keyring=None, addr=("127.0.0.1", 0),
 
 
 def _inject_loss(t, rng, rate, blocked_ports=None):
-    """Sender-side fault injection: drop UDP packets (every transport) and
-    dstream segments; optionally blackhole a set of destination ports (the
-    partition).  Idempotent per transport (wraps once)."""
-    if getattr(t, "_storm_wrapped", False):
-        t._storm_rate = rate
-        t._storm_blocked = blocked_ports or set()
-        return
-    t._storm_wrapped = True
-    t._storm_rate = rate
-    t._storm_blocked = blocked_ports or set()
-
-    orig_send_packet = t.send_packet
-
-    async def send_packet(addr, buf):
-        if addr[1] in t._storm_blocked:
-            return
-        if rng.random() < t._storm_rate:
-            return
-        await orig_send_packet(addr, buf)
-
-    t.send_packet = send_packet
-
-    if isinstance(t, DatagramStreamTransport):
-        orig_sendto = t._sendto
-
-        def _sendto(wire, addr):
-            if addr[1] in t._storm_blocked:
-                return
-            if rng.random() < t._storm_rate:
-                return
-            orig_sendto(wire, addr)
-
-        t._sendto = _sendto
+    """Sender-side fault injection, now delegating to the unified chaos
+    surface (old knob kept so the storm mix reads unchanged): drop UDP
+    packets (every transport) and dstream segments; optionally blackhole
+    a set of destination ports (the partition — blocks packets AND
+    dials).  Idempotent per transport (wraps once; later calls swap the
+    installed ``ChaosRule``)."""
+    attach_transport_chaos(t, src="self", addr_key=lambda a: a[1], rng=rng)
+    blocked = blocked_ports or set()
+    edges = {("self", port): EdgeRates(drop=1.0) for port in blocked}
+    if rate or edges:
+        t._chaos_rule = ChaosRule(drop=rate, edges=edges)
     else:
-        orig_dial = t.dial
-
-        async def dial(addr, timeout=None):
-            if addr[1] in t._storm_blocked:
-                raise ConnectionError(f"partitioned from {addr!r}")
-            return await orig_dial(addr, timeout=timeout)
-
-        t.dial = dial
+        t._chaos_rule = None
 
 
 async def _converged(nodes, live, deadline_s, label):

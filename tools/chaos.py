@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Run a named FaultPlan on either plane and print the invariant report.
+
+    python tools/chaos.py --plan partition-heal-loss --plane both
+    python tools/chaos.py --plan crash-restart --plane host --json
+    python tools/chaos.py --self-check          # tier-1 hook
+
+The host plane stands up an in-process loopback cluster (snapshots in a
+temp dir, so crash/restart plans exercise replay); the device plane runs
+the flagship ``cluster_round`` with the plan lowered to per-round masks.
+Exit 0 iff every invariant on every requested plane is green.  The
+degradation counter block is the ``serf.faults.*`` / ``serf.degraded.*``
+totals accumulated during the run — the measured half of "graceful".
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_host(plan):
+    from serf_tpu.faults.host import run_host_plan
+
+    with tempfile.TemporaryDirectory(prefix="serf-chaos-") as td:
+        return asyncio.run(run_host_plan(plan, tmp_dir=td))
+
+
+def run_device(plan, n: int, k_facts: int):
+    from serf_tpu.faults.device import run_device_plan
+    from serf_tpu.models.dissemination import GossipConfig
+    from serf_tpu.models.failure import FailureConfig
+    from serf_tpu.models.swim import ClusterConfig
+
+    cfg = ClusterConfig(
+        gossip=GossipConfig(n=n, k_facts=k_facts,
+                            peer_sampling="rotation"),
+        failure=FailureConfig(suspicion_rounds=8, max_new_facts=8,
+                              probe_schedule="round_robin"),
+        push_pull_every=8)
+    return run_device_plan(plan, cfg)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--plan", default="partition-heal-loss")
+    ap.add_argument("--plane", choices=("host", "device", "both"),
+                    default="both")
+    ap.add_argument("--n", type=int, default=256,
+                    help="device-plane simulated node count")
+    ap.add_argument("--k-facts", type=int, default=32)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--self-check", action="store_true",
+                    help="run the tiny self-check plan on both planes")
+    args = ap.parse_args()
+
+    from serf_tpu.faults.host import degradation_counters
+    from serf_tpu.faults.plan import named_plan, plan_names
+
+    if args.self_check:
+        plan_name, planes = "self-check", ("host", "device")
+        # the self-check is a tier-1 hook: keep the device side small
+        # (compile time dominates; one phase-scan compile at modest n)
+        args.n = min(args.n, 96)
+    else:
+        plan_name = args.plan
+        planes = ("host", "device") if args.plane == "both" \
+            else (args.plane,)
+    try:
+        plan = named_plan(plan_name)
+    except KeyError:
+        print(f"unknown plan {plan_name!r}; available: "
+              f"{', '.join(plan_names())}", file=sys.stderr)
+        return 2
+
+    reports = []
+    notes = []
+    for plane in planes:
+        if plane == "host":
+            result = run_host(plan)
+        else:
+            result = run_device(plan, args.n, args.k_facts)
+            notes.extend(result.notes)
+        reports.append(result.report)
+
+    counters = degradation_counters()
+    if args.json:
+        print(json.dumps({
+            "plan": plan.name,
+            "ok": all(r.ok for r in reports),
+            "reports": [r.to_dict() for r in reports],
+            "degradation_counters": counters,
+            "lowering_notes": notes,
+        }, indent=1, sort_keys=True))
+    else:
+        for r in reports:
+            print(r.format())
+        if notes:
+            print("lowering notes: " + "; ".join(notes))
+        print("degradation counters:")
+        for name in sorted(counters):
+            print(f"  {name} = {counters[name]:.0f}")
+    return 0 if all(r.ok for r in reports) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
