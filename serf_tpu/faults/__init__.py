@@ -21,3 +21,4 @@ from serf_tpu.faults.invariants import (  # noqa: F401
     InvariantReport,
     InvariantResult,
 )
+from serf_tpu.faults.host import HostLoadReport  # noqa: F401

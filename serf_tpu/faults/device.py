@@ -47,6 +47,9 @@ class DeviceFaultSchedule(NamedTuple):
     drop: jnp.ndarray             # f32[P]    per-delivery loss rate
     down: jnp.ndarray             # bool[P, N] nodes off the network
     notes: Tuple[str, ...] = ()   # lowering caveats (e.g. edges skipped)
+    events: Tuple[int, ...] = ()  # extra fact injections per phase
+                                  # (load lowering: offered event+query
+                                  # ops over the phase's wall duration)
 
 
 def lower_plan(plan: FaultPlan, n: Optional[int] = None
@@ -69,8 +72,23 @@ def lower_plan(plan: FaultPlan, n: Optional[int] = None
     group = np.zeros((p, sim_n), np.int32)
     drop = np.zeros((p,), np.float32)
     down = np.zeros((p, sim_n), bool)
+    events: List[int] = []
     cur_down = np.zeros((sim_n,), bool)
     for pi, phase in enumerate(plan.phases):
+        # load lowering (ISSUE 5): the offered user-plane ops over the
+        # phase's HOST wall duration become extra fact injections —
+        # query fan-out rides the same dissemination plane on device.
+        # A burst past ring capacity is exactly what the model's
+        # overflow accountant (GossipState.overflow) must catch.
+        offered = phase.event_rate + phase.query_rate
+        events.append(int(np.ceil(offered * phase.duration_s))
+                      if offered > 0 else 0)
+        if phase.query_rate > 0:
+            notes.append(f"phase {pi}: query load lowered to "
+                         "dissemination facts (device has no query RPC)")
+        if phase.stall:
+            notes.append(f"phase {pi}: {len(phase.stall)} consumer "
+                         "stall(s) not lowered (host-plane only)")
         if phase.partitions:
             # nodes not listed in any group share one implicit extra
             # group (same rule as faults.host.compile_phase)
@@ -98,6 +116,7 @@ def lower_plan(plan: FaultPlan, n: Optional[int] = None
         drop=jnp.asarray(drop),
         down=jnp.asarray(down),
         notes=tuple(notes),
+        events=tuple(events),
     )
 
 
@@ -128,6 +147,12 @@ class DeviceChaosResult:
     rounds_run: int = 0
     notes: Tuple[str, ...] = ()
     injected: List[int] = field(default_factory=list)
+    #: the overload ledger (GossipState.injected/.overflow): total facts
+    #: offered to the ring by ANY path (executor load + SWIM detection
+    #: traffic) and how many were clobbered while still in-window —
+    #: serf.overload.device_offered / serf.overload.device_dropped
+    offered: int = 0
+    dropped: int = 0
 
 
 def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
@@ -159,31 +184,51 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
     injected: List[int] = []
     next_eid = 1
 
-    def inject(st: ClusterState, origins_key) -> ClusterState:
+    def inject(st: ClusterState, origins_key, m: int) -> ClusterState:
+        """Inject ``m`` facts, CHUNKED at ring capacity: a load phase may
+        offer far more facts than the ring holds (that is the storm) —
+        each chunk recycles the previous one's slots and the model's
+        overflow accountant counts every in-window clobber."""
         nonlocal next_eid
-        m = events_per_phase
         if m <= 0:
             return st
-        eids = jnp.arange(next_eid, next_eid + m, dtype=jnp.int32)
-        injected.extend(range(next_eid, next_eid + m))
-        next_eid += m
-        origins = jax.random.randint(origins_key, (m,), 0, cfg.n,
-                                     dtype=jnp.int32)
-        g = inject_facts_batch(
-            st.gossip, cfg.gossip, eids, K_USER_EVENT,
-            incarnations=jnp.zeros((m,), jnp.uint32),
-            ltimes=eids.astype(jnp.uint32),
-            origins=origins, active=jnp.ones((m,), bool))
-        return st._replace(gossip=g)
+        k = cfg.gossip.k_facts
+        while m > 0:
+            chunk = min(m, k)
+            m -= chunk
+            origins_key, k_chunk = jax.random.split(origins_key)
+            eids = jnp.arange(next_eid, next_eid + chunk, dtype=jnp.int32)
+            injected.extend(range(next_eid, next_eid + chunk))
+            next_eid += chunk
+            origins = jax.random.randint(k_chunk, (chunk,), 0, cfg.n,
+                                         dtype=jnp.int32)
+            g = inject_facts_batch(
+                st.gossip, cfg.gossip, eids, K_USER_EVENT,
+                incarnations=jnp.zeros((chunk,), jnp.uint32),
+                ltimes=eids.astype(jnp.uint32),
+                origins=origins, active=jnp.ones((chunk,), bool))
+            st = st._replace(gossip=g)
+        return st
 
     total = 0
+    # a phase burst past ring capacity MUST clobber in-window facts —
+    # the checker then requires a nonzero overflow ledger (a tautology
+    # guard: a regression zeroing the accountant must fail the run)
+    expect_overflow = any(
+        events_per_phase + (sched.events[pi] if pi < len(sched.events)
+                            else 0) > cfg.gossip.k_facts
+        for pi in range(len(sched.rounds)))
     no_group = jnp.zeros((cfg.n,), jnp.int32)
     no_down = jnp.zeros((cfg.n,), bool)
     for pi, num_rounds in enumerate(sched.rounds):
+        key, k_inj, k_run = jax.random.split(key, 3)
+        extra = sched.events[pi] if pi < len(sched.events) else 0
+        # inject BEFORE the rounds check: a phase authored with only a
+        # host wall duration (rounds=0) still lowered load — its facts
+        # must land in the ring (and the overflow ledger), not vanish
+        state = inject(state, k_inj, events_per_phase + extra)
         if num_rounds <= 0:
             continue
-        key, k_inj, k_run = jax.random.split(key, 3)
-        state = inject(state, k_inj)
         state = run(state, key=k_run, num_rounds=num_rounds,
                     group=sched.group[pi], drop=sched.drop[pi],
                     init_alive=init_alive, down=sched.down[pi])
@@ -199,7 +244,7 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
         else:
             chunk = plan.settle_rounds
         key, k_inj, _ = jax.random.split(key, 3)
-        state = inject(state, k_inj)
+        state = inject(state, k_inj, events_per_phase)
         left = plan.settle_rounds
         while left > 0:
             step = min(chunk, left)
@@ -211,7 +256,12 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
         total += plan.settle_rounds
 
     report = inv.check_device(plan, state, cfg, init_alive,
-                              rounds_run=total)
+                              rounds_run=total, offered=len(injected),
+                              expect_overflow=expect_overflow)
+    ledger = jax.device_get({"dropped": state.gossip.overflow,
+                             "offered": state.gossip.injected})
     return DeviceChaosResult(plan=plan, schedule=sched, state=state,
                              report=report, rounds_run=total,
-                             notes=sched.notes, injected=injected)
+                             notes=sched.notes, injected=injected,
+                             offered=int(ledger["offered"]),
+                             dropped=int(ledger["dropped"]))

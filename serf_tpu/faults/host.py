@@ -231,23 +231,87 @@ class ClockSample:
 
 
 @dataclass
+class HostLoadReport:
+    """Overload accounting for one host chaos run (ISSUE 5).
+
+    Offered counts are the runner's ground truth (every ``user_event``/
+    ``query`` call it made); admitted/shed are the ENGINE's own
+    ``serf.overload.ingress_*`` counter deltas — the accounting
+    invariant (admitted + shed == offered) therefore cross-checks the
+    engine's bookkeeping against an independent tally, not against
+    itself.  Buffer maxima are sampled throughout the run, bounds are
+    the configured limits they must stay under."""
+
+    events_offered: int = 0
+    queries_offered: int = 0
+    ingress_admitted: int = 0
+    ingress_shed: int = 0
+    #: per-queue sampled byte maxima vs per-queue configured budgets —
+    #: each queue is judged against ITS OWN bound (collapsing to one max
+    #: would let a small-budget queue regress unseen under a large one)
+    max_queue_bytes_by: Dict[str, int] = field(default_factory=dict)
+    queue_bounds_by: Dict[str, int] = field(default_factory=dict)
+    max_query_responses: int = 0
+    query_responses_bound: int = 0
+    max_event_inbox: int = 0
+    event_inbox_bound: int = 0
+    lossless_violations: int = 0
+    quiet_convergence_s: float = 0.0
+    settle_convergence_s: float = 0.0
+
+    def to_dict(self) -> Dict[str, float]:
+        return dict(self.__dict__)
+
+
+@dataclass
 class HostChaosResult:
     plan: FaultPlan
     report: object                      # invariants.InvariantReport
     clock_samples: Dict[str, List[ClockSample]] = field(default_factory=dict)
     counters: Dict[str, float] = field(default_factory=dict)
     events_sent: int = 0
+    load: Optional[HostLoadReport] = None
 
 
 def degradation_counters() -> Dict[str, float]:
-    """Sum every ``serf.faults.*`` / ``serf.degraded.*`` counter in the
-    global sink across label sets — the CLI's degradation report."""
+    """Sum every ``serf.faults.*`` / ``serf.degraded.*`` /
+    ``serf.overload.*`` counter in the global sink across label sets —
+    the CLI's degradation + shedding report."""
     sink = metrics.global_sink()
     out: Dict[str, float] = {}
     for (name, _labels), v in sink.counters.items():
-        if name.startswith(("serf.faults.", "serf.degraded.")):
+        if name.startswith(("serf.faults.", "serf.degraded.",
+                            "serf.overload.")):
             out[name] = out.get(name, 0.0) + v
     return out
+
+
+def _counter_total(name: str) -> float:
+    """Sum one counter across every label set in the global sink."""
+    sink = metrics.global_sink()
+    return sum(v for (n, _l), v in sink.counters.items() if n == name)
+
+
+def _load_opts(plan: FaultPlan):
+    """Default Options for a load-bearing plan: admission buckets sized
+    well under the peak offered rate (so a storm MUST shed), and tight
+    buffer bounds (so the bounded-buffers invariant exercises real
+    pressure, not headroom).  Buckets are PER NODE while the plan's
+    rates are cluster-aggregate spread over random origins — divide by
+    n, or no single node ever sees enough load to shed."""
+    from serf_tpu.options import Options
+
+    per_node = plan.offered_rate() / max(1, plan.n)
+    return Options.local(
+        user_event_rate=max(4.0, 0.08 * per_node),
+        user_event_burst=8,
+        query_rate=max(3.0, 0.05 * per_node),
+        query_burst=4,
+        max_query_responses=64,
+        event_queue_bytes=256 * 1024,
+        query_queue_bytes=128 * 1024,
+        event_inbox_max=2048,
+    )
 
 
 async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
@@ -255,16 +319,25 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
                         traffic_period: float = 0.08) -> HostChaosResult:
     """Run ``plan`` against a fresh in-process loopback cluster and check
     the invariants.  ``tmp_dir`` enables per-node snapshots (crash →
-    restart replays them); without it restarts come back cold."""
+    restart replays them); without it restarts come back cold.
+
+    Plans with LOAD phases (event/query rates, stalls) additionally get:
+    per-node subscribers with stallable consumers, a load generator
+    firing the offered rates from random live nodes, buffer-bound
+    sampling every tick, and a :class:`HostLoadReport` the overload
+    invariants are judged against."""
     import os
 
     from serf_tpu.faults import invariants as inv
+    from serf_tpu.host.admission import OverloadError
+    from serf_tpu.host.events import EventSubscriber
     from serf_tpu.host.serf import Serf, SerfState
     from serf_tpu.options import Options
 
     plan.validate()
     n = plan.n
-    base_opts = opts or Options.local()
+    with_load = plan.has_load()
+    base_opts = opts or (_load_opts(plan) if with_load else Options.local())
     net = LoopbackNetwork()
     ex = HostFaultExecutor(plan, net)
 
@@ -276,14 +349,52 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
 
     generation = {i: 0 for i in range(n)}
     nodes: Dict[int, Serf] = {}
+    consumers: Dict[int, asyncio.Task] = {}
+    gates: Dict[int, asyncio.Event] = {}
+
+    async def consume(sub: EventSubscriber, gate: asyncio.Event) -> None:
+        # a stalled gate models the wedged consumer: the subscriber queue
+        # fills, drop-oldest fires (counted), and the engine's bounded
+        # tee/inbox absorb the rest — memory must stay bounded throughout
+        while True:
+            await gate.wait()
+            try:
+                await sub.next(timeout=0.05)
+            except asyncio.TimeoutError:
+                continue
+
+    async def make_node(i: int) -> Serf:
+        sub = None
+        if with_load:
+            sub = EventSubscriber(maxsize=512)
+            gate = gates.setdefault(i, asyncio.Event())
+            gate.set()
+            old = consumers.pop(i, None)
+            if old is not None:
+                old.cancel()
+            consumers[i] = asyncio.create_task(consume(sub, gate))
+        return await Serf.create(net.bind(f"n{i}"), node_opts(i), f"n{i}",
+                                 subscriber=sub)
+
+    base_admitted = _counter_total("serf.overload.ingress_admitted")
+    base_shed = _counter_total("serf.overload.ingress_shed")
+    base_lossless = _counter_total("serf.subscriber.lossless_violation")
+
     for i in range(n):
-        nodes[i] = await Serf.create(net.bind(f"n{i}"), node_opts(i),
-                                     f"n{i}")
+        nodes[i] = await make_node(i)
     samples: Dict[str, List[ClockSample]] = {f"n{i}": [] for i in range(n)}
     events_sent = 0
+    load = HostLoadReport(
+        queue_bounds_by={"intent": base_opts.intent_queue_bytes,
+                         "event": base_opts.event_queue_bytes,
+                         "query": base_opts.query_queue_bytes},
+        query_responses_bound=base_opts.max_query_responses,
+        event_inbox_bound=base_opts.event_inbox_max,
+    )
     down: frozenset = frozenset()
     rng = random.Random(plan.seed ^ 0x5EED)
     stop = asyncio.Event()
+    current_phase: List[Optional[FaultPhase]] = [None]
 
     def sample_clocks() -> None:
         for i, s in nodes.items():
@@ -294,29 +405,96 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
                 clock=int(s.clock.time()), event=int(s.event_clock.time()),
                 query=int(s.query_clock.time())))
 
+    def sample_buffers() -> None:
+        for i, s in nodes.items():
+            if i in down or s.state == SerfState.SHUTDOWN:
+                continue
+            for qname, q in (("intent", s.intent_broadcasts),
+                             ("event", s.event_broadcasts),
+                             ("query", s.query_broadcasts)):
+                load.max_queue_bytes_by[qname] = max(
+                    load.max_queue_bytes_by.get(qname, 0), q.bytes())
+            load.max_query_responses = max(load.max_query_responses,
+                                           len(s._query_responses))
+            load.max_event_inbox = max(load.max_event_inbox,
+                                       s._event_inbox.qsize())
+
+    def live_indices() -> List[int]:
+        return [i for i in nodes
+                if i not in down and nodes[i].state == SerfState.ALIVE]
+
     async def background() -> None:
         nonlocal events_sent
         while not stop.is_set():
             await asyncio.sleep(traffic_period)
             sample_clocks()
-            live = [i for i in nodes
-                    if i not in down
-                    and nodes[i].state == SerfState.ALIVE]
+            sample_buffers()
+            live = live_indices()
             if live:
                 src = rng.choice(live)
+                load.events_offered += 1
                 try:
                     await nodes[src].user_event(
                         f"chaos-{events_sent}", b"x", coalesce=False)
                     events_sent += 1
+                except OverloadError:
+                    pass
                 except Exception:  # noqa: BLE001 - traffic is best-effort
                     pass
 
+    async def load_gen() -> None:
+        """Fire the current phase's offered event/query rates from
+        random live nodes.  Every call is counted as offered; the
+        engine's own ingress counters provide admitted/shed."""
+        from serf_tpu.host.query import QueryParam
+
+        credit_e = credit_q = 0.0
+        tick = 0.02
+        seq = 0
+        while not stop.is_set():
+            await asyncio.sleep(tick)
+            phase = current_phase[0]
+            if phase is None or not phase.has_load():
+                credit_e = credit_q = 0.0
+                continue
+            live = live_indices()
+            if not live:
+                continue
+            credit_e += phase.event_rate * tick
+            credit_q += phase.query_rate * tick
+            while credit_e >= 1.0:
+                credit_e -= 1.0
+                seq += 1
+                load.events_offered += 1
+                try:
+                    await nodes[rng.choice(live)].user_event(
+                        f"storm-{seq}", b"storm-payload", coalesce=False)
+                except OverloadError:
+                    pass
+                except Exception:  # noqa: BLE001
+                    pass
+            while credit_q >= 1.0:
+                credit_q -= 1.0
+                seq += 1
+                load.queries_offered += 1
+                try:
+                    await nodes[rng.choice(live)].query(
+                        f"storm-q-{seq}", b"q",
+                        QueryParam(timeout=0.25))
+                except OverloadError:
+                    pass
+                except Exception:  # noqa: BLE001
+                    pass
+
     bg = asyncio.create_task(background())
+    lg = asyncio.create_task(load_gen()) if with_load else None
     try:
+        t0 = time.monotonic()
         for i in range(1, n):
             await nodes[i].join("n0")
         await inv.wait_host_convergence(
             [nodes[i] for i in range(n)], deadline_s=plan.settle_s)
+        load.quiet_convergence_s = time.monotonic() - t0
 
         for pi, phase in enumerate(plan.phases):
             # crash BEFORE installing the phase rule so the rule never
@@ -329,8 +507,7 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
             for i in phase.restart:
                 if nodes[i].state == SerfState.SHUTDOWN:
                     generation[i] += 1
-                    nodes[i] = await Serf.create(
-                        net.bind(f"n{i}"), node_opts(i), f"n{i}")
+                    nodes[i] = await make_node(i)
                     seeds = [j for j in nodes if j not in down and j != i
                              and nodes[j].state == SerfState.ALIVE]
                     if seeds:
@@ -339,27 +516,59 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
                         except (ConnectionError, TimeoutError, OSError):
                             pass
             down = ex.down_nodes()
+            for i in phase.stall:
+                gates.setdefault(i, asyncio.Event()).clear()
+            current_phase[0] = phase
             await asyncio.sleep(phase.duration_s)
+            current_phase[0] = None
+            for i in phase.stall:
+                gates[i].set()      # consumer resumes; backlog drains
 
         ex.clear()
         down = frozenset()
         live = [nodes[i] for i in nodes
                 if nodes[i].state == SerfState.ALIVE]
+        t1 = time.monotonic()
         await inv.wait_host_convergence(live, deadline_s=plan.settle_s)
+        load.settle_convergence_s = time.monotonic() - t1
         sample_clocks()
+        sample_buffers()
+        # quiesce the traffic tasks BEFORE reading the ingress deltas:
+        # a call in flight between the offered tally and the engine's
+        # counter would otherwise skew the accounting invariant
+        stop.set()
+        for t in (bg, lg):
+            if t is not None:
+                t.cancel()
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+        load.ingress_admitted = int(
+            _counter_total("serf.overload.ingress_admitted") - base_admitted)
+        load.ingress_shed = int(
+            _counter_total("serf.overload.ingress_shed") - base_shed)
+        load.lossless_violations = int(
+            _counter_total("serf.subscriber.lossless_violation")
+            - base_lossless)
         report = inv.check_host(plan, nodes, samples, generation,
-                                snapshots=tmp_dir is not None)
+                                snapshots=tmp_dir is not None,
+                                load=load if with_load else None)
         return HostChaosResult(plan=plan, report=report,
                                clock_samples=samples,
                                counters=degradation_counters(),
-                               events_sent=events_sent)
+                               events_sent=events_sent,
+                               load=load if with_load else None)
     finally:
         stop.set()
-        bg.cancel()
-        try:
-            await bg
-        except (asyncio.CancelledError, Exception):  # noqa: BLE001
-            pass
+        for t in (bg, lg, *consumers.values()):
+            if t is None:
+                continue
+            t.cancel()
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
         # the cluster must die on EVERY path — a raise mid-plan must not
         # leave n gossiping nodes running for the rest of the process
         for s in nodes.values():

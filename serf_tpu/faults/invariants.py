@@ -84,12 +84,15 @@ async def wait_host_convergence(nodes, deadline_s: float,
 
 def check_host(plan: FaultPlan, nodes: Dict[int, object],
                samples: Dict[str, List], generation: Dict[int, int],
-               snapshots: bool = False) -> InvariantReport:
+               snapshots: bool = False, load=None) -> InvariantReport:
     """Judge the host-plane invariants on a finished chaos run.
 
     ``nodes``: index -> Serf (some possibly SHUTDOWN); ``samples``:
     node id -> ClockSample list (faults.host); ``generation``: restart
-    count per node index.
+    count per node index; ``load``: a ``faults.host.HostLoadReport``
+    when the plan offered user-plane load — enables the overload
+    invariants (bounded buffers, closed shed accounting, lossless
+    contract intact, storm-bounded convergence).
     """
     from serf_tpu.host.serf import SerfState
     from serf_tpu.types.member import MemberStatus
@@ -175,7 +178,76 @@ def check_host(plan: FaultPlan, nodes: Dict[int, object],
                   else f"{len(restarted)} restart(s), "
                        f"snapshots={'on' if snapshots else 'off'}")
     rep.add("crash-restart-rejoin", rejoin_ok, detail)
+
+    if load is not None:
+        _check_host_overload(rep, load)
     return rep
+
+
+def _check_host_overload(rep: InvariantReport, load) -> None:
+    """The overload invariants (ISSUE 5) for a load-bearing host run.
+
+    ``load`` is a ``faults.host.HostLoadReport``: offered counts are the
+    runner's independent tally, admitted/shed are the engine's own
+    ``serf.overload.ingress_*`` counter deltas, and buffer maxima were
+    sampled every traffic tick for the whole run."""
+    # 5. bounded buffers: EVERY queue's bytes (judged against its OWN
+    # budget, not the loosest one) and the query handler map never
+    # exceeded their configured bounds at ANY sample — overload degraded
+    # service (shedding), never memory.  The event inbox may exceed its
+    # bound by the member events it never sheds; allow that slack.
+    inbox_slack = 64
+    over = []
+    for qname, seen in sorted(load.max_queue_bytes_by.items()):
+        bound = load.queue_bounds_by.get(qname, 0)
+        if bound > 0 and seen > bound:
+            over.append(f"{qname} queue {seen}B > {bound}B")
+    if load.max_query_responses > load.query_responses_bound:
+        over.append(f"query handlers {load.max_query_responses} > "
+                    f"{load.query_responses_bound}")
+    if load.event_inbox_bound > 0 and load.max_event_inbox \
+            > load.event_inbox_bound + inbox_slack:
+        over.append(f"event inbox {load.max_event_inbox} > "
+                    f"{load.event_inbox_bound}+{inbox_slack}")
+    fills = ", ".join(
+        f"{q} {load.max_queue_bytes_by.get(q, 0)}B/"
+        f"{load.queue_bounds_by.get(q, 0)}B"
+        for q in sorted(load.queue_bounds_by))
+    rep.add("bounded-buffers", not over,
+            "; ".join(over) if over else
+            f"{fills}; handlers "
+            f"{load.max_query_responses}/{load.query_responses_bound}, "
+            f"inbox {load.max_event_inbox}/{load.event_inbox_bound}")
+
+    # 6. shed accounting closes: every offered ingress op is accounted
+    # as either admitted or shed by the ENGINE's own counters — no op
+    # vanished untracked
+    offered = load.events_offered + load.queries_offered
+    accounted = load.ingress_admitted + load.ingress_shed
+    rep.add("shed-accounting", accounted == offered,
+            f"admitted {load.ingress_admitted} + shed "
+            f"{load.ingress_shed} == offered {offered}"
+            if accounted == offered else
+            f"admitted {load.ingress_admitted} + shed "
+            f"{load.ingress_shed} != offered {offered}")
+
+    # 7. the lossless-subscriber contract survived the storm: shedding
+    # happens at admission/inbox boundaries, never by violating a
+    # lossless channel's no-drop promise
+    rep.add("lossless-intact", load.lossless_violations == 0,
+            f"{load.lossless_violations} lossless violation(s)"
+            if load.lossless_violations else "no lossless violations")
+
+    # 8. membership convergence under storm stays bounded: the post-plan
+    # re-convergence took no more than 2x the quiet-baseline join
+    # convergence (floored generously — sub-second baselines would make
+    # scheduler jitter the verdict)
+    allowance = max(2.0 * load.quiet_convergence_s, 3.0)
+    rep.add("storm-convergence",
+            load.settle_convergence_s <= allowance,
+            f"settle {load.settle_convergence_s:.2f}s vs allowance "
+            f"{allowance:.2f}s (quiet baseline "
+            f"{load.quiet_convergence_s:.2f}s)")
 
 
 # ---------------------------------------------------------------------------
@@ -184,8 +256,13 @@ def check_host(plan: FaultPlan, nodes: Dict[int, object],
 
 
 def check_device(plan: FaultPlan, state, cfg, init_alive,
-                 rounds_run: int) -> InvariantReport:
-    """Judge the device-plane invariants on a finished chaos scan."""
+                 rounds_run: int, offered: int = 0,
+                 expect_overflow: bool = False) -> InvariantReport:
+    """Judge the device-plane invariants on a finished chaos scan.
+    ``offered`` is the executor's own injection count;
+    ``expect_overflow`` asserts the run included a burst past ring
+    capacity, so the overflow ledger MUST be nonzero (otherwise the
+    bound check alone would be unfalsifiable)."""
     import jax
     import jax.numpy as jnp
 
@@ -203,6 +280,8 @@ def check_device(plan: FaultPlan, state, cfg, init_alive,
         "round": g.round,
         "alive": jnp.sum(g.alive),
         "expected_alive": jnp.sum(init_alive),
+        "overflow": g.overflow,
+        "injected": g.injected,
     })
 
     # 1. post-heal convergence within the settle bound: every alive node
@@ -230,4 +309,20 @@ def check_device(plan: FaultPlan, state, cfg, init_alive,
     rep.add("round-advance", ok_rounds and ok_alive,
             f"round={int(vals['round'])}/{rounds_run}, "
             f"alive={int(vals['alive'])}/{int(vals['expected_alive'])}")
+
+    # 5. overflow accounted (ISSUE 5): the injection-overflow counter —
+    # facts clobbered while still inside their transmit window — is the
+    # device plane's shed ledger.  It can never exceed the model's own
+    # total-injection counter (every clobber retires a previously
+    # injected fact; SWIM suspicions/declarations/refutations inject
+    # too, not just the executor), and a storm past ring capacity must
+    # show up in it rather than vanish silently.
+    dropped = int(vals["overflow"])
+    total = int(vals["injected"])
+    ok = 0 <= dropped <= total and (dropped > 0 or not expect_overflow)
+    rep.add("overflow-accounted", ok,
+            f"{dropped} clobbered in-window of {total} injected "
+            f"({offered} by the executor"
+            + (", burst past capacity: nonzero required" if expect_overflow
+               else "") + ")")
     return rep

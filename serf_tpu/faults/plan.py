@@ -52,6 +52,19 @@ class FaultPhase:
     network silence, process alive); ``restart`` brings previously
     crashed/paused nodes back.  Down-ness persists across phases until
     restarted.
+
+    LOAD phases (ISSUE 5 — overload scenarios through the same plan):
+    ``event_rate``/``query_rate`` are OFFERED user-plane load in ops/sec
+    (aggregate across the cluster).  The host executor fires real
+    ``user_event``/``query`` calls at that rate from random live nodes,
+    counting offered/admitted/shed so the accounting invariant
+    (admitted + shed == offered) can be judged.  The device executor
+    lowers ``ceil((event_rate + query_rate) * duration_s)`` extra fact
+    injections into the phase (query fan-out rides the same
+    dissemination plane on device — an explicit lowering, noted on the
+    schedule).  ``stall`` names nodes whose event CONSUMER stops reading
+    for the phase (slow-subscriber overload; host-plane only — the
+    device model has no subscriber seam, noted on the schedule).
     """
 
     name: str = ""
@@ -68,10 +81,19 @@ class FaultPhase:
     crash: Tuple[int, ...] = ()
     pause: Tuple[int, ...] = ()
     restart: Tuple[int, ...] = ()
+    event_rate: float = 0.0          # offered user events/sec (cluster)
+    query_rate: float = 0.0          # offered queries/sec (cluster)
+    stall: Tuple[int, ...] = ()      # event consumers stalled this phase
+
+    def has_load(self) -> bool:
+        return (self.event_rate > 0 or self.query_rate > 0
+                or bool(self.stall))
 
     def validate(self, n: int) -> None:
         if self.duration_s < 0 or self.rounds < 0:
             raise ValueError(f"phase {self.name!r}: negative length")
+        if self.event_rate < 0 or self.query_rate < 0:
+            raise ValueError(f"phase {self.name!r}: negative load rate")
         for rate in (self.drop, self.duplicate, self.reorder, self.corrupt):
             if not 0.0 <= rate <= 1.0:
                 raise ValueError(
@@ -86,7 +108,7 @@ class FaultPhase:
                     raise ValueError(
                         f"phase {self.name!r}: node {node} in two groups")
                 seen.add(node)
-        for nodes in (self.crash, self.pause, self.restart):
+        for nodes in (self.crash, self.pause, self.restart, self.stall):
             for node in nodes:
                 if not 0 <= node < n:
                     raise ValueError(
@@ -132,6 +154,17 @@ class FaultPlan:
 
     def total_rounds(self) -> int:
         return sum(ph.rounds for ph in self.phases)
+
+    def has_load(self) -> bool:
+        """Any phase offers user-plane load (the executors then track
+        overload accounting and the checker judges the overload
+        invariants)."""
+        return any(ph.has_load() for ph in self.phases)
+
+    def offered_rate(self) -> float:
+        """Peak offered ops/sec across phases (admission sizing aid)."""
+        return max((ph.event_rate + ph.query_rate for ph in self.phases),
+                   default=0.0)
 
     def ever_down(self) -> frozenset:
         """Nodes the plan crashes or pauses at any point — exempt from
@@ -212,6 +245,48 @@ def _flaky_edges(n: int = 5) -> FaultPlan:
     )
 
 
+def _query_storm(n: int = 5) -> FaultPlan:
+    """THE overload acceptance scenario (ISSUE 5): a 10x event + query
+    stampede against admission-controlled nodes.  The storm phase offers
+    far more user-plane load than the admission buckets allow, so shed
+    counters MUST be nonzero and must fully account for the offered load
+    (ingress admitted + shed == offered); every buffer stays under its
+    byte/depth bound for the whole run, and post-storm membership
+    convergence stays within 2x of the quiet baseline."""
+    return FaultPlan(
+        name="query-storm",
+        n=n,
+        seed=17,
+        phases=(
+            FaultPhase(name="warm", duration_s=0.6, rounds=12),
+            FaultPhase(name="storm", duration_s=1.2, rounds=12,
+                       event_rate=500.0, query_rate=300.0),
+            FaultPhase(name="recover", duration_s=0.6, rounds=12),
+        ),
+        settle_s=10.0,
+        settle_rounds=48,
+    )
+
+
+def _slow_consumer(n: int = 4) -> FaultPlan:
+    """Slow-subscriber overload: one node's event consumer stalls while
+    events keep flowing — memory must stay bounded (tee backpressure +
+    inbox shedding) and the stalled node must catch up after the phase."""
+    return FaultPlan(
+        name="slow-consumer",
+        n=n,
+        seed=19,
+        phases=(
+            FaultPhase(name="warm", duration_s=0.5, rounds=12),
+            FaultPhase(name="stall", duration_s=1.0, rounds=12,
+                       event_rate=200.0, stall=(1,)),
+            FaultPhase(name="drain", duration_s=0.6, rounds=12),
+        ),
+        settle_s=8.0,
+        settle_rounds=48,
+    )
+
+
 def _self_check(n: int = 4) -> FaultPlan:
     """Tiny fast plan for ``tools/chaos.py --self-check`` (tier-1)."""
     return FaultPlan(
@@ -232,6 +307,8 @@ _PLANS: Dict[str, object] = {
     "partition-heal-loss": _partition_heal_loss,
     "crash-restart": _crash_restart,
     "flaky-edges": _flaky_edges,
+    "query-storm": _query_storm,
+    "slow-consumer": _slow_consumer,
     "self-check": _self_check,
 }
 

@@ -12,6 +12,7 @@ Quick start::
     await a.user_event("deploy", b"v2")
 """
 
+from serf_tpu.host.admission import OverloadError, TokenBucket
 from serf_tpu.host.serf import Serf, SerfState, Stats
 from serf_tpu.obs.cluster import ClusterSnapshot  # Serf.cluster_stats() result
 from serf_tpu.obs.health import HealthReport      # Serf.health_report() result

@@ -8,6 +8,17 @@ highest-remaining-retransmits-first under a per-packet byte budget.
 Serf's three queues (intent/event/query) use *no invalidation* — Lamport-time
 dedup supersedes it (reference broadcast.rs:15-45); the SWIM layer's own
 queue invalidates older broadcasts about the same node.
+
+Overload protection (ISSUE 5): a queue can carry a BYTE budget on top of
+the reference's count-only QueueChecker prune.  A queue over its budget
+sheds the most-transmitted (oldest among equals) broadcasts first — they
+have had the most dissemination — emitting ``serf.overload.queue_shed``
+counters and flight events so every shed is accounted.  Queues carrying
+membership state (the SWIM alive/suspect/dead queue) are constructed
+``sheddable=False`` and never byte-shed: the shedding priority order is
+membership facts > leave/join intents > user events > query fan-out,
+realized through each queue's budget (intent gets the largest, query the
+smallest).
 """
 
 from __future__ import annotations
@@ -57,7 +68,8 @@ class TransmitLimitedQueue:
 
     def __init__(self, retransmit_mult: int, node_count_fn: Callable[[], int],
                  name: Optional[str] = None,
-                 labels: Optional[Dict[str, str]] = None):
+                 labels: Optional[Dict[str, str]] = None,
+                 max_bytes: int = 0, sheddable: bool = True):
         self.retransmit_mult = retransmit_mult
         self.node_count_fn = node_count_fn
         #: observability identity: named queues emit ``serf.queue.<name>``
@@ -65,13 +77,29 @@ class TransmitLimitedQueue:
         #: events on overflow/retirement; unnamed queues stay silent
         self.name = name
         self.labels = labels
+        #: byte budget: over this, queue_broadcast sheds most-transmitted
+        #: items until back under.  0 = unbounded.  Ignored (with a
+        #: construction-time error) when the queue is not sheddable.
+        self.max_bytes = max_bytes
+        #: queues carrying membership state are constructed
+        #: sheddable=False: they may be depth-pruned by the legacy
+        #: QueueChecker but NEVER byte-shed — losing a death/alive fact
+        #: is a correctness hazard, losing a user event is load shedding
+        self.sheddable = sheddable
+        if max_bytes > 0 and not sheddable:
+            raise ValueError("a non-sheddable queue cannot take a byte "
+                             "budget (it would have no way to honor it)")
         self._items: List[Broadcast] = []
+        self._bytes = 0
         self._seq = 0
         #: bumped whenever queue MEMBERSHIP changes (queue/invalidate/
         #: retire/prune) — cheap change detection for derived indexes
         #: (transmit-count bumps alone don't count: they change no
         #: membership-derived answer)
         self.mutations = 0
+        #: broadcasts/bytes shed by the byte budget over this queue's life
+        self.shed = 0
+        self.shed_bytes = 0
 
     def __len__(self) -> int:
         return len(self._items)
@@ -79,22 +107,61 @@ class TransmitLimitedQueue:
     def num_queued(self) -> int:
         return len(self._items)
 
+    def bytes(self) -> int:
+        """Total payload bytes currently queued."""
+        return self._bytes
+
     def _gauge_depth(self) -> None:
         if self.name is not None:
             metrics.gauge(f"serf.queue.{self.name}", len(self._items),
                           self.labels)
+            metrics.gauge(f"serf.queue.bytes.{self.name}", self._bytes,
+                          self.labels)
+
+    def _remove(self, b: Broadcast) -> None:
+        self._items.remove(b)
+        self._bytes -= len(b.msg)
 
     def queue_broadcast(self, b: Broadcast) -> None:
         if b.name is not None:
             # invalidate older broadcasts about the same subject
             for old in [x for x in self._items if x.name == b.name]:
-                self._items.remove(old)
+                self._remove(old)
                 old.finished()
         self._seq += 1
         b._seq = self._seq
         self._items.append(b)
+        self._bytes += len(b.msg)
         self.mutations += 1
+        if self.max_bytes > 0 and self._bytes > self.max_bytes:
+            self._shed_over_bytes()
         self._gauge_depth()
+
+    def _shed_over_bytes(self) -> None:
+        """Byte-budget enforcement: drop most-transmitted (oldest among
+        equals) broadcasts until back under ``max_bytes``.  The freshly
+        queued item is the LAST candidate — but a single over-budget
+        item still sheds (the bound is hard, not advisory)."""
+        self._items.sort(key=lambda x: (x.transmits, -x._seq))
+        dropped = 0
+        dropped_bytes = 0
+        while self._bytes > self.max_bytes and self._items:
+            victim = self._items.pop()        # most transmits, then oldest
+            self._bytes -= len(victim.msg)
+            dropped += 1
+            dropped_bytes += len(victim.msg)
+            victim.finished()
+        if not dropped:
+            return
+        self.shed += dropped
+        self.shed_bytes += dropped_bytes
+        self.mutations += 1
+        qname = self.name or "unnamed"
+        labels = {**(self.labels or {}), "queue": qname}
+        metrics.incr("serf.overload.queue_shed", dropped, labels)
+        metrics.incr("serf.overload.queue_shed_bytes", dropped_bytes, labels)
+        flight.record("queue-shed", queue=qname, dropped=dropped,
+                      bytes=dropped_bytes, budget=self.max_bytes)
 
     def get_broadcasts(self, overhead: int, limit: int) -> List[bytes]:
         """Drain up to ``limit`` bytes of broadcasts, ``overhead`` bytes
@@ -120,7 +187,7 @@ class TransmitLimitedQueue:
         if retired:
             self.mutations += 1
         for b in retired:
-            self._items.remove(b)
+            self._remove(b)
             b.finished()
             if self.name is not None:
                 flight.record("broadcast-retired", queue=self.name,
@@ -138,6 +205,7 @@ class TransmitLimitedQueue:
         self._items.sort(key=lambda b: (b.transmits, -b._seq))
         dropped = len(self._items) - max_retained
         for b in self._items[max_retained:]:
+            self._bytes -= len(b.msg)
             b.finished()
         del self._items[max_retained:]
         self.mutations += 1

@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from serf_tpu import codec
 from serf_tpu.host import messages as sm
+from serf_tpu.host.admission import PeerPacer
 from serf_tpu.host.broadcast import Broadcast, TransmitLimitedQueue
 from serf_tpu.host.degrade import Backoff, CircuitBreaker
 from serf_tpu.host.delegate import SwimDelegate
@@ -180,9 +181,20 @@ class Memberlist:
         self._breaker = CircuitBreaker(
             opts.breaker_threshold, opts.breaker_cooldown,
             labels=opts.metric_labels, node=node_id)
+        # the SWIM queue carries MEMBERSHIP FACTS (alive/suspect/dead):
+        # the top of the shedding priority order — never byte-shed, even
+        # under an overload storm (losing a death story is a correctness
+        # hazard; every other queue gives way first)
         self.broadcasts = TransmitLimitedQueue(
-            opts.retransmit_mult, lambda: max(1, self.num_online_members())
+            opts.retransmit_mult, lambda: max(1, self.num_online_members()),
+            sheddable=False,
         )
+        # per-peer send pacing for the USER plane only (host/admission.py,
+        # enforced in send()): loss-based — a paced-out packet is dropped
+        # and counted rather than queued without bound.  The SWIM packet
+        # plane is never paced (membership is never shed).
+        self._pacer = (PeerPacer(opts.peer_send_rate, opts.peer_send_burst)
+                       if opts.peer_send_rate > 0 else None)
         self._leaving = False
         self._shutdown = False
         self._tasks: List[asyncio.Task] = []
@@ -337,7 +349,21 @@ class Memberlist:
         return ok, errs
 
     async def send(self, addr, buf: bytes) -> None:
-        """Unreliable user-plane send (serf query responses/acks/relays)."""
+        """Unreliable user-plane send (serf query responses/acks/relays).
+
+        Per-peer pacing applies HERE and only here: this is the user
+        fan-out seam.  The SWIM packet plane (_send_packet: probes,
+        acks, gossip) is membership traffic — top of the shedding
+        priority order, never paced — or a gossip burst to one peer
+        could starve the very probe ack that keeps it ALIVE."""
+        if self._pacer is not None and not self._pacer.admit(addr):
+            # over-rate user packets to one destination are shed at the
+            # seam (UDP semantics — query relays and gossip redundancy
+            # cover the loss)
+            metrics.incr("serf.overload.paced_dropped", 1,
+                         self.opts.metric_labels)
+            flight.record("paced-drop", node=self.local.id, dest=str(addr))
+            return
         await self._send_packet(addr, sm.encode_swim(sm.UserMsg(buf)))
 
     async def update_node(self, timeout: float) -> None:
@@ -365,6 +391,8 @@ class Memberlist:
     # ------------------------------------------------------------------
 
     async def _send_packet(self, addr, buf: bytes) -> None:
+        # NO pacing here: this is the SWIM membership plane (probes,
+        # acks, gossip) — never shed (see send() for the paced seam)
         buf = self._encode_wire(buf)
         metrics.observe("memberlist.packet.sent", len(buf), self.opts.metric_labels)
         await self.transport.send_packet(addr, buf)

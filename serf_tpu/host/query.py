@@ -56,6 +56,10 @@ class QueryResponse:
         self._responses: asyncio.Queue = asyncio.Queue()
         self._ack_seen: Set[str] = set()
         self._resp_seen: Set[str] = set()
+        #: responders that explicitly fast-failed OVERLOADED instead of
+        #: answering (admission control, ISSUE 5) — the originator can
+        #: tell shed load from silence
+        self._overloaded: Set[str] = set()
         self._closed = False
 
     def finished(self) -> bool:
@@ -78,6 +82,18 @@ class QueryResponse:
         self._ack_seen.add(from_id)
         metrics.incr("serf.query.acks", 1, labels)
         self._acks.put_nowait(from_id)
+
+    def handle_overloaded(self, from_id: str, labels=None) -> None:
+        """A responder shed this query under overload: record the explicit
+        fast-fail (no payload will come from it)."""
+        if self.finished() or from_id in self._overloaded:
+            return
+        self._overloaded.add(from_id)
+        metrics.incr("serf.overload.remote_overloaded", 1, labels)
+
+    @property
+    def overloaded_responders(self) -> Set[str]:
+        return set(self._overloaded)
 
     def handle_response(self, from_id: str, payload: bytes, labels=None) -> None:
         if self.finished():

@@ -23,6 +23,11 @@ from dataclasses import dataclass, field as dataclass_field, replace
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from serf_tpu import codec
+from serf_tpu.host.admission import (
+    AdmissionController,
+    OverloadError,
+    record_ingress,
+)
 from serf_tpu.host.broadcast import Broadcast, TransmitLimitedQueue
 from serf_tpu.host.coordinate import Coordinate, CoordinateClient, CoordinateOptions
 from serf_tpu.host.delegate import CompositeDelegate, SwimDelegate
@@ -407,14 +412,21 @@ class Serf:
             return max(1, len(self._members))
 
         rm = opts.memberlist.retransmit_mult
-        # named queues emit serf.queue.<name> depth gauges at every
-        # mutation (the QueueChecker still re-gauges periodically)
+        # named queues emit serf.queue.<name> depth + byte gauges at every
+        # mutation (the QueueChecker still re-gauges periodically).  Byte
+        # budgets realize the shedding priority order (ISSUE 5): the SWIM
+        # membership queue (memberlist.broadcasts) is never shed at all;
+        # intents carry the largest budget, user events less, query
+        # fan-out least — under a storm, queries give way first.
         self.intent_broadcasts = TransmitLimitedQueue(
-            rm, _num_nodes, name="intent", labels=self._labels)
+            rm, _num_nodes, name="intent", labels=self._labels,
+            max_bytes=opts.intent_queue_bytes)
         self.event_broadcasts = TransmitLimitedQueue(
-            rm, _num_nodes, name="event", labels=self._labels)
+            rm, _num_nodes, name="event", labels=self._labels,
+            max_bytes=opts.event_queue_bytes)
         self.query_broadcasts = TransmitLimitedQueue(
-            rm, _num_nodes, name="query", labels=self._labels)
+            rm, _num_nodes, name="query", labels=self._labels,
+            max_bytes=opts.query_queue_bytes)
 
         self.coord_client: Optional[CoordinateClient] = None
         self._coord_cache: Dict[str, Coordinate] = {}
@@ -430,6 +442,11 @@ class Serf:
         self._tee_queue: Optional[asyncio.Queue] = None
         self._loop_lag_ewma_ms = 0.0
         self._health = HealthScorer(serf_sources(self))
+        # admission control (host/admission.py): ingress token buckets +
+        # health-aware shedding; all knobs default off
+        self._admission = AdmissionController(self)
+        #: non-membership events shed at the inbox bound (accounting)
+        self._events_shed = 0
 
         self._tasks: List[asyncio.Task] = []
         self._bg: set = set()
@@ -505,6 +522,8 @@ class Serf:
         s._tasks.append(asyncio.create_task(s._reconnector(), name=f"serf-reconnect-{node_id}"))
         s._tasks.append(asyncio.create_task(
             s._health_monitor(), name=f"serf-health-{node_id}"))
+        s._tasks.append(asyncio.create_task(
+            s._query_sweeper(), name=f"serf-query-sweep-{node_id}"))
         for qname, q in (("intent", s.intent_broadcasts),
                          ("event", s.event_broadcasts),
                          ("query", s.query_broadcasts)):
@@ -577,8 +596,11 @@ class Serf:
 
     async def _coalesce_pipeline(self, member_c, user_c) -> None:
         """Chain: inbox -> member coalescer -> user coalescer -> subscriber
-        (reference wires coalescers as channel wrappers, base.rs:88-115)."""
-        mid: asyncio.Queue = asyncio.Queue()
+        (reference wires coalescers as channel wrappers, base.rs:88-115).
+        The relay queues are bounded like the passthrough tee: a wedged
+        consumer backpressures the pipeline task at TEE_QUEUE_MAX instead
+        of growing process memory without limit."""
+        mid: asyncio.Queue = asyncio.Queue(maxsize=TEE_QUEUE_MAX)
         out = self._subscriber
 
         async def tee() -> None:
@@ -593,7 +615,7 @@ class Serf:
         t = asyncio.create_task(tee())
         try:
             if member_c and user_c:
-                mid2: asyncio.Queue = asyncio.Queue()
+                mid2: asyncio.Queue = asyncio.Queue(maxsize=TEE_QUEUE_MAX)
                 relay = EventSubscriber()
 
                 async def pump() -> None:
@@ -625,6 +647,26 @@ class Serf:
             t.cancel()
 
     def _emit(self, ev) -> None:
+        """Enqueue an event for the delivery pipeline, shedding under
+        overload: once the inbox holds ``event_inbox_max`` entries,
+        non-membership events are dropped with a counter + flight
+        event.  In practice that is user events plus a node's OWN
+        query deliveries (remote queries fast-fail earlier, at
+        ``overloaded()``'s 0.9-of-cap pressure threshold, so they
+        rarely reach a full inbox).  MemberEvents are membership state
+        and are ALWAYS enqueued — the shedding priority order never
+        sacrifices them, and the snapshotter (fed from this pipeline)
+        must not miss an alive-set change."""
+        cap = self.opts.event_inbox_max
+        if (cap > 0 and ev is not None and not isinstance(ev, MemberEvent)
+                and self._event_inbox.qsize() >= cap):
+            kind = type(ev).__name__
+            self._events_shed += 1
+            metrics.incr("serf.overload.event_shed", 1,
+                         {**self._labels, "event": kind})
+            obs.record("event-shed", node=self.local_id, event=kind,
+                       inbox=self._event_inbox.qsize())
+            return
         self._event_inbox.put_nowait(ev)
 
     # ------------------------------------------------------------------
@@ -869,7 +911,11 @@ class Serf:
     # -- user events --------------------------------------------------------
 
     async def user_event(self, name: str, payload: bytes, coalesce: bool = True) -> None:
-        """(reference api.rs:241-299)"""
+        """(reference api.rs:241-299); raises :class:`OverloadError` when
+        admission control (token bucket / health floor) sheds the event —
+        an explicit fast failure the caller can back off on."""
+        # size validation FIRST: a rejected oversized event must not
+        # drain a rate-limit token nor count as admitted ingress
         size = len(name) + len(payload)
         if size > self.opts.max_user_event_size:
             raise ValueError(
@@ -877,6 +923,10 @@ class Serf:
                 f"{self.opts.max_user_event_size} bytes before encoding")
         if size > USER_EVENT_SIZE_LIMIT:
             raise ValueError(f"user event exceeds sane limit of {USER_EVENT_SIZE_LIMIT} bytes")
+        reason = self._admission.admit("user_event")
+        record_ingress(self._labels, self.local_id, "user_event", reason)
+        if reason is not None:
+            raise OverloadError("user_event", reason)
         ltime = self.event_clock.increment()
         tctx = new_trace(self.local_id)
         msg = UserEventMessage(ltime, name, payload, coalesce, tctx)
@@ -894,8 +944,23 @@ class Serf:
 
     async def query(self, name: str, payload: bytes,
                     params: Optional[QueryParam] = None) -> QueryResponse:
-        """(reference api.rs:304-313, base.rs:875-944)"""
+        """(reference api.rs:304-313, base.rs:875-944); raises
+        :class:`OverloadError` when admission control sheds the query
+        (internal ``_serf_*`` control queries are exempt — the operator
+        needs the stats plane most while the node is overloaded)."""
         params = params or QueryParam()
+        # cheap size pre-check FIRST (raw <= encoded, so raw over the
+        # limit can never encode under it): an obviously oversized query
+        # must not drain a token nor count as admitted ingress.  The
+        # exact encoded-size check below still governs.
+        if len(name) + len(payload) > self.opts.query_size_limit:
+            raise ValueError(
+                f"query exceeds limit of {self.opts.query_size_limit} bytes")
+        if not name.startswith("_serf_"):
+            reason = self._admission.admit("query")
+            record_ingress(self._labels, self.local_id, "query", reason)
+            if reason is not None:
+                raise OverloadError("query", reason)
         timeout = params.timeout or default_query_timeout(
             max(1, len(self._members)),
             self.opts.memberlist.gossip_interval,
@@ -919,18 +984,60 @@ class Serf:
             raise ValueError(f"query exceeds limit of {self.opts.query_size_limit} bytes")
         resp = QueryResponse(ltime, qid, timeout, params.request_ack,
                              len(self._members))
-        self._query_responses[(ltime, qid)] = resp
-        self._spawn(self._expire_query(resp), "serf-query-expire")
+        self._admit_query_response((ltime, qid), resp)
         with trace_scope(tctx), span("serf.query", node=self.local_id,
                                      query=name, bytes=len(raw)):
             self._handle_query(msg, rebroadcast=False)
             self._queue(self.query_broadcasts, raw)
         return resp
 
-    async def _expire_query(self, resp: QueryResponse) -> None:
-        await asyncio.sleep(max(0.0, resp.deadline - time.monotonic()))
-        resp.close()
-        self._query_responses.pop((resp.ltime, resp.id), None)
+    def _admit_query_response(self, key, resp: QueryResponse) -> None:
+        """Bounded insert into the originator-side handler map: at
+        ``max_query_responses`` the expired entries are reclaimed inline;
+        if the map is still full, the entry closest to its deadline is
+        evicted (closed, counted, flight-recorded) — a query storm can
+        no longer grow the map without limit.  The periodic
+        ``_query_sweeper`` does the routine TTL reclamation."""
+        cap = self.opts.max_query_responses
+        if len(self._query_responses) >= cap:
+            self._sweep_query_responses(time.monotonic())
+        if len(self._query_responses) >= cap:
+            victim_key = min(self._query_responses,
+                             key=lambda k: self._query_responses[k].deadline)
+            victim = self._query_responses.pop(victim_key)
+            victim.close()
+            metrics.incr("serf.overload.query_responses_shed", 1,
+                         self._labels)
+            obs.record("query-responses-shed", node=self.local_id,
+                       ltime=victim_key[0], qid=victim_key[1], cap=cap)
+        self._query_responses[key] = resp
+
+    def _sweep_query_responses(self, now: float) -> int:
+        """Close + drop every expired handler; returns how many."""
+        expired = [k for k, r in self._query_responses.items()
+                   if now > r.deadline]
+        for k in expired:
+            resp = self._query_responses.pop(k, None)
+            if resp is not None:
+                resp.close()
+        return len(expired)
+
+    async def _query_sweeper(self) -> None:
+        """ONE periodic task reclaims every expired query handler —
+        replacing the per-query expiry task the engine used to spawn
+        (a query storm meant a task storm).  Consumers never notice the
+        latency: ``QueryResponse`` iterators end at the deadline on
+        their own; the sweep only reclaims the map entry."""
+        interval = self.opts.query_sweep_interval
+        while not self._shutdown_event.is_set():
+            await asyncio.sleep(interval)
+            try:
+                self._sweep_query_responses(time.monotonic())
+                metrics.gauge("serf.overload.query_responses",
+                              len(self._query_responses),
+                              {**self._labels, "node": self.local_id})
+            except Exception:  # noqa: BLE001
+                log.exception("query sweeper tick failed")
 
     async def relay_response(self, relay_factor: int, target: Node, raw: bytes) -> None:
         """Redundantly relay a query response through k random members
@@ -1262,6 +1369,29 @@ class Serf:
                        ltime=msg.ltime, qid=msg.id,
                        **({"origin": msg.tctx.origin, "hops": msg.tctx.hops}
                           if msg.tctx is not None else {}))
+            if (not msg.name.startswith("_serf_")
+                    and msg.from_node.id != self.local_id
+                    and self._admission.overloaded()):
+                # Lifeguard-style self-awareness at the query plane: a
+                # node under loop-lag/queue pressure fast-fails with an
+                # explicit OVERLOADED response instead of serving late
+                # (or timing out silently).  Internal control queries
+                # are exempt, and so is OUR OWN query's local handling
+                # (sending ourselves an OVERLOADED packet would burn a
+                # send exactly when overloaded — local delivery shedding
+                # at the bounded inbox covers that case).  The query
+                # still rebroadcasts so healthy nodes serve it.
+                metrics.incr("serf.overload.query_fastfail", 1,
+                             self._labels)
+                obs.record("query-fastfail", node=self.local_id,
+                           query=msg.name, qid=msg.id)
+                over = QueryResponseMessage(
+                    ltime=msg.ltime, id=msg.id,
+                    from_node=self.memberlist.local_node(),
+                    flags=QueryFlag.OVERLOADED, tctx=msg.tctx)
+                self._spawn(self._send_and_relay(msg, encode_message(over)),
+                            "serf-query-overloaded")
+                return rebroadcast_out
             if msg.ack():
                 ack = QueryResponseMessage(
                     ltime=msg.ltime, id=msg.id,
@@ -1297,7 +1427,11 @@ class Serf:
             obs.record("query-response", node=self.local_id,
                        responder=msg.from_node.id, ack=msg.ack(),
                        trace=msg.tctx.hex_id, hops=msg.tctx.hops)
-        if msg.ack():
+        if msg.overloaded():
+            obs.record("query-overloaded-response", node=self.local_id,
+                       responder=msg.from_node.id)
+            resp.handle_overloaded(msg.from_node.id, self._labels)
+        elif msg.ack():
             resp.handle_ack(msg.from_node.id, self._labels)
         else:
             resp.handle_response(msg.from_node.id, msg.payload, self._labels)

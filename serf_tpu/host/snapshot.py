@@ -105,6 +105,9 @@ class ReplayResult:
     #: bytes of the file covered by COMPLETE records; anything past this
     #: is a torn tail (crash mid-append) the writer truncates on reopen
     valid_length: int = 0
+    #: unknown/legacy record types skipped during replay (counted in
+    #: ``serf.snapshot.unknown_record``) — replay continues past them
+    unknown_records: int = 0
 
 
 def open_and_replay_snapshot(path: str, rejoin_after_leave: bool = False) -> ReplayResult:
@@ -141,6 +144,17 @@ def open_and_replay_snapshot(path: str, rejoin_after_leave: bool = False) -> Rep
                 alive.clear()
         elif ty == R_COMMENT:
             pass
+        else:
+            # unknown/legacy record type: SKIP it and keep replaying
+            # (reference snapshot.rs:115-215 skips legacy Coordinate
+            # records the same way).  The length prefix makes the skip
+            # safe without understanding the payload; aborting here
+            # would throw away every record after the first one a newer
+            # (or older) build wrote.
+            res.unknown_records += 1
+            metrics.incr("serf.snapshot.unknown_record", 1)
+            log.warning("skipping unknown snapshot record type %d "
+                        "(%d bytes payload)", ty, len(payload))
     res.alive_nodes = list(alive.values())
     return res
 

@@ -189,6 +189,30 @@ class GossipState(NamedTuple):
                                 # round - last_clamp >= CLAMP_EVERY, so
                                 # under sustained load the standalone
                                 # pass never fires.
+    slot_round: jnp.ndarray     # i32[K]  round each ring slot was last
+                                # WRITTEN by an injection — the overflow
+                                # accountant's clock (O(K): bytes-free
+                                # next to the N-sized planes)
+    overflow: jnp.ndarray       # u32 scalar: cumulative count of facts
+                                # clobbered while still inside their
+                                # transmit window — injection recycled
+                                # the slot before the fact could finish
+                                # disseminating.  The device analog of
+                                # the host plane's shed counters
+                                # (``serf.overload.device_dropped`` via
+                                # emit_gossip_metrics): bounded
+                                # fact-injection ACCOUNTS its overflow
+                                # instead of silently clobbering when
+                                # events_per_round bursts past ring
+                                # capacity.
+    injected: jnp.ndarray       # u32 scalar: cumulative facts injected
+                                # into the ring by ANY path (executor
+                                # events, SWIM suspicions/declarations,
+                                # refutations, churn).  The other half
+                                # of the overload ledger: overflow can
+                                # never exceed it, and
+                                # ``injected - overflow`` is the count
+                                # that got a full dissemination window.
 
 
 @dataclasses.dataclass(frozen=True)
@@ -320,6 +344,10 @@ def make_state(cfg: GossipConfig) -> GossipState:
         sendable=jnp.zeros((n, w), jnp.uint32),
         sendable_round=jnp.asarray(-1, jnp.int32),
         last_clamp=jnp.asarray(0, jnp.int32),
+        # far in the past: writing over a never-used slot is not overflow
+        slot_round=jnp.full((k,), -(1 << 30), jnp.int32),
+        overflow=jnp.asarray(0, jnp.uint32),
+        injected=jnp.asarray(0, jnp.uint32),
     )
 
 
@@ -590,6 +618,15 @@ def inject_fact(state: GossipState, cfg: GossipConfig, subject, kind,
     dead_retired = (state.facts.valid[slot] & (old_kind == K_DEAD)
                     & covered & not_superseded)
     tombstone = state.tombstone.at[old_subject].max(dead_retired)
+    # overflow accounting (ISSUE 5): overwriting a valid fact whose slot
+    # was written fewer than transmit_window_rounds ago drops a fact that
+    # was still disseminating — count it (O(1) on K-sized planes)
+    clobbered = (state.facts.valid[slot]
+                 & ((state.round - state.slot_round[slot])
+                    < cfg.transmit_window_rounds))
+    overflow = state.overflow + clobbered.astype(jnp.uint32)
+    injected_total = state.injected + jnp.uint32(1)
+    slot_round = state.slot_round.at[slot].set(state.round)
     is_alive_fact = jnp.asarray(kind, jnp.uint8) == K_ALIVE
     subj_idx = jnp.clip(jnp.asarray(subject, jnp.int32), 0)
     tombstone = tombstone.at[subj_idx].set(
@@ -639,6 +676,8 @@ def inject_fact(state: GossipState, cfg: GossipConfig, subject, kind,
                           stamp=stamp, next_slot=state.next_slot + 1,
                           tombstone=tombstone,
                           sendable=sendable, sendable_round=sendable_round,
+                          slot_round=slot_round, overflow=overflow,
+                          injected=injected_total,
                           last_learn=bump_last_learn(True, state.round,
                                                      state.last_learn))
 
@@ -704,6 +743,20 @@ def inject_facts_batch(state: GossipState, cfg: GossipConfig, subjects,
 
     tombstone = jax.lax.cond(jnp.any(maybe_dead), fold,
                              lambda ts: ts, state.tombstone)
+
+    # overflow accounting (ISSUE 5): active entries overwriting a valid
+    # fact whose slot was written inside the transmit window drop a
+    # still-disseminating fact.  O(M) gathers on K-sized arrays — no
+    # N-plane traffic, so the sustained-regime HBM model is untouched.
+    # Chunked storm injections land in the same round, so a burst past
+    # ring capacity counts every still-live slot it clobbers.
+    clobbered = (state.facts.valid[r_slots] & active
+                 & ((state.round - state.slot_round[r_slots])
+                    < cfg.transmit_window_rounds))
+    overflow = state.overflow + jnp.sum(clobbered).astype(jnp.uint32)
+    injected_total = state.injected + jnp.sum(active).astype(jnp.uint32)
+    slot_round = state.slot_round.at[wslots].set(state.round, mode="drop")
+
     if kind == K_ALIVE:
         tombstone = tombstone.at[
             jnp.where(active, jnp.clip(subjects, 0), n)].set(
@@ -787,6 +840,8 @@ def inject_facts_batch(state: GossipState, cfg: GossipConfig, subjects,
     return state._replace(facts=facts, known=known, stamp=stamp,
                           tombstone=tombstone,
                           sendable=sendable, sendable_round=sendable_round,
+                          slot_round=slot_round, overflow=overflow,
+                          injected=injected_total,
                           next_slot=state.next_slot
                           + jnp.sum(active).astype(jnp.int32),
                           last_learn=bump_last_learn(
@@ -1297,6 +1352,11 @@ def emit_gossip_metrics(state: GossipState, cfg: GossipConfig,
         "serf.model.gossip.coverage": mean_cov,
         "serf.model.gossip.fan-out": fan_out,
         "serf.model.gossip.tombstones": jnp.sum(state.tombstone),
+        # the overload ledger (GossipState.overflow/.injected): facts
+        # clobbered while still inside their transmit window, and total
+        # facts injected by any path (dropped <= offered always)
+        "serf.overload.device_dropped": state.overflow,
+        "serf.overload.device_offered": state.injected,
     })
     vals = {name: float(v) for name, v in vals.items()}
     for name, v in vals.items():

@@ -108,6 +108,13 @@ class MemberlistOptions:
     join_retries: int = 2                    # extra join (push/pull) attempts
     breaker_threshold: int = 4               # consecutive failures to open
     breaker_cooldown: float = 2.0            # open-circuit fast-fail window
+    # overload protection (host/admission.py): per-peer USER-plane send
+    # pacing at the Memberlist.send seam — at most peer_send_rate
+    # packets/sec (burst peer_send_burst) to any single destination;
+    # excess is DROPPED (loss-based pacing; gossip is redundant).  The
+    # SWIM probe/ack/gossip plane is never paced.  0 = disabled.
+    peer_send_rate: float = 0.0
+    peer_send_burst: int = 64
     metric_labels: Dict[str, str] = field(default_factory=dict)
 
     def validate(self) -> None:
@@ -137,6 +144,10 @@ class MemberlistOptions:
         if self.breaker_threshold < 1 or self.breaker_cooldown < 0:
             raise ValueError("breaker_threshold >= 1 and "
                              "breaker_cooldown >= 0 required")
+        if self.peer_send_rate < 0:
+            raise ValueError("peer_send_rate must be >= 0 (0 = disabled)")
+        if self.peer_send_burst < 1:
+            raise ValueError("peer_send_burst must be >= 1")
 
     @classmethod
     def lan(cls) -> "MemberlistOptions":
@@ -208,6 +219,32 @@ class Options:
     query_timeout_mult: int = 16
     query_size_limit: int = 1024
     query_response_size_limit: int = 1024
+    # ---- overload protection (ISSUE 5) ------------------------------------
+    # Byte budgets per broadcast queue (0 = unbounded).  Shedding priority
+    # (host/broadcast.py): SWIM membership facts are NEVER shed; intents
+    # get the largest budget, user events less, query fan-out least.
+    intent_queue_bytes: int = 8 * 1024 * 1024
+    event_queue_bytes: int = 4 * 1024 * 1024
+    query_queue_bytes: int = 2 * 1024 * 1024
+    #: bound on live originator-side query handlers (_query_responses);
+    #: at capacity the entry closest to its deadline is evicted (counted)
+    max_query_responses: int = 1024
+    #: cadence of the single periodic sweep that reclaims expired query
+    #: handlers (replaces the per-query expiry task — a query storm must
+    #: not be a task storm)
+    query_sweep_interval: float = 1.0
+    #: bound on the protocol->pipeline event inbox; non-membership events
+    #: beyond it are shed (member events are membership state: never shed)
+    event_inbox_max: int = 8192
+    #: ingress token buckets (host/admission.py); rate 0 = unlimited
+    user_event_rate: float = 0.0
+    user_event_burst: int = 64
+    query_rate: float = 0.0
+    query_burst: int = 32
+    #: health floor: when the obs.health score drops below this, user
+    #: ingress is shed and inbound user queries are fast-failed with an
+    #: explicit OVERLOADED response (0 = disabled)
+    admission_min_health: int = 0
     memberlist: MemberlistOptions = field(default_factory=MemberlistOptions.lan)
     snapshot_path: Optional[str] = None
     snapshot_min_compact_size: int = SNAPSHOT_SIZE_LIMIT
@@ -227,6 +264,20 @@ class Options:
                 f"max_user_event_size {self.max_user_event_size} exceeds hard cap "
                 f"{USER_EVENT_SIZE_LIMIT}"
             )
+        for name in ("intent_queue_bytes", "event_queue_bytes",
+                     "query_queue_bytes", "event_inbox_max"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"{name} must be >= 0 (0 = unbounded)")
+        if self.max_query_responses < 1:
+            raise ValueError("max_query_responses must be >= 1")
+        if self.query_sweep_interval <= 0:
+            raise ValueError("query_sweep_interval must be positive")
+        if self.user_event_rate < 0 or self.query_rate < 0:
+            raise ValueError("ingress rates must be >= 0 (0 = unlimited)")
+        if self.user_event_burst < 1 or self.query_burst < 1:
+            raise ValueError("ingress bursts must be >= 1")
+        if not 0 <= self.admission_min_health <= 100:
+            raise ValueError("admission_min_health must be in [0, 100]")
         self.memberlist.validate()
 
     @classmethod
@@ -239,6 +290,7 @@ class Options:
             recent_intent_timeout=5.0,
             queue_check_interval=1.0,
             health_interval=0.25,
+            query_sweep_interval=0.1,
         )
         defaults.update(kw)
         return cls(**defaults)
@@ -332,7 +384,7 @@ _OPTIONS_DURATIONS = frozenset({
     "quiescent_period", "user_coalesce_period", "user_quiescent_period",
     "reap_interval", "reconnect_interval", "reconnect_timeout",
     "tombstone_timeout", "flap_timeout", "queue_check_interval",
-    "health_interval", "recent_intent_timeout",
+    "health_interval", "recent_intent_timeout", "query_sweep_interval",
 })
 _ML_DURATIONS = frozenset({
     "gossip_interval", "probe_interval", "probe_timeout",

@@ -45,11 +45,15 @@ class MessageType(enum.IntEnum):
 
 
 class QueryFlag(enum.IntFlag):
-    """reference query.rs:20-38."""
+    """reference query.rs:20-38, extended with the overload fast-fail
+    bit (ISSUE 5): a responder under admission-control pressure answers
+    OVERLOADED immediately instead of letting the originator time out
+    silently."""
 
     NONE = 0
     ACK = 1
     NO_BROADCAST = 2
+    OVERLOADED = 4
 
 
 @dataclass(frozen=True)
@@ -309,6 +313,9 @@ class QueryResponseMessage:
 
     def ack(self) -> bool:
         return bool(self.flags & QueryFlag.ACK)
+
+    def overloaded(self) -> bool:
+        return bool(self.flags & QueryFlag.OVERLOADED)
 
     def encode_body(self) -> bytes:
         out = codec.encode_varint_field(1, self.ltime)

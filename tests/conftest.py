@@ -32,8 +32,18 @@ jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402
+import time  # noqa: E402
 
 import pytest  # noqa: E402
+
+#: tier-1 runtime budget guard (ISSUE 5 satellite): the slow-window
+#: baseline the suite must stay under, vs. the driver's hard timeout.
+#: pytest_terminal_summary prints a loud warning into the run log when
+#: the wall clock exceeds the baseline — overload soaks must not
+#: silently eat the tier-1 headroom.
+TIER1_BASELINE_S = 790.0
+TIER1_TIMEOUT_S = 870.0
+_SESSION_T0 = time.monotonic()
 
 
 def pytest_configure(config):
@@ -45,6 +55,25 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: heavy redundant parametrization; excluded from "
                    "tier-1 (-m 'not slow'), run explicitly with -m slow")
+    # persist the slowest-test table into every run log (tier-1 tees its
+    # terminal output): the budget guard below is only actionable when
+    # the log also says WHERE the time went
+    if config.option.durations is None:
+        config.option.durations = 25
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    elapsed = time.monotonic() - _SESSION_T0
+    if elapsed > TIER1_BASELINE_S:
+        terminalreporter.write_line(
+            f"TIER1-BUDGET WARNING: suite wall clock {elapsed:.0f}s exceeds "
+            f"the ~{TIER1_BASELINE_S:.0f}s baseline (hard timeout "
+            f"{TIER1_TIMEOUT_S:.0f}s) — check --durations table above for "
+            "what grew", red=True, bold=True)
+    else:
+        terminalreporter.write_line(
+            f"tier1-budget: {elapsed:.0f}s of ~{TIER1_BASELINE_S:.0f}s "
+            f"baseline ({TIER1_TIMEOUT_S:.0f}s timeout)")
 
 
 @pytest.hookimpl(tryfirst=True)

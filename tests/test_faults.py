@@ -341,6 +341,83 @@ async def test_chaos_effects_duplicate_and_corrupt():
 
 
 # ---------------------------------------------------------------------------
+# overload plans (ISSUE 5 acceptance): query-storm on both planes
+# ---------------------------------------------------------------------------
+
+
+def test_load_phase_validation_and_lowering():
+    with pytest.raises(ValueError):   # negative rate
+        FaultPlan("x", n=4, phases=(FaultPhase(event_rate=-1.0),)).validate()
+    with pytest.raises(ValueError):   # stall out of range
+        FaultPlan("x", n=4, phases=(FaultPhase(stall=(9,)),)).validate()
+    plan = named_plan("query-storm")
+    assert plan.has_load() and plan.offered_rate() == 800.0
+
+    from serf_tpu.faults.device import lower_plan
+    sched = lower_plan(plan, 64)
+    # the storm phase lowered its offered ops to fact injections
+    assert sched.events[0] == 0
+    assert sched.events[1] == 960       # ceil(800/s * 1.2s)
+    assert any("query load lowered" in n for n in sched.notes)
+
+
+async def test_query_storm_host_plane(tmp_path):
+    """THE overload acceptance run (host flavor): admission sized under
+    the storm, so the run is green only if every buffer held its bound,
+    shed counters are NONZERO, accounting closes, and the lossless
+    contract + post-storm convergence survive."""
+    from serf_tpu.faults.host import run_host_plan
+
+    plan = named_plan("query-storm")
+    result = await run_host_plan(plan, tmp_dir=str(tmp_path))
+    assert result.report.ok, result.report.format()
+    names = {r.name for r in result.report.results}
+    assert {"bounded-buffers", "shed-accounting", "lossless-intact",
+            "storm-convergence"} <= names
+    load = result.load
+    assert load is not None
+    assert load.ingress_shed > 0                  # the storm DID shed
+    assert load.ingress_admitted > 0              # but service continued
+    offered = load.events_offered + load.queries_offered
+    assert load.ingress_admitted + load.ingress_shed == offered
+    # the shed counters reached the degradation report too
+    assert result.counters.get("serf.overload.ingress_shed", 0) > 0
+
+
+def test_query_storm_device_plane():
+    """The same plan object, device flavor: the storm's offered load
+    lowers to fact injections past ring capacity, and the overflow
+    accountant (serf.overload.device_dropped) must see the burst instead
+    of letting it clobber silently."""
+    from serf_tpu.faults.device import run_device_plan
+
+    result = run_device_plan(named_plan("query-storm"), _device_cfg(n=96))
+    assert result.report.ok, result.report.format()
+    assert "overflow-accounted" in {r.name for r in result.report.results}
+    assert result.offered > 0
+    assert 0 < result.dropped <= result.offered
+    # the pull-based emitter exports the same ledger
+    from serf_tpu.models.dissemination import emit_gossip_metrics
+    vals = emit_gossip_metrics(result.state.gossip,
+                               _device_cfg(n=96).gossip)
+    assert vals["serf.overload.device_dropped"] == result.dropped
+
+
+@pytest.mark.slow
+async def test_slow_consumer_host_plane(tmp_path):
+    """The slow-consumer plan: a stalled event reader under sustained
+    load — bounded memory, accounted sheds, and the stalled node catches
+    up after the phase (heavier sibling of the direct slow-reader units
+    in test_overload.py)."""
+    from serf_tpu.faults.host import run_host_plan
+
+    result = await run_host_plan(named_plan("slow-consumer"),
+                                 tmp_dir=str(tmp_path))
+    assert result.report.ok, result.report.format()
+    assert result.load.ingress_shed > 0
+
+
+# ---------------------------------------------------------------------------
 # CLI self-check (tier-1 hook)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,385 @@
+"""Overload-protection plane (ISSUE 5): bounded everything, priority
+shedding, admission control.
+
+Pins:
+
+- ``TransmitLimitedQueue`` byte budgets: most-transmitted-first shedding,
+  exact byte bookkeeping through queue/drain/prune/invalidate, and the
+  never-shed contract for membership queues;
+- ingress admission: token buckets + health floor raise ``OverloadError``
+  and the accounting (admitted + shed == offered) closes on the engine's
+  own counters;
+- responder-side query fast-fail: an overloaded node answers
+  ``QueryFlag.OVERLOADED`` instead of timing out silently;
+- the single periodic query sweep: no per-query expiry tasks, the
+  handler map is TTL-reclaimed and capacity-bounded with
+  earliest-deadline eviction;
+- bounded event inbox: user events shed at the cap, member events never;
+- slow-reader EventChannel under sustained push: memory stays bounded,
+  the tee gauge tracks, and the lossless-violation guard fires exactly
+  when contracted (heavy soak variants are ``slow``);
+- per-peer send pacing at the transport seam.
+"""
+
+import asyncio
+
+import pytest
+
+from serf_tpu.host.admission import (
+    AdmissionController,
+    OverloadError,
+    PeerPacer,
+    TokenBucket,
+)
+from serf_tpu.host.broadcast import Broadcast, TransmitLimitedQueue
+from serf_tpu.host.events import EventSubscriber, MemberEvent, MemberEventType, UserEvent
+from serf_tpu.host.serf import Serf
+from serf_tpu.host.transport import LoopbackNetwork
+from serf_tpu.options import Options
+from serf_tpu.utils import metrics
+
+pytestmark = pytest.mark.asyncio
+
+
+def _counter(name, **want_labels):
+    sink = metrics.global_sink()
+    total = 0.0
+    for (n, labels), v in sink.counters.items():
+        if n != name:
+            continue
+        ld = dict(labels or ())
+        if all(ld.get(k) == v2 for k, v2 in want_labels.items()):
+            total += v
+    return total
+
+
+# ---------------------------------------------------------------------------
+# token bucket / pacer units
+# ---------------------------------------------------------------------------
+
+
+def test_token_bucket_limits_and_refills():
+    now = [0.0]
+    b = TokenBucket(rate=10.0, burst=2.0, clock=lambda: now[0])
+    assert b.try_take() and b.try_take()       # burst
+    assert not b.try_take()                    # empty
+    now[0] += 0.1                              # +1 token
+    assert b.try_take()
+    assert not b.try_take()
+    now[0] += 10.0                             # refill clamps at burst
+    assert b.try_take() and b.try_take() and not b.try_take()
+    # rate <= 0 admits everything
+    free = TokenBucket(rate=0.0, burst=1.0)
+    assert all(free.try_take() for _ in range(100))
+
+
+def test_peer_pacer_is_per_destination_and_bounded():
+    p = PeerPacer(rate=0.0001, burst=2.0)      # ~never refills in-test
+    assert p.admit("a") and p.admit("a")
+    assert not p.admit("a")                    # a's bucket empty
+    assert p.admit("b")                        # b unaffected
+    # the peer map itself is bounded (stalest eviction, no unbounded map)
+    from serf_tpu.host import admission
+    for i in range(admission.PACER_MAX_PEERS + 10):
+        p.admit(f"peer-{i}")
+    assert len(p._peers) <= admission.PACER_MAX_PEERS
+
+
+async def test_memberlist_send_pacing_drops_over_rate():
+    from dataclasses import replace
+
+    net = LoopbackNetwork()
+    opts = Options.local()
+    opts = opts.replace(memberlist=replace(
+        opts.memberlist, peer_send_rate=5.0, peer_send_burst=2))
+    a = await Serf.create(net.bind("p0"), opts, "p0")
+    b = await Serf.create(net.bind("p1"), Options.local(), "p1")
+    try:
+        await b.join("p0")
+        base = _counter("serf.overload.paced_dropped")
+        for _ in range(30):
+            await a.memberlist.send("p1", b"x")
+        assert _counter("serf.overload.paced_dropped") > base
+        # the SWIM plane is NEVER paced: with a's user bucket long
+        # drained, probes/acks still flow and membership stays intact
+        await asyncio.sleep(0.5)    # several probe intervals
+        assert a.num_members() == 2 and b.num_members() == 2
+        assert all(m.status.name == "ALIVE" for m in a.members())
+    finally:
+        await a.shutdown()
+        await b.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# byte-bounded broadcast queues
+# ---------------------------------------------------------------------------
+
+
+def test_queue_byte_budget_sheds_most_transmitted_first():
+    q = TransmitLimitedQueue(4, lambda: 100, name="t-shed",
+                             max_bytes=100)
+    old = Broadcast(b"x" * 40)
+    q.queue_broadcast(old)
+    old.transmits = 3                      # well-disseminated
+    mid = Broadcast(b"y" * 40)
+    q.queue_broadcast(mid)
+    mid.transmits = 1
+    assert q.bytes() == 80
+    fresh = Broadcast(b"z" * 40)
+    q.queue_broadcast(fresh)               # 120 > 100: shed
+    assert q.bytes() <= 100
+    msgs = [b.msg for b in q._items]
+    assert fresh.msg in msgs               # freshest survives
+    assert old.msg not in msgs             # most-transmitted went first
+    assert q.shed == 1 and q.shed_bytes == 40
+    assert _counter("serf.overload.queue_shed", queue="t-shed") >= 1
+
+
+def test_queue_byte_bookkeeping_through_drain_prune_invalidate():
+    q = TransmitLimitedQueue(1, lambda: 1, name="t-bytes")
+    for i in range(4):
+        q.queue_broadcast(Broadcast(b"m" * 10, name=f"s{i}"))
+    assert q.bytes() == 40
+    q.queue_broadcast(Broadcast(b"mm" * 10, name="s0"))  # invalidates s0
+    assert q.bytes() == 30 + 20
+    # retransmit limit 1 at n=1: one drain retires what it sends
+    q.get_broadcasts(0, 1000)
+    assert q.bytes() == 0 and len(q) == 0
+    for i in range(4):
+        q.queue_broadcast(Broadcast(b"m" * 10))
+    q.prune(1)
+    assert q.bytes() == 10 and len(q) == 1
+
+
+def test_membership_queue_never_sheddable():
+    with pytest.raises(ValueError):
+        TransmitLimitedQueue(4, lambda: 1, max_bytes=10, sheddable=False)
+    q = TransmitLimitedQueue(4, lambda: 1, sheddable=False)
+    for i in range(100):
+        q.queue_broadcast(Broadcast(b"x" * 100))
+    assert len(q) == 100                   # no byte budget, nothing shed
+    assert q.shed == 0
+
+
+# ---------------------------------------------------------------------------
+# ingress admission
+# ---------------------------------------------------------------------------
+
+
+async def test_user_event_rate_limit_sheds_and_accounts():
+    net = LoopbackNetwork()
+    opts = Options.local(user_event_rate=5.0, user_event_burst=3)
+    s = await Serf.create(net.bind("a0"), opts, "a0")
+    base_adm = _counter("serf.overload.ingress_admitted", op="user_event")
+    base_shed = _counter("serf.overload.ingress_shed", op="user_event")
+    try:
+        offered, admitted, shed = 20, 0, 0
+        for i in range(offered):
+            try:
+                await s.user_event(f"e{i}", b"x", coalesce=False)
+                admitted += 1
+            except OverloadError as e:
+                assert e.op == "user_event" and e.reason == "rate"
+                shed += 1
+        assert admitted >= 3               # the burst got through
+        assert shed > 0                    # the rest was shed
+        assert admitted + shed == offered
+        # the engine's own counters close the same accounting
+        adm_d = _counter("serf.overload.ingress_admitted",
+                         op="user_event") - base_adm
+        shed_d = _counter("serf.overload.ingress_shed",
+                          op="user_event") - base_shed
+        assert adm_d == admitted and shed_d == shed
+    finally:
+        await s.shutdown()
+
+
+async def test_health_floor_sheds_ingress_and_internal_queries_exempt():
+    net = LoopbackNetwork()
+    opts = Options.local(admission_min_health=100)
+    s = await Serf.create(net.bind("h0"), opts, "h0")
+    try:
+        # healthy node (score 100): admitted
+        await s.user_event("ok", b"", coalesce=False)
+        # saturate the loop-lag component -> score < 100 -> shed
+        s._loop_lag_ewma_ms = 1e6
+        s._admission._health_at = -1e9     # invalidate the gate's cache
+        with pytest.raises(OverloadError) as ei:
+            await s.user_event("no", b"", coalesce=False)
+        assert ei.value.reason == "health"
+        with pytest.raises(OverloadError):
+            await s.query("user-query", b"")
+        # internal control queries bypass admission: the stats plane must
+        # work EXACTLY when the node is overloaded
+        resp = await s.query("_serf_ping", b"")
+        assert resp is not None
+    finally:
+        await s.shutdown()
+
+
+async def test_responder_fast_fails_overloaded_query():
+    net = LoopbackNetwork()
+    a = await Serf.create(net.bind("q0"), Options.local(), "q0")
+    b = await Serf.create(net.bind("q1"),
+                          Options.local(admission_min_health=100), "q1")
+    base_ff = _counter("serf.overload.query_fastfail")
+    try:
+        await b.join("q0")
+        # wedge b: health floor trips its responder-side self-awareness
+        b._loop_lag_ewma_ms = 1e6
+        b._admission._health_at = -1e9
+        from serf_tpu.host.query import QueryParam
+        resp = await a.query("who-is-there", b"", QueryParam(timeout=0.5))
+        await asyncio.sleep(0.3)
+        assert "q1" in resp.overloaded_responders
+        assert _counter("serf.overload.query_fastfail") > base_ff
+        assert _counter("serf.overload.remote_overloaded") >= 1
+    finally:
+        await a.shutdown()
+        await b.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# query handler map: single sweep, bounded capacity
+# ---------------------------------------------------------------------------
+
+
+async def test_query_sweep_replaces_per_query_tasks():
+    net = LoopbackNetwork()
+    s = await Serf.create(net.bind("s0"), Options.local(), "s0")
+    try:
+        from serf_tpu.host.query import QueryParam
+        for i in range(5):
+            await s.query(f"q{i}", b"", QueryParam(timeout=0.05))
+        # a query storm is NOT a task storm: no per-query expiry tasks
+        names = [t.get_name() for t in asyncio.all_tasks()]
+        assert not any("serf-query-expire" in n for n in names)
+        assert len(s._query_responses) == 5
+        # the single periodic sweep reclaims them after the deadline
+        await asyncio.sleep(0.4)           # local sweep interval is 0.1s
+        assert len(s._query_responses) == 0
+    finally:
+        await s.shutdown()
+
+
+async def test_query_responses_capacity_evicts_earliest_deadline():
+    net = LoopbackNetwork()
+    s = await Serf.create(net.bind("c0"),
+                          Options.local(max_query_responses=3), "c0")
+    base = _counter("serf.overload.query_responses_shed")
+    try:
+        from serf_tpu.host.query import QueryParam
+        resps = [await s.query(f"q{i}", b"", QueryParam(timeout=5.0))
+                 for i in range(6)]
+        assert len(s._query_responses) <= 3
+        assert _counter("serf.overload.query_responses_shed") - base >= 3
+        # the evicted handlers were CLOSED (explicit, not leaked)
+        assert sum(1 for r in resps if r._closed) >= 3
+    finally:
+        await s.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# bounded event inbox
+# ---------------------------------------------------------------------------
+
+
+async def test_event_inbox_sheds_user_events_never_member_events():
+    net = LoopbackNetwork()
+    s = await Serf.create(net.bind("i0"),
+                          Options.local(event_inbox_max=8), "i0")
+    base = _counter("serf.overload.event_shed")
+    try:
+        # let the drain pipeline consume the startup self-join event
+        while s._event_inbox.qsize():
+            await asyncio.sleep(0.01)
+        # synchronous burst: the pipeline task gets no loop turns, so the
+        # inbox genuinely fills
+        for i in range(50):
+            s._emit(UserEvent(i, f"u{i}", b""))
+        assert s._event_inbox.qsize() <= 8
+        shed = _counter("serf.overload.event_shed") - base
+        assert shed == 50 - 8
+        # membership state is NEVER shed, even over the cap
+        me = MemberEvent(MemberEventType.JOIN, (s.local_member(),))
+        s._emit(me)
+        assert s._event_inbox.qsize() == 9
+    finally:
+        await s.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# slow-reader EventChannel under sustained push (ISSUE 5 satellite)
+# ---------------------------------------------------------------------------
+
+
+async def _pump_slow_reader(n_events: int, inbox_max: int):
+    """Sustained push against a LOSSLESS subscriber that never reads:
+    returns (serf, subscriber, shed_delta, violations)."""
+    net = LoopbackNetwork()
+    sub = EventSubscriber(maxsize=16, lossless=True)
+    s = await Serf.create(net.bind("w0"),
+                          Options.local(event_inbox_max=inbox_max), "w0",
+                          subscriber=sub)
+    base = _counter("serf.overload.event_shed")
+    for i in range(n_events):
+        s._emit(UserEvent(i, f"e{i}", b"payload"))
+        if i % 64 == 0:
+            await asyncio.sleep(0)         # let the pipeline tee run
+    await asyncio.sleep(0.1)
+    shed = _counter("serf.overload.event_shed") - base
+    return net, s, sub, shed
+
+
+async def test_slow_lossless_reader_memory_bounded_and_gauge_tracks():
+    # the delivery path absorbs subscriber(16) + tee(TEE_QUEUE_MAX) +
+    # inbox(64) before shedding starts — pump past all of it
+    inbox_max = 64
+    n = 5000
+    net, s, sub, shed = await _pump_slow_reader(n, inbox_max)
+    try:
+        # memory stays bounded end to end: subscriber queue at its cap,
+        # tee + inbox at theirs, everything else shed AND counted
+        assert sub.qsize() <= 16
+        assert s._event_inbox.qsize() <= inbox_max
+        assert shed > 0
+        assert sub.qsize() + s._event_inbox.qsize() \
+            + s._tee_queue.qsize() + shed >= n - 32
+        # the tee gauge tracked the backlog (health input)
+        g = metrics.global_sink().gauge_value(
+            "serf.events.tee_depth", {"node": "w0"})
+        assert g is not None and g > 0
+        assert s.event_tee_fill() > 0
+        # the LOSSLESS contract held: shedding happened at the bounded
+        # inbox (admission), never by drop-oldest on the channel
+        assert sub.dropped == 0 and sub.lossless_violations == 0
+    finally:
+        await s.shutdown()
+
+
+async def test_lossless_violation_guard_fires_exactly_when_contracted():
+    sub = EventSubscriber(maxsize=2, lossless=True)
+    await sub.push(UserEvent(1, "a", b""))
+    await sub.push(UserEvent(2, "b", b""))
+    assert sub.lossless_violations == 0
+    # a synchronous producer bypassing the awaiting push IS the contract
+    # break — the guard must fire exactly then, loudly
+    sub._push(UserEvent(3, "c", b""))
+    assert sub.lossless_violations == 1 and sub.dropped == 1
+    assert _counter("serf.subscriber.lossless_violation") >= 1
+
+
+@pytest.mark.slow
+async def test_slow_reader_soak_heavy():
+    """Heavy soak sibling: 10k events against a wedged lossless reader —
+    bounds must hold at an order of magnitude more pressure."""
+    inbox_max = 128
+    net, s, sub, shed = await _pump_slow_reader(10_000, inbox_max)
+    try:
+        assert sub.qsize() <= 16
+        assert s._event_inbox.qsize() <= inbox_max
+        assert sub.lossless_violations == 0
+        # - 4 slack: the tee/delivery tasks each hold one event in hand
+        assert shed >= 10_000 - 16 - inbox_max - s._tee_queue.maxsize - 4
+    finally:
+        await s.shutdown()

@@ -121,3 +121,40 @@ def test_fully_torn_file_boots_empty(tmp_path):
     finally:
         import asyncio
         asyncio.run(snap.shutdown())
+
+
+def test_unknown_record_types_skipped_not_fatal(tmp_path):
+    """Unknown/legacy record types are SKIPPED with a counter — replay
+    continues to the records after them (ISSUE 5 satellite; reference
+    snapshot.rs:115-215 legacy Coordinate skip).  The length prefix
+    makes the skip safe without understanding the payload."""
+    from serf_tpu.utils import metrics
+
+    sink = metrics.global_sink()
+    base = sink.counter("serf.snapshot.unknown_record")
+    path = tmp_path / "s.snap"
+    recs = [
+        _record(R_CLOCK, codec.encode_varint(5)),
+        _record(R_ALIVE, Node("alpha", "addr-a").encode()),
+        _record(42, b"future-or-legacy-payload"),   # unknown type
+        _record(99),                                # unknown, empty
+        _record(R_ALIVE, Node("beta", "addr-b").encode()),
+        _record(R_EVENT_CLOCK, codec.encode_varint(7)),
+    ]
+    buf = b"".join(recs)
+    path.write_bytes(buf)
+    res = open_and_replay_snapshot(str(path))
+    # everything AFTER the unknown records still replayed
+    assert {n.id for n in res.alive_nodes} == {"alpha", "beta"}
+    assert res.last_clock == 5 and res.last_event_clock == 7
+    assert res.valid_length == len(buf)     # no torn tail: all complete
+    assert res.unknown_records == 2
+    assert sink.counter("serf.snapshot.unknown_record") == base + 2
+    # the writer appends cleanly after them (no truncation of unknowns:
+    # they are complete records, owned by some other build)
+    snap = Snapshotter(str(path), res)
+    try:
+        assert path.stat().st_size == len(buf)
+    finally:
+        import asyncio
+        asyncio.run(snap.shutdown())

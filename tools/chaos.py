@@ -81,12 +81,18 @@ def main() -> int:
 
     reports = []
     notes = []
+    overload = {}
     for plane in planes:
         if plane == "host":
             result = run_host(plan)
+            if result.load is not None:
+                overload["host"] = result.load.to_dict()
         else:
             result = run_device(plan, args.n, args.k_facts)
             notes.extend(result.notes)
+            if plan.has_load():
+                overload["device"] = {"offered": result.offered,
+                                      "dropped": result.dropped}
         reports.append(result.report)
 
     counters = degradation_counters()
@@ -97,12 +103,18 @@ def main() -> int:
             "reports": [r.to_dict() for r in reports],
             "degradation_counters": counters,
             "lowering_notes": notes,
+            "overload": overload,
         }, indent=1, sort_keys=True))
     else:
         for r in reports:
             print(r.format())
         if notes:
             print("lowering notes: " + "; ".join(notes))
+        if overload:
+            print("overload accounting:")
+            for plane, data in sorted(overload.items()):
+                row = ", ".join(f"{k}={v}" for k, v in sorted(data.items()))
+                print(f"  [{plane}] {row}")
         print("degradation counters:")
         for name in sorted(counters):
             print(f"  {name} = {counters[name]:.0f}")
