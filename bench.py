@@ -186,16 +186,17 @@ def main() -> None:
     gcfg, fcfg = cfg.gossip, cfg.failure
 
     def seeded_state(c):
+        n = c.n
         key = jax.random.key(0)
         st = make_cluster(c, key)
         g = st.gossip
         # realistic work: live dissemination + churn events to detect
-        spacing = max(1, N_NODES // 8)
-        origins = {(i * spacing) % N_NODES for i in range(8)}
+        spacing = max(1, n // 8)
+        origins = {(i * spacing) % n for i in range(8)}
         for i in range(8):
-            g = inject_fact(g, c.gossip, subject=(i * spacing) % N_NODES,
+            g = inject_fact(g, c.gossip, subject=(i * spacing) % n,
                             kind=K_USER_EVENT, incarnation=0, ltime=i + 1,
-                            origin=(i * spacing) % N_NODES)
+                            origin=(i * spacing) % n)
         # 16 deaths: real churn for the detector, with ring HEADROOM —
         # 16 suspicions + 16 declarations + 8 events + refutations fit
         # K_FACTS=64, so detection COMPLETES and the cluster reaches its
@@ -203,16 +204,16 @@ def main() -> None:
         # locking the simulation in a permanent evict/re-inject cycle no
         # provisioned deployment runs in — the reference sizes its event
         # buffers at 512 for the same reason.)
-        n_dead = min(16, N_NODES // 100)   # keep tiny smoke-test Ns sane
+        n_dead = min(16, n // 100)        # keep tiny smoke-test Ns sane
         if n_dead:
             # never kill a fact origin: a dead origin can't gossip, so its
             # fact would legitimately sit at coverage 0 and trip the
             # protocol-progress sanity check
-            ids, step = [], N_NODES // n_dead
+            ids, step = [], n // n_dead
             for i in range(n_dead):
-                d = (i * step + 1) % N_NODES
+                d = (i * step + 1) % n
                 while d in origins:
-                    d = (d + 1) % N_NODES
+                    d = (d + 1) % n
                 ids.append(d)
             g = g._replace(alive=g.alive.at[jnp.asarray(ids)].set(False))
         return st._replace(gossip=g)
@@ -233,6 +234,84 @@ def main() -> None:
         op="bench.run_cluster_sustained")
     detail["cluster_round_sustained_rps"] = round(sustained_rps, 2)
     detail["sustained_events_per_round"] = EVENTS_PER_ROUND
+
+    # --- SHARDED flagship: the path the 10k target actually lives on ------
+    # (ISSUE 6).  The single-chip HBM arithmetic caps the sustained round
+    # at ~3.5k rps; the N/8-per-chip shard with packets-only ICI traffic
+    # is the headline path on a v5e-8.  Measured on whatever mesh is
+    # visible (the CPU fallback provisions 8 virtual host devices — that
+    # measures collective-schedule overhead, not ICI, so the analytic
+    # 8-chip ceiling is embedded right next to the measured number); on
+    # CPU the mesh leg runs at a bounded N so it never eats the driver
+    # window (override with SERF_TPU_BENCH_SHARD_N).
+    try:
+        from serf_tpu.models.accounting import ici_round_traffic
+        from serf_tpu.parallel.mesh import (
+            best_device_count,
+            emit_shard_metrics,
+            make_mesh,
+            shard_state,
+        )
+        model8 = ici_round_traffic(cfg, 8)
+        shard_n = int(os.environ.get(
+            "SERF_TPU_BENCH_SHARD_N",
+            min(N_NODES, 131072) if on_cpu else N_NODES))
+        d_use = best_device_count(shard_n, len(jax.devices()))
+        schedule = model8["schedule"]["recommended"]
+        sharded = {
+            "n": shard_n,
+            "devices": d_use,
+            "schedule": schedule,
+            "virtual_mesh": on_cpu,
+            # the analytic 8-chip numbers the virtual-mesh rps must be
+            # judged against (the trajectory the BASELINE target tracks)
+            "model_8chip": {
+                "exchange_ici_bytes_per_chip": model8["per_phase_per_chip"]
+                ["exchange"][f"ici_bytes_per_chip_{schedule}"],
+                "hbm_bytes_per_chip_sustained":
+                    model8["hbm_bytes_per_chip_sustained"],
+                "implied_sustained_ceiling_rps":
+                    round(model8["implied_sustained_ceiling_rps"], 1),
+            },
+        }
+        if d_use >= 2:
+            # measure the schedule the model recommends — thread it into
+            # the config so the recorded schedule is the one that RAN
+            cfg_s = dataclasses.replace(
+                flagship_config(shard_n, k_facts=K_FACTS),
+                exchange_schedule=schedule)
+            mesh = make_mesh(d_use)
+            run_shard = jax.jit(
+                functools.partial(run_cluster_sustained, cfg=cfg_s,
+                                  events_per_round=EVENTS_PER_ROUND,
+                                  mesh=mesh),
+                static_argnames=("num_rounds",), donate_argnums=(0,))
+            _, shard_rps, _ = _time_rounds(
+                run_shard, lambda: shard_state(seeded_state(cfg_s), mesh),
+                jax.random.key(3), rounds_per_call, timed_calls,
+                measure_active=False, op="bench.run_cluster_sharded")
+            sharded["sustained_rps"] = round(shard_rps, 2)
+            # gauges describe the MEASURED run (shard_n nodes, d_use
+            # devices), not the 1M/8-chip target model beside them
+            model_run = ici_round_traffic(cfg_s, d_use)
+            sharded["model_measured_run"] = {
+                "exchange_ici_bytes_per_chip":
+                    model_run["per_phase_per_chip"]["exchange"]
+                    [f"ici_bytes_per_chip_{schedule}"],
+                "hbm_bytes_per_chip_sustained":
+                    model_run["hbm_bytes_per_chip_sustained"],
+            }
+            emit_shard_metrics(
+                d_use, schedule,
+                sharded["model_measured_run"]
+                ["exchange_ici_bytes_per_chip"],
+                rps=shard_rps)
+        else:
+            sharded["skipped"] = "mesh needs >= 2 devices dividing n"
+        detail["sharded"] = sharded
+    except Exception as e:  # noqa: BLE001 - never lose the headline to it
+        sharded = {"error": repr(e)[:300]}
+        detail["sharded"] = sharded
 
     # sanity: injection genuinely ran every round (the gate never closed)
     # and dissemination made real progress (facts spreading, ring live)
@@ -262,6 +341,9 @@ def main() -> None:
         "value": round(sustained_rps, 2),
         "unit": "rounds/sec",
         "vs_baseline": round(sustained_rps / TARGET_ROUNDS_PER_SEC, 4),
+        # the flagship sharded path (N/P per chip, packets-only ICI) —
+        # where the 10k target lives; full numbers in BENCH_DETAIL.json
+        "sharded": sharded,
     }), flush=True)
 
     # --- secondary: quiescent steady state + detection-hot active window --
@@ -538,6 +620,14 @@ if __name__ == "__main__":
         probe()
     elif "--run" in sys.argv:
         if os.environ.get("SERF_TPU_BENCH_CPU") == "1":
+            # provision the virtual 8-device mesh BEFORE the first jax
+            # import so the CPU fallback can still measure the sharded
+            # flagship section (same recipe as tests/conftest.py); the
+            # TPU path sees its real chips instead
+            _flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                      if "xla_force_host_platform_device_count" not in f]
+            _flags.append("--xla_force_host_platform_device_count=8")
+            os.environ["XLA_FLAGS"] = " ".join(_flags)
             import jax
             jax.config.update("jax_platforms", "cpu")
         main()

@@ -122,16 +122,20 @@ def lower_plan(plan: FaultPlan, n: Optional[int] = None
 
 def run_phase(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
               num_rounds: int, group: jnp.ndarray, drop,
-              init_alive: jnp.ndarray, down: jnp.ndarray) -> ClusterState:
+              init_alive: jnp.ndarray, down: jnp.ndarray,
+              mesh=None) -> ClusterState:
     """Scan ``num_rounds`` chaos rounds with one phase's masks applied.
     Jit with ``num_rounds`` static; group/drop/down are traced, so equal-
-    length phases reuse the compiled executable."""
+    length phases reuse the compiled executable.  ``mesh`` runs every
+    round on the sharded flagship path (the masks are per-node planes,
+    so they shard with the state — nothing else changes)."""
     alive = init_alive & ~down
     st = state._replace(gossip=state.gossip._replace(alive=alive),
                         group=group)
 
     def body(carry, subkey):
-        return cluster_round(carry, cfg, subkey, drop_rate=drop), ()
+        return cluster_round(carry, cfg, subkey, drop_rate=drop,
+                             mesh=mesh), ()
 
     keys = jax.random.split(key, num_rounds)
     final, _ = jax.lax.scan(body, st, keys)
@@ -158,11 +162,18 @@ class DeviceChaosResult:
 def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
                     key: Optional[jax.Array] = None,
                     state: Optional[ClusterState] = None,
-                    events_per_phase: int = 2) -> DeviceChaosResult:
+                    events_per_phase: int = 2,
+                    mesh=None) -> DeviceChaosResult:
     """Run ``plan`` against the flagship device cluster and check the
     invariants.  Injects ``events_per_phase`` fresh user events at the
     start of every phase (plus the settle window) so there is always
-    knowledge whose post-heal convergence the checker can judge."""
+    knowledge whose post-heal convergence the checker can judge.
+
+    ``mesh`` runs the whole plan on the SHARDED flagship round: the
+    initial state is node-sharded (``parallel.mesh.shard_state``), every
+    phase scan exchanges under the explicit ICI schedule, and the
+    invariant checkers consume the sharded final state unchanged (they
+    are reductions — jax gathers on device_get)."""
     import functools
 
     from serf_tpu.faults import invariants as inv
@@ -177,8 +188,11 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
     if state is None:
         key, k0 = jax.random.split(key)
         state = make_cluster(cfg, k0)
+    if mesh is not None:
+        from serf_tpu.parallel.mesh import shard_state
+        state = shard_state(state, mesh)
     init_alive = state.gossip.alive
-    run = jax.jit(functools.partial(run_phase, cfg=cfg),
+    run = jax.jit(functools.partial(run_phase, cfg=cfg, mesh=mesh),
                   static_argnames=("num_rounds",))
 
     injected: List[int] = []

@@ -52,6 +52,13 @@ V5E_HBM_BYTES_PER_S = 819e9
 #: v5e inter-chip interconnect, bytes/s per chip (public spec: 1600 Gbps
 #: ICI per chip on v5e)
 V5E_ICI_BYTES_PER_S = 200e9
+#: per-collective launch latency (the α of the hierarchy-aware α-β comm
+#: model, "A Model for Communication in Clusters of Multi-core Machines"
+#: PAPERS.md): what one ppermute hop or one all-gather dispatch costs
+#: before any byte moves.  ~1 µs is the right order for an on-chip ICI
+#: launch; the schedule decision is insensitive to 2-3× error here
+#: because the crossover block size scales linearly in it.
+ICI_HOP_ALPHA_S = 1e-6
 
 
 @dataclasses.dataclass(frozen=True)
@@ -295,27 +302,40 @@ def round_traffic(cfg, regime: str = "sustained",
 
 
 def ici_round_traffic(cfg, n_devices: int = 8) -> dict:
-    """Per-chip ICI bytes for one gossip exchange under node sharding —
-    the arithmetic behind the 8-chip throughput claim (VERDICT r4
-    next-3; STATUS.md carries the 1M/8-chip table).
+    """Per-phase, per-chip byte attribution for one flagship round under
+    node sharding — the arithmetic behind the 8-chip throughput claim
+    AND the ring-vs-all-gather schedule decision (ISSUE 6: the CPU
+    virtual mesh measures collective *schedule shape*, not ICI
+    bandwidth, so the decision is settled here, on the α-β model of
+    "A Model for Communication in Clusters of Multi-core Machines").
 
-    Three exchange schedules:
+    Legacy whole-round schedules (kept for STATUS.md continuity):
 
-    - ``rotation`` (the production flagship path): each of the ``fanout``
+    - ``rotation`` (minimal-traffic bound): each of the ``fanout``
       rolled reads shifts the packed packet plane by a global offset, so
       a chip's rolled block arrives from (at most two) offset-neighbor
       chips — bytes/chip ≈ fanout × the local packet block.  The probe /
       vivaldi / push_pull rolls move N-sized columns at their cadences.
-    - ``iid_allgather`` (GSPMD's lowering of ``packets[srcs]`` with
-      random sources): every chip materializes the full packet plane —
-      (D-1)/D of it arrives over ICI.
-    - ``iid_ring`` (``parallel/ring.py``): D-1 ppermute hops of the
-      local block — the SAME total ICI bytes as the all-gather ring
-      algorithm, but peak HBM stays at the block size and the per-hop
-      transfers overlap with the per-hop resolve compute.
+    - ``iid_allgather`` / ``iid_ring``: the full-plane materialization
+      vs D-1 ppermute hops of the local block — same wire totals, peak
+      HBM and overlap differ.
+
+    New (the flagship sharded round, ``parallel.ring``):
+
+    - ``per_phase_per_chip``: every round phase's HBM bytes/chip (the
+      sustained model split D ways — every plane is node-sharded) plus
+      its ICI bytes/chip, with the exchange leg priced under BOTH
+      explicit schedules.
+    - ``schedule``: the α-β decision.  Both schedules ship (D-1)×block
+      per chip; the ring pays (D-1) collective launches but overlaps
+      each hop's transfer with the previous hop's resolve and keeps peak
+      HBM at 2 blocks; the all-gather pays one launch but materializes
+      the full plane — an extra write+read of D blocks through HBM.
+      Ring is recommended once that extra HBM round-trip outweighs the
+      extra (D-2) launches: ``2·D·block/HBM_BW > (D-2)·α``.
 
     Returns a dict of bytes/chip/round plus derived μs at v5e bandwidths
-    and the implied 8-chip sustained ceiling.
+    and the implied D-chip sustained ceiling.
     """
     g: GossipConfig = cfg.gossip
     n, w, d = g.n, g.words, n_devices
@@ -324,22 +344,26 @@ def ici_round_traffic(cfg, n_devices: int = 8) -> dict:
 
     rot_gossip = g.fanout * block               # fanout rolled block reads
     # push_pull: known-plane roll at its cadence
-    rot_aux = ((packets_plane / d) / max(cfg.push_pull_every, 1)
-               if cfg.push_pull_every > 0 else 0.0)
+    pp_ici = ((packets_plane / d) / max(cfg.push_pull_every, 1)
+              if cfg.push_pull_every > 0 else 0.0)
+    probe_ici = 0.0
     if cfg.with_failure:
         # probe rolls: N-sized liveness columns per probe tick
-        rot_aux += ((2 + cfg.failure.indirect_probes) * n / d
-                    ) / cfg.probe_every
+        probe_ici = ((2 + cfg.failure.indirect_probes) * n / d
+                     ) / cfg.probe_every
+    viv_ici = 0.0
     if cfg.with_vivaldi:
         # vivaldi partner rolls (positions f32[N,3] + liveness) ride the
         # probe cadence (cluster_round wires them to probe_tick)
-        rot_aux += ((3 * 4 * n + 4 * n) / d) / cfg.probe_every
+        viv_ici = ((3 * 4 * n + 4 * n) / d) / cfg.probe_every
+    rot_aux = pp_ici + probe_ici + viv_ici
     rotation = rot_gossip + rot_aux
 
     allgather = (d - 1) / d * packets_plane     # the rest of the plane in
     ring = (d - 1) * block                      # D-1 hops of the block
 
-    hbm_per_chip = round_traffic(cfg, regime="sustained").total_bytes / d
+    report = round_traffic(cfg, regime="sustained")
+    hbm_per_chip = report.total_bytes / d
     out = {
         "n": n, "n_devices": d,
         "rotation_bytes_per_chip": rotation,
@@ -350,6 +374,57 @@ def ici_round_traffic(cfg, n_devices: int = 8) -> dict:
         "allgather_ici_us": allgather / V5E_ICI_BYTES_PER_S * 1e6,
         "hbm_us_per_chip": hbm_per_chip / V5E_HBM_BYTES_PER_S * 1e6,
     }
+
+    # per-phase, per-chip attribution: HBM from the sustained model
+    # (node-sharded planes split D ways), ICI from the collective leg
+    # each phase actually runs on the sharded flagship round
+    exchange_ici = {"ring": (d - 1) * block, "allgather": (d - 1) * block}
+    phase_ici = {"exchange": exchange_ici["ring"], "push_pull": pp_ici,
+                 "probe": probe_ici, "vivaldi": viv_ici}
+    per_phase = {}
+    for phase, nbytes in report.by_phase().items():
+        per_phase[phase] = {
+            "hbm_bytes_per_chip": nbytes / d,
+            "ici_bytes_per_chip": phase_ici.get(phase, 0.0),
+        }
+    per_phase.setdefault("exchange", {"hbm_bytes_per_chip": 0.0,
+                                      "ici_bytes_per_chip": 0.0})
+    per_phase["exchange"].update({
+        "ici_bytes_per_chip_ring": exchange_ici["ring"],
+        "ici_bytes_per_chip_allgather": exchange_ici["allgather"],
+        # peak HBM held by the leg: ring keeps the resident block + the
+        # visiting block; all-gather materializes the whole plane next
+        # to the local block
+        "peak_hbm_bytes_ring": 2 * block,
+        "peak_hbm_bytes_allgather": packets_plane + block,
+        "collective_launches_ring": d - 1,
+        "collective_launches_allgather": 1,
+    })
+    out["per_phase_per_chip"] = per_phase
+
+    # the α-β schedule decision (module docstring): wire bytes tie, so
+    # ring wins exactly when the all-gather's extra HBM round-trip of
+    # the materialized plane costs more than the ring's extra launches
+    ring_alpha_s = (d - 1) * ICI_HOP_ALPHA_S
+    ag_alpha_s = ICI_HOP_ALPHA_S
+    ag_extra_hbm = 2.0 * packets_plane          # write + read the plane
+    ring_us = (ring_alpha_s + ring / V5E_ICI_BYTES_PER_S) * 1e6
+    ag_us = (ag_alpha_s + allgather / V5E_ICI_BYTES_PER_S
+             + ag_extra_hbm / V5E_HBM_BYTES_PER_S) * 1e6
+    out["schedule"] = {
+        "ring": {"ici_us": ring_us, "launches": d - 1,
+                 "peak_hbm_bytes": 2 * block, "extra_hbm_bytes": 0.0},
+        "allgather": {"ici_us": ag_us, "launches": 1,
+                      "peak_hbm_bytes": packets_plane + block,
+                      "extra_hbm_bytes": ag_extra_hbm},
+        "recommended": "ring" if ring_us <= ag_us else "allgather",
+        "rule": "wire bytes tie at (D-1)*block; ring wins once the "
+                "all-gather's full-plane HBM round-trip (2*D*block/"
+                "HBM_BW) exceeds the ring's extra (D-2) collective "
+                "launches — i.e. at flagship scale; allgather wins at "
+                "small blocks where launch latency dominates",
+    }
+
     # the round is bound by the slower of HBM and ICI (they overlap at
     # best); the implied D-chip sustained ceiling uses the rotation path
     bound_s = max(out["rotation_ici_us"], out["hbm_us_per_chip"]) / 1e6

@@ -7,6 +7,13 @@ same round with the same RNG discipline (keys are caller supplied, so a
 resumed run with the same keys is identical to an unbroken one; pinned by
 tests).  Restore fails closed (``ValueError``) on corrupt files and on any
 shape or dtype mismatch against the template.
+
+Sharded flagship states round-trip too: ``save`` GATHERS (``np.asarray``
+on a node-sharded jax.Array pulls every addressable shard), so the
+on-disk artifact is mesh-agnostic; ``restore(..., mesh=)`` RE-SHARDS the
+loaded pytree onto the given mesh — after validating that the mesh size
+divides every node-sharded axis, so a device-count mismatch fails closed
+with a clear error instead of an XLA shape crash.
 """
 
 from __future__ import annotations
@@ -26,7 +33,9 @@ def _flatten(state) -> dict:
 
 def save(path: str, state: Any) -> None:
     """Write the state pytree; atomic replace so a crash never leaves a
-    half-written checkpoint (same guarantee as the host snapshot compactor)."""
+    half-written checkpoint (same guarantee as the host snapshot
+    compactor).  Sharded states gather here (``np.asarray`` pulls all
+    addressable shards) — the artifact is mesh-agnostic."""
     arrays = _flatten(state)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
@@ -34,10 +43,34 @@ def save(path: str, state: Any) -> None:
     os.replace(tmp, path)
 
 
-def restore(path: str, template: Any) -> Any:
+def _validate_mesh(state: Any, mesh) -> Any:
+    """Fail-closed re-shard: every axis the node sharding would split
+    must be divisible by the mesh size (a 1M-node checkpoint restored
+    onto a 7-device mesh must raise, not crash inside XLA)."""
+    from serf_tpu.parallel.mesh import NODE_AXIS, state_shardings
+
+    shardings = state_shardings(state, mesh)
+    d = int(mesh.size)
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    flat_sh = jax.tree_util.tree_leaves(shardings)
+    for (path_k, leaf), sh in zip(flat, flat_sh):
+        for axis, name in enumerate(sh.spec):
+            if name == NODE_AXIS and leaf.shape[axis] % d != 0:
+                raise ValueError(
+                    f"checkpoint re-shard device-count mismatch: array "
+                    f"{jax.tree_util.keystr(path_k)!r} axis {axis} of "
+                    f"size {leaf.shape[axis]} is not divisible by the "
+                    f"{d}-device mesh — restore with a device count "
+                    f"that divides the node axis")
+    return jax.device_put(state, shardings)
+
+
+def restore(path: str, template: Any, mesh=None) -> Any:
     """Load into the shape of ``template`` (the make_* result for the same
     config); raises FileNotFoundError/ValueError on missing or mismatched
-    checkpoints."""
+    checkpoints.  ``mesh`` re-shards the restored pytree onto a device
+    mesh (``parallel.mesh.state_shardings``), failing closed on a
+    device-count mismatch."""
     import zipfile
 
     try:
@@ -72,7 +105,10 @@ def restore(path: str, template: Any) -> Any:
                         f"checkpoint array {key!r} has dtype {arr.dtype}, "
                         f"state expects {np.asarray(leaf).dtype}")
                 leaves.append(jnp.asarray(arr, dtype=leaf.dtype))
-            return jax.tree_util.tree_unflatten(treedef, leaves)
+            state = jax.tree_util.tree_unflatten(treedef, leaves)
+            if mesh is not None:
+                state = _validate_mesh(state, mesh)
+            return state
     except FileNotFoundError:
         raise
     except (zipfile.BadZipFile, KeyError, OSError) as e:

@@ -472,9 +472,9 @@ def budgets_of(state: GossipState, cfg: GossipConfig) -> jnp.ndarray:
 def sending_mask(state: GossipState, cfg: GossipConfig) -> jnp.ndarray:
     """bool[N, K]: facts with remaining transmit budget at alive nodes —
     the per-round packet-selection predicate.  THE place the budget
-    derivation is encoded for the round kernels (round_step,
-    push_round_step, ring.round_step_ring); keep in sync with
-    ``budgets_of``."""
+    derivation is encoded for the round kernels (round_step —
+    which the sharded flagship reuses via its ``exchange`` hook —
+    and push_round_step); keep in sync with ``budgets_of``."""
     known = unpack_bits(state.known, cfg.k_facts)
     return (known & (mod_age(state, cfg) < jnp.uint8(cfg.transmit_limit_q))
             & state.alive[:, None])
@@ -1049,11 +1049,11 @@ def learn_stamp_pass(stamp: jnp.ndarray, known: jnp.ndarray,
     ``known & woven-age-words`` directly).
 
     Returns ``(stamp', sendable', sendable_round')``.  The single
-    definition shared by :func:`merge_phase` and
-    ``parallel.ring.round_step_ring`` — the two exchange schedules must
-    stay bit-identical, so there is deliberately exactly one copy of
-    this arithmetic (``antientropy.push_pull_round`` has a reduced
-    stamp-only variant with its own cache semantics)."""
+    definition :func:`merge_phase` applies for EVERY exchange schedule —
+    the sharded flagship swaps only ``round_step``'s exchange leg, so
+    all schedules share this one copy of the arithmetic and stay
+    bit-identical by construction (``antientropy.push_pull_round`` has a
+    reduced stamp-only variant with its own cache semantics)."""
     k = cfg.k_facts
     rq = round_q(next_round)
     limit_q = jnp.uint8(cfg.transmit_limit_q)
@@ -1140,7 +1140,8 @@ def merge_phase(state: GossipState, incoming: jnp.ndarray,
 
 
 def round_step(state: GossipState, cfg: GossipConfig,
-               key: jax.Array, group=None, drop_rate=None) -> GossipState:
+               key: jax.Array, group=None, drop_rate=None,
+               exchange=None) -> GossipState:
     """One gossip round: select packets, pull-exchange, Lamport-merge
     (the :func:`select_phase`/:func:`exchange_phase`/:func:`merge_phase`
     composition — the profiler jits the same phases in isolation,
@@ -1161,11 +1162,19 @@ def round_step(state: GossipState, cfg: GossipConfig,
     empty, and the whole select/exchange/merge is a bit-exact identity — a fully quiescent cluster (serf with an empty broadcast
     queue) pays only the round increment and the amortized clamp.  A new
     injection or merge bumps ``last_learn`` and re-opens the gate.
+
+    ``exchange`` (optional) swaps the exchange leg for a drop-in with
+    the same ``(packets, cfg, key, group=, drop_rate=)`` contract — THE
+    hook the sharded flagship uses (``parallel.ring.exchange_sharded``
+    runs the leg under shard_map with an explicit ICI schedule).  One
+    copy of everything around the leg is what keeps the sharded round
+    bit-exact with this one by construction.
     """
     def active(state):
         packets = select_phase(state, cfg)
-        incoming = exchange_phase(packets, cfg, key, group=group,
-                                  drop_rate=drop_rate)
+        ex = exchange_phase if exchange is None else exchange
+        incoming = ex(packets, cfg, key, group=group,
+                      drop_rate=drop_rate)
         st = merge_phase(state, incoming, cfg)
         return (st.known, st.stamp, st.last_learn, st.sendable,
                 st.sendable_round, st.last_clamp)

@@ -70,6 +70,15 @@ class ClusterConfig:
     probe_every: int = 1
     with_failure: bool = True
     with_vivaldi: bool = True
+    #: ICI schedule of the sharded exchange leg when ``cluster_round``
+    #: runs with a mesh ("ring" | "allgather"; ignored unsharded).
+    #: Default ring: at flagship scale the block is far past the
+    #: dispatch-latency crossover, so the all-gather's full-plane
+    #: materialization (extra HBM round-trip + D× peak memory) costs
+    #: more than the ring's D-1 overlapped neighbor hops — the decision
+    #: rule and both schedules' per-chip bytes live in
+    #: ``accounting.ici_round_traffic``.
+    exchange_schedule: str = "ring"
 
     def __post_init__(self):
         if self.probe_every < 1:
@@ -78,6 +87,11 @@ class ClusterConfig:
             raise ValueError(
                 f"probe_every must be >= 1, got {self.probe_every} "
                 f"(use with_failure=False to disable probing)")
+        from serf_tpu.parallel.ring import EXCHANGE_SCHEDULES
+        if self.exchange_schedule not in EXCHANGE_SCHEDULES:
+            raise ValueError(
+                f"unknown exchange_schedule {self.exchange_schedule!r} "
+                f"(one of {EXCHANGE_SCHEDULES})")
 
     @property
     def n(self) -> int:
@@ -118,7 +132,8 @@ def make_cluster(cfg: ClusterConfig, key: jax.Array) -> ClusterState:
 
 
 def cluster_round(state: ClusterState, cfg: ClusterConfig,
-                  key: jax.Array, drop_rate=None) -> ClusterState:
+                  key: jax.Array, drop_rate=None, mesh=None
+                  ) -> ClusterState:
     """One full protocol round for every simulated node.
 
     ``drop_rate`` (optional f32 scalar, may be traced) is the chaos
@@ -127,15 +142,35 @@ def cluster_round(state: ClusterState, cfg: ClusterConfig,
     FaultPlan loss phase degrades dissemination and pressures the
     failure detector exactly like host-plane UDP loss.  ``state.group``
     is the per-round partition/adjacency mask throughout (gossip,
-    probes, push/pull, Vivaldi)."""
+    probes, push/pull, Vivaldi).
+
+    ``mesh`` (optional ``jax.sharding.Mesh``, node axis) makes this the
+    SHARDED flagship round: the gossip exchange runs as an explicit
+    shard_map leg (``parallel.ring.exchange_sharded``, ICI schedule per
+    ``cfg.exchange_schedule``) so each chip streams only its N/P slice
+    and only packet words ride the interconnect; every other phase is
+    elementwise or rolled, which GSPMD keeps chip-local over
+    node-sharded state (``parallel.mesh.shard_state``).  Bit-exact with
+    the unsharded round for the same keys — the exchange hook swaps the
+    collective schedule, never the arithmetic."""
     k_gossip, k_probe, k_refute, k_declare, k_pp, k_viv, k_peer = \
         jax.random.split(key, 7)
     g = state.gossip
     probe_tick = (g.round % cfg.probe_every == 0) \
         if cfg.probe_every > 1 else None
     chaos_group = state.group if drop_rate is not None else None
-    g = round_step(g, cfg.gossip, k_gossip, group=state.group,
-                   drop_rate=drop_rate)
+    if mesh is not None:
+        # THE one sharded round in the tree (parallel.ring): round_step
+        # with only the exchange leg swapped for the explicit shard_map
+        # schedule (and the single-device pallas kernels trace-time
+        # disabled, loudly)
+        from serf_tpu.parallel.ring import sharded_round_step
+        g = sharded_round_step(g, cfg.gossip, k_gossip, mesh,
+                               schedule=cfg.exchange_schedule,
+                               group=state.group, drop_rate=drop_rate)
+    else:
+        g = round_step(g, cfg.gossip, k_gossip, group=state.group,
+                       drop_rate=drop_rate)
     if cfg.with_failure:
         if probe_tick is None:
             g = probe_round(g, cfg.gossip, cfg.failure, k_probe,
@@ -207,9 +242,9 @@ def vivaldi_phase(state: ClusterState, cfg: ClusterConfig, k_peer,
 
 
 def run_cluster(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
-                num_rounds: int) -> ClusterState:
+                num_rounds: int, mesh=None) -> ClusterState:
     def body(carry, subkey):
-        return cluster_round(carry, cfg, subkey), ()
+        return cluster_round(carry, cfg, subkey, mesh=mesh), ()
 
     keys = jax.random.split(key, num_rounds)
     final, _ = jax.lax.scan(body, state, keys)
@@ -217,7 +252,7 @@ def run_cluster(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
 
 
 def sustained_round(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
-                    events_per_round: int) -> ClusterState:
+                    events_per_round: int, mesh=None) -> ClusterState:
     """``cluster_round`` under continuous dissemination load: inject
     ``events_per_round`` fresh user events at uniform random origins, then
     run the round.
@@ -262,14 +297,16 @@ def sustained_round(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
         incarnations=jnp.zeros((m,), jnp.uint32),
         ltimes=eids.astype(jnp.uint32),
         origins=origins, active=jnp.ones((m,), bool))
-    return cluster_round(state._replace(gossip=g), cfg, k_rnd)
+    return cluster_round(state._replace(gossip=g), cfg, k_rnd, mesh=mesh)
 
 
 def run_cluster_sustained(state: ClusterState, cfg: ClusterConfig,
                           key: jax.Array, num_rounds: int,
-                          events_per_round: int = 2) -> ClusterState:
+                          events_per_round: int = 2,
+                          mesh=None) -> ClusterState:
     def body(carry, subkey):
-        return sustained_round(carry, cfg, subkey, events_per_round), ()
+        return sustained_round(carry, cfg, subkey, events_per_round,
+                               mesh=mesh), ()
 
     keys = jax.random.split(key, num_rounds)
     final, _ = jax.lax.scan(body, state, keys)

@@ -25,6 +25,12 @@ place to look when measured rps sits far under the byte ceiling.
 Used by ``tools/roundprof.py`` (CLI, ``--json`` contract) and embedded
 in ``BENCH_DETAIL.json`` by bench.py on every run (CPU fallback
 included).
+
+The SHARDED flagship path profiles the same way (``profile_round(...,
+mesh=, schedule=)`` / ``roundprof --mesh``): phases jit on node-sharded
+inputs, the exchange phase is the explicit ``parallel.ring`` leg, and —
+the compiled module being SPMD — every cost-analysis byte column reads
+per chip, with the ≥90% attribution self-check preserved.
 """
 
 from __future__ import annotations
@@ -57,9 +63,11 @@ def _cost(compiled) -> Dict[str, float]:
     return ca or {}
 
 
-def _seeded_cluster(cfg, key, events_per_round: int, warm_rounds: int):
+def _seeded_cluster(cfg, key, events_per_round: int, warm_rounds: int,
+                    mesh=None):
     """A populated steady-ish state: seeded facts + churn, then a warm
-    sustained scan (compiles once; plays the detection cycle out)."""
+    sustained scan (compiles once; plays the detection cycle out).
+    ``mesh`` shards the state and warms on the sharded flagship path."""
     import jax
     import jax.numpy as jnp
 
@@ -79,17 +87,24 @@ def _seeded_cluster(cfg, key, events_per_round: int, warm_rounds: int):
         ids = [(i * (n // n_dead) + 1) % n for i in range(n_dead)]
         g = g._replace(alive=g.alive.at[jnp.asarray(ids)].set(False))
     state = state._replace(gossip=g)
+    if mesh is not None:
+        from serf_tpu.parallel.mesh import shard_state
+        state = shard_state(state, mesh)
     run = jax.jit(functools.partial(run_cluster_sustained, cfg=cfg,
-                                    events_per_round=events_per_round),
+                                    events_per_round=events_per_round,
+                                    mesh=mesh),
                   static_argnames=("num_rounds",))
     state = run(state, key=jax.random.key(7), num_rounds=warm_rounds)
     _sync(state.gossip.round)
     return state
 
 
-def _phase_callables(state, cfg, events_per_round: int):
+def _phase_callables(state, cfg, events_per_round: int, mesh=None,
+                     schedule: str = "ring"):
     """(name, jitted_fn, args) per phase — each jits EXACTLY the
-    production phase function on the warmed state."""
+    production phase function on the warmed state.  With ``mesh`` the
+    inputs are sharded and the exchange phase is the explicit
+    ``parallel.ring.exchange_sharded`` leg under ``schedule``."""
     import jax
     import jax.numpy as jnp
 
@@ -104,6 +119,13 @@ def _phase_callables(state, cfg, events_per_round: int):
     origins = jax.random.randint(jax.random.key(12), (m,), 0, cfg.n,
                                  dtype=jnp.int32)
 
+    if mesh is not None:
+        from serf_tpu.parallel.ring import exchange_sharded
+        exchange_fn = functools.partial(exchange_sharded, mesh=mesh,
+                                        schedule=schedule)
+    else:
+        exchange_fn = dissemination.exchange_phase
+
     def inject(g, key):
         return dissemination.inject_facts_batch(
             g, gcfg, eids, dissemination.K_USER_EVENT,
@@ -114,7 +136,7 @@ def _phase_callables(state, cfg, events_per_round: int):
     # phase inputs are materialized once so each phase is timed alone
     packets = jax.jit(functools.partial(dissemination.select_phase,
                                         cfg=gcfg))(g)
-    incoming = jax.jit(functools.partial(dissemination.exchange_phase,
+    incoming = jax.jit(functools.partial(exchange_fn,
                                          cfg=gcfg))(packets, key=key)
     _sync(incoming)
 
@@ -123,7 +145,7 @@ def _phase_callables(state, cfg, events_per_round: int):
         ("selection",
          lambda g, key: dissemination.select_phase(g, gcfg), (g,)),
         ("exchange",
-         lambda p, key: dissemination.exchange_phase(p, gcfg, key),
+         lambda p, key: exchange_fn(p, gcfg, key),
          (packets,)),
         ("merge",
          lambda g, key: dissemination.merge_phase(g, incoming, gcfg),
@@ -144,9 +166,16 @@ def _phase_callables(state, cfg, events_per_round: int):
 
 def profile_round(cfg, events_per_round: int = 2, timed_calls: int = 3,
                   warm_rounds: int = 24,
-                  hbm_bytes_per_s: Optional[float] = None
-                  ) -> Dict[str, Any]:
+                  hbm_bytes_per_s: Optional[float] = None,
+                  mesh=None, schedule: str = "ring") -> Dict[str, Any]:
     """Profile one sustained flagship round phase-by-phase.
+
+    With ``mesh`` the profile runs the SHARDED flagship path: state is
+    node-sharded, the exchange phase is the explicit shard_map leg under
+    ``schedule``, and — because the compiled module is SPMD — XLA's
+    cost-analysis bytes are per-chip, so every byte column (and the
+    ≥90% attribution self-check) reads per chip.  ``devices``/
+    ``schedule`` in the output say which flavor ran.
 
     Returns the JSON-ready dict documented in the module docstring
     (``tools/roundprof.py --json`` prints it verbatim)."""
@@ -161,9 +190,20 @@ def profile_round(cfg, events_per_round: int = 2, timed_calls: int = 3,
 
     if hbm_bytes_per_s is None:
         hbm_bytes_per_s = V5E_HBM_BYTES_PER_S
+    n_devices = 1
+    if mesh is not None:
+        from serf_tpu.parallel.mesh import NODE_AXIS
+        n_devices = mesh.shape[NODE_AXIS]
+        if cfg.n % n_devices != 0:
+            # the per-chip byte columns assume exactly N/P per chip and
+            # the authored exchange schedule; an indivisible N would
+            # silently profile the GSPMD fallback under those labels
+            raise ValueError(
+                f"sharded profile needs n divisible by the mesh: "
+                f"n={cfg.n}, devices={n_devices}")
     key = jax.random.key(5)
     state = _seeded_cluster(cfg, jax.random.key(0), events_per_round,
-                            warm_rounds)
+                            warm_rounds, mesh=mesh)
 
     # analytic model, per-OCCURRENCE bytes per phase (isolated phase
     # calls pay the full occurrence; the amortized column is what one
@@ -177,7 +217,8 @@ def profile_round(cfg, events_per_round: int = 2, timed_calls: int = 3,
         model_amort[e.phase] = model_amort.get(e.phase, 0.0) + e.amortized
 
     rows: List[Dict[str, Any]] = []
-    for name, jfn, args in _phase_callables(state, cfg, events_per_round):
+    for name, jfn, args in _phase_callables(state, cfg, events_per_round,
+                                            mesh=mesh, schedule=schedule):
         lowered = jfn.lower(*args, key=key)
         compiled = lowered.compile()
         ca = _cost(compiled)
@@ -204,7 +245,8 @@ def profile_round(cfg, events_per_round: int = 2, timed_calls: int = 3,
 
     # the whole compiled round, same workload (inject + cluster_round)
     whole = jax.jit(functools.partial(
-        sustained_round, cfg=cfg, events_per_round=events_per_round))
+        sustained_round, cfg=cfg, events_per_round=events_per_round,
+        mesh=mesh))
     lowered = whole.lower(state, key=key)
     compiled = lowered.compile()
     wca = _cost(compiled)
@@ -228,6 +270,14 @@ def profile_round(cfg, events_per_round: int = 2, timed_calls: int = 3,
         if anomaly is None or r["excess"] > anomaly["excess"]:
             anomaly = r
 
+    # per-phase model bytes on a mesh are per chip (the planes are
+    # node-sharded), matching the SPMD cost-analysis column
+    if n_devices > 1:
+        for r in rows:
+            r["model_bytes"] = round(r["model_bytes"] / n_devices, 1)
+            r["model_amortized_bytes"] = round(
+                r["model_amortized_bytes"] / n_devices, 1)
+
     out = {
         "n": cfg.n,
         "k": cfg.gossip.k_facts,
@@ -236,11 +286,16 @@ def profile_round(cfg, events_per_round: int = 2, timed_calls: int = 3,
         "backend": jax.default_backend(),
         "pack_stamp": cfg.gossip.pack_stamp,
         "hbm_bytes_per_s": hbm_bytes_per_s,
+        # sharded flavor: >1 devices means every byte column is PER CHIP
+        # (SPMD module) and the exchange ran the explicit schedule
+        "devices": n_devices,
+        "schedule": schedule if n_devices > 1 else None,
         "phases": rows,
         "whole_round": {
             "wall_ms": round(whole_wall, 4),
             "xla_bytes": whole_bytes,
-            "model_amortized_bytes": round(report.total_bytes, 1),
+            "model_amortized_bytes": round(
+                report.total_bytes / n_devices, 1),
             "roofline_frac": round(
                 whole_bytes / max(whole_wall, 1e-9) * 1e3
                 / hbm_bytes_per_s, 6),
@@ -263,10 +318,13 @@ def profile_round(cfg, events_per_round: int = 2, timed_calls: int = 3,
 
 def profile_table(profile: Dict[str, Any]) -> str:
     """Human rendering of a :func:`profile_round` result."""
+    shard = (f" devices={profile['devices']}"
+             f" schedule={profile['schedule']} (per-chip bytes)"
+             if profile.get("devices", 1) > 1 else "")
     lines = [
         f"per-phase round profile: n={profile['n']} k={profile['k']} "
         f"backend={profile['backend']} regime={profile['regime']} "
-        f"pack_stamp={profile['pack_stamp']}",
+        f"pack_stamp={profile['pack_stamp']}" + shard,
         f"{'phase':<10} {'wall ms':>9} {'XLA MB':>9} {'model MB':>9} "
         f"{'GB/s':>8} {'roofline':>9} {'excess':>7}",
     ]

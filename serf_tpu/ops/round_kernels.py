@@ -57,7 +57,16 @@ def _block_for(n: int) -> int:
 def pallas_ok(n: int, k_facts: int) -> bool:
     """Shapes the kernels support: a node block divides N, K is a multiple
     of 32 (the word size — which also keeps the nibble-packed plane at a
-    whole number of 16-byte word groups)."""
+    whole number of 16-byte word groups).
+
+    SINGLE-DEVICE ONLY: a ``pallas_call`` grid over the full N axis is
+    not partitionable by GSPMD, so the sharded flagship round
+    (``cluster_round(..., mesh=)``) disables the pallas path at trace
+    time and records a ``pallas-fallback`` flight event
+    (``parallel.ring.sharded_round_step``) — re-enabling it there means
+    wrapping these kernels in shard_map over the node-block grid, which
+    is exactly how they are written (per-block bodies), but is left for
+    the fused-megakernel round (ROADMAP item 2)."""
     return _block_for(n) > 0 and k_facts % 32 == 0
 
 

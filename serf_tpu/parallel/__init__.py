@@ -1,6 +1,20 @@
-"""Device-mesh sharding: single-host node-axis sharding (``mesh``) and
+"""Device-mesh sharding: single-host node-axis sharding (``mesh``), the
+flagship sharded exchange with explicit ICI schedules (``ring``), and
 multi-host DCN x ICI hybrid meshes (``multihost``)."""
 
-from serf_tpu.parallel.mesh import NODE_AXIS, make_mesh, shard_state, state_shardings
+from serf_tpu.parallel.mesh import (
+    NODE_AXIS,
+    best_device_count,
+    make_mesh,
+    shard_state,
+    state_shardings,
+)
+from serf_tpu.parallel.ring import (
+    EXCHANGE_SCHEDULES,
+    exchange_sharded,
+    sharded_round_step,
+)
 
-__all__ = ["NODE_AXIS", "make_mesh", "shard_state", "state_shardings"]
+__all__ = ["NODE_AXIS", "best_device_count", "make_mesh", "shard_state",
+           "state_shardings", "EXCHANGE_SCHEDULES", "exchange_sharded",
+           "sharded_round_step"]

@@ -9,7 +9,11 @@ packet words (N×W uint32 is small: 32 MB at 1M nodes), which rides ICI.
 
 We annotate shardings with ``NamedSharding``/``PartitionSpec`` and let
 GSPMD place the collectives — the pick-a-mesh / annotate / let-XLA-insert
-recipe — rather than hand-scheduling shard_map loops.
+recipe — for every elementwise/rolled phase; the one genuinely
+cross-chip leg of the flagship round (the gossip exchange) is EXPLICIT
+under ``shard_map`` in ``serf_tpu.parallel.ring`` (ring ppermute vs
+all-gather, selectable per config) so its ICI schedule is an authored
+decision, not a lowering accident.
 """
 
 from __future__ import annotations
@@ -18,8 +22,6 @@ from typing import Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-from serf_tpu.models.swim import ClusterState
 
 NODE_AXIS = "nodes"
 
@@ -33,6 +35,17 @@ def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     return Mesh(np.array(devices), (NODE_AXIS,))
 
 
+def best_device_count(n: int, available: int) -> int:
+    """Largest device count <= ``available`` that divides ``n`` — the
+    graceful pick for N-not-divisible-by-P deployments (a 1M-node sim on
+    a 7-device pool runs on 4 chips rather than crashing or silently
+    falling back to one)."""
+    for d in range(max(1, min(available, n)), 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
 # QueryState per-node planes are [Q, N]: the node axis is SECOND
 _QUERY_QN_FIELDS = frozenset(
     {"eligible", "attempted", "acked", "responded", "resp_value"})
@@ -41,6 +54,16 @@ _QUERY_QN_FIELDS = frozenset(
 # fields of the same name are caught by the "facts" ancestor check first.
 _QUERY_Q_FIELDS = frozenset(
     {"origin", "fact_slot", "deadline", "want_ack", "ltime", "valid"})
+# K-sized (fact-ring) and otherwise cluster-global planes: every chip
+# needs the whole thing.  slot_round is the overflow accountant's i32[K]
+# clock (PR 5) — sharding it over the node axis would be semantically
+# wrong (it is per ring SLOT, not per node) and forces GSPMD reshards in
+# the inject path.
+_REPLICATED_LEAVES = frozenset({"adj_index", "slot_round"})
+# DeviceFaultSchedule (faults.device) chaos masks: [P, N] per-phase
+# group/down planes shard on their SECOND axis; per-phase loss rates
+# ([P]) are replicated.
+_FAULT_PN_FIELDS = frozenset({"down"})
 
 
 def _path_names(path) -> list:
@@ -54,10 +77,11 @@ def _path_names(path) -> list:
 
 
 def _spec_for(path, arr) -> P:
-    """Per-node arrays shard on their first (N) axis; facts, scalars, and
-    query-slot metadata are replicated; query [Q, N] planes shard on their
-    second axis."""
-    if arr.ndim == 0:
+    """Per-node arrays shard on their first (N) axis; facts, ring-slot
+    planes, scalars, and query-slot metadata are replicated; query [Q, N]
+    planes and fault-schedule [P, N] masks shard on their second axis."""
+    if not hasattr(arr, "ndim") or arr.ndim == 0:
+        # python scalars (static per-phase round counts) and 0-d arrays
         return P()
     names = _path_names(path)
     leaf = names[-1] if names else ""
@@ -65,11 +89,18 @@ def _spec_for(path, arr) -> P:
     # 'gossip.facts' or with a non-N leading dim stays replicated
     if "facts" in names:
         return P()
-    if leaf == "adj_index":
+    if leaf in _REPLICATED_LEAVES:
         return P()
     if leaf in _QUERY_QN_FIELDS:
         return P(None, NODE_AXIS)
     if leaf in _QUERY_Q_FIELDS:
+        return P()
+    # chaos masks (faults.device.DeviceFaultSchedule): [P, N] planes —
+    # "group" is [N] in ClusterState (node-sharded below) but [P, N] in
+    # a fault schedule, so dispatch on rank
+    if leaf in _FAULT_PN_FIELDS or (leaf == "group" and arr.ndim == 2):
+        return P(None, NODE_AXIS)
+    if leaf == "drop":
         return P()
     return P(NODE_AXIS)
 
@@ -85,3 +116,25 @@ def state_shardings(state, mesh: Mesh):
 
 def shard_state(state, mesh: Mesh):
     return jax.device_put(state, state_shardings(state, mesh))
+
+
+def emit_shard_metrics(n_devices: int, schedule: str,
+                       exchange_bytes_per_chip: float,
+                       rps: Optional[float] = None, labels=None) -> dict:
+    """Emit the sharded-flagship gauges onto the process sink (bench.py
+    calls this from its ``sharded`` section; every name is README-
+    documented and lint-enforced).  ``schedule`` rides as a label so the
+    ring and all-gather legs of an A/B stay distinguishable."""
+    from serf_tpu.utils import metrics
+
+    vals = {
+        "serf.shard.devices": float(n_devices),
+        "serf.shard.exchange-bytes-per-chip": float(exchange_bytes_per_chip),
+        "serf.shard.rps": float(rps) if rps is not None else None,
+    }
+    if vals["serf.shard.rps"] is None:
+        del vals["serf.shard.rps"]
+    lab = dict(labels or {}, schedule=schedule)
+    for name, v in vals.items():
+        metrics.gauge(name, v, lab)
+    return vals

@@ -1,24 +1,42 @@
-"""Ring-pipelined gossip exchange: ppermute block rotation over ICI.
+"""The flagship sharded exchange: explicit ICI schedules under shard_map.
 
-The default multi-chip round (`models/dissemination.round_step` under a
-node-sharded mesh) lets GSPMD turn ``packets[srcs]`` into an **all-gather**
-of the packed packet plane — simple, but it materializes the full N×W
-uint32 packet array on every chip (32 MB at 1M nodes) and puts one big
-collective on the critical path.
+THE one sharded gossip round in the tree (ISSUE 6): ``cluster_round``
+with a mesh routes its gossip exchange through :func:`exchange_sharded`,
+which produces an ``incoming`` plane bit-identical to
+``dissemination.exchange_phase`` (same RNG stream, same group/loss
+masking, bitwise-OR accumulation — order-free) while keeping the packet
+plane node-sharded: each chip streams only its N/P block per round and
+only packet words ride the interconnect (fact words + stamps travel AS
+the packed exchange; no replicated-plane rewrites).
 
-This module is the ring-attention-style alternative (SURVEY.md §5's
-"where ring-attention-style SPMD decomposition would go"): under
-``shard_map``, each device keeps only its N/D-sized packet block and the
-blocks rotate around the ring with ``lax.ppermute``, one hop per step.
-At hop h device d holds the block of shard (d − h) mod D; each node
-resolves the sampled sources that live in the visiting block.  After D
-hops every source has been resolved — **bit-identical to the all-gather
-round** (same sampled sources, same merge), with peak memory N/D×W per
-chip and D point-to-point neighbor transfers riding the ICI ring instead
-of one global collective.
+Two ICI schedules, selectable per config (``ClusterConfig.
+exchange_schedule``) and settled analytically in
+``accounting.ici_round_traffic`` — the CPU virtual mesh measures
+collective *schedule shape* (dispatch count, materialization), not ICI
+bandwidth, so MULTICHIP_AB.json's CPU timings are not dispositive:
 
-Use when the packet plane dominates HBM or the all-gather dominates the
-round; the parity test pins bit-equality against ``round_step``.
+- ``"ring"``: the packet blocks rotate around the device ring with
+  ``lax.ppermute``, one neighbor hop per step; each hop resolves the
+  rows the visiting block can serve.  D-1 hops ship (D-1)×block bytes
+  per chip — the same wire total as the all-gather — but peak HBM stays
+  at 2 blocks and each hop's transfer overlaps the previous hop's
+  resolve (ring-attention-style SPMD, SURVEY.md §5).
+- ``"allgather"``: one explicit ``lax.all_gather`` of the packet plane,
+  then local contiguous slices (rotation) or a local gather (iid).  One
+  collective dispatch, but the full N×W plane materializes on every
+  chip.
+
+Both sampling modes are covered: ``rotation`` (the production flagship —
+every peer read is a contiguous roll, assembled under the ring schedule
+from at most two visiting-block slices per offset, still no random
+gather) and ``iid`` (the data-dependent gather mode the original
+ring-vs-allgather A/B measured).
+
+Edge cases: a mesh whose size does not divide ``n`` falls back to the
+unsharded ``exchange_phase`` (GSPMD lowers it over whatever sharding the
+operands carry — bit-identical, just not schedule-authored) and records
+a ``shard-fallback`` flight event; a 1-device mesh degenerates to the
+local resolve with no collective.
 """
 
 from __future__ import annotations
@@ -36,130 +54,243 @@ from jax.sharding import PartitionSpec as P
 from serf_tpu.models.dissemination import (
     GossipConfig,
     GossipState,
-    bump_last_learn,
-    clamp_stamps,
-    learn_stamp_pass,
-    select_words,
+    exchange_phase,
+    rolled_rows,
+    round_step,
+    sample_offsets,
 )
 from serf_tpu.parallel.mesh import NODE_AXIS
 
+#: the legal ICI schedules (ClusterConfig.exchange_schedule validates
+#: against this; accounting.ici_round_traffic models both)
+EXCHANGE_SCHEDULES = ("ring", "allgather")
 
-def _ring_gather(packets_local: jnp.ndarray, srcs_local: jnp.ndarray,
-                 n_local: int, n_devices: int) -> jnp.ndarray:
-    """Inside shard_map: resolve global source indices by rotating packet
-    blocks around the ring.
 
-    packets_local: u32[Nl, W] — this shard's packet block
-    srcs_local:    i32[Nl, F] — global source ids sampled by local nodes
-    returns:       u32[Nl, W] — bitwise-OR of the packets of all sources
-    """
-    me = jax.lax.axis_index(NODE_AXIS)
+def _ring_scan(pk, grp, resolve, n_devices):
+    """Shared D-hop ring driver: rotate (packets, group) blocks one
+    neighbor per hop, resolving the visiting block each hop.  The final
+    visiting block is resolved in place — a D-th rotation would ship a
+    block nobody reads."""
+    acc0 = jnp.zeros_like(pk)
+    if n_devices == 1:
+        return resolve(pk, grp, 0, acc0)
     perm = [(d, (d + 1) % n_devices) for d in range(n_devices)]
 
-    def resolve(visiting, h, acc):
-        visiting_shard = (me - h) % n_devices
-        mask = (srcs_local // n_local) == visiting_shard      # bool[Nl, F]
-        idx = srcs_local % n_local                            # i32[Nl, F]
-        got = visiting[idx]                                   # u32[Nl, F, W]
-        got = jnp.where(mask[:, :, None], got, jnp.uint32(0))
-        return acc | jax.lax.reduce(got, jnp.uint32(0),
-                                    jnp.bitwise_or, (1,))     # u32[Nl, W]
-
     def hop(carry, h):
-        visiting, acc = carry
-        acc = resolve(visiting, h, acc)
-        # rotate: my block moves to the next device; I receive the previous
-        visiting = jax.lax.ppermute(visiting, NODE_AXIS, perm)
-        return (visiting, acc), ()
+        vis_pk, vis_grp, acc = carry
+        acc = resolve(vis_pk, vis_grp, h, acc)
+        vis_pk = jax.lax.ppermute(vis_pk, NODE_AXIS, perm)
+        if vis_grp is not None:
+            vis_grp = jax.lax.ppermute(vis_grp, NODE_AXIS, perm)
+        return (vis_pk, vis_grp, acc), ()
 
-    acc0 = jnp.zeros_like(packets_local)
-    if n_devices == 1:
-        return resolve(packets_local, 0, acc0)
-    # D-1 rotations suffice: the last visiting block is resolved in place
-    # (a final rotation would ship a block nobody reads)
-    (visiting, acc), _ = jax.lax.scan(hop, (packets_local, acc0),
-                                      jnp.arange(n_devices - 1))
-    return resolve(visiting, n_devices - 1, acc)
+    (vis_pk, vis_grp, acc), _ = jax.lax.scan(
+        hop, (pk, grp, acc0), jnp.arange(n_devices - 1))
+    return resolve(vis_pk, vis_grp, n_devices - 1, acc)
 
 
-def round_step_ring(state: GossipState, cfg: GossipConfig, key: jax.Array,
-                    mesh, group=None) -> GossipState:
-    """One gossip round with the ring-pipelined exchange.
+def _rotation_ring_leg(pk, offs, grp, lost, *, n, n_local, n_devices,
+                       fanout):
+    """Rotation sampling over the ring schedule (inside shard_map).
 
-    Bit-identical to ``round_step(state, cfg, key, group)`` for the same
-    inputs (same RNG stream → same sampled sources, same Lamport merge);
-    only the collective schedule differs.  Requires ``cfg.n`` divisible by
-    the mesh size.
+    Each fanout offset's rolled read ``packets[(i + off) % n]`` is, for
+    this chip's receivers, a contiguous circular range of global rows —
+    it intersects each visiting block in at most one run, and
+    ``rolled_rows(visiting, off % n_local)`` lays both possible runs
+    (the tail of shard ``s0 = start//n_local`` and the head of shard
+    ``s0+1``) at exactly the right local positions.  So the assembly is
+    concat + contiguous dynamic slices per hop — no random gather, the
+    same property the rotation mode exists for.
     """
-    n, k, w = cfg.n, cfg.k_facts, cfg.words
-    n_devices = mesh.shape[NODE_AXIS]
-    if n % n_devices != 0:
-        raise ValueError(f"n={n} not divisible by mesh size {n_devices}")
-    n_local = n // n_devices
+    me = jax.lax.axis_index(NODE_AXIS)
+    gstart = me * n_local
+    j = jnp.arange(n_local, dtype=jnp.int32)
 
-    # phases 1+2 exactly as round_step (elementwise; GSPMD shards freely),
-    # including the cached selection when the sendable plane is valid
-    # (AND `known` — stale cache bits for retired slots, see
-    # GossipState.sendable_round)
-    if cfg.use_sendable_cache:
-        packets = jax.lax.cond(
-            state.sendable_round == state.round,
-            lambda s: jnp.where(s.alive[:, None],
-                                s.sendable & s.known, jnp.uint32(0)),
-            lambda s: select_words(s, cfg),
-            state)
+    def resolve(vis_pk, vis_grp, h, acc):
+        s = (me - h) % n_devices
+        dbl_pk = jnp.concatenate([vis_pk, vis_pk], axis=0)
+        dbl_grp = (jnp.concatenate([vis_grp, vis_grp], axis=0)
+                   if vis_grp is not None else None)
+        for f in range(fanout):
+            start = (gstart + offs[f]) % n
+            r = start % n_local
+            s0 = start // n_local
+            rolled = rolled_rows(vis_pk, r, doubled=dbl_pk)
+            # receivers j < n_local - r read shard s0's tail; the rest
+            # read shard (s0+1)'s head.  Both conjuncts apply when D=1.
+            sel = (((s == s0) & (j < n_local - r))
+                   | ((s == (s0 + 1) % n_devices) & (j >= n_local - r)))
+            if vis_grp is not None:
+                sel = sel & (rolled_rows(vis_grp, r, doubled=dbl_grp)
+                             == grp)
+            if lost is not None:
+                sel = sel & ~lost[f]
+            acc = acc | jnp.where(sel[:, None], rolled, jnp.uint32(0))
+        return acc
+
+    return _ring_scan(pk, grp, resolve, n_devices)
+
+
+def _rotation_allgather_leg(pk, offs, grp, lost, *, n, n_local, fanout):
+    """Rotation sampling over the all-gather schedule: one collective,
+    then the fanout rolled reads are local contiguous slices of the
+    (doubled) gathered plane."""
+    me = jax.lax.axis_index(NODE_AXIS)
+    gstart = me * n_local
+    full = jax.lax.all_gather(pk, NODE_AXIS, tiled=True)        # u32[N, W]
+    dbl = jnp.concatenate([full, full], axis=0)
+    dbl_grp = None
+    if grp is not None:
+        fgrp = jax.lax.all_gather(grp, NODE_AXIS, tiled=True)
+        dbl_grp = jnp.concatenate([fgrp, fgrp], axis=0)
+    acc = jnp.zeros_like(pk)
+    for f in range(fanout):
+        start = (gstart + offs[f]) % n
+        contrib = jax.lax.dynamic_slice_in_dim(dbl, start, n_local, axis=0)
+        sel = None
+        if grp is not None:
+            peer_grp = jax.lax.dynamic_slice_in_dim(dbl_grp, start,
+                                                    n_local, axis=0)
+            sel = peer_grp == grp
+        if lost is not None:
+            sel = ~lost[f] if sel is None else (sel & ~lost[f])
+        if sel is not None:
+            contrib = jnp.where(sel[:, None], contrib, jnp.uint32(0))
+        acc = acc | contrib
+    return acc
+
+
+def _iid_ring_leg(pk, srcs, grp, lost, *, n_local, n_devices):
+    """iid sampling over the ring schedule: rotate blocks; each hop, the
+    sampled sources living in the visiting block resolve by local
+    gather (u32[Nl, F, W] masked OR-reduce)."""
+    me = jax.lax.axis_index(NODE_AXIS)
+
+    def resolve(vis_pk, vis_grp, h, acc):
+        s = (me - h) % n_devices
+        here = (srcs // n_local) == s                 # bool[Nl, F]
+        idx = srcs % n_local                          # i32[Nl, F]
+        got = vis_pk[idx]                             # u32[Nl, F, W]
+        ok = here
+        if vis_grp is not None:
+            ok = ok & (vis_grp[idx] == grp[:, None])
+        if lost is not None:
+            ok = ok & ~lost
+        got = jnp.where(ok[:, :, None], got, jnp.uint32(0))
+        return acc | jax.lax.reduce(got, jnp.uint32(0),
+                                    jnp.bitwise_or, (1,))
+
+    return _ring_scan(pk, grp, resolve, n_devices)
+
+
+def _iid_allgather_leg(pk, srcs, grp, lost):
+    """iid sampling over the all-gather schedule: materialize the plane,
+    gather the sampled sources locally, mask, OR-reduce."""
+    full = jax.lax.all_gather(pk, NODE_AXIS, tiled=True)        # u32[N, W]
+    got = full[srcs]                                  # u32[Nl, F, W]
+    ok = None
+    if grp is not None:
+        fgrp = jax.lax.all_gather(grp, NODE_AXIS, tiled=True)
+        ok = fgrp[srcs] == grp[:, None]
+    if lost is not None:
+        ok = ~lost if ok is None else (ok & ~lost)
+    if ok is not None:
+        got = jnp.where(ok[:, :, None], got, jnp.uint32(0))
+    return jax.lax.reduce(got, jnp.uint32(0), jnp.bitwise_or, (1,))
+
+
+def exchange_sharded(packets: jnp.ndarray, cfg: GossipConfig,
+                     key: jax.Array, group=None, drop_rate=None, *,
+                     mesh, schedule: str = "ring") -> jnp.ndarray:
+    """The sharded exchange leg — a drop-in for
+    ``dissemination.exchange_phase`` (``round_step``'s ``exchange``
+    hook) that is bit-identical for the same ``key``: the RNG splits,
+    sample shapes, and mask semantics mirror ``exchange_phase`` line
+    for line, and bitwise-OR accumulation is order-free, so only the
+    collective schedule differs."""
+    if schedule not in EXCHANGE_SCHEDULES:
+        raise ValueError(f"unknown exchange schedule {schedule!r} "
+                         f"(one of {EXCHANGE_SCHEDULES})")
+    n = packets.shape[0]
+    d = mesh.shape[NODE_AXIS]
+    if n % d != 0:
+        # graceful N-not-divisible-by-P: GSPMD lowers the unsharded
+        # exchange over whatever sharding the operands carry —
+        # bit-identical, just not schedule-authored.  Recorded loud so
+        # an 8-chip deployment that silently lost its authored schedule
+        # is visible in the flight recorder.
+        from serf_tpu import obs
+        obs.record("shard-fallback", op="exchange_sharded", n=n,
+                   devices=d, reason="n % devices != 0; GSPMD lowering")
+        return exchange_phase(packets, cfg, key, group=group,
+                              drop_rate=drop_rate)
+    n_local = n // d
+    if drop_rate is not None:
+        key, k_drop = jax.random.split(key)
+    rotation = cfg.peer_sampling == "rotation"
+    if rotation:
+        sample = sample_offsets(key, cfg.fanout, n)             # i32[F]
+        lost = (jax.random.bernoulli(k_drop, drop_rate, (cfg.fanout, n))
+                if drop_rate is not None else None)
+        sample_spec, lost_spec = P(), P(None, NODE_AXIS)
     else:
-        packets = select_words(state, cfg)                    # u32[N, W]
+        sample = jax.random.randint(key, (n, cfg.fanout), 0, n)
+        lost = (jax.random.bernoulli(k_drop, drop_rate, (n, cfg.fanout))
+                if drop_rate is not None else None)
+        sample_spec, lost_spec = P(NODE_AXIS, None), P(NODE_AXIS, None)
 
-    srcs = jax.random.randint(key, (n, cfg.fanout), 0, n)     # i32[N, F]
+    operands = [packets, sample]
+    specs = [P(NODE_AXIS, None), sample_spec]
     if group is not None:
-        # Partition mask, evaluated on the sampler side so the ring kernel
-        # stays a pure gather: disallowed cross-group samples are
-        # substituted with SELF.  Parity-safe: a node's sending bits are
-        # always a subset of its known bits (budgets only exist for known
-        # facts), so OR-ing its own packets into `incoming` changes no
-        # merge outcome — exactly like round_step's zeroing.
-        allowed = group[srcs] == group[:, None]               # bool[N, F]
-        srcs = jnp.where(allowed, srcs, jnp.arange(n)[:, None])
-    exchange = shard_map(
-        functools.partial(_ring_gather, n_local=n_local,
-                          n_devices=n_devices),
-        mesh=mesh,
-        in_specs=(P(NODE_AXIS, None), P(NODE_AXIS, None)),
-        out_specs=P(NODE_AXIS, None),
-    )
-    incoming = exchange(packets, srcs)
+        operands.append(group)
+        specs.append(P(NODE_AXIS))
+    if lost is not None:
+        operands.append(lost)
+        specs.append(lost_spec)
+    has_group, has_lost = group is not None, lost is not None
 
-    alive_col = state.alive[:, None]
-    new_words = incoming & ~state.known & jnp.where(
-        alive_col, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
-    known = state.known | new_words
-    learned_any = jnp.any(new_words != 0)
+    def leg(pk, sample, *rest):
+        grp = rest[0] if has_group else None
+        lo = rest[1 if has_group else 0] if has_lost else None
+        if rotation and schedule == "ring":
+            return _rotation_ring_leg(pk, sample, grp, lo, n=n,
+                                      n_local=n_local, n_devices=d,
+                                      fanout=cfg.fanout)
+        if rotation:
+            return _rotation_allgather_leg(pk, sample, grp, lo, n=n,
+                                           n_local=n_local,
+                                           fanout=cfg.fanout)
+        if schedule == "ring":
+            return _iid_ring_leg(pk, sample, grp, lo, n_local=n_local,
+                                 n_devices=d)
+        return _iid_allgather_leg(pk, sample, grp, lo)
 
-    # stamp learn pass gated on learned_any exactly as round_step phase 5
-    # (bit-exact identity when skipped), with the sendable-cache
-    # recompute riding the same pass — keeps the ring bit-identical to
-    # the all-gather round INCLUDING the cache, so the ring schedule
-    # gets the same cached-selection saving (without this the ring leg
-    # of any A/B pays the full stamp-plane selection read every round)
-    def stamp_learns(_):
-        # THE shared learn/clamp/cache pass (dissemination.
-        # learn_stamp_pass) — one definition keeps the ring leg
-        # bit-identical to round_step's merge by construction
-        stamp2, send2, sr2 = learn_stamp_pass(
-            state.stamp, known, new_words, state.round + 1, cfg,
-            state.sendable)
-        return stamp2, send2, sr2, jnp.asarray(state.round + 1, jnp.int32)
+    ex = shard_map(leg, mesh=mesh, in_specs=tuple(specs),
+                   out_specs=P(NODE_AXIS, None))
+    return ex(*operands)
 
-    stamp, sendable, sendable_round, last_clamp = jax.lax.cond(
-        learned_any, stamp_learns,
-        lambda _: (state.stamp, state.sendable, state.sendable_round,
-                   state.last_clamp),
-        None)
-    stamp, last_clamp = clamp_stamps(stamp, state.round + 1, last_clamp,
-                                     cfg)
-    last_learn = bump_last_learn(learned_any, state.round + 1,
-                                 state.last_learn)
-    return state._replace(known=known, stamp=stamp, last_learn=last_learn,
-                          sendable=sendable, sendable_round=sendable_round,
-                          last_clamp=last_clamp, round=state.round + 1)
+
+def sharded_round_step(state: GossipState, cfg: GossipConfig,
+                       key: jax.Array, mesh, schedule: str = "ring",
+                       group=None, drop_rate=None) -> GossipState:
+    """One gossip round with the explicit sharded exchange — bit-exact
+    with ``round_step(state, cfg, key, group, drop_rate)`` by
+    construction: it IS ``round_step`` (same select/merge/quiet-gate/
+    cache/clamp code, one copy) with only the exchange leg swapped for
+    :func:`exchange_sharded`."""
+    if cfg.use_pallas:
+        # the pallas select/merge kernels are single-device (a
+        # pallas_call grid over the full N axis is not GSPMD-
+        # partitionable — ops/round_kernels.pallas_ok); fall back to the
+        # XLA phases on the sharded path, loudly
+        import dataclasses
+
+        from serf_tpu import obs
+        obs.record("pallas-fallback", op="sharded_round_step", n=cfg.n,
+                   reason="pallas kernels are single-device; sharded "
+                          "round uses the XLA phases")
+        cfg = dataclasses.replace(cfg, use_pallas=False)
+    return round_step(state, cfg, key, group=group, drop_rate=drop_rate,
+                      exchange=functools.partial(exchange_sharded,
+                                                 mesh=mesh,
+                                                 schedule=schedule))

@@ -76,6 +76,33 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
             f"baseline ({TIER1_TIMEOUT_S:.0f}s timeout)")
 
 
+@pytest.fixture(scope="session")
+def vmesh8():
+    """The shard tests' 8-virtual-device CPU mesh (ISSUE 6 CI satellite).
+
+    The device count is PROCESS-GLOBAL: ``xla_force_host_platform_
+    device_count=8`` is set at the top of this conftest, before the
+    first jax import, for the WHOLE tier-1 process — it cannot be
+    toggled per test, and this fixture deliberately does not try (a
+    mid-session flag flip would silently not take).  The fixture is the
+    one sanctioned handle: it hands out the ``Mesh`` when the 8 devices
+    actually materialized and skips (rather than mysteriously failing
+    in shard_map) when some other harness launched the suite without
+    the flag.  Unsharded tests are unaffected either way — a CPU
+    "device" here is a thread-backed virtual device, and single-device
+    jit never touches the other seven.
+    """
+    import jax
+
+    from serf_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("virtual 8-device CPU mesh not provisioned "
+                    "(xla_force_host_platform_device_count must be set "
+                    "before the first jax import)")
+    return make_mesh(8)
+
+
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem):
     """Minimal async-test support (pytest-asyncio is not in the image)."""

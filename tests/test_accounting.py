@@ -9,6 +9,7 @@ import pytest
 
 from serf_tpu.models.accounting import (
     hlo_bytes_per_round,
+    ici_round_traffic,
     round_traffic,
 )
 from serf_tpu.models.swim import (
@@ -72,6 +73,41 @@ def test_regime_ordering_matches_gate_design():
     # the gated regime — the 8-chip shard is where the target lives
     assert round_traffic(cfg, regime="sustained").ceiling_rounds_per_sec() < 10_000
     assert round_traffic(cfg, regime="quiescent").ceiling_rounds_per_sec() > 10_000
+
+
+def test_ici_per_phase_per_chip_attribution():
+    """ISSUE 6 acceptance: ici_round_traffic reports per-phase per-chip
+    bytes for BOTH explicit exchange schedules, the per-phase HBM sums
+    to the sustained model split D ways, and the α-β schedule decision
+    lands where the arithmetic says it must (ring at flagship scale —
+    the all-gather's full-plane HBM round-trip dominates; allgather at
+    small blocks — launch latency dominates)."""
+    cfg = flagship_config(1_000_000)
+    d = 8
+    m = ici_round_traffic(cfg, d)
+    phases = m["per_phase_per_chip"]
+    for name in ("selection", "exchange", "merge", "inject", "probe",
+                 "push_pull", "vivaldi"):
+        assert name in phases, name
+        assert phases[name]["hbm_bytes_per_chip"] > 0
+    ex = phases["exchange"]
+    # both schedules ship the same wire bytes: (D-1) x the local block
+    block = cfg.gossip.n * cfg.gossip.words * 4 / d
+    assert ex["ici_bytes_per_chip_ring"] == (d - 1) * block
+    assert ex["ici_bytes_per_chip_allgather"] == (d - 1) * block
+    # ...but peak HBM differs by ~D/2x: that asymmetry IS the decision
+    assert ex["peak_hbm_bytes_allgather"] > 4 * ex["peak_hbm_bytes_ring"]
+    # per-phase HBM attribution closes against the sustained model
+    total = sum(p["hbm_bytes_per_chip"] for p in phases.values())
+    model = round_traffic(cfg, regime="sustained").total_bytes / d
+    assert abs(total - model) / model < 1e-6
+    # the schedule decision: ring at 1M, allgather at small n
+    assert m["schedule"]["recommended"] == "ring"
+    assert ici_round_traffic(flagship_config(8192), d)[
+        "schedule"]["recommended"] == "allgather"
+    # the 8-chip implied ceiling clears the 10k target with margin —
+    # the whole reason the sharded path is the flagship (ROADMAP 1)
+    assert m["implied_sustained_ceiling_rps"] > 2 * 10_000
 
 
 def test_hlo_cross_check_small_n():

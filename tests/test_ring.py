@@ -1,6 +1,10 @@
-"""Ring-pipelined gossip exchange (parallel/ring.py): bit-parity with the
-all-gather round on the virtual 8-device mesh, partition masking, and
-convergence."""
+"""The flagship sharded exchange (parallel/ring.py): bit-parity with the
+unsharded round on the virtual 8-device mesh for BOTH explicit ICI
+schedules, partition masking, loss masking, convergence, and the
+N-not-divisible-by-P fallback.  (Rotation sampling — the production
+flagship — is covered at cluster level in tests/test_sharded_round.py;
+this file pins the iid mode, where the exchange is a data-dependent
+gather.)"""
 
 import functools
 
@@ -18,8 +22,8 @@ from serf_tpu.models.dissemination import (
     round_step,
     unpack_bits,
 )
-from serf_tpu.parallel.mesh import make_mesh, shard_state, state_shardings
-from serf_tpu.parallel.ring import round_step_ring
+from serf_tpu.parallel.mesh import shard_state
+from serf_tpu.parallel.ring import sharded_round_step
 
 
 def _seeded(cfg, n_facts=4):
@@ -31,59 +35,125 @@ def _seeded(cfg, n_facts=4):
     return s
 
 
-def test_ring_round_bit_identical_to_all_gather():
-    cfg = GossipConfig(n=512, k_facts=32, fanout=3)
-    mesh = make_mesh(8)
-    base = _seeded(cfg)
-    ring = jax.jit(functools.partial(round_step_ring, cfg=cfg, mesh=mesh))
-    ref = jax.jit(functools.partial(round_step, cfg=cfg))
-    a, b = shard_state(base, mesh), base
+def _mixed_round_kwargs(i, group):
+    """The per-round mask mix every parity variant drives: plain,
+    partitioned, lossy — cycling so one trajectory covers all three."""
+    if i % 3 == 1:
+        return dict(group=group)
+    if i % 3 == 2:
+        return dict(drop_rate=jnp.float32(0.25))
+    return {}
+
+
+def _parity_cfg(sampling):
+    return GossipConfig(n=512, k_facts=32, fanout=3,
+                        peer_sampling=sampling)
+
+
+def _ref_trajectory(sampling):
+    """Unsharded reference, ONE compile per sampling mode (memoized —
+    both schedules and the P=1 variant compare against it)."""
+    cache = _ref_trajectory.__dict__.setdefault("cache", {})
+    if sampling not in cache:
+        cfg = _parity_cfg(sampling)
+        ref = jax.jit(functools.partial(round_step, cfg=cfg))
+        b = _seeded(cfg)
+        group = make_partition(cfg.n, 0.5)
+        key = jax.random.key(0)
+        for i in range(12):
+            key, k2 = jax.random.split(key)
+            b = ref(b, key=k2, **_mixed_round_kwargs(i, group))
+        cache[sampling] = b
+    return cache[sampling]
+
+
+@pytest.mark.parametrize("sampling,schedule,n_devices", [
+    ("iid", "ring", 8),
+    ("iid", "allgather", 8),
+    ("rotation", "ring", 8),
+    ("rotation", "allgather", 8),
+    ("rotation", "ring", 1),          # P=1: degenerate shard, no collective
+])
+def test_sharded_round_bit_identical(vmesh8, sampling, schedule,
+                                     n_devices):
+    """Every (sampling mode × explicit schedule) leg produces the same
+    state as the unsharded round — same RNG stream, same merge —
+    including under partition and loss masks (mixed in across the
+    rounds) and on the degenerate 1-device mesh."""
+    from serf_tpu.parallel.mesh import make_mesh
+
+    mesh = vmesh8 if n_devices == 8 else make_mesh(1)
+    cfg = _parity_cfg(sampling)
+    group = make_partition(cfg.n, 0.5)
+    sh = jax.jit(functools.partial(sharded_round_step, cfg=cfg,
+                                   mesh=mesh, schedule=schedule))
+    a = shard_state(_seeded(cfg), mesh)
     key = jax.random.key(0)
-    for _ in range(15):
+    for i in range(12):
         key, k2 = jax.random.split(key)
-        a = ring(a, key=k2)
-        b = ref(b, key=k2)
-    for name in ("known", "stamp", "round"):
+        a = sh(a, key=k2, **_mixed_round_kwargs(i, group))
+    b = _ref_trajectory(sampling)
+    for name in ("known", "stamp", "round", "sendable", "sendable_round",
+                 "last_learn", "last_clamp"):
         assert bool(jnp.all(getattr(a, name) == getattr(b, name))), name
 
 
-def test_ring_round_respects_partition():
-    cfg = GossipConfig(n=256, k_facts=32, fanout=3)
-    mesh = make_mesh(8)
+def test_sharded_round_respects_partition(vmesh8):
+    cfg = GossipConfig(n=256, k_facts=32, fanout=3, peer_sampling="iid")
     group = make_partition(cfg.n, 0.5)
     s = make_state(cfg)
     s = inject_fact(s, cfg, 0, K_USER_EVENT, 0, 1, 0)             # side 0
     s = inject_fact(s, cfg, 1, K_USER_EVENT, 0, 2, cfg.n - 1)     # side 1
-    ring = jax.jit(functools.partial(round_step_ring, cfg=cfg, mesh=mesh))
-    ref = jax.jit(functools.partial(round_step, cfg=cfg))
-    a, b = shard_state(s, mesh), s
+    sh = jax.jit(functools.partial(sharded_round_step, cfg=cfg,
+                                   mesh=vmesh8, schedule="ring"))
+    a = shard_state(s, vmesh8)
     key = jax.random.key(1)
     for _ in range(30):
         key, k2 = jax.random.split(key)
-        a = ring(a, key=k2, group=group)
-        b = ref(b, key=k2, group=group)
-    assert bool(jnp.all(a.known == b.known))
+        a = sh(a, key=k2, group=group)
     known = unpack_bits(a.known, cfg.k_facts)
     half = cfg.n // 2
     assert bool(jnp.all(known[:half, 0])) and not bool(jnp.any(known[half:, 0]))
     assert bool(jnp.all(known[half:, 1])) and not bool(jnp.any(known[:half, 1]))
 
 
-def test_ring_round_converges_standalone():
-    cfg = GossipConfig(n=1024, k_facts=32, fanout=3)
-    mesh = make_mesh(8)
+def test_sharded_round_converges_standalone(vmesh8):
+    cfg = GossipConfig(n=1024, k_facts=32, fanout=3, peer_sampling="iid")
     s = shard_state(inject_fact(make_state(cfg), cfg, 0, K_USER_EVENT,
-                                0, 1, 0), mesh)
-    ring = jax.jit(functools.partial(round_step_ring, cfg=cfg, mesh=mesh))
+                                0, 1, 0), vmesh8)
+    sh = jax.jit(functools.partial(sharded_round_step, cfg=cfg,
+                                   mesh=vmesh8, schedule="ring"))
     key = jax.random.key(2)
     for _ in range(30):
         key, k2 = jax.random.split(key)
-        s = ring(s, key=k2)
+        s = sh(s, key=k2)
     assert float(coverage(s, cfg)[0]) == 1.0
 
 
-def test_ring_round_rejects_indivisible_n():
-    cfg = GossipConfig(n=100, k_facts=32)
-    mesh = make_mesh(8)
-    with pytest.raises(ValueError):
-        round_step_ring(make_state(cfg), cfg, jax.random.key(0), mesh)
+def test_indivisible_n_falls_back_bit_exact(vmesh8):
+    """n % P != 0 must not crash OR change results: the exchange falls
+    back to the GSPMD-lowered unsharded leg (recorded as a
+    ``shard-fallback`` flight event) and stays bit-identical."""
+    from serf_tpu import obs
+
+    cfg = GossipConfig(n=100, k_facts=32, fanout=3, peer_sampling="iid")
+    base = _seeded(cfg)
+    sh = jax.jit(functools.partial(sharded_round_step, cfg=cfg,
+                                   mesh=vmesh8, schedule="ring"))
+    ref = jax.jit(functools.partial(round_step, cfg=cfg))
+    a, b = base, base
+    key = jax.random.key(3)
+    for _ in range(8):
+        key, k2 = jax.random.split(key)
+        a, b = sh(a, key=k2), ref(b, key=k2)
+    assert bool(jnp.all(a.known == b.known))
+    assert bool(jnp.all(a.stamp == b.stamp))
+    assert obs.flight_dump(kind="shard-fallback"), \
+        "fallback must be recorded, not silent"
+
+
+def test_unknown_schedule_rejected(vmesh8):
+    cfg = GossipConfig(n=256, k_facts=32)
+    with pytest.raises(ValueError, match="schedule"):
+        sharded_round_step(make_state(cfg), cfg, jax.random.key(0),
+                           vmesh8, schedule="butterfly")

@@ -1,0 +1,232 @@
+"""The sharded flagship ``cluster_round`` (ISSUE 6 acceptance): bit-exact
+vs the single-device round at small N for BOTH stamp flavors and BOTH
+explicit ICI schedules; N-not-divisible-by-P and P=1 edge cases; the
+sharded checkpoint round-trip; an existing named chaos plan green on the
+sharded path; the roundprof ``--mesh`` smoke (≥90% byte attribution
+preserved); and the sharding-spec coverage of the post-PR5 pytree.
+
+Budget discipline: every variant is small and jitted once; the heavy
+redundant parametrizations ride ``-m slow``.
+"""
+
+import functools
+import json
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    K_USER_EVENT,
+    coverage,
+    inject_fact,
+)
+from serf_tpu.models.failure import FailureConfig, believed_dead
+from serf_tpu.models.swim import (
+    ClusterConfig,
+    make_cluster,
+    run_cluster_sustained,
+)
+from serf_tpu.parallel.mesh import (
+    best_device_count,
+    make_mesh,
+    shard_state,
+    state_shardings,
+)
+
+
+def _cfg(n=256, pack=True, schedule="ring"):
+    return ClusterConfig(
+        gossip=GossipConfig(n=n, k_facts=32, peer_sampling="rotation",
+                            pack_stamp=pack),
+        failure=FailureConfig(suspicion_rounds=8, max_new_facts=8,
+                              probe_schedule="round_robin"),
+        push_pull_every=8, probe_every=2, exchange_schedule=schedule)
+
+
+def _seeded(cfg):
+    st = make_cluster(cfg, jax.random.key(0))
+    g = inject_fact(st.gossip, cfg.gossip, subject=3, kind=K_USER_EVENT,
+                    incarnation=0, ltime=5, origin=0)
+    # two silent crashes so detection outcomes are part of the parity
+    g = g._replace(alive=g.alive.at[jnp.asarray([7, cfg.n // 2])]
+                   .set(False))
+    return st._replace(gossip=g)
+
+
+def _assert_cluster_equal(s8, s1, cfg):
+    for name in ("known", "stamp", "alive", "tombstone", "round",
+                 "incarnation", "next_slot", "overflow", "injected"):
+        assert bool(jnp.all(getattr(s8.gossip, name)
+                            == getattr(s1.gossip, name))), name
+    # membership views / coverage trajectory / detection outcomes
+    assert bool(jnp.all(coverage(s8.gossip, cfg.gossip)
+                        == coverage(s1.gossip, cfg.gossip)))
+    assert bool(jnp.all(
+        believed_dead(s8.gossip, cfg.gossip, cfg.failure)
+        == believed_dead(s1.gossip, cfg.gossip, cfg.failure)))
+    assert bool(jnp.all(s8.vivaldi.vec == s1.vivaldi.vec))
+
+
+def _ref_cluster(pack, n=128, rounds=16):
+    """Single-device reference trajectory, memoized per stamp flavor —
+    the ICI schedule cannot affect the unsharded round, so one compile
+    serves both schedule variants."""
+    cache = _ref_cluster.__dict__.setdefault("cache", {})
+    if pack not in cache:
+        cfg = _cfg(n=n, pack=pack)
+        run_1 = jax.jit(functools.partial(run_cluster_sustained, cfg=cfg,
+                                          events_per_round=2),
+                        static_argnames=("num_rounds",))
+        cache[pack] = run_1(_seeded(cfg), key=jax.random.key(2),
+                            num_rounds=rounds)
+    return cache[pack]
+
+
+def _run_sharded(cfg, mesh, rounds=16):
+    divisible = cfg.n % mesh.size == 0
+    run_m = jax.jit(functools.partial(run_cluster_sustained, cfg=cfg,
+                                      events_per_round=2, mesh=mesh),
+                    static_argnames=("num_rounds",),
+                    out_shardings=state_shardings(_seeded(cfg), mesh)
+                    if divisible else None)
+    st = _seeded(cfg)
+    st_m = shard_state(st, mesh) if divisible else st
+    return run_m(st_m, key=jax.random.key(2), num_rounds=rounds)
+
+
+# tier-1 covers the flavor axis at CLUSTER level (both stamp flavors —
+# the acceptance bar) on the flagship ring schedule; the allgather
+# crosses are redundant at this level (both schedules are pinned
+# bit-exact at round level in tests/test_ring.py, and the cluster path
+# only threads the schedule string through) and ride -m slow.  The
+# unsharded reference is compiled once per flavor (schedule-
+# independent).
+@pytest.mark.parametrize("pack,schedule", [
+    (True, "ring"),
+    (False, "ring"),
+    pytest.param(True, "allgather", marks=pytest.mark.slow),
+    pytest.param(False, "allgather", marks=pytest.mark.slow),
+])
+def test_sharded_cluster_round_bit_exact(vmesh8, pack, schedule):
+    """Sharded (8 virtual devices) vs single-device cluster_round under
+    sustained load: identical membership views, coverage trajectories,
+    and detection outcomes — for both stamp flavors and both explicit
+    ICI schedules."""
+    cfg = _cfg(n=128, pack=pack, schedule=schedule)
+    s8 = _run_sharded(cfg, vmesh8)
+    _assert_cluster_equal(s8, _ref_cluster(pack), cfg)
+
+
+@pytest.mark.slow
+def test_sharded_cluster_round_indivisible_n(vmesh8):
+    """n=100 on an 8-device mesh: the exchange falls back (GSPMD
+    lowering) and the FULL round stays bit-exact — no crash, no drift.
+    Redundant at cluster level (the fallback decision + parity + flight
+    event are pinned at round level in tests/test_ring.py, which is the
+    code that makes the choice), so it rides -m slow; the P=1 degenerate
+    mesh is likewise pinned at round level."""
+    cfg = _cfg(n=100)
+    s8 = _run_sharded(cfg, vmesh8, rounds=10)
+    run_1 = jax.jit(functools.partial(run_cluster_sustained, cfg=cfg,
+                                      events_per_round=2),
+                    static_argnames=("num_rounds",))
+    s1 = run_1(_seeded(cfg), key=jax.random.key(2), num_rounds=10)
+    _assert_cluster_equal(s8, s1, cfg)
+
+
+def test_checkpoint_sharded_round_trip(vmesh8):
+    """Gather on save, re-shard on load: a sharded state round-trips
+    bit-exactly and comes back with the node sharding applied.  The
+    state checkpointed is the (already advanced, already sharded)
+    bit-exactness reference — no extra scan compile."""
+    from serf_tpu.models import checkpoint
+
+    cfg = _cfg(n=128)
+    st = shard_state(_ref_cluster(True), vmesh8)
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "shard.npz")
+        checkpoint.save(p, st)
+        back = checkpoint.restore(p, make_cluster(cfg, jax.random.key(0)),
+                                  mesh=vmesh8)
+        for a, b in zip(jax.tree_util.tree_leaves(back),
+                        jax.tree_util.tree_leaves(st)):
+            assert bool(jnp.all(a == b))
+        # the restored state is actually node-sharded on the mesh
+        assert back.gossip.known.sharding.spec[0] == "nodes"
+
+        # device-count mismatch fails CLOSED with a clear error (128 is
+        # not divisible by 6), never an XLA shape crash
+        with pytest.raises(ValueError, match="device-count mismatch"):
+            checkpoint.restore(p, make_cluster(cfg, jax.random.key(0)),
+                               mesh=make_mesh(6))
+
+
+def test_device_chaos_plan_green_on_sharded_path(vmesh8):
+    """An existing named FaultPlan runs on the sharded flagship round
+    with every invariant green (ISSUE 6 acceptance; tools/chaos.py
+    --plane device reaches the same path via --devices)."""
+    from serf_tpu.faults.device import run_device_plan
+    from serf_tpu.faults.plan import named_plan
+
+    cfg = ClusterConfig(
+        gossip=GossipConfig(n=64, k_facts=32, peer_sampling="rotation"),
+        failure=FailureConfig(suspicion_rounds=8, max_new_facts=8,
+                              probe_schedule="round_robin"),
+        push_pull_every=8)
+    result = run_device_plan(named_plan("self-check"), cfg, mesh=vmesh8)
+    assert result.report.ok, result.report.format()
+
+
+def test_roundprof_mesh_smoke(vmesh8, capsys):
+    """tools/roundprof.py --mesh: the sharded per-phase profile honors
+    the JSON contract, labels the mesh, and keeps the ≥90% byte
+    attribution self-check on the sharded path."""
+    import tools.roundprof as roundprof
+
+    rc = roundprof.main(["--n", "256", "--calls", "1", "--warm", "2",
+                         "--mesh", "8", "--schedule", "ring", "--json"])
+    assert rc == 0
+    prof = json.loads(capsys.readouterr().out)
+    assert prof["devices"] == 8 and prof["schedule"] == "ring"
+    assert [r["phase"] for r in prof["phases"]] == [
+        "inject", "selection", "exchange", "merge", "probe", "refute",
+        "declare", "push_pull", "vivaldi"]
+    frac = prof["attributed_bytes_frac"]
+    assert frac is not None and frac >= 0.9, (
+        f"sharded profile attributes only {frac} of the round's bytes")
+
+
+def test_state_shardings_cover_post_pr5_pytree(vmesh8):
+    """The sharding specs must cover the FULL GossipState: K-sized ring
+    planes (slot_round) and scalars (overflow ledger) replicated,
+    per-node planes node-sharded, and the chaos-mask schedule's [P, N]
+    planes sharded on their second axis."""
+    from serf_tpu.faults.device import lower_plan
+    from serf_tpu.faults.plan import named_plan
+
+    cfg = _cfg(n=128)
+    st = _seeded(cfg)
+    sh = state_shardings(st, vmesh8)
+    assert sh.gossip.slot_round.spec == jax.sharding.PartitionSpec()
+    assert sh.gossip.overflow.spec == jax.sharding.PartitionSpec()
+    assert sh.gossip.known.spec[0] == "nodes"
+    assert sh.gossip.stamp.spec[0] == "nodes"
+    assert sh.positions.spec[0] == "nodes"
+    assert sh.group.spec[0] == "nodes"
+
+    sched = lower_plan(named_plan("self-check"), n=128)
+    ssh = state_shardings(sched, vmesh8)
+    assert ssh.group.spec == jax.sharding.PartitionSpec(None, "nodes")
+    assert ssh.down.spec == jax.sharding.PartitionSpec(None, "nodes")
+    assert ssh.drop.spec == jax.sharding.PartitionSpec()
+
+
+def test_best_device_count():
+    assert best_device_count(1_000_000, 8) == 8
+    assert best_device_count(100, 8) == 5
+    assert best_device_count(97, 8) == 1      # prime: unsharded
+    assert best_device_count(8, 16) == 8

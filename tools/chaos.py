@@ -32,7 +32,7 @@ def run_host(plan):
         return asyncio.run(run_host_plan(plan, tmp_dir=td))
 
 
-def run_device(plan, n: int, k_facts: int):
+def run_device(plan, n: int, k_facts: int, devices: int = 0):
     from serf_tpu.faults.device import run_device_plan
     from serf_tpu.models.dissemination import GossipConfig
     from serf_tpu.models.failure import FailureConfig
@@ -44,7 +44,32 @@ def run_device(plan, n: int, k_facts: int):
         failure=FailureConfig(suspicion_rounds=8, max_new_facts=8,
                               probe_schedule="round_robin"),
         push_pull_every=8)
-    return run_device_plan(plan, cfg)
+    # sharded flagship path: 0 = auto (largest visible device count that
+    # divides n — a single-device host simply runs unsharded), 1 = force
+    # unsharded, >1 = exactly that many devices (fail loud rather than
+    # silently truncating — the report must never claim a mesh size
+    # that did not run)
+    mesh = None
+    d = devices
+    if d != 1:
+        import jax
+
+        from serf_tpu.parallel.mesh import best_device_count, make_mesh
+        visible = len(jax.devices())
+        if d == 0:
+            d = best_device_count(n, visible)
+        elif d > visible:
+            raise SystemExit(
+                f"--devices {d} exceeds the {visible} visible device(s) "
+                f"(set XLA_FLAGS=--xla_force_host_platform_device_count="
+                f"{d} for a virtual CPU mesh)")
+        elif n % d != 0:
+            raise SystemExit(
+                f"--devices {d} does not divide --n {n}; pick a dividing "
+                f"count (auto would use {best_device_count(n, visible)})")
+        if d > 1:
+            mesh = make_mesh(d)
+    return run_device_plan(plan, cfg, mesh=mesh), (d if mesh else 1)
 
 
 def main() -> int:
@@ -55,6 +80,10 @@ def main() -> int:
     ap.add_argument("--n", type=int, default=256,
                     help="device-plane simulated node count")
     ap.add_argument("--k-facts", type=int, default=32)
+    ap.add_argument("--devices", type=int, default=0,
+                    help="device-plane mesh size for the sharded "
+                         "flagship round (0 = auto: largest visible "
+                         "device count dividing --n; 1 = unsharded)")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--self-check", action="store_true",
                     help="run the tiny self-check plan on both planes")
@@ -67,7 +96,12 @@ def main() -> int:
         plan_name, planes = "self-check", ("host", "device")
         # the self-check is a tier-1 hook: keep the device side small
         # (compile time dominates; one phase-scan compile at modest n)
+        # and UNSHARDED unless asked — the sharded chaos path has its
+        # own tier-1 pin (tests/test_sharded_round.py) and the auto
+        # mesh would grow this hook's compile on the 8-device harness
         args.n = min(args.n, 96)
+        if args.devices == 0:
+            args.devices = 1
     else:
         plan_name = args.plan
         planes = ("host", "device") if args.plane == "both" \
@@ -82,13 +116,15 @@ def main() -> int:
     reports = []
     notes = []
     overload = {}
+    device_mesh = 1
     for plane in planes:
         if plane == "host":
             result = run_host(plan)
             if result.load is not None:
                 overload["host"] = result.load.to_dict()
         else:
-            result = run_device(plan, args.n, args.k_facts)
+            result, device_mesh = run_device(plan, args.n, args.k_facts,
+                                             args.devices)
             notes.extend(result.notes)
             if plan.has_load():
                 overload["device"] = {"offered": result.offered,
@@ -104,10 +140,15 @@ def main() -> int:
             "degradation_counters": counters,
             "lowering_notes": notes,
             "overload": overload,
+            "device_mesh_devices": device_mesh,
         }, indent=1, sort_keys=True))
     else:
         for r in reports:
             print(r.format())
+        if "device" in planes:
+            print(f"device mesh: {device_mesh} device(s)"
+                  + (" (sharded flagship round)" if device_mesh > 1
+                     else ""))
         if notes:
             print("lowering notes: " + "; ".join(notes))
         if overload:

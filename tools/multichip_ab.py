@@ -1,11 +1,14 @@
 """Ring-vs-allgather crossover sweep on a virtual 8-device mesh.
 
-VERDICT r4 next-3: scale the A/B across n ∈ {1k, 16k, 128k} per device
-(× 8 devices) and find where the ppermute ring overtakes the GSPMD
-all-gather for the iid-sampling exchange.  CPU-mesh timings quantify the
-collective SCHEDULE (dispatch count, materialization, overlap shape) —
-not ICI bandwidth; the bandwidth arithmetic lives in
-``accounting.ici_round_traffic`` and STATUS.md.
+A THIN config loop over the flagship sharded round (ISSUE 6: there is
+exactly ONE sharded round in the tree — ``parallel.ring.
+sharded_round_step``, the same code ``cluster_round`` runs with a mesh);
+the A/B is just ``schedule="allgather"`` vs ``schedule="ring"`` on the
+same jitted step.  CPU-mesh timings quantify the collective SCHEDULE
+(dispatch count, materialization, overlap shape) — not ICI bandwidth, so
+``ring_wins: false`` here is expected and NOT dispositive; the decision
+of record is ``accounting.ici_round_traffic``'s α-β arithmetic
+(``schedule.recommended``), which this sweep embeds per row.
 
 Writes MULTICHIP_AB.json at the repo root and prints the table.
 
@@ -48,11 +51,10 @@ def main() -> None:
         K_USER_EVENT,
         inject_fact,
         make_state,
-        round_step,
     )
     from serf_tpu.models.swim import flagship_config
     from serf_tpu.parallel.mesh import make_mesh, shard_state, state_shardings
-    from serf_tpu.parallel.ring import round_step_ring
+    from serf_tpu.parallel.ring import sharded_round_step
 
     d = args.devices
     mesh = make_mesh(d)
@@ -60,8 +62,8 @@ def main() -> None:
     for n_local in args.per_device:
         n = n_local * d
         # iid sampling: the mode where the exchange is a data-dependent
-        # gather — GSPMD lowers it to an all-gather of the packet plane;
-        # the ring resolves it in D-1 ppermute hops
+        # gather — the all-gather schedule materializes the packet plane;
+        # the ring schedule resolves it in D-1 ppermute hops
         cfg = GossipConfig(n=n, k_facts=64, peer_sampling="iid")
         g = make_state(cfg)
         for i in range(8):
@@ -71,10 +73,13 @@ def main() -> None:
         g = shard_state(g, mesh)
         sh = state_shardings(g, mesh)
 
-        ag = jax.jit(lambda s, key: round_step(s, cfg, key),
-                     out_shardings=sh)
-        ring = jax.jit(functools.partial(round_step_ring, cfg=cfg,
-                                         mesh=mesh))
+        # the thin config loop: same flagship step, two schedules
+        steps = {
+            sched: jax.jit(functools.partial(sharded_round_step, cfg=cfg,
+                                             mesh=mesh, schedule=sched),
+                           out_shardings=sh)
+            for sched in ("allgather", "ring")
+        }
 
         def rps(stepfn, g0):
             g1 = stepfn(g0, key=jax.random.key(1))     # compile + warm
@@ -86,7 +91,7 @@ def main() -> None:
             int(np.asarray(gg.round))                  # completion barrier
             return args.reps / (time.perf_counter() - t0)
 
-        ag_rps, ring_rps = rps(ag, g), rps(ring, g)
+        ag_rps, ring_rps = rps(steps["allgather"], g), rps(steps["ring"], g)
         model = ici_round_traffic(flagship_config(n), d)
         row = {
             "n": n, "n_per_device": n_local,
@@ -97,11 +102,15 @@ def main() -> None:
                 model["iid_allgather_bytes_per_chip"],
             "model_ring_bytes_per_chip":
                 model["iid_ring_bytes_per_chip"],
+            # the decision of record (ICI α-β arithmetic, not CPU wall)
+            "model_schedule_recommended": model["schedule"]["recommended"],
         }
         results.append(row)
         print(f"n={n:>8} ({n_local}/dev): allgather {ag_rps:8.1f} rps, "
               f"ring {ring_rps:8.1f} rps -> "
-              f"{'RING' if row['ring_wins'] else 'ALLGATHER'} wins",
+              f"{'RING' if row['ring_wins'] else 'ALLGATHER'} wins on "
+              f"CPU wall; model recommends "
+              f"{row['model_schedule_recommended'].upper()}",
               flush=True)
 
     crossover = next((r["n"] for r in results if r["ring_wins"]), None)
@@ -109,8 +118,10 @@ def main() -> None:
         "devices": d, "reps": args.reps, "results": results,
         "crossover_n": crossover,
         "note": "CPU virtual mesh: collective schedule shape, not ICI "
-                "bandwidth; bandwidth arithmetic in "
-                "accounting.ici_round_traffic / STATUS.md",
+                "bandwidth — ring_wins on CPU is NOT dispositive; the "
+                "decision of record is accounting.ici_round_traffic's "
+                "schedule.recommended (per-phase per-chip bytes + α-β "
+                "launch model); see STATUS.md",
         "ici_model_1m_8chip": ici_round_traffic(flagship_config(1_000_000),
                                                 8),
     }
@@ -118,7 +129,7 @@ def main() -> None:
         os.path.abspath(__file__))), "MULTICHIP_AB.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
-    print(f"wrote {path}; crossover at n={crossover}")
+    print(f"wrote {path}; CPU-wall crossover at n={crossover}")
 
 
 if __name__ == "__main__":

@@ -43,6 +43,13 @@ def main(argv=None) -> int:
                     help="timed steady calls per phase")
     ap.add_argument("--warm", type=int, default=24,
                     help="sustained warmup rounds before profiling")
+    ap.add_argument("--mesh", type=int, default=0,
+                    help="profile the SHARDED flagship path on this "
+                         "many devices (0 = unsharded; the count must "
+                         "divide --n and be <= the visible devices)")
+    ap.add_argument("--schedule", choices=("ring", "allgather"),
+                    default="ring",
+                    help="ICI schedule of the sharded exchange leg")
     ap.add_argument("--json", action="store_true",
                     help="print the JSON contract on stdout")
     args = ap.parse_args(argv)
@@ -50,9 +57,29 @@ def main(argv=None) -> int:
     from serf_tpu.models.swim import flagship_config
     from serf_tpu.obs.profile import profile_round, profile_table
 
+    mesh = None
+    if args.mesh:
+        import jax
+
+        from serf_tpu.parallel.mesh import make_mesh
+        if args.mesh > len(jax.devices()):
+            sys.stderr.write(
+                f"--mesh {args.mesh} exceeds the {len(jax.devices())} "
+                "visible device(s)\n")
+            return 2
+        if args.n % args.mesh != 0:
+            # the sharded profile's per-chip byte columns assume exactly
+            # N/P per chip; an indivisible N would silently profile the
+            # GSPMD fallback while claiming the authored schedule
+            sys.stderr.write(
+                f"--mesh {args.mesh} does not divide --n {args.n}\n")
+            return 2
+        mesh = make_mesh(args.mesh)
+
     cfg = flagship_config(args.n, k_facts=args.k)
     prof = profile_round(cfg, events_per_round=args.events,
-                         timed_calls=args.calls, warm_rounds=args.warm)
+                         timed_calls=args.calls, warm_rounds=args.warm,
+                         mesh=mesh, schedule=args.schedule)
     sys.stderr.write(profile_table(prof) + "\n")
     if args.json:
         print(json.dumps(prof))
