@@ -169,7 +169,11 @@ def main() -> None:
         run_cluster,
         run_cluster_sustained,
     )
-    from serf_tpu.obs.device import dispatch_summary, reset_dispatch_registry
+    from serf_tpu.obs.device import (
+        dispatch_summary,
+        dispatch_timer,
+        reset_dispatch_registry,
+    )
 
     reset_dispatch_registry()
 
@@ -313,6 +317,70 @@ def main() -> None:
         sharded = {"error": repr(e)[:300]}
         detail["sharded"] = sharded
 
+    # --- fused-vs-phased pallas A/B (ISSUE 7): the fused cache-
+    # maintaining kernel family vs the standalone (phased) kernels, same
+    # seeds, same sustained-load config.  On the CPU fallback the
+    # kernels run in interpret mode at a bounded N (override with
+    # SERF_TPU_BENCH_FUSED_N) — that measures kernel-DISPATCH shape, not
+    # HBM; the analytic kernel-path model embedded beside it carries the
+    # TPU claim (same convention as the sharded section's ICI model).
+    try:
+        from serf_tpu.models.accounting import kernel_path_summary
+        fused_n = int(os.environ.get(
+            "SERF_TPU_BENCH_FUSED_N",
+            min(N_NODES, 4096) if on_cpu else N_NODES))
+        summary = kernel_path_summary(cfg, sustained_rate=EVENTS_PER_ROUND)
+        fused_ab = {
+            "n": fused_n,
+            "interpret_mode": on_cpu,
+            # the analytic kernel-path comparison @ headline N (the
+            # number STATUS.md re-pins): fused removes the selection's
+            # full stamp-plane pass vs the phased kernels
+            "model_n": N_NODES,
+            "model": {
+                "bytes_per_round": {
+                    p: round(v["total_bytes"], 1)
+                    for p, v in summary["paths"].items()},
+                "stamp_passes": {
+                    p: v["passes_by_plane"].get("stamp")
+                    for p, v in summary["paths"].items()},
+                "fused_vs_kernels": summary["fused_vs_kernels"],
+            },
+        }
+        ab_rounds = 5 if on_cpu else 50
+        base_ab = flagship_config(fused_n, k_facts=K_FACTS)
+        from serf_tpu.models.dissemination import pallas_dispatch_mode
+        for name, fused in (("phased", False), ("fused", True)):
+            cfg_ab = dataclasses.replace(
+                base_ab, gossip=dataclasses.replace(
+                    base_ab.gossip, use_pallas=True, fused_kernels=fused))
+            # breadcrumb: what each flavor ACTUALLY dispatched — a shape
+            # rejection (e.g. a SERF_TPU_BENCH_FUSED_N override) falls
+            # back to XLA and would otherwise masquerade as a kernel A/B
+            mode, _ = pallas_dispatch_mode(cfg_ab.gossip)
+            fused_ab[f"{name}_kernel_path"] = mode or "xla"
+            run_ab = jax.jit(
+                functools.partial(run_cluster_sustained, cfg=cfg_ab,
+                                  events_per_round=EVENTS_PER_ROUND),
+                static_argnames=("num_rounds",))
+            st = seeded_state(cfg_ab)
+            with dispatch_timer(f"bench.fused_ab.{name}",
+                                signature=ab_rounds):
+                st = run_ab(st, key=jax.random.key(3),
+                            num_rounds=ab_rounds)
+                int(jnp.asarray(st.gossip.round))  # barrier (compile)
+            t0 = time.time()
+            st = run_ab(st, key=jax.random.key(4), num_rounds=ab_rounds)
+            int(jnp.asarray(st.gossip.round))      # barrier (steady)
+            fused_ab[f"{name}_rps"] = round(ab_rounds / (time.time() - t0),
+                                            2)
+        fused_ab["fused_over_phased"] = round(
+            fused_ab["fused_rps"] / max(fused_ab["phased_rps"], 1e-9), 3)
+        detail["fused_ab"] = fused_ab
+    except Exception as e:  # noqa: BLE001 - never lose the headline to it
+        fused_ab = {"error": repr(e)[:300]}
+        detail["fused_ab"] = fused_ab
+
     # sanity: injection genuinely ran every round (the gate never closed)
     # and dissemination made real progress (facts spreading, ring live)
     g = sus_state.gossip
@@ -344,6 +412,9 @@ def main() -> None:
         # the flagship sharded path (N/P per chip, packets-only ICI) —
         # where the 10k target lives; full numbers in BENCH_DETAIL.json
         "sharded": sharded,
+        # fused-vs-phased pallas kernel A/B (same seeds/config) + the
+        # analytic kernel-path model; full numbers in BENCH_DETAIL.json
+        "fused_ab": fused_ab,
     }), flush=True)
 
     # --- secondary: quiescent steady state + detection-hot active window --

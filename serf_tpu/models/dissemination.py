@@ -39,7 +39,7 @@ a liveness mask (SURVEY.md §7 "hard parts").
 from __future__ import annotations
 
 import dataclasses
-from typing import NamedTuple
+from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -223,7 +223,14 @@ class GossipConfig:
     k_facts: int = 64           # fact-table capacity (ring)
     fanout: int = 3             # gossip_nodes
     retransmit_mult: int = 4    # transmit budget = mult * ceil(log10(n+1))
-    use_pallas: bool = False    # fused Pallas kernels for phases 1+3
+    use_pallas: bool = False    # Pallas kernels for phases 1+3
+    #: with ``use_pallas``: dispatch the FUSED kernel family (ops.
+    #: fused_select_cached / ops.fused_merge — cache-maintaining, one
+    #: streaming pass per plane per round, shard_map-ready, bit-exact
+    #: with the XLA path on every GossipState leaf).  False keeps the
+    #: PR-3 standalone kernels (cache-invalidating, single-device) — the
+    #: A/B flavor and escape hatch the bench measures against.
+    fused_kernels: bool = True
     #: "iid": every node samples uniform peers each round — the direct
     #: analog of memberlist's random gossip targets, but each sample is a
     #: random-index gather/scatter, which XLA lowers to a SERIAL loop on
@@ -943,36 +950,93 @@ def pick_bounded(candidates: jnp.ndarray, max_events: int, key: jax.Array):
 
 # -- the gossip round kernel -------------------------------------------------
 
-def _use_pallas(cfg: GossipConfig) -> bool:
-    """Trace-time pallas gate; an unsupported shape records a flight
-    event (obs) instead of silently falling back."""
+def pallas_dispatch_mode(cfg: GossipConfig,
+                         n_devices: int = 0) -> Tuple[str, str]:
+    """THE pallas dispatch decision, pure (no recording — the
+    profiler's path labeling uses it too): ``("", reason)`` for XLA,
+    ``("kernels", "")`` for the PR-3 standalone family, or
+    ``("fused", "")`` for the cache-maintaining fused family — the only
+    one that runs under shard_map on the sharded flagship path.
+    ``n_devices=0`` means unsharded (no mesh); ``>=1`` means sharded
+    over that many chips (a 1-device mesh is still the shard_map
+    path)."""
     if not cfg.use_pallas:
-        return False
+        return "", "use_pallas off"
     from serf_tpu.ops import round_kernels
-    if round_kernels.pallas_ok(cfg.n, cfg.k_facts):
-        return True
-    from serf_tpu import obs
-    obs.record("pallas-fallback", op="round_step", n=cfg.n,
-               k=cfg.k_facts, reason="pallas_ok rejected shape")
-    return False
+    if not cfg.fused_kernels:
+        if n_devices == 0 and round_kernels.pallas_ok(cfg.n, cfg.k_facts):
+            return "kernels", ""
+        return "", ("standalone kernels are single-device; use "
+                    "fused_kernels for the sharded path"
+                    if n_devices else "pallas_ok rejected shape")
+    d = max(1, n_devices)
+    if cfg.n % d != 0:
+        return "", f"n % devices != 0 (n={cfg.n}, devices={d})"
+    ok, reason = round_kernels.fused_ok(cfg.n // d, cfg.k_facts,
+                                        cfg.stamp_cols)
+    return ("fused", "") if ok else ("", reason)
 
 
-def select_phase(state: GossipState, cfg: GossipConfig) -> jnp.ndarray:
+def _pallas_mode(cfg: GossipConfig, mesh=None, op: str = "round_step",
+                 record: bool = True) -> str:
+    """Trace-time dispatch wrapper around :func:`pallas_dispatch_mode`.
+    A rejection of a ``use_pallas`` config is LOUD when ``record``: a
+    ``pallas-fallback`` flight event with the reason plus a
+    ``serf.pallas.fused_fallback`` counter bump — once per round trace
+    (only the selection phase records; the merge passes
+    ``record=False``)."""
+    n_devices = 0
+    if mesh is not None:
+        from serf_tpu.parallel.mesh import NODE_AXIS
+        n_devices = mesh.shape[NODE_AXIS]
+    mode, reason = pallas_dispatch_mode(cfg, n_devices)
+    if mode or not cfg.use_pallas:
+        return mode
+    if record:
+        from serf_tpu import obs
+        from serf_tpu.utils import metrics
+        obs.record("pallas-fallback", op=op, n=cfg.n, k=cfg.k_facts,
+                   reason=reason)
+        metrics.incr("serf.pallas.fused_fallback", 1, {"op": op})
+    return ""
+
+
+def select_phase(state: GossipState, cfg: GossipConfig,
+                 mesh=None) -> jnp.ndarray:
     """Phase 1 — packet selection: u32[N, W] of sending bits.
 
     Cached path: ``sendable & known`` under the alive mask — the AND
     with ``known`` is what masks stale cache bits for retired ring slots
     (see GossipState.sendable_round), trading an N×W read here for the
     inject path's second full-plane retirement pass.  Falls back to the
-    stamp-plane recompute when the cache is stale; the pallas flavor is
-    a fused single pass that never touches the cache."""
-    if _use_pallas(cfg):
+    stamp-plane recompute when the cache is stale.
+
+    Pallas flavors: the FUSED family honors the cache exactly like the
+    XLA path (the fused merge maintains it, so the valid branch is a
+    word-plane-only kernel — no stamp read; this is the full-plane pass
+    the fusion removes); the standalone family never trusts the cache
+    (its merge invalidates) and always runs the stamp recompute
+    kernel."""
+    mode = _pallas_mode(cfg, mesh)
+    if mode:
         from serf_tpu.ops import round_kernels
-        return round_kernels.select_packets(
-            state.stamp, state.known,
-            state.alive[:, None].astype(jnp.uint8),
-            cfg.transmit_limit_q, state.round, packed=cfg.pack_stamp,
-            k_facts=cfg.k_facts)
+
+        def recompute(s):
+            return round_kernels.select_packets(
+                s.stamp, s.known, s.alive[:, None].astype(jnp.uint8),
+                cfg.transmit_limit_q, s.round, packed=cfg.pack_stamp,
+                k_facts=cfg.k_facts, mesh=mesh)
+
+        if mode == "fused" and cfg.use_sendable_cache:
+            return jax.lax.cond(
+                state.sendable_round == state.round,
+                lambda s: round_kernels.fused_select_cached(
+                    s.sendable, s.known,
+                    s.alive[:, None].astype(jnp.uint8),
+                    k_facts=cfg.k_facts, stamp_cols=cfg.stamp_cols,
+                    mesh=mesh),
+                recompute, state)
+        return recompute(state)
     if cfg.use_sendable_cache:
         return jax.lax.cond(
             state.sendable_round == state.round,
@@ -1077,7 +1141,7 @@ def learn_stamp_pass(stamp: jnp.ndarray, known: jnp.ndarray,
 
 
 def merge_phase(state: GossipState, incoming: jnp.ndarray,
-                cfg: GossipConfig) -> GossipState:
+                cfg: GossipConfig, mesh=None) -> GossipState:
     """Phases 4+5 — Lamport merge + the stamp learn pass.
 
     Learn facts we did not know (dead learn nothing), then the round's
@@ -1095,13 +1159,47 @@ def merge_phase(state: GossipState, incoming: jnp.ndarray,
     sendable-cache recompute for round+1 (expiry transitions included —
     the only place the cache's validity round advances).
 
+    The FUSED pallas flavor (``ops.fused_merge``) carries all three jobs
+    in one authored kernel pass and emits per-block learn flags; its
+    outputs are gated through the SAME ``learned_any`` cond as the XLA
+    path, so both paths are bit-exact on every leaf (stamp clamp timing
+    and cache validity included).  The standalone flavor keeps its PR-3
+    semantics: clamp every active round, cache invalidated.
+
     Does NOT increment ``state.round`` (the caller owns the round
     counter and the standalone clamp)."""
     k = cfg.k_facts
-    if _use_pallas(cfg):
+    mode = _pallas_mode(cfg, mesh, record=False)
+    if mode == "fused":
         from serf_tpu.ops import round_kernels
         alive_u8 = state.alive[:, None].astype(jnp.uint8)
-        # fused kernel: learn + stamp + inline clamp.  "learned
+        known, stamp2, sendable2, flags = round_kernels.fused_merge(
+            state.known, incoming, alive_u8, state.stamp,
+            state.round + 1, limit_q=cfg.transmit_limit_q,
+            packed=cfg.pack_stamp, k_facts=k,
+            with_cache=cfg.use_sendable_cache, mesh=mesh)
+        learned_any = jnp.any(flags != 0)
+        r1 = jnp.asarray(state.round + 1, jnp.int32)
+
+        def learned(_):
+            if cfg.use_sendable_cache:
+                return stamp2, sendable2, r1, r1
+            # learned without mirroring: mixed-flag hygiene (same as
+            # learn_stamp_pass's cache-off branch)
+            return stamp2, state.sendable, jnp.asarray(-1, jnp.int32), r1
+
+        # identical gating to the XLA path below: when nothing is
+        # learned the kernel's stamp/cache outputs are DISCARDED (the
+        # clamp must not advance last_clamp off-schedule) — known is
+        # bit-exact either way (no learns => known' == known)
+        stamp, sendable, sendable_round, last_clamp = jax.lax.cond(
+            learned_any, learned,
+            lambda _: (state.stamp, state.sendable,
+                       state.sendable_round, state.last_clamp), None)
+    elif mode == "kernels":
+        from serf_tpu.ops import round_kernels
+        alive_u8 = state.alive[:, None].astype(jnp.uint8)
+        # standalone kernel: learn + stamp + inline clamp.  "learned
         # anything" is definitional (output vs input known) so it can
         # never desync from the kernel's learn semantics.
         known, stamp = round_kernels.merge_incoming(
@@ -1110,7 +1208,7 @@ def merge_phase(state: GossipState, incoming: jnp.ndarray,
         learned_any = jnp.any(known != state.known)
         # the kernel learns without maintaining the cache — a later
         # cached selection on this state would miss those learns, so
-        # invalidate (the pallas path always selects from stamps)
+        # invalidate (this path always selects from stamps)
         sendable = state.sendable
         sendable_round = jnp.asarray(-1, jnp.int32)
         last_clamp = jnp.asarray(state.round + 1, jnp.int32)
@@ -1141,7 +1239,7 @@ def merge_phase(state: GossipState, incoming: jnp.ndarray,
 
 def round_step(state: GossipState, cfg: GossipConfig,
                key: jax.Array, group=None, drop_rate=None,
-               exchange=None) -> GossipState:
+               exchange=None, mesh=None) -> GossipState:
     """One gossip round: select packets, pull-exchange, Lamport-merge
     (the :func:`select_phase`/:func:`exchange_phase`/:func:`merge_phase`
     composition — the profiler jits the same phases in isolation,
@@ -1169,13 +1267,18 @@ def round_step(state: GossipState, cfg: GossipConfig,
     runs the leg under shard_map with an explicit ICI schedule).  One
     copy of everything around the leg is what keeps the sharded round
     bit-exact with this one by construction.
+
+    ``mesh`` (optional) tells the select/merge phases they are running
+    on node-sharded state so the FUSED pallas kernels can run under
+    shard_map per chip (the exchange leg stays whatever ``exchange``
+    says — the kernels never swallow the cross-chip leg).
     """
     def active(state):
-        packets = select_phase(state, cfg)
+        packets = select_phase(state, cfg, mesh=mesh)
         ex = exchange_phase if exchange is None else exchange
         incoming = ex(packets, cfg, key, group=group,
                       drop_rate=drop_rate)
-        st = merge_phase(state, incoming, cfg)
+        st = merge_phase(state, incoming, cfg, mesh=mesh)
         return (st.known, st.stamp, st.last_learn, st.sendable,
                 st.sendable_round, st.last_clamp)
 

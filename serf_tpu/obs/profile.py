@@ -31,6 +31,14 @@ mesh=, schedule=)`` / ``roundprof --mesh``): phases jit on node-sharded
 inputs, the exchange phase is the explicit ``parallel.ring`` leg, and —
 the compiled module being SPMD — every cost-analysis byte column reads
 per chip, with the ≥90% attribution self-check preserved.
+
+The profile self-identifies which KERNEL DISPATCH PATH it exercised
+(``kernel_path``: xla | kernels | fused — the pallas families of
+``ops/round_kernels.py``) and carries the byte model's amortized
+``full_plane_passes`` per plane for that path, which is how
+``tools/roundprof.py --fused`` shows the fused family streaming the
+packed stamp plane strictly fewer times per round than the phased
+kernels (the removed selection read).
 """
 
 from __future__ import annotations
@@ -51,6 +59,17 @@ def _sync(out) -> None:
 
     leaves = jax.tree_util.tree_leaves(out)
     np.asarray(jax.device_get(leaves[0]))
+
+
+def _kernel_path(cfg, mesh_devices: int) -> str:
+    """Which dispatch path (accounting.KERNEL_PATHS) this config runs on
+    — THE production decision (``dissemination.pallas_dispatch_mode``,
+    the pure half of ``_pallas_mode``), so the profile's path label and
+    byte model can never drift from what the phases actually dispatch.
+    ``mesh_devices=0`` = unsharded."""
+    from serf_tpu.models.dissemination import pallas_dispatch_mode
+
+    return pallas_dispatch_mode(cfg.gossip, mesh_devices)[0] or "xla"
 
 
 def _cost(compiled) -> Dict[str, float]:
@@ -133,9 +152,11 @@ def _phase_callables(state, cfg, events_per_round: int, mesh=None,
             ltimes=eids.astype(jnp.uint32), origins=origins,
             active=jnp.ones((m,), bool))
 
-    # phase inputs are materialized once so each phase is timed alone
+    # phase inputs are materialized once so each phase is timed alone;
+    # mesh threads into select/merge so the fused pallas kernels run
+    # under shard_map exactly as the production sharded round does
     packets = jax.jit(functools.partial(dissemination.select_phase,
-                                        cfg=gcfg))(g)
+                                        cfg=gcfg, mesh=mesh))(g)
     incoming = jax.jit(functools.partial(exchange_fn,
                                          cfg=gcfg))(packets, key=key)
     _sync(incoming)
@@ -143,12 +164,14 @@ def _phase_callables(state, cfg, events_per_round: int, mesh=None,
     phases = [
         ("inject", inject, (g,)),
         ("selection",
-         lambda g, key: dissemination.select_phase(g, gcfg), (g,)),
+         lambda g, key: dissemination.select_phase(g, gcfg, mesh=mesh),
+         (g,)),
         ("exchange",
          lambda p, key: exchange_fn(p, gcfg, key),
          (packets,)),
         ("merge",
-         lambda g, key: dissemination.merge_phase(g, incoming, gcfg),
+         lambda g, key: dissemination.merge_phase(g, incoming, gcfg,
+                                                  mesh=mesh),
          (g,)),
         ("probe",
          lambda g, key: failure.probe_round(g, gcfg, fcfg, key), (g,)),
@@ -205,11 +228,17 @@ def profile_round(cfg, events_per_round: int = 2, timed_calls: int = 3,
     state = _seeded_cluster(cfg, jax.random.key(0), events_per_round,
                             warm_rounds, mesh=mesh)
 
+    # which dispatch path this profile actually exercises (the fused
+    # pallas family, the standalone kernels, or plain XLA) — the pure
+    # production decision, no fallback side effects
+    kernel_path = _kernel_path(cfg, 0 if mesh is None else n_devices)
+
     # analytic model, per-OCCURRENCE bytes per phase (isolated phase
     # calls pay the full occurrence; the amortized column is what one
     # average round pays at the configured cadences)
     report = round_traffic(cfg, regime="sustained",
-                           sustained_rate=events_per_round)
+                           sustained_rate=events_per_round,
+                           path=kernel_path)
     model_occur: Dict[str, float] = {}
     model_amort: Dict[str, float] = {}
     for e in report.entries:
@@ -285,6 +314,13 @@ def profile_round(cfg, events_per_round: int = 2, timed_calls: int = 3,
         "events_per_round": events_per_round,
         "backend": jax.default_backend(),
         "pack_stamp": cfg.gossip.pack_stamp,
+        # which kernel dispatch path ran (accounting.KERNEL_PATHS) and
+        # the byte model's amortized full-plane streaming passes per
+        # round for it — the fused-vs-phased "removed pass" evidence
+        # (tools/roundprof.py --fused prints the delta)
+        "kernel_path": kernel_path,
+        "full_plane_passes": {p: round(v, 3)
+                              for p, v in report.passes_by_plane().items()},
         "hbm_bytes_per_s": hbm_bytes_per_s,
         # sharded flavor: >1 devices means every byte column is PER CHIP
         # (SPMD module) and the exchange ran the explicit schedule
@@ -324,7 +360,8 @@ def profile_table(profile: Dict[str, Any]) -> str:
     lines = [
         f"per-phase round profile: n={profile['n']} k={profile['k']} "
         f"backend={profile['backend']} regime={profile['regime']} "
-        f"pack_stamp={profile['pack_stamp']}" + shard,
+        f"pack_stamp={profile['pack_stamp']} "
+        f"path={profile.get('kernel_path', 'xla')}" + shard,
         f"{'phase':<10} {'wall ms':>9} {'XLA MB':>9} {'model MB':>9} "
         f"{'GB/s':>8} {'roofline':>9} {'excess':>7}",
     ]

@@ -1,2 +1,34 @@
-"""Device kernels: Pallas fused fast paths for the gossip round
-(``round_kernels``; enabled via ``GossipConfig.use_pallas``)."""
+"""Device kernels: Pallas fast paths for the gossip round.
+
+Two families (``round_kernels``): the standalone per-phase kernels
+(``select_packets``/``merge_incoming``) and the fused-round family
+(``fused_select_cached``/``fused_merge``) that maintains the sendable
+cache in-kernel and runs under shard_map on the sharded flagship path.
+Dispatch is selected by ``GossipConfig.use_pallas`` +
+``GossipConfig.fused_kernels`` and gated by ``fused_ok`` (shape + VMEM
+working-set estimate; rejections record a ``pallas-fallback`` flight
+event and bump the ``serf.pallas.fused_fallback`` counter).
+
+Kernel dispatch timers ride the shared obs compile-vs-steady split
+(``serf_tpu.obs.device.dispatch_timer``) under ``ops.*`` op names — a
+host wall clock, never an extra ``jax.device_get``; the bench's
+``dispatch`` section enumerates whatever ops registered, so there is no
+name list here to drift.
+"""
+
+from serf_tpu.ops.round_kernels import (
+    VMEM_BUDGET_BYTES,
+    fused_merge,
+    fused_ok,
+    fused_select_cached,
+    fused_vmem_bytes,
+    merge_incoming,
+    pallas_ok,
+    select_packets,
+)
+
+__all__ = [
+    "VMEM_BUDGET_BYTES", "fused_merge", "fused_ok",
+    "fused_select_cached", "fused_vmem_bytes", "merge_incoming",
+    "pallas_ok", "select_packets",
+]

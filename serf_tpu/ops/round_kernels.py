@@ -6,11 +6,32 @@ The round kernel (serf_tpu/models/dissemination.py) has three phases:
    alive`` into uint32 words (a fact's age derives from its 4-bit
    learn-quarter stamp — see ``GossipState``; nothing ticks),
 2. pull-exchange: peer read + OR-reduce (left to XLA — rolls/gathers are
-   already bandwidth-optimal and fuse with the RNG),
+   already bandwidth-optimal and fuse with the RNG; this is also the one
+   cross-chip leg, so it stays a separate hookable leg for the sharded
+   flagship, ``parallel.ring.exchange_sharded``),
 3. merge: learn new facts (bit ops over N×W), stamp them with the
    post-increment round's quarter, and re-pin wrap-stale stamps
    (``clamp_nibbles`` folded in — a fresh stamp is a fresh budget, and
    the standalone clamp pass never needs to fire after a merge).
+
+Two kernel families live here:
+
+- the PR-3 **standalone** kernels (``select_packets``/``merge_incoming``):
+  each phase fused into one pass, but the merge does NOT maintain the
+  sendable cache, so the pallas path used to invalidate it and every
+  selection re-read the full stamp plane.
+- the **fused-round** family (``fused_select_cached``/``fused_merge``,
+  this PR): the merge kernel recomputes the sendable cache for round+1
+  in the SAME streaming pass (the in-kernel analog of
+  ``dissemination.learn_stamp_pass``), so the next round's selection is
+  a word-plane-only kernel and the packed stamp plane is streamed
+  exactly ONCE per round (the merge's R+W) instead of once per phase.
+  Both kernels take an optional ``mesh`` and then run under
+  ``shard_map`` over the node axis — each chip streams its N/P block —
+  which is what lets the 8-chip sharded flagship round keep the pallas
+  fast path (the PR-6 round had to disable it).  Dispatch is gated by
+  :func:`fused_ok`: shape limits plus a VMEM working-set estimate so
+  big-K configs fall back loudly instead of OOMing.
 
 Phases 1 and 3 each touch the stamp plane (u8[N, K/2] nibble-packed by
 default, u8[N, K] for the unpacked A/B flavor) plus the N×W word plane;
@@ -22,7 +43,9 @@ nibbles' age predicates are evaluated per BYTE column and woven straight
 into u32 words (fact ``2c+p`` of byte ``c`` is bit ``2*(c%16)+p`` of
 word ``c//16``), so selection is pure word-plane arithmetic.  The XLA
 path in ``dissemination.py`` remains the semantic oracle; parity is
-pinned by tests (interpret mode on CPU, compiled on TPU).
+pinned by tests (interpret mode on CPU, compiled on TPU) — the fused
+family is BIT-EXACT with the XLA path on every GossipState leaf
+(tests/test_fused_round.py), cache included.
 
 Layout notes (pallas_guide.md): blocks are (BLOCK_N, C) uint8 / (BLOCK_N,
 W) uint32 in VMEM; scalars ride SMEM as (1, 1); iota is 2-D
@@ -55,19 +78,89 @@ def _block_for(n: int) -> int:
 
 
 def pallas_ok(n: int, k_facts: int) -> bool:
-    """Shapes the kernels support: a node block divides N, K is a multiple
-    of 32 (the word size — which also keeps the nibble-packed plane at a
-    whole number of 16-byte word groups).
-
-    SINGLE-DEVICE ONLY: a ``pallas_call`` grid over the full N axis is
-    not partitionable by GSPMD, so the sharded flagship round
-    (``cluster_round(..., mesh=)``) disables the pallas path at trace
-    time and records a ``pallas-fallback`` flight event
-    (``parallel.ring.sharded_round_step``) — re-enabling it there means
-    wrapping these kernels in shard_map over the node-block grid, which
-    is exactly how they are written (per-block bodies), but is left for
-    the fused-megakernel round (ROADMAP item 2)."""
+    """Shapes the STANDALONE kernels support: a node block divides N, K a
+    multiple of 32 (the word size — which also keeps the nibble-packed
+    plane at a whole number of 16-byte word groups).  Single-device only
+    (a ``pallas_call`` grid over the full N axis is not GSPMD-
+    partitionable); the fused family's :func:`fused_ok` supersedes this
+    with a VMEM working-set gate and shard_map support."""
     return _block_for(n) > 0 and k_facts % 32 == 0
+
+
+# ---------------------------------------------------------------------------
+# fused-family dispatch gate: shapes + VMEM working set
+# ---------------------------------------------------------------------------
+
+#: VMEM budget for one grid step's resident working set (v5e has ~16 MB
+#: of VMEM per core; leave headroom for Mosaic's own scratch and the
+#: compute intermediates the estimate cannot see)
+VMEM_BUDGET_BYTES = 12 << 20
+
+
+def fused_vmem_bytes(block_n: int, k_facts: int, stamp_cols: int) -> int:
+    """Worst-case VMEM resident set of one fused-merge grid step: the
+    known/incoming/known'/sendable' u32 blocks, the stamp block in and
+    out, and the alive column — times 2 for the double-buffered DMA
+    windows the pipelined grid keeps in flight.  The select kernels'
+    sets are strict subsets, so one estimate gates the family."""
+    w = k_facts // 32
+    per_row = 4 * 4 * w + 2 * stamp_cols + 1
+    return 2 * block_n * per_row
+
+
+def _fused_block(n: int, k_facts: int, stamp_cols: int) -> int:
+    """Largest node block dividing N whose fused working set fits the
+    VMEM budget (0 = none does)."""
+    if k_facts % 32 != 0:
+        return 0
+    for b in (512, 256, 128, 64, 32):
+        if n % b == 0 and fused_vmem_bytes(b, k_facts,
+                                           stamp_cols) <= VMEM_BUDGET_BYTES:
+            return b
+    return 0
+
+
+def fused_ok(n: int, k_facts: int, stamp_cols: int) -> Tuple[bool, str]:
+    """Can the fused kernel family run on an ``n``-row shard?  Returns
+    ``(ok, reason)`` — the reason string is what the loud fallback
+    (flight event + ``serf.pallas.fused_fallback`` counter) records, so
+    an operator can tell a shape rejection from a VMEM rejection.  On
+    the sharded path callers pass the PER-CHIP row count n/P."""
+    if k_facts % 32 != 0:
+        return False, f"k_facts {k_facts} not a multiple of 32"
+    if _block_for(n) == 0:
+        return False, f"no supported node block divides n={n}"
+    if _fused_block(n, k_facts, stamp_cols) == 0:
+        smallest = fused_vmem_bytes(32, k_facts, stamp_cols)
+        return False, (
+            f"VMEM working set {smallest >> 20} MiB at the smallest "
+            f"block exceeds the {VMEM_BUDGET_BYTES >> 20} MiB budget "
+            f"(k_facts={k_facts})")
+    return True, ""
+
+
+def _maybe_shard(fn, mesh, n_arrays: int, n_scalars: int,
+                 n_out: int = 1):
+    """Wrap ``fn(*scalars, *arrays) -> out`` in shard_map over the node
+    axis: scalar (1, 1) operands replicate, plane operands shard on axis
+    0, all ``n_out`` outputs shard on axis 0 (the only pattern the
+    kernel family produces — per-chip row blocks, flags included).
+    ``fn`` must build its pallas_call from the (then per-chip) array
+    shapes it receives.  ``mesh=None`` returns ``fn`` unchanged."""
+    if mesh is None:
+        return fn
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.5 jax exposes it under experimental
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from serf_tpu.parallel.mesh import NODE_AXIS
+    in_specs = (P(None, None),) * n_scalars + (P(NODE_AXIS, None),) * n_arrays
+    spec = P(NODE_AXIS, None)
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=(spec,) * n_out if n_out > 1 else spec,
+                     check_rep=False)
 
 
 def _unpack_words(words: jnp.ndarray, k: int) -> jnp.ndarray:
@@ -99,19 +192,15 @@ def _pack_bits(mask: jnp.ndarray, k: int) -> jnp.ndarray:
         jnp.concatenate(words, axis=1), jnp.uint32)
 
 
-def _nibble_pred_words(stamp_i32: jnp.ndarray, rq, limit_q,
-                       k: int) -> jnp.ndarray:
-    """(B, K/2) i32 packed-stamp bytes -> (B, W) u32 of per-fact
-    ``q-age < limit_q`` bits, without ever widening to K lanes: evaluate
-    both nibbles per byte column, then weave fact ``2c+p`` into bit
+def _weave_pair_words(ok_lo: jnp.ndarray, ok_hi: jnp.ndarray,
+                      k: int) -> jnp.ndarray:
+    """Per-nibble predicate bits (two (B, K/2) i32 0/1 arrays) -> (B, W)
+    u32 fact words: weave fact ``2c+p`` of byte column ``c`` into bit
     ``2*(c%16)+p`` of word ``c//16`` with a weighted i32 sum (each weight
-    used once per word — representable, never overflows)."""
-    c = stamp_i32.shape[1]
+    used once per word — representable, never overflows).  The in-kernel
+    twin of ``dissemination.pack_pred_words``."""
+    c = ok_lo.shape[1]
     w = k // 32
-    lo = stamp_i32 & 0xF
-    hi = (stamp_i32 >> 4) & 0xF
-    ok_lo = (((rq - lo) & 0xF) < limit_q).astype(jnp.int32)
-    ok_hi = (((rq - hi) & 0xF) < limit_q).astype(jnp.int32)
     bytepos = (jax.lax.broadcasted_iota(jnp.int32, (1, c), 1) % 16)
     weighted = (ok_lo * (jnp.int32(1) << (2 * bytepos))
                 + ok_hi * (jnp.int32(1) << (2 * bytepos + 1)))
@@ -121,6 +210,18 @@ def _nibble_pred_words(stamp_i32: jnp.ndarray, rq, limit_q,
                              keepdims=True, dtype=jnp.int32))
     return jax.lax.bitcast_convert_type(
         jnp.concatenate(words, axis=1), jnp.uint32)
+
+
+def _nibble_pred_words(stamp_i32: jnp.ndarray, rq, limit_q,
+                       k: int) -> jnp.ndarray:
+    """(B, K/2) i32 packed-stamp bytes -> (B, W) u32 of per-fact
+    ``q-age < limit_q`` bits, without ever widening to K lanes: evaluate
+    both nibbles per byte column, then weave (:func:`_weave_pair_words`)."""
+    lo = stamp_i32 & 0xF
+    hi = (stamp_i32 >> 4) & 0xF
+    ok_lo = (((rq - lo) & 0xF) < limit_q).astype(jnp.int32)
+    ok_hi = (((rq - hi) & 0xF) < limit_q).astype(jnp.int32)
+    return _weave_pair_words(ok_lo, ok_hi, k)
 
 
 def _learn_pairs(new_words: jnp.ndarray, c: int) -> Tuple[jnp.ndarray,
@@ -173,21 +274,31 @@ def _make_select_kernel(packed: bool, k: int):
 
 def select_packets(stamp: jnp.ndarray, known: jnp.ndarray,
                    alive_u8: jnp.ndarray, limit_q: int, round_, *,
-                   packed: bool, k_facts: int) -> jnp.ndarray:
+                   packed: bool, k_facts: int,
+                   mesh=None) -> jnp.ndarray:
     """packets u32[N,W]: one read-only pass over the stamp plane + known
-    words (q-ages derive from stamps; nothing is ticked anywhere)."""
+    words (q-ages derive from stamps; nothing is ticked anywhere).
+
+    With ``mesh`` the call runs under shard_map over the node axis (each
+    chip streams its N/P block) — the fused family's stale-cache branch
+    on the sharded flagship path."""
     n, c = stamp.shape
     k = k_facts
     w = k // 32
-    BLOCK_N = _block_for(n)
-    grid = (n // BLOCK_N,)
     from serf_tpu.models.dissemination import round_q
 
     limit_arr = jnp.asarray(limit_q, jnp.int32).reshape(1, 1)
     round_arr = round_q(round_).astype(jnp.int32).reshape(1, 1)
-    # host wall clock only: eager calls time a real dispatch (first call
-    # at a shape = compile), calls inside an outer jit time the trace
-    with dispatch_timer("ops.select_packets", signature=(n, k, packed)):
+
+    def call(limit_arr, round_arr, stamp, known, alive_u8):
+        nl = stamp.shape[0]                        # per-chip under mesh
+        # prefer the VMEM-gated block so fused_ok's budget governs the
+        # kernel actually dispatched (fused_ok guarantees it exists on
+        # every fused-path call, sharded or not); only the standalone
+        # path — gated by the VMEM-blind pallas_ok — may fall back to
+        # the shape-only block, its documented PR-3 status quo
+        block = _fused_block(nl, k, c) or _block_for(nl)
+        grid = (nl // block,)
         return pl.pallas_call(
             _make_select_kernel(packed, k),
             grid=grid,
@@ -196,18 +307,24 @@ def select_packets(stamp: jnp.ndarray, known: jnp.ndarray,
                              memory_space=pltpu.SMEM),
                 pl.BlockSpec((1, 1), lambda i: (0, 0),
                              memory_space=pltpu.SMEM),
-                pl.BlockSpec((BLOCK_N, c), lambda i: (i, 0),
+                pl.BlockSpec((block, c), lambda i: (i, 0),
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
+                pl.BlockSpec((block, w), lambda i: (i, 0),
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((BLOCK_N, 1), lambda i: (i, 0),
+                pl.BlockSpec((block, 1), lambda i: (i, 0),
                              memory_space=pltpu.VMEM),
             ],
-            out_specs=pl.BlockSpec((BLOCK_N, w), lambda i: (i, 0),
+            out_specs=pl.BlockSpec((block, w), lambda i: (i, 0),
                                    memory_space=pltpu.VMEM),
-            out_shape=jax.ShapeDtypeStruct((n, w), jnp.uint32),
+            out_shape=jax.ShapeDtypeStruct((nl, w), jnp.uint32),
             interpret=_interpret(),
         )(limit_arr, round_arr, stamp, known, alive_u8)
+
+    # host wall clock only: eager calls time a real dispatch (first call
+    # at a shape = compile), calls inside an outer jit time the trace
+    with dispatch_timer("ops.select_packets", signature=(n, k, packed)):
+        return _maybe_shard(call, mesh, n_arrays=3, n_scalars=2)(
+            limit_arr, round_arr, stamp, known, alive_u8)
 
 
 # ---------------------------------------------------------------------------
@@ -288,3 +405,187 @@ def merge_incoming(known: jnp.ndarray, incoming: jnp.ndarray,
             ],
             interpret=_interpret(),
         )(round_arr, known, incoming, alive_u8, stamp)
+
+
+# ---------------------------------------------------------------------------
+# the fused-round family (cache-maintaining; shard_map-ready)
+# ---------------------------------------------------------------------------
+
+
+def _make_fused_select_kernel():
+    def kernel(sendable_ref, known_ref, alive_ref, packets_ref):
+        alive = alive_ref[:]                       # (B, 1) u8
+        alive_words = jnp.where(alive > 0, jnp.uint32(0xFFFFFFFF),
+                                jnp.uint32(0))
+        # the AND with `known` masks stale cache bits for retired ring
+        # slots (GossipState.sendable_round invariant) — identical to
+        # the XLA cached select
+        packets_ref[:] = sendable_ref[:] & known_ref[:] & alive_words
+
+    return kernel
+
+
+def fused_select_cached(sendable: jnp.ndarray, known: jnp.ndarray,
+                        alive_u8: jnp.ndarray, *, k_facts: int,
+                        stamp_cols: int, mesh=None) -> jnp.ndarray:
+    """Selection off the VALID sendable cache: a word-plane-only kernel
+    (no stamp read at all — the pass the fused family removes from the
+    standalone-kernel round).  Callers must guard on
+    ``sendable_round == round``; the stale branch is
+    :func:`select_packets`."""
+    n, w = known.shape
+
+    def call(sendable, known, alive_u8):
+        nl = known.shape[0]
+        block = _fused_block(nl, k_facts, stamp_cols)
+        return pl.pallas_call(
+            _make_fused_select_kernel(),
+            grid=(nl // block,),
+            in_specs=[
+                pl.BlockSpec((block, w), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((block, w), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((block, 1), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((block, w), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((nl, w), jnp.uint32),
+            interpret=_interpret(),
+        )(sendable, known, alive_u8)
+
+    with dispatch_timer("ops.fused_select", signature=(n, k_facts)):
+        return _maybe_shard(call, mesh, n_arrays=3, n_scalars=0)(
+            sendable, known, alive_u8)
+
+
+def _make_fused_merge_kernel(packed: bool, k: int, pin: int,
+                             with_cache: bool):
+    """Merge + stamp learn + inline clamp + (optionally) the sendable-
+    cache recompute for round+1 — the in-kernel twin of
+    ``dissemination.learn_stamp_pass``, sharing its exact arithmetic so
+    the fused round is bit-exact with the XLA path by construction."""
+
+    def kernel(round_ref, limit_ref, known_ref, incoming_ref, alive_ref,
+               stamp_ref, *out_refs):
+        if with_cache:
+            known_out_ref, stamp_out_ref, send_out_ref, flag_ref = out_refs
+        else:
+            known_out_ref, stamp_out_ref, flag_ref = out_refs
+        known = known_ref[:]                       # (B, W) u32
+        incoming = incoming_ref[:]                 # (B, W) u32
+        alive = alive_ref[:]                       # (B, 1) u8
+        rq = round_ref[0, 0]                       # i32, already mod 16
+        limit_q = limit_ref[0, 0]                  # i32
+        alive_words = jnp.where(alive > 0, jnp.uint32(0xFFFFFFFF),
+                                jnp.uint32(0))
+        new_words = incoming & ~known & alive_words    # (B, W)
+        known2 = known | new_words
+        known_out_ref[:] = known2
+        # per-block learn flag: callers OR the (grid, 1) column into the
+        # round's `learned_any` — definitional (it IS the learn set), so
+        # it can never desync from the kernel's learn semantics
+        flag_ref[0, 0] = jnp.sum((new_words != 0).astype(jnp.int32))
+        if packed:
+            b = stamp_ref[:].astype(jnp.int32)     # (B, C)
+            lo = _clamped(b & 0xF, rq, pin)
+            hi = _clamped((b >> 4) & 0xF, rq, pin)
+            lo_learn, hi_learn = _learn_pairs(new_words, b.shape[1])
+            nlo = jnp.where(lo_learn, rq, lo)
+            nhi = jnp.where(hi_learn, rq, hi)
+            stamp_out_ref[:] = (nlo | (nhi << 4)).astype(jnp.uint8)
+            if with_cache:
+                # sendable' for round+1 from the just-written nibbles —
+                # both already in registers, so the cache recompute costs
+                # only the output write (learn_stamp_pass pays an extra
+                # XLA pass for the same plane)
+                ok_lo = (((rq - nlo) & 0xF) < limit_q).astype(jnp.int32)
+                ok_hi = (((rq - nhi) & 0xF) < limit_q).astype(jnp.int32)
+                send_out_ref[:] = known2 & _weave_pair_words(ok_lo, ok_hi,
+                                                             k)
+        else:
+            nib = _clamped(stamp_ref[:].astype(jnp.int32), rq, pin)
+            new_mask = _unpack_words(new_words, k)     # (B, K) bool
+            nib2 = jnp.where(new_mask, rq, nib)
+            stamp_out_ref[:] = nib2.astype(jnp.uint8)
+            if with_cache:
+                ok = (((rq - nib2) & 0xF) < limit_q)
+                send_out_ref[:] = known2 & _pack_bits(ok, k)
+
+    return kernel
+
+
+def fused_merge(known: jnp.ndarray, incoming: jnp.ndarray,
+                alive_u8: jnp.ndarray, stamp: jnp.ndarray, next_round,
+                *, limit_q: int, packed: bool, k_facts: int,
+                with_cache: bool, mesh=None):
+    """The fused-round merge: ``(known', stamp', sendable'|None, flags)``
+    in ONE streaming pass over every plane — learn new facts, stamp them
+    with ``next_round``'s quarter, re-pin wrap-stale stamps, and (when
+    ``with_cache``) recompute the sendable cache for ``next_round`` from
+    the in-register nibbles.  ``flags`` is an i32[(grid), 1] per-block
+    learn count; ``jnp.any(flags != 0)`` is the round's ``learned_any``.
+
+    With ``mesh`` the whole call runs under shard_map over the node axis
+    — the per-chip grid streams N/P rows, which is what keeps the
+    8-chip sharded flagship on the pallas fast path."""
+    from serf_tpu.models.dissemination import AGE_PIN_Q, round_q
+
+    n, c = stamp.shape
+    k = k_facts
+    w = k // 32
+    round_arr = round_q(next_round).astype(jnp.int32).reshape(1, 1)
+    limit_arr = jnp.asarray(limit_q, jnp.int32).reshape(1, 1)
+
+    def call(round_arr, limit_arr, known, incoming, alive_u8, stamp):
+        nl = stamp.shape[0]
+        block = _fused_block(nl, k, c)
+        grid = (nl // block,)
+        out_specs = [
+            pl.BlockSpec((block, w), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block, c), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((nl, w), jnp.uint32),
+            jax.ShapeDtypeStruct((nl, c), jnp.uint8),
+        ]
+        if with_cache:
+            out_specs.append(pl.BlockSpec((block, w), lambda i: (i, 0),
+                                          memory_space=pltpu.VMEM))
+            out_shape.append(jax.ShapeDtypeStruct((nl, w), jnp.uint32))
+        out_specs.append(pl.BlockSpec((1, 1), lambda i: (i, 0),
+                                      memory_space=pltpu.SMEM))
+        out_shape.append(jax.ShapeDtypeStruct((nl // block, 1), jnp.int32))
+        return pl.pallas_call(
+            _make_fused_merge_kernel(packed, k, AGE_PIN_Q, with_cache),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1), lambda i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, 1), lambda i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((block, w), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((block, w), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((block, 1), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((block, c), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=_interpret(),
+        )(round_arr, limit_arr, known, incoming, alive_u8, stamp)
+
+    with dispatch_timer("ops.fused_merge",
+                        signature=(n, k, packed, with_cache)):
+        out = _maybe_shard(call, mesh, n_arrays=4, n_scalars=2,
+                           n_out=4 if with_cache else 3)(
+            round_arr, limit_arr, known, incoming, alive_u8, stamp)
+    if with_cache:
+        return out[0], out[1], out[2], out[3]
+    return out[0], out[1], None, out[2]

@@ -277,20 +277,17 @@ def sharded_round_step(state: GossipState, cfg: GossipConfig,
     with ``round_step(state, cfg, key, group, drop_rate)`` by
     construction: it IS ``round_step`` (same select/merge/quiet-gate/
     cache/clamp code, one copy) with only the exchange leg swapped for
-    :func:`exchange_sharded`."""
-    if cfg.use_pallas:
-        # the pallas select/merge kernels are single-device (a
-        # pallas_call grid over the full N axis is not GSPMD-
-        # partitionable — ops/round_kernels.pallas_ok); fall back to the
-        # XLA phases on the sharded path, loudly
-        import dataclasses
+    :func:`exchange_sharded`.
 
-        from serf_tpu import obs
-        obs.record("pallas-fallback", op="sharded_round_step", n=cfg.n,
-                   reason="pallas kernels are single-device; sharded "
-                          "round uses the XLA phases")
-        cfg = dataclasses.replace(cfg, use_pallas=False)
+    With ``cfg.use_pallas`` + ``cfg.fused_kernels`` the select/merge
+    phases run the FUSED kernel family under shard_map per chip
+    (``round_step(mesh=)`` threads it through) — the PR-6 restriction
+    that forced the sharded round off the pallas path is gone.  The
+    standalone (non-fused) kernels remain single-device; requesting
+    them here falls back to the XLA phases with a loud
+    ``pallas-fallback`` flight event (``dissemination._pallas_mode``)."""
     return round_step(state, cfg, key, group=group, drop_rate=drop_rate,
                       exchange=functools.partial(exchange_sharded,
                                                  mesh=mesh,
-                                                 schedule=schedule))
+                                                 schedule=schedule),
+                      mesh=mesh)

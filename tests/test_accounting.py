@@ -10,6 +10,7 @@ import pytest
 from serf_tpu.models.accounting import (
     hlo_bytes_per_round,
     ici_round_traffic,
+    kernel_path_summary,
     round_traffic,
 )
 from serf_tpu.models.swim import (
@@ -108,6 +109,44 @@ def test_ici_per_phase_per_chip_attribution():
     # the 8-chip implied ceiling clears the 10k target with margin —
     # the whole reason the sharded path is the flagship (ROADMAP 1)
     assert m["implied_sustained_ceiling_rps"] > 2 * 10_000
+
+
+def test_kernel_path_model_fused_vs_phased():
+    """ISSUE 7 acceptance arithmetic: the fused kernel family removes
+    the selection's full stamp-plane pass from the kernel dispatch path
+    (>= 1 full-plane pass and >= 15 MB/round @1M vs the standalone
+    kernels) and lands at byte PARITY with the XLA model of record —
+    the fusion turns the model's XLA-fusion assumptions into authored
+    DMA guarantees rather than claiming bytes the phased XLA model
+    never paid.  (The ISSUE's aspirational >= 2x vs the 233.4 pin is
+    unreachable under the bit-exactness constraint — the floor
+    arithmetic is recorded in STATUS.md round 8.)"""
+    cfg = flagship_config(1_000_000)
+    s = kernel_path_summary(cfg)
+    xla = s["paths"]["xla"]
+    kern = s["paths"]["kernels"]
+    fused = s["paths"]["fused"]
+    # strictly fewer full-plane stamp passes than the phased kernels
+    assert s["fused_vs_kernels"]["stamp_passes_removed"] >= 1.0
+    assert fused["passes_by_plane"]["stamp"] < kern["passes_by_plane"]["stamp"]
+    # the removed pass is the 32 MB selection stamp read at 1M, minus
+    # the word-plane cache reads the cached selection pays instead
+    assert s["fused_vs_kernels"]["bytes_saved"] >= 15e6
+    # parity with the XLA model of record (the +-alive-column slack is
+    # the kernels' explicit alive read the XLA model folds away)
+    assert abs(fused["total_bytes"] - xla["total_bytes"]) <= 2e6
+    assert fused["passes_by_plane"]["stamp"] == xla["passes_by_plane"]["stamp"]
+    # regime sanity on the kernel paths: the pallas kernels stream the
+    # stamp plane whenever the gossip gate is open (no learned_any DMA
+    # gate), so their no-learn "active" round costs more than XLA's
+    act_x = round_traffic(cfg, regime="active", path="xla").total_bytes
+    act_f = round_traffic(cfg, regime="active", path="fused").total_bytes
+    assert act_f > act_x
+    # quiescent rounds never reach the kernels: identical on every path
+    for path in ("kernels", "fused"):
+        assert round_traffic(cfg, regime="quiescent",
+                             path=path).total_bytes == round_traffic(
+            cfg, regime="quiescent").total_bytes
 
 
 def test_hlo_cross_check_small_n():

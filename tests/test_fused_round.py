@@ -1,0 +1,245 @@
+"""ISSUE 7 acceptance: the fused pallas round family
+(``ops.fused_select_cached`` / ``ops.fused_merge``) is BIT-EXACT with
+the phased XLA reference on EVERY GossipState leaf — sendable cache,
+stamp clamp timing, tombstone, coverage, and believed_dead included —
+for both stamp flavors, single-device and sharded (vmesh8, where the
+kernels run under shard_map per chip).  Plus the loud VMEM/shape
+fallback contract (flight event + ``serf.pallas.fused_fallback``
+counter) and the fused dispatch timers riding the shared obs split.
+
+Interpret mode on CPU; the compiled-parity gate for real TPU is
+``tools/tpu_proof.py`` (the pallas stage runs whatever family the
+config dispatches, which is now the fused one by default)."""
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    K_DEAD,
+    K_USER_EVENT,
+    coverage,
+    inject_fact,
+    inject_facts_batch,
+    make_state,
+    round_step,
+)
+from serf_tpu.ops import round_kernels
+
+
+def _rand_state(cfg, key):
+    k2, k3, k4 = jax.random.split(key, 3)
+    s = make_state(cfg)
+    known = jax.random.bits(k2, (cfg.n, cfg.words), jnp.uint32)
+    stamp = jax.random.randint(k3, (cfg.n, cfg.stamp_cols), 0, 256
+                               ).astype(jnp.uint8)
+    if not cfg.pack_stamp:
+        stamp = stamp & 0xF
+    alive = jax.random.bernoulli(k4, 0.9, (cfg.n,))
+    return s._replace(known=known, stamp=stamp, alive=alive,
+                      round=jnp.asarray(7, jnp.int32))
+
+
+def _fused(cfg):
+    return dataclasses.replace(cfg, use_pallas=True, fused_kernels=True)
+
+
+def _assert_states_equal(a, b, context=""):
+    for (path, la), lb in zip(jax.tree_util.tree_leaves_with_path(a),
+                              jax.tree_util.tree_leaves(b)):
+        assert bool(jnp.all(la == lb)), (
+            f"leaf {jax.tree_util.keystr(path)} diverged {context}")
+
+
+def _drive_pair(cfg, n_rounds=4, mesh=None, seed=1):
+    """Run fused vs phased-XLA rounds in lockstep (same keys), with
+    injections between rounds (cache-mirror + retirement paths) and a
+    batch containing a DEAD fact so the tombstone fold and
+    believed_dead plumbing are exercised; assert every leaf after every
+    round."""
+    fast = _fused(cfg)
+    s0 = _rand_state(cfg, jax.random.key(seed))
+    s0 = inject_fact(s0, cfg, 3, K_USER_EVENT, 0, 9, 3)
+    if mesh is None:
+        step_a = jax.jit(functools.partial(round_step, cfg=cfg))
+        step_b = jax.jit(functools.partial(round_step, cfg=fast))
+    else:
+        from serf_tpu.parallel.ring import sharded_round_step
+        step_a = jax.jit(functools.partial(sharded_round_step, cfg=cfg,
+                                           mesh=mesh))
+        step_b = jax.jit(functools.partial(sharded_round_step, cfg=fast,
+                                           mesh=mesh))
+    a, b = s0, s0
+    n = cfg.n
+    for r in range(n_rounds):
+        key = jax.random.key(100 + r)
+        a = step_a(a, key=key)
+        b = step_b(b, key=key)
+        _assert_states_equal(a, b, f"after round {r}")
+        kind = K_DEAD if r == 1 else K_USER_EVENT
+        subs = jnp.asarray([(r * 7 + 1) % n, (r * 11 + 2) % n], jnp.int32)
+        args = dict(kind=kind, incarnations=jnp.ones((2,), jnp.uint32),
+                    ltimes=jnp.asarray([30 + 2 * r, 31 + 2 * r],
+                                       jnp.uint32),
+                    origins=subs, active=jnp.ones((2,), bool))
+        a = inject_facts_batch(a, cfg, subs, **args)
+        b = inject_facts_batch(b, fast, subs, **args)
+    _assert_states_equal(a, b, "at end of drive")
+    # protocol outcomes, not just raw planes
+    assert bool(jnp.all(coverage(a, cfg) == coverage(b, cfg)))
+    return a, b
+
+
+@pytest.mark.parametrize("packed", [True, False])
+def test_fused_round_bit_exact_single_device(packed):
+    cfg = GossipConfig(n=512, k_facts=64, pack_stamp=packed)
+    _drive_pair(cfg)
+
+
+def test_fused_round_bit_exact_cache_off():
+    cfg = GossipConfig(n=512, k_facts=64, use_sendable_cache=False)
+    _drive_pair(cfg)
+
+
+def test_fused_round_bit_exact_under_chaos_masks():
+    """Partition groups + per-round loss flow through the exchange leg
+    around the fused kernels — the chaos plane composes with the fused
+    round unchanged, bit-exactly."""
+    cfg = GossipConfig(n=512, k_facts=64)
+    fast = _fused(cfg)
+    s0 = inject_fact(_rand_state(cfg, jax.random.key(3)), cfg, 3,
+                     K_USER_EVENT, 0, 9, 3)
+    group = (jnp.arange(512) % 2).astype(jnp.int32)
+    step_a = jax.jit(functools.partial(round_step, cfg=cfg,
+                                       drop_rate=0.25))
+    step_b = jax.jit(functools.partial(round_step, cfg=fast,
+                                       drop_rate=0.25))
+    a, b = s0, s0
+    for r in range(3):
+        key = jax.random.key(40 + r)
+        a = step_a(a, key=key, group=group)
+        b = step_b(b, key=key, group=group)
+        _assert_states_equal(a, b, f"under chaos masks, round {r}")
+
+
+def test_fused_round_bit_exact_sharded_vmesh8(vmesh8):
+    """The fused kernels under shard_map (8 virtual devices) against the
+    single-path XLA reference — the PR-6 sharded round could not run
+    pallas at all; this pins that the re-enabled path changed nothing."""
+    cfg = GossipConfig(n=2048, k_facts=64)
+    _drive_pair(cfg, n_rounds=3, mesh=vmesh8)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("packed", [True, False])
+def test_fused_round_bit_exact_sharded_flavors(vmesh8, packed):
+    """Heavy cross-product (flavors x sharded x longer drive)."""
+    cfg = GossipConfig(n=2048, k_facts=64, pack_stamp=packed)
+    _drive_pair(cfg, n_rounds=6, mesh=vmesh8, seed=5)
+
+
+def test_fused_cluster_round_views_and_believed_dead():
+    """Full flagship cluster rounds (probe/refute/declare/push-pull on
+    top) under sustained load with real deaths: final ClusterState and
+    the derived membership outcomes (believed_dead) must match between
+    the fused and XLA paths."""
+    from serf_tpu.models.failure import believed_dead
+    from serf_tpu.models.swim import (
+        ClusterConfig,
+        FailureConfig,
+        make_cluster,
+        run_cluster_sustained,
+    )
+
+    def mk(gossip):
+        return ClusterConfig(
+            gossip=gossip,
+            failure=FailureConfig(suspicion_rounds=4, max_new_facts=8),
+            push_pull_every=8, probe_every=2)
+
+    g = GossipConfig(n=512, k_facts=64, peer_sampling="rotation")
+    cfg_a, cfg_b = mk(g), mk(_fused(g))
+    st = make_cluster(cfg_a, jax.random.key(0))
+    gos = st.gossip._replace(
+        alive=st.gossip.alive.at[jnp.asarray([5, 99])].set(False))
+    st = st._replace(gossip=gos)
+    out = []
+    for cfg in (cfg_a, cfg_b):
+        run = jax.jit(functools.partial(run_cluster_sustained, cfg=cfg,
+                                        events_per_round=2),
+                      static_argnames=("num_rounds",))
+        out.append(run(st, key=jax.random.key(7), num_rounds=16))
+    _assert_states_equal(out[0], out[1], "after 16 sustained cluster rounds")
+    bd_a = believed_dead(out[0].gossip, cfg_a.gossip, cfg_a.failure)
+    bd_b = believed_dead(out[1].gossip, cfg_b.gossip, cfg_b.failure)
+    assert bool(jnp.all(bd_a == bd_b))
+
+
+def test_fused_ok_gate_shape_and_vmem():
+    ok, reason = round_kernels.fused_ok(1_000_000, 64, 32)
+    assert ok and reason == ""
+    ok, reason = round_kernels.fused_ok(1000, 64, 32)
+    assert not ok and "node block" in reason
+    ok, reason = round_kernels.fused_ok(512, 48, 24)
+    assert not ok and "multiple of 32" in reason
+    # big-K: the working set exceeds the VMEM budget at EVERY block size
+    # -> loud fallback instead of a Mosaic OOM (ISSUE 7 satellite)
+    big_k = 1 << 18
+    ok, reason = round_kernels.fused_ok(512, big_k, big_k // 2)
+    assert not ok and "VMEM" in reason
+    assert round_kernels.fused_vmem_bytes(
+        32, big_k, big_k // 2) > round_kernels.VMEM_BUDGET_BYTES
+
+
+def test_fused_fallback_counter_and_flight_reason():
+    """A gate rejection must leave BOTH breadcrumbs: the pallas-fallback
+    flight event carrying the reason, and the
+    serf.pallas.fused_fallback counter (labeled by op)."""
+    from serf_tpu import obs
+    from serf_tpu.utils import metrics
+
+    rec = obs.FlightRecorder(capacity=64)
+    old = obs.global_recorder()
+    obs.set_global_recorder(rec)
+    sink = metrics.MetricsSink()
+    old_sink = metrics.global_sink()
+    metrics.set_global_sink(sink)
+    try:
+        cfg = GossipConfig(n=100, k_facts=32, use_pallas=True)
+        s = inject_fact(make_state(cfg), cfg, 0, K_USER_EVENT, 0, 1, 0)
+        s = jax.jit(functools.partial(round_step, cfg=cfg))(
+            s, key=jax.random.key(0))
+        assert int(s.round) == 1
+        events = rec.dump(kind="pallas-fallback")
+        assert events and "node block" in events[0]["reason"]
+        assert sink.counter("serf.pallas.fused_fallback",
+                            {"op": "round_step"}) >= 1
+    finally:
+        obs.set_global_recorder(old)
+        metrics.set_global_sink(old_sink)
+
+
+def test_fused_dispatch_timers_ride_obs_split():
+    """Satellite: the fused kernels time under the shared obs dispatch
+    registry (compile-vs-steady split) — no second jax.device_get, just
+    the host wall clock the other device ops already use."""
+    from serf_tpu.obs.device import dispatch_summary, reset_dispatch_registry
+
+    reset_dispatch_registry()
+    n, k = 64, 64
+    cfg = GossipConfig(n=n, k_facts=k)
+    known = jnp.zeros((n, cfg.words), jnp.uint32)
+    stamp = jnp.zeros((n, cfg.stamp_cols), jnp.uint8)
+    alive = jnp.ones((n, 1), jnp.uint8)
+    round_kernels.fused_select_cached(known, known, alive, k_facts=k,
+                                      stamp_cols=cfg.stamp_cols)
+    round_kernels.fused_merge(known, known, alive, stamp, 1,
+                              limit_q=cfg.transmit_limit_q, packed=True,
+                              k_facts=k, with_cache=True)
+    summary = dispatch_summary()
+    assert summary["ops.fused_select"]["calls"] == 1
+    assert summary["ops.fused_merge"]["calls"] == 1

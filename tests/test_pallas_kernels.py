@@ -53,9 +53,13 @@ def test_select_packets_matches_oracle(packed):
 
 @pytest.mark.parametrize("packed", [True, False])
 def test_full_round_parity_pallas_vs_xla(packed):
+    """STANDALONE-kernel path (fused_kernels=False — the PR-3 family the
+    bench A/Bs against; the default fused family's stronger all-leaf
+    bit-exactness contract lives in tests/test_fused_round.py)."""
     base = GossipConfig(n=512, k_facts=64, use_pallas=False,
                         pack_stamp=packed)
-    fast = dataclasses.replace(base, use_pallas=True)
+    fast = dataclasses.replace(base, use_pallas=True,
+                               fused_kernels=False)
     s0 = _rand_state(base, jax.random.key(1))
     key = jax.random.key(2)
     a = jax.jit(functools.partial(round_step, cfg=base))(s0, key=key)

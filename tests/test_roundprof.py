@@ -73,3 +73,42 @@ def test_roundprof_anomaly_flags_low_roofline_phase():
     worst = max(prof["phases"], key=lambda r: r["excess"])
     assert an["phase"] == worst["phase"]
     assert an["excess"] == worst["excess"]
+
+
+def test_roundprof_fused_attribution_and_removed_pass():
+    """ISSUE 7 tier-1 smoke: the FUSED-kernel round profiles with >=90%
+    byte attribution (the fusion must REMOVE plane passes, not hide
+    them inside one opaque call), the profile self-identifies its
+    dispatch path, and the packed stamp plane is streamed strictly
+    fewer times per round than on the phased standalone-kernel path."""
+    import dataclasses
+
+    from serf_tpu.models.accounting import round_traffic
+    from serf_tpu.models.swim import flagship_config
+
+    base = flagship_config(2048, k_facts=64)
+    cfg = dataclasses.replace(
+        base, gossip=dataclasses.replace(base.gossip, use_pallas=True))
+    prof = profile_round(cfg, events_per_round=2, timed_calls=1,
+                         warm_rounds=6)
+    assert prof["kernel_path"] == "fused"
+    frac = prof["attributed_bytes_frac"]
+    assert frac is not None and frac >= 0.9, (
+        f"fused round attributes only {frac} of compiled bytes:\n"
+        + profile_table(prof))
+    fused_stamp = prof["full_plane_passes"]["stamp"]
+    phased_stamp = round_traffic(cfg, regime="sustained",
+                                 path="kernels").passes_by_plane()["stamp"]
+    assert fused_stamp < phased_stamp, (
+        "the fused round must stream the packed stamp plane strictly "
+        f"fewer times than the phased kernels ({fused_stamp} vs "
+        f"{phased_stamp})")
+    # the profiled byte columns agree: the fused selection phase reads
+    # word planes only (no 1-byte-per-2-facts stamp column), so its
+    # model bytes must be smaller than the phased kernel selection's
+    sel = next(r for r in prof["phases"] if r["phase"] == "selection")
+    phased_sel = sum(
+        e.nbytes for e in round_traffic(cfg, regime="sustained",
+                                        path="kernels").entries
+        if e.phase == "selection")
+    assert sel["model_bytes"] < phased_sel

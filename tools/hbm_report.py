@@ -41,6 +41,21 @@ def main() -> None:
         print(r.table())
         print()
 
+    # kernel dispatch paths (ISSUE 7): XLA vs standalone vs fused pallas
+    from serf_tpu.models.accounting import kernel_path_summary
+    s = kernel_path_summary(cfg)
+    print("kernel-path comparison (sustained):")
+    for path, v in s["paths"].items():
+        passes = v["passes_by_plane"].get("stamp", 0.0)
+        print(f"  {path:<8} {v['total_bytes'] / 1e6:>8.1f} MB/round   "
+              f"stamp-plane passes {passes:.3f}   "
+              f"ceiling {v['ceiling_rps']:,.0f} rps")
+    fk = s["fused_vs_kernels"]
+    print(f"  fused vs kernels: {fk['bytes_saved'] / 1e6:.1f} MB/round "
+          f"saved ({fk['reduction_factor']}x), "
+          f"{fk['stamp_passes_removed']} full stamp-plane pass(es)/round "
+          f"removed\n")
+
     if args.hlo:
         import functools
 

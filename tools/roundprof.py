@@ -50,12 +50,31 @@ def main(argv=None) -> int:
     ap.add_argument("--schedule", choices=("ring", "allgather"),
                     default="ring",
                     help="ICI schedule of the sharded exchange leg")
+    ap.add_argument("--fused", action="store_true",
+                    help="profile the FUSED pallas round vs the phased "
+                         "(standalone-kernel) round side by side and "
+                         "print the removed-pass delta (single-device: "
+                         "the phased kernels cannot shard, so --mesh is "
+                         "rejected); --json emits "
+                         "{'fused': ..., 'phased': ..., 'delta': ...}")
     ap.add_argument("--json", action="store_true",
                     help="print the JSON contract on stdout")
     args = ap.parse_args(argv)
 
     from serf_tpu.models.swim import flagship_config
     from serf_tpu.obs.profile import profile_round, profile_table
+
+    if args.fused:
+        if args.mesh:
+            # the phased (standalone-kernel) side of the A/B is
+            # single-device only; silently profiling unsharded under a
+            # --mesh flag would mislabel the answer
+            sys.stderr.write("--fused is a single-device kernel A/B "
+                             "(the standalone kernels cannot shard); "
+                             "drop --mesh or profile the sharded fused "
+                             "path without --fused\n")
+            return 2
+        return _fused_ab(args)
 
     mesh = None
     if args.mesh:
@@ -83,6 +102,66 @@ def main(argv=None) -> int:
     sys.stderr.write(profile_table(prof) + "\n")
     if args.json:
         print(json.dumps(prof))
+    return 0
+
+
+def _fused_ab(args) -> int:
+    """``--fused``: the fused family vs the phased standalone kernels,
+    same config/seeds, with the removed-pass delta — the observational
+    side of ``accounting.kernel_path_summary`` (the fused merge
+    maintains the sendable cache in-kernel, so selection's full
+    stamp-plane read disappears from the round)."""
+    import dataclasses
+
+    from serf_tpu.models.swim import flagship_config
+    from serf_tpu.obs.profile import profile_round, profile_table
+
+    base = flagship_config(args.n, k_facts=args.k)
+    profs = {}
+    for name, fused in (("phased", False), ("fused", True)):
+        cfg = dataclasses.replace(
+            base, gossip=dataclasses.replace(base.gossip, use_pallas=True,
+                                             fused_kernels=fused))
+        profs[name] = profile_round(cfg, events_per_round=args.events,
+                                    timed_calls=args.calls,
+                                    warm_rounds=args.warm)
+        want = "fused" if fused else "kernels"
+        if profs[name]["kernel_path"] != want:
+            # a shape/VMEM rejection fell back to XLA: refuse to print an
+            # XLA-vs-XLA comparison labeled as the kernel A/B
+            sys.stderr.write(
+                "--fused: the %s flavor dispatched kernel_path=%r, not "
+                "%r (pallas rejected n=%d k=%d — see the pallas-fallback "
+                "flight event); pick a supported shape\n" % (
+                    name, profs[name]["kernel_path"], want, args.n,
+                    args.k))
+            return 2
+        sys.stderr.write(profile_table(profs[name]) + "\n\n")
+    fp = profs["fused"]["full_plane_passes"]
+    pp = profs["phased"]["full_plane_passes"]
+    planes = sorted(set(fp) | set(pp))
+    delta = {
+        "stamp_passes_removed": round(pp.get("stamp", 0.0)
+                                      - fp.get("stamp", 0.0), 3),
+        "passes": {p: {"phased": pp.get(p, 0.0), "fused": fp.get(p, 0.0)}
+                   for p in planes},
+        "wall_ms": {name: round(sum(r["wall_ms"]
+                                    for r in profs[name]["phases"]), 3)
+                    for name in profs},
+        "attributed_bytes_frac": {
+            name: profs[name]["attributed_bytes_frac"] for name in profs},
+    }
+    sys.stderr.write(
+        "fused vs phased kernel round @n=%d: stamp-plane passes "
+        "%.2f -> %.2f (%.2f full-plane pass(es)/round removed — the "
+        "selection's stamp read; the cache is maintained in-kernel); "
+        "phase wall %s -> %s ms\n" % (
+            args.n, pp.get("stamp", 0.0), fp.get("stamp", 0.0),
+            delta["stamp_passes_removed"],
+            delta["wall_ms"]["phased"], delta["wall_ms"]["fused"]))
+    if args.json:
+        print(json.dumps({"fused": profs["fused"],
+                          "phased": profs["phased"], "delta": delta}))
     return 0
 
 
