@@ -512,6 +512,27 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - the profile is best-effort
         detail["profile_error"] = repr(e)[:300]
 
+    # static-analysis finding trajectory (serflint, pure AST — ~3s):
+    # the tier-1 gate holds NEW findings at zero and the baseline
+    # should only shrink; BENCH_DETAIL tracks both per round
+    try:
+        from serf_tpu import analysis
+        from serf_tpu.utils import metrics
+        rep = analysis.analyze_repo()
+        by_rule: dict = {}
+        for f in rep.findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        metrics.gauge("serf.analysis.findings", len(rep.findings))
+        metrics.gauge("serf.analysis.baselined", len(rep.baselined))
+        detail["analysis"] = {
+            "serf.analysis.findings": len(rep.findings),
+            "serf.analysis.baselined": len(rep.baselined),
+            "suppressed": len(rep.suppressed),
+            "by_rule": by_rule,
+        }
+    except Exception as e:  # noqa: BLE001 - the lint embed is best-effort
+        detail["analysis_error"] = repr(e)[:300]
+
     detail["platform"] = platform
     sys.stderr.write(json.dumps(detail) + "\n")
     # Only ORCHESTRATED runs write the committed artifact: ad-hoc

@@ -1,0 +1,61 @@
+"""serflint: the repo's static-analysis plane (ISSUE 8).
+
+An AST-based multi-pass analyzer over the whole tree — pure AST, no
+module under analysis is ever imported, so a full run is single-digit
+seconds.  Four pass families:
+
+- **async-concurrency** (``async_rules``): fire-and-forget tasks,
+  blocking calls in coroutines, parking awaits under locks, unlocked
+  shared-container mutation;
+- **JAX tracing** (``jax_rules``): Python branches / host
+  concretization inside traced device-plane code, host transfers in
+  round-step code, unhashable jitted-call args;
+- **registry cross-check** (``registry``): ONE declared registry of
+  every metric name and flight-event kind, checked against emit sites
+  and the README table (subsumes PR 1's ``tools/metrics_lint.py``);
+- **schema drift** (``schema``): the checkpoint pytree leaf-spec and
+  the wire-message field lists are fingerprinted and version-pinned —
+  changing either without a deliberate bump is a lint failure, not a
+  fail-closed-checkpoint surprise.
+
+Plus the self-referential docs pass (``docs``): the README rule table
+is enforced both ways, like the metrics table.
+
+Entry points: ``tools/serflint.py`` CLI; :func:`analyze_repo` for
+embedding (bench.py tracks the finding trajectory per round); the
+tier-1 gate is *zero new findings* over the committed baseline.
+"""
+
+from __future__ import annotations
+
+from serf_tpu.analysis.core import (   # noqa: F401
+    ALL_RULES,
+    DEFAULT_SCAN,
+    Finding,
+    Project,
+    Registry,
+    Report,
+    default_project,
+    collect_files,
+    fix_baseline,
+    run_rules,
+)
+
+# importing the rule modules registers every rule
+from serf_tpu.analysis import async_rules   # noqa: F401,E402
+from serf_tpu.analysis import jax_rules     # noqa: F401,E402
+from serf_tpu.analysis import registry      # noqa: F401,E402
+from serf_tpu.analysis import schema        # noqa: F401,E402
+from serf_tpu.analysis import docs          # noqa: F401,E402
+
+
+def analyze_repo(rules=None) -> Report:
+    """Run the full analyzer on the repo with the committed baseline."""
+    return run_rules(default_project(), rules=rules)
+
+
+__all__ = [
+    "ALL_RULES", "DEFAULT_SCAN", "Finding", "Project", "Registry",
+    "Report", "analyze_repo", "collect_files", "default_project",
+    "fix_baseline", "run_rules",
+]

@@ -1,0 +1,279 @@
+"""serflint pass family (b): JAX tracing discipline.
+
+Scoped to the device plane (``serf_tpu/models``, ``ops``, ``parallel``):
+a single Python-level branch on a tracer, a host concretization inside a
+jitted body, or an unhashable argument to a jitted callable silently
+breaks compile caching, forces a recompile per call, or raises a
+ConcretizationTypeError three layers away from the bug.
+
+All detection is pure-AST.  "Traced" is approximated as:
+
+- a function decorated with anything mentioning ``jit`` (``@jax.jit``,
+  ``@partial(jax.jit, ...)``);
+- a function whose NAME is passed to a tracing entry point
+  (``lax.scan``/``cond``/``while_loop``/``fori_loop``/``switch``,
+  ``shard_map``, ``vmap``/``pmap``, ``pallas_call``) anywhere in the
+  same module, or wrapped as ``g = jax.jit(f)``;
+- any ``def`` nested inside a traced function.
+
+Parameters named ``self``/``cfg``/``config``/``mesh`` or annotated with
+a ``*Config`` type are treated as static (they are hashable config, the
+codebase's convention), so ``if cfg.with_failure:`` never fires.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Set, Tuple
+
+from serf_tpu.analysis.core import (
+    Finding,
+    Project,
+    SourceFile,
+    call_name,
+    finding,
+    names_in,
+    rule,
+)
+
+#: device-plane scope (project-relative path prefixes)
+JAX_SCOPE = ("serf_tpu/models/", "serf_tpu/ops/", "serf_tpu/parallel/")
+
+_TRACING_ENTRIES = frozenset({
+    "scan", "cond", "while_loop", "fori_loop", "switch", "shard_map",
+    "vmap", "pmap", "pallas_call", "custom_vjp", "checkpoint", "remat",
+})
+
+_STATIC_PARAM_NAMES = frozenset({"self", "cls", "cfg", "config", "mesh",
+                                 "schedule", "opts"})
+
+_TRANSFER_CALLS = frozenset({"jax.device_get", "np.asarray", "np.array",
+                             "numpy.asarray", "numpy.array",
+                             "jax.device_put"})
+
+#: round-step code: the jitted hot path where a host transfer is a
+#: per-round device sync (emit_*_metrics pulls are batched, and live
+#: outside these name shapes)
+_ROUND_NAME = re.compile(r"(^|_)(round|phase|step|pass)(_|$|\d)")
+
+
+def _in_scope(src: SourceFile) -> bool:
+    return src.rel.startswith(JAX_SCOPE)
+
+
+def _mentions(node: ast.AST, needles: Set[str]) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in needles:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in needles:
+            return True
+    return False
+
+
+def _module_traced_names(tree: ast.AST) -> Tuple[Set[str], Set[str]]:
+    """(names of functions that get traced, names bound to jitted
+    callables) for one module."""
+    traced: Set[str] = set()
+    jitted: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fname = call_name(node.func)
+            tail = fname.split(".")[-1]
+            if tail == "jit":
+                # jax.jit(f, ...) — f is traced; a name bound to the
+                # result is a jitted callable
+                if node.args and isinstance(node.args[0], ast.Name):
+                    traced.add(node.args[0].id)
+            elif tail in _TRACING_ENTRIES:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        traced.add(arg.id)
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if call_name(node.value.func).split(".")[-1] == "jit":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        jitted.add(t.id)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_mentions(d, {"jit"}) for d in node.decorator_list):
+                traced.add(node.name)
+                jitted.add(node.name)
+    return traced, jitted
+
+
+def _static_params(fn: ast.FunctionDef) -> Set[str]:
+    static = set()
+    args = fn.args
+    for a in [*args.posonlyargs, *args.args, *args.kwonlyargs]:
+        if a.arg in _STATIC_PARAM_NAMES:
+            static.add(a.arg)
+        elif a.annotation is not None and _static_annotation(a.annotation):
+            static.add(a.arg)
+    return static
+
+
+def _static_annotation(ann: ast.AST) -> bool:
+    """Annotations that mark hashable/static config: ``GossipConfig``,
+    ``Mesh``, plain ``int``/``bool``/``str``."""
+    for n in ast.walk(ann):
+        ident = n.id if isinstance(n, ast.Name) else (
+            n.attr if isinstance(n, ast.Attribute) else None)
+        if ident is None:
+            continue
+        if ident in ("int", "bool", "str") or ident.endswith(
+                ("Config", "Mesh", "Schedule")):
+            return True
+    return False
+
+
+def _data_params(fn: ast.FunctionDef) -> Set[str]:
+    args = fn.args
+    all_params = {a.arg for a in
+                  [*args.posonlyargs, *args.args, *args.kwonlyargs]}
+    return all_params - _static_params(fn)
+
+
+def _traced_functions(src: SourceFile) -> List[ast.FunctionDef]:
+    """Every FunctionDef in a traced context: named-traced functions and
+    all defs nested inside them."""
+    traced_names, _ = _module_traced_names(src.tree)
+    roots = [n for n in ast.walk(src.tree)
+             if isinstance(n, ast.FunctionDef) and n.name in traced_names]
+    out: List[ast.FunctionDef] = []
+    seen = set()
+    stack = list(roots)
+    while stack:
+        fn = stack.pop()
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        out.append(fn)
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.FunctionDef) and sub is not fn:
+                stack.append(sub)
+    return out
+
+
+def _own_nodes(fn: ast.FunctionDef):
+    """Nodes of ``fn`` excluding nested defs (those are visited as their
+    own traced functions, with their own parameter sets)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _is_none_check(test: ast.AST) -> bool:
+    """``x is None`` / ``x is not None`` — legitimate Python-level
+    dispatch on optional args, not a tracer branch."""
+    return (isinstance(test, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops))
+
+
+@rule("jax-python-branch",
+      "Python `if`/`while` on a traced value inside a jit/scan/shard_map "
+      "body — raises ConcretizationTypeError or silently specializes",
+      "@jax.jit\ndef f(x):\n    if x > 0: ...")
+def check_python_branch(src: SourceFile,
+                        project: Project) -> Iterable[Finding]:
+    if not _in_scope(src):
+        return
+    for fn in _traced_functions(src):
+        data = _data_params(fn)
+        if not data:
+            continue
+        for node in _own_nodes(fn):
+            if not isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                continue
+            test = node.test
+            if _is_none_check(test):
+                continue
+            if any(isinstance(c, ast.Call) and call_name(c.func) in
+                   ("isinstance", "hasattr", "callable")
+                   for c in ast.walk(test)):
+                continue
+            hit = names_in(test) & data
+            if hit:
+                yield finding(
+                    "jax-python-branch", src, node,
+                    f"Python branch on traced {sorted(hit)} inside traced "
+                    f"`{fn.name}` — use lax.cond/lax.select/jnp.where")
+
+
+@rule("jax-host-concretize",
+      "`.item()`/`bool()`/`int()`/`float()` on a traced value inside a "
+      "traced body — forces a host sync or fails under jit",
+      "@jax.jit\ndef f(x):\n    return float(x.sum())")
+def check_host_concretize(src: SourceFile,
+                          project: Project) -> Iterable[Finding]:
+    if not _in_scope(src):
+        return
+    for fn in _traced_functions(src):
+        data = _data_params(fn)
+        for node in _own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node.func)
+            if name.endswith(".item") and not node.args:
+                yield finding(
+                    "jax-host-concretize", src, node,
+                    f"`.item()` inside traced `{fn.name}` — keep the value "
+                    "on device or move the read outside the traced region")
+            elif name in ("bool", "int", "float") and node.args and \
+                    names_in(node.args[0]) & data:
+                yield finding(
+                    "jax-host-concretize", src, node,
+                    f"`{name}()` on traced value inside `{fn.name}` — "
+                    "use jnp casts / keep it symbolic")
+
+
+@rule("jax-host-transfer",
+      "`jax.device_get`/`np.asarray` inside round-step code — a "
+      "per-round device sync on the hot path",
+      "def round_step(...):\n    np.asarray(state.known)")
+def check_host_transfer(src: SourceFile,
+                        project: Project) -> Iterable[Finding]:
+    if not _in_scope(src):
+        return
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, ast.FunctionDef) \
+                or not _ROUND_NAME.search(fn.name) \
+                or fn.name.startswith("emit_"):
+            # emit_* is the sanctioned batched-pull pattern (obs device
+            # emitters): one device_get per snapshot, off the hot path
+            continue
+        for node in _own_nodes(fn):
+            if isinstance(node, ast.Call) \
+                    and call_name(node.func) in _TRANSFER_CALLS:
+                yield finding(
+                    "jax-host-transfer", src, node,
+                    f"host transfer `{call_name(node.func)}` inside "
+                    f"round-step `{fn.name}` — batch reads outside the "
+                    "round (obs device emitters pattern)")
+
+
+@rule("jax-unhashable-arg",
+      "list/dict/set literal passed to a jitted callable — unhashable "
+      "static args force a recompile every call",
+      "jitted_fn(x, [1, 2, 3])")
+def check_unhashable_arg(src: SourceFile,
+                         project: Project) -> Iterable[Finding]:
+    if not _in_scope(src):
+        return
+    _, jitted = _module_traced_names(src.tree)
+    if not jitted:
+        return
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id in jitted:
+            for arg in [*node.args, *[kw.value for kw in node.keywords]]:
+                if isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+                    yield finding(
+                        "jax-unhashable-arg", src, arg,
+                        f"mutable literal passed to jitted "
+                        f"`{node.func.id}` — pass a tuple or hoist to a "
+                        "static config")

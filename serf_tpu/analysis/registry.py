@@ -1,0 +1,443 @@
+"""serflint pass family (c): the declared observability registry.
+
+ONE registry of every metric name and flight-event kind the tree may
+emit.  Three surfaces are cross-checked against it:
+
+- **emit sites** — ``metrics.incr/gauge/observe`` call sites plus the
+  device plane's ``emit_*_metrics`` name->value dict literals (the same
+  extraction ``tools/metrics_lint.py`` shipped in PR 1; that tool is now
+  a thin wrapper over this module);
+- **flight-recorder kinds** — ``flight.record("kind", ...)`` /
+  ``obs.record("kind", ...)`` call sites;
+- **README rows** — the ``## Observability`` table operators build
+  dashboards against.
+
+Dynamic name segments normalize to ``<>`` on every surface (an f-string
+``serf.queue.{name}`` and a documented ``serf.queue.<name>`` are the
+same family).  Adding a metric now takes three deliberate edits — emit
+it, declare it here, document it — and each half-done state is a
+distinct finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set
+
+from serf_tpu.analysis.core import (
+    REPO,
+    Finding,
+    Project,
+    SourceFile,
+    project_rule,
+)
+
+# ---------------------------------------------------------------------------
+# THE registry
+# ---------------------------------------------------------------------------
+
+#: every metric name the tree may emit (normalized: dynamic segments are
+#: ``<>``).  Grouped by plane; the README Observability table carries
+#: the per-name docs.
+METRICS: tuple = (
+    # memberlist plane
+    "memberlist.node.dead",
+    "memberlist.node.join",
+    "memberlist.node.suspect",
+    "memberlist.node.version_rejected",
+    "memberlist.packet.<>_failed",
+    "memberlist.packet.decrypt_failed",
+    "memberlist.packet.received",
+    "memberlist.packet.sent",
+    "memberlist.probe.failed",
+    # serf host plane
+    "serf.coordinate.adjustment-ms",
+    "serf.coordinate.rejected",
+    "serf.coordinate.zero-rtt",
+    "serf.degraded.breaker_fastfail",
+    "serf.degraded.breaker_opened",
+    "serf.degraded.corrupt_frame",
+    "serf.degraded.dial_retry",
+    "serf.degraded.join_retry",
+    "serf.degraded.pushpull_skipped",
+    "serf.events",
+    "serf.events.<>",
+    "serf.events.tee_depth",
+    "serf.health.component.<>",
+    "serf.health.score",
+    "serf.loop.lag-ms",
+    "serf.member.failed",
+    "serf.member.flap",
+    "serf.member.join",
+    "serf.member.leave",
+    "serf.member.unleave",
+    "serf.member.update",
+    "serf.messages.received",
+    "serf.messages.sent",
+    "serf.queries",
+    "serf.queries.<>",
+    "serf.query.acks",
+    "serf.query.duplicate_acks",
+    "serf.query.duplicate_responses",
+    "serf.query.responses",
+    "serf.query.rtt-ms",
+    "serf.queue.<>",
+    "serf.queue.bytes.<>",
+    "serf.snapshot.append_line",
+    "serf.snapshot.compact",
+    "serf.snapshot.torn_tail",
+    "serf.snapshot.unknown_record",
+    "serf.subscriber.dropped",
+    "serf.subscriber.lossless_violation",
+    "serf.trace.span-ms",
+    # chaos / faults plane
+    "serf.faults.corrupted",
+    "serf.faults.delayed",
+    "serf.faults.dropped",
+    "serf.faults.duplicated",
+    "serf.faults.phase",
+    "serf.faults.reordered",
+    # overload plane
+    "serf.overload.device_dropped",
+    "serf.overload.device_offered",
+    "serf.overload.event_shed",
+    "serf.overload.ingress_admitted",
+    "serf.overload.ingress_shed",
+    "serf.overload.paced_dropped",
+    "serf.overload.query_fastfail",
+    "serf.overload.query_responses",
+    "serf.overload.query_responses_shed",
+    "serf.overload.queue_shed",
+    "serf.overload.queue_shed_bytes",
+    "serf.overload.remote_overloaded",
+    # device plane (emit_*_metrics)
+    "serf.device.dispatch-ms",
+    "serf.device.dispatch.calls",
+    "serf.model.gossip.alive",
+    "serf.model.gossip.coverage",
+    "serf.model.gossip.facts-valid",
+    "serf.model.gossip.fan-out",
+    "serf.model.gossip.round",
+    "serf.model.gossip.tombstones",
+    "serf.model.swim.accusations-pending",
+    "serf.model.swim.dead-facts",
+    "serf.model.swim.live-suspicions",
+    "serf.model.swim.undetected-deaths",
+    "serf.model.traffic.bytes-per-round",
+    "serf.model.traffic.ceiling-rps",
+    "serf.model.traffic.plane-bytes",
+    "serf.model.vivaldi.adjustment",
+    "serf.model.vivaldi.error",
+    "serf.model.vivaldi.height",
+    "serf.pallas.fused_fallback",
+    # sharded flagship
+    "serf.shard.devices",
+    "serf.shard.exchange-bytes-per-chip",
+    "serf.shard.rps",
+    # dstream transport
+    "serf.dstream.ooo_dropped",
+    "serf.dstream.retransmits",
+    # static analysis (bench embeds the finding trajectory per round)
+    "serf.analysis.findings",
+    "serf.analysis.baselined",
+)
+
+#: every flight-recorder event kind (obs/flight.py ``record`` call sites)
+FLIGHT_KINDS: tuple = (
+    "broadcast-retired",
+    "circuit-breaker",
+    "coordinate-rejected",
+    "corrupt-frame",
+    "dial-retry",
+    "event-shed",
+    "fault-phase",
+    "ingress-shed",
+    "member-state",
+    "paced-drop",
+    "packet-dropped",
+    "pallas-fallback",
+    "probe-failed",
+    "query-fastfail",
+    "query-overloaded-response",
+    "query-received",
+    "query-response",
+    "query-responses-shed",
+    "queue-overflow",
+    "queue-shed",
+    "shard-fallback",
+    "snapshot-torn-tail",
+    "subscriber-drop",
+    "swim-state",
+    "user-event",
+)
+
+
+# ---------------------------------------------------------------------------
+# extraction (the PR-1 metrics_lint scanner, now shared)
+# ---------------------------------------------------------------------------
+
+#: a string is a candidate metric name only under this grammar
+NAME_RE = re.compile(r"^(serf|memberlist)\.[a-z0-9_.<>{}-]+$")
+#: README table rows: | `name` | type | ...
+ROW_RE = re.compile(r"^\|\s*`([^`]+)`\s*\|")
+_DYNAMIC = re.compile(r"(\{[^{}]*\}|<[^<>]*>)")
+
+
+def normalize(name: str) -> str:
+    """Collapse every dynamic segment ({expr} or <doc>) to ``<>``."""
+    return _DYNAMIC.sub("<>", name)
+
+
+def _joined_str_pattern(node: ast.JoinedStr) -> str:
+    parts = []
+    for v in node.values:
+        if isinstance(v, ast.Constant):
+            parts.append(str(v.value))
+        else:
+            parts.append("{}")
+    return "".join(parts)
+
+
+def _obs_sites(f):
+    """(metric_sites, flight_sites) for one source, each a list of
+    (raw_name, rel, lineno).  One AST walk per file, cached on the
+    SourceFile object so the four registry rules (metric/flight x
+    unknown/unused) share it instead of re-walking the whole tree."""
+    if isinstance(f, SourceFile):
+        cached = getattr(f, "_obs_sites", None)
+        if cached is not None:
+            return cached
+    tree, rel = _tree_of(f)
+    metric_sites: List[tuple] = []
+    flight_sites: List[tuple] = []
+    for node in ast.walk(tree):
+        # metrics.incr/gauge/observe("name"...) and f-string variants
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.args):
+            if (node.func.attr in ("incr", "gauge", "observe")
+                    and node.func.value.id == "metrics"):
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    metric_sites.append((arg.value, rel, node.lineno))
+                elif isinstance(arg, ast.JoinedStr):
+                    metric_sites.append(
+                        (_joined_str_pattern(arg), rel, node.lineno))
+            # flight.record("kind", ...) / obs.record("kind", ...)
+            elif (node.func.attr == "record"
+                  and node.func.value.id in ("flight", "obs")
+                  and isinstance(node.args[0], ast.Constant)
+                  and isinstance(node.args[0].value, str)):
+                flight_sites.append((node.args[0].value, rel, node.lineno))
+        # device-plane emitters: {"name": value, ...} dict literals
+        # inside emit_*_metrics functions (emitted via a loop)
+        elif (isinstance(node, ast.FunctionDef)
+              and node.name.startswith("emit_")
+              and node.name.endswith("_metrics")):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Dict):
+                    for key in sub.keys:
+                        if (isinstance(key, ast.Constant)
+                                and isinstance(key.value, str)):
+                            metric_sites.append((key.value, rel, sub.lineno))
+    out = (metric_sites, flight_sites)
+    if isinstance(f, SourceFile):
+        f._obs_sites = out
+    return out
+
+
+def emitted_metric_names(files: Iterable) -> Dict[str, Set[str]]:
+    """{normalized_name: {file:line, ...}} across sources.  ``files``
+    are paths or SourceFiles (paths keep the metrics_lint wrapper API)."""
+    out: Dict[str, Set[str]] = {}
+    for f in files:
+        for raw, rel, lineno in _obs_sites(f)[0]:
+            if NAME_RE.match(normalize(raw).replace("<>", "x")):
+                out.setdefault(normalize(raw), set()).add(f"{rel}:{lineno}")
+    return out
+
+
+def flight_kinds_emitted(files: Iterable) -> Dict[str, Set[str]]:
+    """{kind: {file:line, ...}}: first string arg of ``flight.record`` /
+    ``obs.record`` call sites."""
+    out: Dict[str, Set[str]] = {}
+    for f in files:
+        for kind, rel, lineno in _obs_sites(f)[1]:
+            out.setdefault(kind, set()).add(f"{rel}:{lineno}")
+    return out
+
+
+def documented_metric_names(readme: Path) -> Dict[str, str]:
+    """{normalized_name: raw_name} from the README Observability table."""
+    out: Dict[str, str] = {}
+    in_section = False
+    for line in readme.read_text().splitlines():
+        if line.startswith("## "):
+            in_section = line.strip() == "## Observability"
+            continue
+        if not in_section:
+            continue
+        m = ROW_RE.match(line)
+        if m and m.group(1) != "Metric":
+            out[normalize(m.group(1))] = m.group(1)
+    return out
+
+
+def _tree_of(f):
+    if isinstance(f, SourceFile):
+        return f.tree, f.rel
+    # bare-path callers (the metrics_lint wrapper API) get repo-relative
+    # site strings, matching the PR-1 message format
+    p = Path(f).resolve()
+    try:
+        rel = str(p.relative_to(REPO))
+    except ValueError:
+        rel = str(p)
+    return ast.parse(p.read_text(), filename=str(p)), rel
+
+
+def _metric_files(files: List[SourceFile],
+                  project: Project) -> List[SourceFile]:
+    prefixes = tuple(
+        e + "/" if (project.root / e).is_dir() else e
+        for e in project.metric_scan)
+    return [f for f in files if f.rel.startswith(prefixes)]
+
+
+# ---------------------------------------------------------------------------
+# the cross-check rules
+# ---------------------------------------------------------------------------
+
+def _reg_finding(rule_id: str, path: str, line: int, name: str,
+                 message: str) -> Finding:
+    return Finding(rule=rule_id, path=path, line=line, message=message,
+                   key=name)
+
+
+@project_rule("reg-metric-unknown",
+              "a metric is emitted but not declared in the registry",
+              'metrics.incr("serf.new.counter") with no registry entry')
+def check_metric_unknown(files: List[SourceFile],
+                         project: Project) -> Iterable[Finding]:
+    if project.registry is None:
+        return
+    emitted = emitted_metric_names(_metric_files(files, project))
+    for name in sorted(set(emitted) - set(project.registry.metrics)):
+        site = sorted(emitted[name])[0]
+        path, _, line = site.rpartition(":")
+        yield _reg_finding(
+            "reg-metric-unknown", path, int(line), name,
+            f"metric {name!r} emitted but not declared — add it to "
+            "serf_tpu/analysis/registry.py METRICS (and the README table)")
+
+
+@project_rule("reg-metric-unused",
+              "a registry metric is never emitted anywhere",
+              "a METRICS entry whose emit site was deleted")
+def check_metric_unused(files: List[SourceFile],
+                        project: Project) -> Iterable[Finding]:
+    if project.registry is None:
+        return
+    emitted = emitted_metric_names(_metric_files(files, project))
+    for name in sorted(set(project.registry.metrics) - set(emitted)):
+        yield _reg_finding(
+            "reg-metric-unused", "serf_tpu/analysis/registry.py", 1, name,
+            f"registry metric {name!r} is never emitted — delete the "
+            "entry or restore the emission")
+
+
+@project_rule("reg-doc-drift",
+              "README Observability table out of sync with the registry "
+              "(missing or stale row)",
+              "a registry metric with no README row")
+def check_doc_drift(files: List[SourceFile],
+                    project: Project) -> Iterable[Finding]:
+    if project.registry is None or project.readme is None \
+            or not project.readme.exists():
+        return
+    documented = documented_metric_names(project.readme)
+    readme_rel = project.readme.name
+    for name in sorted(set(project.registry.metrics) - set(documented)):
+        yield _reg_finding(
+            "reg-doc-drift", readme_rel, 1, name,
+            f"registry metric {name!r} has no row in the README "
+            "'## Observability' table")
+    for name in sorted(set(documented) - set(project.registry.metrics)):
+        yield _reg_finding(
+            "reg-doc-drift", readme_rel, 1, name,
+            f"README documents {documented[name]!r} but the registry "
+            "does not declare it — delete the row or declare the metric")
+
+
+@project_rule("reg-flight-unknown",
+              "a flight-event kind is recorded but not declared",
+              'flight.record("new-kind", ...) with no registry entry')
+def check_flight_unknown(files: List[SourceFile],
+                         project: Project) -> Iterable[Finding]:
+    if project.registry is None:
+        return
+    kinds = flight_kinds_emitted(_metric_files(files, project))
+    for kind in sorted(set(kinds) - set(project.registry.flight_kinds)):
+        site = sorted(kinds[kind])[0]
+        path, _, line = site.rpartition(":")
+        yield _reg_finding(
+            "reg-flight-unknown", path, int(line), kind,
+            f"flight kind {kind!r} recorded but not declared — add it to "
+            "serf_tpu/analysis/registry.py FLIGHT_KINDS")
+
+
+@project_rule("reg-flight-unused",
+              "a registry flight-event kind is never recorded",
+              "a FLIGHT_KINDS entry whose record site was deleted")
+def check_flight_unused(files: List[SourceFile],
+                        project: Project) -> Iterable[Finding]:
+    if project.registry is None:
+        return
+    kinds = flight_kinds_emitted(_metric_files(files, project))
+    for kind in sorted(set(project.registry.flight_kinds) - set(kinds)):
+        yield _reg_finding(
+            "reg-flight-unused", "serf_tpu/analysis/registry.py", 1, kind,
+            f"registry flight kind {kind!r} is never recorded — delete "
+            "the entry or restore the record site")
+
+
+# ---------------------------------------------------------------------------
+# metrics_lint compatibility (tools/metrics_lint.py delegates here)
+# ---------------------------------------------------------------------------
+
+def metric_drift_report(files: Iterable, readme: Path,
+                        metrics: Iterable[str],
+                        emitted: Optional[Dict[str, Set[str]]] = None,
+                        ) -> List[str]:
+    """The PR-1 metrics_lint contract as one function: emitted vs README
+    both ways, routed through the declared registry.  Returns drift
+    messages (empty = in sync).  Pass ``emitted`` (the
+    ``emitted_metric_names`` map) to reuse an existing scan."""
+    if emitted is None:
+        emitted = emitted_metric_names(files)
+    documented = documented_metric_names(readme)
+    declared = set(metrics)
+    out = []
+    if not documented:
+        return [f"no table rows found under '## Observability' in {readme}"]
+    undeclared = set(emitted) - declared
+    for name in sorted(undeclared):
+        out.append(f"EMITTED BUT UNDECLARED: {name} "
+                   f"(at {', '.join(sorted(emitted[name]))}) — add a "
+                   "registry entry + a row to README.md '## Observability'")
+    # undeclared names already tell the user to add the README row too —
+    # don't report the same defect twice
+    for name in sorted(set(emitted) - set(documented) - undeclared):
+        out.append(f"EMITTED BUT UNDOCUMENTED: {name} "
+                   f"(at {', '.join(sorted(emitted[name]))}) — add a row "
+                   "to README.md '## Observability'")
+    for name in sorted(set(documented) - set(emitted)):
+        out.append(f"DOCUMENTED BUT NEVER EMITTED: {documented[name]} — "
+                   "delete the README row or restore the emission")
+    for name in sorted(declared - set(emitted)):
+        out.append(f"DECLARED BUT NEVER EMITTED: {name} — delete the "
+                   "registry entry or restore the emission")
+    return out

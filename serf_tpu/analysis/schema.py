@@ -1,0 +1,256 @@
+"""serflint pass family (d): schema-drift fingerprints.
+
+Two schemas have silently broken consumers twice each (CHANGES.md):
+
+- the **checkpoint pytree** — adding/removing a ``GossipState`` /
+  ``ClusterState`` leaf makes every existing device checkpoint fail
+  closed on restore ("pre-round-6 / pre-PR5 checkpoints fail closed"
+  recurred in PR 3 and PR 5 as a *surprise*);
+- the **wire-message field lists** — a re-numbered or added field skews
+  the codec between mixed-version nodes.
+
+Both are now FINGERPRINTED from the AST (NamedTuple leaf names for the
+pytree; dataclass field names + wire field numbers + enum registries for
+the wire) and pinned with a version in
+``serf_tpu/analysis/pins/schema_pins.json``.  Changing either schema
+without bumping the pin is a lint failure; the deliberate bump is
+``python tools/serflint.py --bump-schema`` (see MIGRATION.md).  The
+pinned *version* is also the runtime guard: ``models/checkpoint.py``
+stamps it into every checkpoint and refuses a mismatched restore with a
+clear error instead of a shape surprise, and ``serf_tpu.codec`` exports
+it as ``WIRE_SCHEMA_VERSION``.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional
+
+from serf_tpu.analysis.core import (
+    REPO,
+    PINS_NAME,
+    Finding,
+    Project,
+    SourceFile,
+    project_rule,
+)
+
+#: the checkpoint pytree surface: {source file: [NamedTuple classes]}
+PYTREE_SOURCES: Dict[str, List[str]] = {
+    "serf_tpu/models/dissemination.py": ["FactTable", "GossipState"],
+    "serf_tpu/models/vivaldi.py": ["VivaldiState"],
+    "serf_tpu/models/swim.py": ["ClusterState"],
+}
+
+#: the wire surface: the serf envelope plane, the SWIM packet plane AND
+#: the shared node/member structs they nest — all cross-node wire
+#: formats, so all are drift-pinned
+WIRE_SOURCES: List[str] = [
+    "serf_tpu/types/messages.py",
+    "serf_tpu/host/messages.py",
+    "serf_tpu/types/member.py",
+]
+
+#: wire-carried enum registries (member numbering IS wire semantics)
+WIRE_REGISTRIES = ("MessageType", "QueryFlag", "SwimMessageType",
+                   "SwimState", "MemberStatus")
+
+
+def _fingerprint(obj) -> str:
+    blob = json.dumps(obj, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# spec extraction (pure AST)
+# ---------------------------------------------------------------------------
+
+def _class_fields(tree: ast.AST, names: List[str]) -> Dict[str, List[str]]:
+    out: Dict[str, List[str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name in names:
+            fields = [s.target.id for s in node.body
+                      if isinstance(s, ast.AnnAssign)
+                      and isinstance(s.target, ast.Name)]
+            out[node.name] = fields
+    return out
+
+
+def pytree_spec(root: Path) -> Dict[str, List[str]]:
+    """Ordered leaf names of every checkpointed NamedTuple.  Order
+    matters: the checkpoint flattens by field position."""
+    spec: Dict[str, List[str]] = {}
+    for rel, classes in PYTREE_SOURCES.items():
+        p = root / rel
+        if not p.exists():
+            continue
+        spec.update(_class_fields(ast.parse(p.read_text()), classes))
+    return spec
+
+
+def wire_spec(root: Path) -> Dict[str, dict]:
+    """Per message class: dataclass field names + the wire field numbers
+    its codec uses (both encode_* first args and decode ``f == N``
+    comparisons), plus the wire-carried enum registries.  Covers every
+    ``WIRE_SOURCES`` file — the serf envelope messages, the SWIM packet
+    plane, and the nested node/member structs (class names are disjoint
+    across the files)."""
+    spec: Dict[str, dict] = {}
+    for rel in WIRE_SOURCES:
+        p = root / rel
+        if p.exists():
+            _wire_spec_of(ast.parse(p.read_text()), spec)
+    return spec
+
+
+def _wire_spec_of(tree: ast.AST, spec: Dict[str, dict]) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        if node.name in WIRE_REGISTRIES:
+            members = {}
+            for s in node.body:
+                if isinstance(s, ast.Assign) and len(s.targets) == 1 \
+                        and isinstance(s.targets[0], ast.Name) \
+                        and isinstance(s.value, ast.Constant) \
+                        and isinstance(s.value.value, int):
+                    members[s.targets[0].id] = s.value.value
+            spec[node.name] = {"members": members}
+            continue
+        fields = [s.target.id for s in node.body
+                  if isinstance(s, ast.AnnAssign)
+                  and isinstance(s.target, ast.Name)]
+        wire_nums = set()
+        for sub in ast.walk(node):
+            # codec.encode_*_field(N, ...) / encode_length_delimited(N, ...)
+            if isinstance(sub, ast.Call) and isinstance(sub.func,
+                                                        ast.Attribute) \
+                    and sub.func.attr.startswith("encode_") \
+                    and sub.args \
+                    and isinstance(sub.args[0], ast.Constant) \
+                    and isinstance(sub.args[0].value, int):
+                wire_nums.add(sub.args[0].value)
+            # decode loop: ``if f == N`` / ``elif f == N``
+            if isinstance(sub, ast.Compare) \
+                    and isinstance(sub.left, ast.Name) \
+                    and sub.left.id == "f" \
+                    and len(sub.comparators) == 1 \
+                    and isinstance(sub.comparators[0], ast.Constant) \
+                    and isinstance(sub.comparators[0].value, int):
+                wire_nums.add(sub.comparators[0].value)
+        # a class is wire surface if it carries a TYPE tag OR actually
+        # encodes/decodes numbered fields (catches nested structs like
+        # PushNodeState/Node/Member that have codecs but no TYPE)
+        has_type = any(
+            isinstance(s, ast.Assign) and len(s.targets) == 1
+            and isinstance(s.targets[0], ast.Name)
+            and s.targets[0].id == "TYPE"
+            for s in node.body)
+        if has_type or wire_nums:
+            spec[node.name] = {"fields": fields, "wire": sorted(wire_nums)}
+
+
+def pytree_fingerprint(root: Path = REPO) -> str:
+    return _fingerprint(pytree_spec(root))
+
+
+def wire_fingerprint(root: Path = REPO) -> str:
+    return _fingerprint(wire_spec(root))
+
+
+# ---------------------------------------------------------------------------
+# pins
+# ---------------------------------------------------------------------------
+
+def load_pins(path: Optional[Path] = None) -> dict:
+    p = path or (REPO / PINS_NAME)
+    return json.loads(p.read_text())
+
+
+def save_pins(pins: dict, path: Optional[Path] = None) -> None:
+    p = path or (REPO / PINS_NAME)
+    p.write_text(json.dumps(pins, indent=1, sort_keys=True) + "\n")
+
+
+def bump_pins(root: Path = REPO, path: Optional[Path] = None) -> dict:
+    """The deliberate schema bump: recompute both fingerprints, bump the
+    version of whichever changed (MIGRATION.md documents the workflow)."""
+    p = path or (root / PINS_NAME)
+    pins = json.loads(p.read_text()) if p.exists() else {
+        "pytree": {"version": 0, "fingerprint": ""},
+        "wire": {"version": 0, "fingerprint": ""}}
+    for kind, fp in (("pytree", pytree_fingerprint(root)),
+                     ("wire", wire_fingerprint(root))):
+        if pins[kind]["fingerprint"] != fp:
+            pins[kind] = {"version": pins[kind]["version"] + 1,
+                          "fingerprint": fp}
+    save_pins(pins, p)
+    return pins
+
+
+def pytree_schema_version() -> int:
+    """Runtime accessor (models/checkpoint.py stamps this into every
+    checkpoint).  Reads the pin only — never recomputes the AST
+    fingerprint at runtime."""
+    return int(load_pins()["pytree"]["version"])
+
+
+def wire_schema_version() -> int:
+    """Runtime accessor (exported as ``serf_tpu.codec
+    .WIRE_SCHEMA_VERSION``)."""
+    return int(load_pins()["wire"]["version"])
+
+
+# ---------------------------------------------------------------------------
+# the drift rules
+# ---------------------------------------------------------------------------
+
+def _drift_finding(kind: str, rule_id: str, project: Project,
+                   current: str, pinned: dict, anchor: str) -> Finding:
+    return Finding(
+        rule=rule_id, path=anchor, line=1,
+        message=(f"{kind} schema drifted: fingerprint {current} != pinned "
+                 f"{pinned['fingerprint']} (version {pinned['version']}) — "
+                 "if the change is deliberate run `python tools/serflint.py "
+                 "--bump-schema` and note it per MIGRATION.md"),
+        # the drifted fingerprint is part of the key: baselining one
+        # drift (instead of --bump-schema) can never grandfather the
+        # NEXT drift — each new schema shape is a fresh finding
+        key=f"{kind}-schema@{current}")
+
+
+@project_rule("schema-pytree-drift",
+              "a GossipState/checkpoint pytree leaf changed without a "
+              "pinned-version bump — old checkpoints would fail closed "
+              "as a surprise",
+              "adding a GossipState field, pin untouched")
+def check_pytree_drift(files: List[SourceFile],
+                       project: Project) -> Iterable[Finding]:
+    if project.pins_path is None or not project.pins_path.exists():
+        return
+    pins = json.loads(project.pins_path.read_text())
+    current = pytree_fingerprint(project.root)
+    if current != pins["pytree"]["fingerprint"]:
+        yield _drift_finding("pytree", "schema-pytree-drift", project,
+                             current, pins["pytree"],
+                             "serf_tpu/models/dissemination.py")
+
+
+@project_rule("schema-wire-drift",
+              "a wire-message field list / field number / envelope tag "
+              "changed without a pinned-version bump — codec skew between "
+              "mixed-version nodes",
+              "re-numbering a JoinMessage field, pin untouched")
+def check_wire_drift(files: List[SourceFile],
+                     project: Project) -> Iterable[Finding]:
+    if project.pins_path is None or not project.pins_path.exists():
+        return
+    pins = json.loads(project.pins_path.read_text())
+    current = wire_fingerprint(project.root)
+    if current != pins["wire"]["fingerprint"]:
+        yield _drift_finding("wire", "schema-wire-drift", project,
+                             current, pins["wire"],
+                             "serf_tpu/types/messages.py")

@@ -9,12 +9,30 @@ specs and get symmetric encode/decode.
 
 A C++ fast path (``native/codec.cpp``) is loaded via ctypes when built; the
 pure-Python path is always available and is the semantic definition.
+
+``WIRE_SCHEMA_VERSION`` (module attribute, lazily loaded) is the pinned
+version of the whole wire surface — message field lists, wire field
+numbers, the ``MessageType``/``QueryFlag`` registries — from serflint's
+``serf_tpu/analysis/pins/schema_pins.json``.  Changing any of those
+without bumping the pin is a lint failure (``schema-wire-drift``); the
+deliberate bump is ``python tools/serflint.py --bump-schema`` (see
+MIGRATION.md).  Persisted or cross-version consumers should record this
+number next to encoded payloads.
 """
 
 from __future__ import annotations
 
 import struct
 from typing import Iterator, Tuple
+
+
+def __getattr__(name: str):
+    # lazy so codec (imported everywhere, early) never depends on the
+    # analysis package's import order
+    if name == "WIRE_SCHEMA_VERSION":
+        from serf_tpu.analysis.schema import wire_schema_version
+        return wire_schema_version()
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 # Wire types (protobuf-compatible numbering).
 WT_VARINT = 0

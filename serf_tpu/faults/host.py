@@ -36,6 +36,7 @@ from serf_tpu.host.transport import (
 from serf_tpu.obs import flight
 from serf_tpu.utils import metrics
 from serf_tpu.utils.logging import get_logger
+from serf_tpu.utils.tasks import spawn_logged
 
 log = get_logger("faults")
 
@@ -372,7 +373,8 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
             old = consumers.pop(i, None)
             if old is not None:
                 old.cancel()
-            consumers[i] = asyncio.create_task(consume(sub, gate))
+            consumers[i] = spawn_logged(consume(sub, gate),
+                                        f"chaos-consume-n{i}")
         return await Serf.create(net.bind(f"n{i}"), node_opts(i), f"n{i}",
                                  subscriber=sub)
 
@@ -486,8 +488,8 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
                 except Exception:  # noqa: BLE001
                     pass
 
-    bg = asyncio.create_task(background())
-    lg = asyncio.create_task(load_gen()) if with_load else None
+    bg = spawn_logged(background(), "chaos-background")
+    lg = spawn_logged(load_gen(), "chaos-load-gen") if with_load else None
     try:
         t0 = time.monotonic()
         for i in range(1, n):

@@ -39,6 +39,7 @@ from serf_tpu.types.member import Node
 from serf_tpu.utils import metrics
 
 from serf_tpu.utils.logging import get_logger
+from serf_tpu.utils.tasks import log_task_exception, spawn_logged
 
 log = get_logger("memberlist")
 
@@ -202,9 +203,17 @@ class Memberlist:
         self._started = False
 
     def _spawn(self, coro, name: str) -> asyncio.Task:
+        """Dynamic background task: retained in ``_bg``, exception-logged
+        on death (serflint async-fire-forget contract)."""
+        return spawn_logged(coro, name, registry=self._bg)
+
+    def _track(self, coro, name: str) -> asyncio.Task:
+        """Protocol-loop task: retained in ``_tasks`` for shutdown,
+        exception-logged the moment it dies — a dead probe loop is a
+        loud log line, not a cluster that silently stops detecting."""
         t = asyncio.create_task(coro, name=name)
-        self._bg.add(t)
-        t.add_done_callback(self._bg.discard)
+        t.add_done_callback(log_task_exception)
+        self._tasks.append(t)
         return t
 
     # ------------------------------------------------------------------
@@ -219,16 +228,13 @@ class Memberlist:
         self._nodes[self.local.id] = me
         self._probe_order.append(self.local.id)
         self.delegate.notify_join(me)
-        self._tasks = [
-            asyncio.create_task(self._packet_loop(), name=f"ml-packet-{self.local.id}"),
-            asyncio.create_task(self._stream_loop(), name=f"ml-stream-{self.local.id}"),
-            asyncio.create_task(self._probe_loop(), name=f"ml-probe-{self.local.id}"),
-            asyncio.create_task(self._gossip_loop(), name=f"ml-gossip-{self.local.id}"),
-        ]
+        self._tasks = []
+        self._track(self._packet_loop(), f"ml-packet-{self.local.id}")
+        self._track(self._stream_loop(), f"ml-stream-{self.local.id}")
+        self._track(self._probe_loop(), f"ml-probe-{self.local.id}")
+        self._track(self._gossip_loop(), f"ml-gossip-{self.local.id}")
         if self.opts.push_pull_interval > 0:
-            self._tasks.append(
-                asyncio.create_task(self._push_pull_loop(), name=f"ml-pp-{self.local.id}")
-            )
+            self._track(self._push_pull_loop(), f"ml-pp-{self.local.id}")
         self._started = True
 
     async def shutdown(self) -> None:

@@ -85,6 +85,7 @@ from serf_tpu import obs
 from serf_tpu.obs.health import HealthReport, HealthScorer, serf_sources
 from serf_tpu.obs.trace import new_trace, span, trace_scope
 from serf_tpu.utils import metrics
+from serf_tpu.utils.tasks import log_task_exception, spawn_logged
 
 from serf_tpu.utils.logging import get_logger
 
@@ -459,9 +460,18 @@ class Serf:
         self._leave_index_version = -1
 
     def _spawn(self, coro, name: str) -> asyncio.Task:
-        t = asyncio.create_task(coro, name=f"{name}-{self.local_id}")
-        self._bg.add(t)
-        t.add_done_callback(self._bg.discard)
+        """Dynamic background task: retained in ``_bg``, exception-logged
+        on death (serflint async-fire-forget contract)."""
+        return spawn_logged(coro, f"{name}-{self.local_id}",
+                            registry=self._bg)
+
+    def _track(self, coro, name: str) -> asyncio.Task:
+        """Long-lived engine task: retained in ``_tasks`` for shutdown,
+        exception-logged on death — a reaper that dies mid-run is a loud
+        log line now, not a silent stall until shutdown."""
+        t = asyncio.create_task(coro, name=name)
+        t.add_done_callback(log_task_exception)
+        self._tasks.append(t)
         return t
 
     @classmethod
@@ -480,14 +490,12 @@ class Serf:
             member_c = MemberEventCoalescer() if opts.coalesce_period > 0 else None
             user_c = UserEventCoalescer() if opts.user_coalesce_period > 0 else None
             if member_c or user_c:
-                s._tasks.append(asyncio.create_task(
-                    s._coalesce_pipeline(member_c, user_c), name=f"serf-coalesce-{node_id}"))
+                s._track(s._coalesce_pipeline(member_c, user_c),
+                         f"serf-coalesce-{node_id}")
             else:
-                s._tasks.append(asyncio.create_task(
-                    s._passthrough_pipeline(), name=f"serf-events-{node_id}"))
+                s._track(s._passthrough_pipeline(), f"serf-events-{node_id}")
         else:
-            s._tasks.append(asyncio.create_task(
-                s._drain_pipeline(), name=f"serf-drain-{node_id}"))
+            s._track(s._drain_pipeline(), f"serf-drain-{node_id}")
 
         # snapshot replay (reference base.rs:130-155)
         replay_nodes: List[Node] = []
@@ -507,8 +515,7 @@ class Serf:
                                   s.query_clock.time()),
                 min_compact_size=opts.snapshot_min_compact_size,
                 rejoin_after_leave=opts.rejoin_after_leave)
-            s._tasks.append(asyncio.create_task(
-                s.snapshotter.run(), name=f"serf-snapshot-{node_id}"))
+            s._track(s.snapshotter.run(), f"serf-snapshot-{node_id}")
 
         await s.memberlist.start()
 
@@ -518,17 +525,15 @@ class Serf:
             s._key_manager = KeyManager(s)
 
         # background tasks (reference base.rs:284-335)
-        s._tasks.append(asyncio.create_task(s._reaper(), name=f"serf-reaper-{node_id}"))
-        s._tasks.append(asyncio.create_task(s._reconnector(), name=f"serf-reconnect-{node_id}"))
-        s._tasks.append(asyncio.create_task(
-            s._health_monitor(), name=f"serf-health-{node_id}"))
-        s._tasks.append(asyncio.create_task(
-            s._query_sweeper(), name=f"serf-query-sweep-{node_id}"))
+        s._track(s._reaper(), f"serf-reaper-{node_id}")
+        s._track(s._reconnector(), f"serf-reconnect-{node_id}")
+        s._track(s._health_monitor(), f"serf-health-{node_id}")
+        s._track(s._query_sweeper(), f"serf-query-sweep-{node_id}")
         for qname, q in (("intent", s.intent_broadcasts),
                          ("event", s.event_broadcasts),
                          ("query", s.query_broadcasts)):
-            s._tasks.append(asyncio.create_task(
-                s._queue_checker(qname, q), name=f"serf-qc-{qname}-{node_id}"))
+            s._track(s._queue_checker(qname, q),
+                     f"serf-qc-{qname}-{node_id}")
 
         # auto-rejoin snapshot nodes (reference handle_rejoin, base.rs:1782)
         if replay_nodes and (opts.rejoin_after_leave or not getattr(
@@ -573,7 +578,7 @@ class Serf:
                 if ev is None:
                     return
 
-        t = asyncio.create_task(tee())
+        t = spawn_logged(tee(), f"serf-tee-{self.local_id}")
         try:
             while True:
                 ev = await mid.get()
@@ -612,7 +617,7 @@ class Serf:
                 if ev is None:
                     return
 
-        t = asyncio.create_task(tee())
+        t = spawn_logged(tee(), f"serf-coalesce-tee-{self.local_id}")
         try:
             if member_c and user_c:
                 mid2: asyncio.Queue = asyncio.Queue(maxsize=TEE_QUEUE_MAX)
@@ -623,7 +628,7 @@ class Serf:
                         ev = await relay._q.get()
                         await mid2.put(ev)
 
-                p = asyncio.create_task(pump())
+                p = spawn_logged(pump(), f"serf-coalesce-pump-{self.local_id}")
                 try:
                     await asyncio.gather(
                         coalesce_loop(mid, relay, member_c,
@@ -864,6 +869,11 @@ class Serf:
                     log.warning("timeout while waiting for leave broadcast")
             await self.memberlist.leave(self.opts.broadcast_timeout)
             if self._has_alive_peers():
+                # serflint: ignore[async-lock-await] -- deliberate: leave()
+                # must serialize end-to-end; a concurrent leave() parking
+                # here is exactly the intended behavior (reference
+                # api.rs:477 sleeps the propagate delay inside the leave
+                # critical section too)
                 await asyncio.sleep(self.opts.leave_propagate_delay)
             self.state = SerfState.LEFT
 

@@ -14,16 +14,39 @@ on-disk artifact is mesh-agnostic; ``restore(..., mesh=)`` RE-SHARDS the
 loaded pytree onto the given mesh — after validating that the mesh size
 divides every node-sharded axis, so a device-count mismatch fails closed
 with a clear error instead of an XLA shape crash.
+
+Every checkpoint is stamped with the pinned **pytree schema version**
+(serflint's ``serf_tpu/analysis/pins/schema_pins.json``): a leaf-spec
+change now fails restore with a *versioned* error pointing at
+MIGRATION.md instead of the shape-mismatch surprise that recurred in
+PR 3 and PR 5.  Checkpoints written before the stamp existed fall back
+to the per-array shape/dtype validation below.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+#: reserved npz key for the schema stamp (never a pytree leaf: keystr
+#: paths always start with a dot/bracket)
+_SCHEMA_KEY = "__pytree_schema_version__"
+
+_schema_version_cache: Optional[int] = None
+
+
+def _schema_version() -> int:
+    # deferred + cached: the runtime device plane must not import the
+    # analysis package (or re-read its pins file) on every save/restore
+    global _schema_version_cache
+    if _schema_version_cache is None:
+        from serf_tpu.analysis.schema import pytree_schema_version
+        _schema_version_cache = pytree_schema_version()
+    return _schema_version_cache
 
 
 def _flatten(state) -> dict:
@@ -37,6 +60,7 @@ def save(path: str, state: Any) -> None:
     compactor).  Sharded states gather here (``np.asarray`` pulls all
     addressable shards) — the artifact is mesh-agnostic."""
     arrays = _flatten(state)
+    arrays[_SCHEMA_KEY] = np.asarray(_schema_version(), np.int64)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
@@ -75,6 +99,17 @@ def restore(path: str, template: Any, mesh=None) -> Any:
 
     try:
         with np.load(path) as data:
+            if _SCHEMA_KEY in data:
+                found = int(data[_SCHEMA_KEY])
+                current = _schema_version()
+                if found != current:
+                    raise ValueError(
+                        f"checkpoint {path!r} was written at pytree "
+                        f"schema version {found}, this build is at "
+                        f"{current} — the GossipState/ClusterState leaf "
+                        "spec changed since it was saved; see "
+                        "MIGRATION.md ('Schema versioning') for the "
+                        "bump workflow")
             flat, treedef = jax.tree_util.tree_flatten_with_path(template)
             leaves = []
             for path_k, leaf in flat:

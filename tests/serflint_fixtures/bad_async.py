@@ -1,0 +1,44 @@
+"""serflint fixture: every async rule MUST fire on this file.
+
+Linted as a toy-project file (never imported, never executed); the clean
+twin is ok_async.py.
+"""
+import asyncio
+import time
+
+
+async def spawn_and_forget(loop):
+    # async-fire-forget: bare statement, handle discarded
+    asyncio.create_task(asyncio.sleep(1))
+    # async-fire-forget: ensure_future variant
+    asyncio.ensure_future(asyncio.sleep(1))
+    # async-fire-forget: loop.create_task variant
+    loop.create_task(asyncio.sleep(1))
+
+
+async def blocks_the_loop():
+    # async-blocking-call: sync sleep stalls every coroutine
+    time.sleep(0.5)
+
+
+class Holder:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+
+    async def parks_under_lock(self, event):
+        async with self._lock:
+            # async-lock-await: timer park inside the critical section
+            await asyncio.sleep(1.0)
+            # async-lock-await: event park inside the critical section
+            await event.wait()
+
+
+class SharedState:
+    def __init__(self):
+        self._peers = {}
+
+    async def writer_a(self, k, v):
+        self._peers[k] = v
+
+    async def writer_b(self, k):
+        self._peers.pop(k, None)

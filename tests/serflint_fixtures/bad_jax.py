@@ -1,0 +1,50 @@
+"""serflint fixture: every JAX rule MUST fire (linted at a
+serf_tpu/models/ path inside a toy project; never imported)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+
+@jax.jit
+def branch_on_tracer(x):
+    # jax-python-branch: Python `if` on a traced parameter
+    if x > 0:
+        return x + 1
+    return x - 1
+
+
+@partial(jax.jit, static_argnums=())
+def concretize_tracer(x):
+    # jax-host-concretize: float() on a traced value
+    total = float(x)
+    # jax-host-concretize: .item() inside a traced body
+    peak = x.item()
+    return total + peak
+
+
+def scan_body_branches(carry, x):
+    # jax-python-branch: this function is traced via lax.scan below
+    while x > 0:
+        carry = carry + 1
+    return carry, x
+
+
+def drive(xs):
+    return jax.lax.scan(scan_body_branches, 0, xs)
+
+
+def round_step_transfers(state):
+    # jax-host-transfer: per-round device sync on the hot path
+    host_view = np.asarray(state)
+    return jax.device_get(host_view)
+
+
+@jax.jit
+def jitted_consumer(x, extras):
+    return x
+
+
+def caller(x):
+    # jax-unhashable-arg: list literal forces a recompile every call
+    return jitted_consumer(x, [1, 2, 3])

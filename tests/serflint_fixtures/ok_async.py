@@ -1,0 +1,49 @@
+"""serflint fixture: the clean twin of bad_async.py — NO async rule may
+fire here."""
+import asyncio
+
+
+def _log_exc(t):
+    if not t.cancelled() and t.exception() is not None:
+        pass
+
+
+async def spawn_retained(registry: set):
+    # handle retained + exception sink: the fire-forget contract
+    t = asyncio.create_task(asyncio.sleep(1))
+    registry.add(t)
+    t.add_done_callback(registry.discard)
+    t.add_done_callback(_log_exc)
+    return t
+
+
+async def sleeps_asynchronously():
+    # the asyncio equivalent never blocks the loop
+    await asyncio.sleep(0.5)
+
+
+class Holder:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+
+    async def parks_outside_lock(self, event):
+        async with self._lock:
+            state = dict()
+        # parks AFTER releasing — contenders are not serialized
+        await asyncio.sleep(1.0)
+        await event.wait()
+        return state
+
+
+class SharedState:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        self._peers = {}
+
+    async def writer_a(self, k, v):
+        async with self._lock:
+            self._peers[k] = v
+
+    async def writer_b(self, k):
+        async with self._lock:
+            self._peers.pop(k, None)

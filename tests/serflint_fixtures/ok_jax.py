@@ -1,0 +1,55 @@
+"""serflint fixture: the clean twin of bad_jax.py — NO JAX rule may
+fire (linted at a serf_tpu/models/ path inside a toy project)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def select_on_tracer(x):
+    # the traced branch, expressed symbolically
+    return jnp.where(x > 0, x + 1, x - 1)
+
+
+@jax.jit
+def branch_on_config(x, cfg):
+    # cfg params are static by convention — a Python branch is fine
+    if cfg.with_failure:
+        return x + 1
+    return x
+
+
+@jax.jit
+def optional_arg(x, key=None):
+    # `is None` dispatch on an optional arg is Python-level and legit
+    if key is None:
+        return x
+    return x + 1
+
+
+def scan_body_symbolic(carry, x):
+    return carry + jnp.minimum(x, 1), x
+
+
+def drive(xs):
+    return jax.lax.scan(scan_body_symbolic, 0, xs)
+
+
+def emit_round_metrics(state):
+    # not round-step code (emit_* batched-pull pattern): host transfer ok
+    return {"serf.fixture.gauge": float(np.asarray(state).sum())}
+
+
+def round_step_on_device(state):
+    # the hot path stays on device
+    return state * 2
+
+
+@jax.jit
+def jitted_consumer(x, extras):
+    return x
+
+
+def caller(x):
+    # hashable static shapes: tuple, not list
+    return jitted_consumer(x, (1, 2, 3))

@@ -1,0 +1,453 @@
+"""serflint tier-1 contract (ISSUE 8).
+
+- golden fixtures: per rule, one intentionally-bad snippet that MUST
+  fire and one clean twin that must NOT (tests/serflint_fixtures/);
+- suppression comments (mandatory reason) and the baseline round-trip;
+- schema drift: changing a pytree leaf or a wire field without bumping
+  the pinned fingerprint fails lint (toy-project fixture), and the
+  runtime guards (checkpoint stamp, codec export) agree with the pins;
+- the repo gate: ``tools/serflint.py --json`` exits 0 with zero new
+  findings, in well under the 30 s acceptance bound.
+
+Everything here runs the analyzer in-process on toy projects under
+tmp_path (fixture files are copied to the path the rule scopes expect);
+only the repo gate shells out, mirroring the chaos/obstop tier-1 hooks.
+"""
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "serflint_fixtures"
+
+sys.path.insert(0, str(REPO))
+
+from serf_tpu import analysis                               # noqa: E402
+from serf_tpu.analysis import schema as schema_mod          # noqa: E402
+from serf_tpu.analysis.core import Project, Registry        # noqa: E402
+
+
+def toy_project(tmp_path, files, readme=None, registry=None,
+                baseline=False, pins=False) -> Project:
+    """Materialize a toy project tree and return its Project config."""
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    readme_path = None
+    if readme is not None:
+        readme_path = tmp_path / "README.md"
+        readme_path.write_text(readme)
+    return Project(
+        root=tmp_path, scan=("serf_tpu",), metric_scan=("serf_tpu",),
+        readme=readme_path,
+        baseline_path=(tmp_path / "baseline.json") if baseline else None,
+        pins_path=(tmp_path / "pins.json") if pins else None,
+        registry=registry)
+
+
+def rules_fired(report):
+    return {f.rule for f in report.findings}
+
+
+def count(report, rule):
+    return sum(1 for f in report.findings if f.rule == rule)
+
+
+# ---------------------------------------------------------------------------
+# async family: fixtures fire / clean twins don't
+# ---------------------------------------------------------------------------
+
+
+def test_async_bad_fixture_fires_every_rule(tmp_path):
+    project = toy_project(tmp_path, {
+        "serf_tpu/host/fake.py": (FIXTURES / "bad_async.py").read_text()})
+    report = analysis.run_rules(project)
+    assert count(report, "async-fire-forget") == 3
+    assert count(report, "async-blocking-call") == 1
+    assert count(report, "async-lock-await") == 2
+    assert count(report, "async-shared-mut") == 1
+
+
+def test_async_clean_twin_is_silent(tmp_path):
+    project = toy_project(tmp_path, {
+        "serf_tpu/host/fake.py": (FIXTURES / "ok_async.py").read_text()})
+    report = analysis.run_rules(project)
+    assert report.findings == []
+
+
+# ---------------------------------------------------------------------------
+# JAX family (scoped to serf_tpu/models|ops|parallel paths)
+# ---------------------------------------------------------------------------
+
+
+def test_jax_bad_fixture_fires_every_rule(tmp_path):
+    project = toy_project(tmp_path, {
+        "serf_tpu/models/fake.py": (FIXTURES / "bad_jax.py").read_text()})
+    report = analysis.run_rules(project)
+    assert count(report, "jax-python-branch") == 2      # if + scan while
+    assert count(report, "jax-host-concretize") == 2    # float() + .item()
+    assert count(report, "jax-host-transfer") == 2      # asarray + device_get
+    assert count(report, "jax-unhashable-arg") == 1
+
+
+def test_jax_clean_twin_is_silent(tmp_path):
+    project = toy_project(tmp_path, {
+        "serf_tpu/models/fake.py": (FIXTURES / "ok_jax.py").read_text()})
+    report = analysis.run_rules(project)
+    assert report.findings == []
+
+
+def test_jax_rules_scope_outside_device_plane(tmp_path):
+    """The same bad file OUTSIDE models/ops/parallel trips only the
+    path-agnostic families — the JAX passes are scoped."""
+    project = toy_project(tmp_path, {
+        "serf_tpu/host/fake.py": (FIXTURES / "bad_jax.py").read_text()})
+    report = analysis.run_rules(project)
+    assert not any(r.startswith("jax-") for r in rules_fired(report))
+
+
+# ---------------------------------------------------------------------------
+# registry family
+# ---------------------------------------------------------------------------
+
+_EMITTER = '''\
+from wherever import flight, metrics
+
+
+def emit():
+    metrics.incr("serf.fixture.good")
+    metrics.gauge("serf.fixture.rogue", 1)
+    flight.record("good-kind", detail=1)
+    flight.record("rogue-kind")
+'''
+
+_README_OBS = '''\
+## Observability
+
+| Metric | type | labels | doc |
+|---|---|---|---|
+| `serf.fixture.good` | counter | — | fine |
+'''
+
+
+def test_registry_cross_checks_fire(tmp_path):
+    project = toy_project(
+        tmp_path, {"serf_tpu/fake.py": _EMITTER}, readme=_README_OBS,
+        registry=Registry(
+            metrics=frozenset({"serf.fixture.good", "serf.fixture.unused"}),
+            flight_kinds=frozenset({"good-kind", "unused-kind"})))
+    report = analysis.run_rules(project)
+    by_key = {(f.rule, f.key) for f in report.findings}
+    assert ("reg-metric-unknown", "serf.fixture.rogue") in by_key
+    assert ("reg-metric-unused", "serf.fixture.unused") in by_key
+    assert ("reg-flight-unknown", "rogue-kind") in by_key
+    assert ("reg-flight-unused", "unused-kind") in by_key
+    # registry declares serf.fixture.unused but README has no row
+    assert ("reg-doc-drift", "serf.fixture.unused") in by_key
+
+
+def test_registry_in_sync_is_silent(tmp_path):
+    readme = _README_OBS + "| `serf.fixture.rogue` | gauge | — | now ok |\n"
+    project = toy_project(
+        tmp_path, {"serf_tpu/fake.py": _EMITTER}, readme=readme,
+        registry=Registry(
+            metrics=frozenset({"serf.fixture.good", "serf.fixture.rogue"}),
+            flight_kinds=frozenset({"good-kind", "rogue-kind"})))
+    report = analysis.run_rules(
+        project, rules=["reg-metric-unknown", "reg-metric-unused",
+                        "reg-doc-drift", "reg-flight-unknown",
+                        "reg-flight-unused"])
+    assert report.findings == []
+
+
+# ---------------------------------------------------------------------------
+# schema family: drift without a bump fails lint; bump clears it
+# ---------------------------------------------------------------------------
+
+_TOY_PYTREE = '''\
+from typing import NamedTuple
+
+
+class GossipState(NamedTuple):
+    known: int
+    stamp: int
+'''
+
+_TOY_WIRE = '''\
+class JoinMessage:
+    ltime: int
+    id: str
+
+    TYPE = 2
+
+    def encode_body(self):
+        return codec.encode_varint_field(1, self.ltime) \\
+            + codec.encode_str_field(2, self.id)
+
+    @classmethod
+    def decode_body(cls, buf):
+        for f, _wt, v, _p in codec.iter_fields(buf):
+            if f == 1:
+                lt = v
+            elif f == 2:
+                nid = v
+        return cls(lt, nid)
+'''
+
+
+def _schema_project(tmp_path):
+    project = toy_project(tmp_path, {
+        "serf_tpu/models/dissemination.py": _TOY_PYTREE,
+        "serf_tpu/types/messages.py": _TOY_WIRE,
+    }, pins=True)
+    schema_mod.bump_pins(root=tmp_path, path=project.pins_path)
+    return project
+
+
+SCHEMA_RULES = ["schema-pytree-drift", "schema-wire-drift"]
+
+
+def test_schema_pinned_is_silent(tmp_path):
+    project = _schema_project(tmp_path)
+    report = analysis.run_rules(project, rules=SCHEMA_RULES)
+    assert report.findings == []
+
+
+def test_pytree_leaf_change_without_bump_fails(tmp_path):
+    project = _schema_project(tmp_path)
+    p = tmp_path / "serf_tpu/models/dissemination.py"
+    p.write_text(p.read_text() + "    tombstone: int\n")
+    report = analysis.run_rules(project, rules=SCHEMA_RULES)
+    assert rules_fired(report) == {"schema-pytree-drift"}
+    # the deliberate bump clears it and advances the version
+    before = json.loads(project.pins_path.read_text())
+    schema_mod.bump_pins(root=tmp_path, path=project.pins_path)
+    after = json.loads(project.pins_path.read_text())
+    assert after["pytree"]["version"] == before["pytree"]["version"] + 1
+    assert after["wire"] == before["wire"]
+    report = analysis.run_rules(project, rules=SCHEMA_RULES)
+    assert report.findings == []
+
+
+def test_wire_field_change_without_bump_fails(tmp_path):
+    project = _schema_project(tmp_path)
+    p = tmp_path / "serf_tpu/types/messages.py"
+    p.write_text(p.read_text().replace(
+        "codec.encode_str_field(2, self.id)",
+        "codec.encode_str_field(3, self.id)"))
+    report = analysis.run_rules(project, rules=SCHEMA_RULES)
+    assert rules_fired(report) == {"schema-wire-drift"}
+
+
+def test_repo_pins_match_current_sources():
+    """The committed pins match the committed schemas — a PR that edits
+    GossipState or a wire message without --bump-schema fails HERE
+    (and in the repo gate below)."""
+    pins = schema_mod.load_pins()
+    assert pins["pytree"]["fingerprint"] == schema_mod.pytree_fingerprint()
+    assert pins["wire"]["fingerprint"] == schema_mod.wire_fingerprint()
+    # the specs cover the real surface
+    spec = schema_mod.pytree_spec(REPO)
+    assert set(spec) == {"FactTable", "GossipState", "VivaldiState",
+                         "ClusterState"}
+    assert "tombstone" in spec["GossipState"]
+    wire = schema_mod.wire_spec(REPO)
+    assert "JoinMessage" in wire and "MessageType" in wire
+    assert wire["MessageType"]["members"]["QUERY"] == 5
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+_BLOCKING = '''\
+import asyncio
+import time
+
+
+async def f():
+    time.sleep(1){suffix}
+'''
+
+
+def test_suppression_with_reason_silences(tmp_path):
+    src = _BLOCKING.format(
+        suffix="  # serflint: ignore[async-blocking-call] -- fixture: "
+               "proving the suppression path")
+    project = toy_project(tmp_path, {"serf_tpu/fake.py": src})
+    report = analysis.run_rules(project)
+    assert report.findings == []
+    assert len(report.suppressed) == 1
+
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+    src = _BLOCKING.format(
+        suffix="  # serflint: ignore[async-blocking-call]")
+    project = toy_project(tmp_path, {"serf_tpu/fake.py": src})
+    report = analysis.run_rules(project)
+    # the original finding is suppressed, but the bare ignore is flagged
+    assert rules_fired(report) == {"suppress-no-reason"}
+
+
+def test_suppression_on_preceding_comment_line(tmp_path):
+    src = ('import asyncio\nimport time\n\n\nasync def f():\n'
+           '    # serflint: ignore[async-blocking-call] -- fixture: the\n'
+           '    # reason wraps onto a second comment line\n'
+           '    time.sleep(1)\n')
+    project = toy_project(tmp_path, {"serf_tpu/fake.py": src})
+    report = analysis.run_rules(project)
+    assert report.findings == []
+    assert len(report.suppressed) == 1
+
+
+def test_unused_suppression_is_a_finding(tmp_path):
+    src = ('import asyncio\n\n\nasync def f():\n'
+           '    await asyncio.sleep(1)  '
+           '# serflint: ignore[async-blocking-call] -- stale\n')
+    project = toy_project(tmp_path, {"serf_tpu/fake.py": src})
+    report = analysis.run_rules(project)
+    assert rules_fired(report) == {"suppress-unused"}
+
+
+def test_suppression_grammar_in_strings_is_inert(tmp_path):
+    src = ('DOC = "use # serflint: ignore[async-blocking-call] -- reason"\n')
+    project = toy_project(tmp_path, {"serf_tpu/fake.py": src})
+    report = analysis.run_rules(project)
+    assert report.findings == []
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_round_trip(tmp_path):
+    bad = (FIXTURES / "bad_async.py").read_text()
+    project = toy_project(tmp_path, {"serf_tpu/fake.py": bad},
+                          baseline=True)
+    n = len(analysis.run_rules(project).findings)
+    assert n > 0
+
+    # --fix-baseline grandfathers everything, but with TODO reasons the
+    # gate refuses until a human annotates them
+    wrote = analysis.fix_baseline(project)
+    assert wrote == n
+    report = analysis.run_rules(project)
+    assert rules_fired(report) == {"baseline-no-reason"}
+    assert len(report.baselined) == n
+
+    # annotating every reason makes the gate green
+    data = json.loads(project.baseline_path.read_text())
+    for e in data["entries"]:
+        e["reason"] = "fixture: justified"
+    project.baseline_path.write_text(json.dumps(data))
+    report = analysis.run_rules(project)
+    assert report.findings == []
+    assert len(report.baselined) == n
+
+    # fixing the code makes every entry stale — loudly
+    (tmp_path / "serf_tpu/fake.py").write_text(
+        (FIXTURES / "ok_async.py").read_text())
+    report = analysis.run_rules(project)
+    assert rules_fired(report) == {"baseline-stale"}
+    assert len(report.findings) == n
+
+
+# ---------------------------------------------------------------------------
+# docs pass
+# ---------------------------------------------------------------------------
+
+
+def test_docs_rule_table_enforced_both_ways(tmp_path):
+    readme = ("## Static analysis\n\n| Rule | Catches | Example |\n"
+              "|---|---|---|\n| `no-such-rule` | x | y |\n")
+    project = toy_project(tmp_path, {"serf_tpu/fake.py": "x = 1\n"},
+                          readme=readme)
+    report = analysis.run_rules(project, rules=["docs-rule-table"])
+    keys = {f.key for f in report.findings}
+    assert "no-such-rule" in keys                  # stale row
+    assert "async-fire-forget" in keys             # missing row
+
+
+# ---------------------------------------------------------------------------
+# runtime guards agree with the pins
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_stamps_and_checks_schema_version(tmp_path):
+    import numpy as np
+    from serf_tpu.models import checkpoint
+    from serf_tpu.models.dissemination import GossipConfig, make_state
+
+    cfg = GossipConfig(n=32, k_facts=32)
+    path = str(tmp_path / "ck.npz")
+    checkpoint.save(path, make_state(cfg))
+    with np.load(path) as data:
+        assert int(data["__pytree_schema_version__"]) \
+            == schema_mod.pytree_schema_version()
+    checkpoint.restore(path, make_state(cfg))      # same version: fine
+
+    tampered = dict(np.load(path))
+    tampered["__pytree_schema_version__"] = np.asarray(
+        schema_mod.pytree_schema_version() + 1, np.int64)
+    path2 = str(tmp_path / "ck2.npz")
+    with open(path2, "wb") as f:
+        np.savez(f, **tampered)
+    with pytest.raises(ValueError, match="MIGRATION.md"):
+        checkpoint.restore(path2, make_state(cfg))
+
+
+def test_codec_exports_wire_schema_version():
+    from serf_tpu import codec
+    assert codec.WIRE_SCHEMA_VERSION \
+        == schema_mod.load_pins()["wire"]["version"]
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 repo gate (like chaos.py --self-check)
+# ---------------------------------------------------------------------------
+
+
+def test_serflint_repo_gate_zero_new_findings():
+    """``tools/serflint.py --json`` exits 0 on the repo: zero new
+    findings over the reason-annotated baseline, in <30 s (acceptance
+    bound; pure AST keeps it in single digits)."""
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "serflint.py"), "--json"],
+        capture_output=True, text=True, timeout=120,
+        env={"PATH": "/usr/bin:/bin", "PYTHONPATH": str(REPO)})
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["findings"] == []
+    assert out["stale_baseline"] == []
+    # every baseline entry carries a real reason (gate-enforced too)
+    for e in json.loads((REPO / "serflint_baseline.json").read_text(
+            ))["entries"]:
+        assert e["reason"] and not e["reason"].upper().startswith(
+            ("TODO", "FIXME"))
+    assert elapsed < 30, f"serflint took {elapsed:.1f}s (budget 30s)"
+
+
+def test_rule_registry_is_exactly_the_shipped_set():
+    """Adding a rule without extending the fixtures/README fails here
+    on purpose — every rule ships with its golden fixtures."""
+    assert set(analysis.ALL_RULES) == {
+        "async-fire-forget", "async-blocking-call", "async-lock-await",
+        "async-shared-mut",
+        "jax-python-branch", "jax-host-concretize", "jax-host-transfer",
+        "jax-unhashable-arg",
+        "reg-metric-unknown", "reg-metric-unused", "reg-doc-drift",
+        "reg-flight-unknown", "reg-flight-unused",
+        "schema-pytree-drift", "schema-wire-drift",
+        "docs-rule-table",
+        "suppress-no-reason", "suppress-unused",
+        "baseline-stale", "baseline-no-reason",
+    }

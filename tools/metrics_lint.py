@@ -1,115 +1,57 @@
 #!/usr/bin/env python
 """Lint: every emitted metric name is documented, and vice versa.
 
-The README "Observability" table is the contract operators build dashboards
-against; this tool keeps it honest in both directions:
+Since ISSUE 8 this is a THIN WRAPPER over the serflint registry pass
+(``serf_tpu.analysis.registry``) — the PR-1 one-off grew into the
+repo-wide static-analysis plane, and the metric extraction, README-table
+parsing, and drift checks all live there now (shared with the
+``reg-metric-*``/``reg-doc-drift`` rules).  The original contract is
+unchanged and still tier-1:
 
-- every metric name the tree emits (``metrics.incr/gauge/observe`` call
-  sites, plus the name->value dict literals inside the device plane's
-  ``emit_*_metrics`` functions, where the gauge call loops over the dict)
-  must have a row in the table;
-- every row in the table must correspond to at least one emission site
-  (no stale docs).
+- every metric name the tree emits must have a row in the README
+  "## Observability" table;
+- every row in the table must correspond to at least one emission site;
+- (new) both must be declared in the ONE registry
+  (``serf_tpu/analysis/registry.py`` METRICS).
 
-Dynamic name segments are normalized on both sides — an f-string
-``serf.queue.{self.name}`` at a call site and ``serf.queue.<name>`` in the
-table both become ``serf.queue.<>`` — so parameterized families stay
-documented as one row.
-
-Exit 0 = in sync; exit 1 prints the drift.  Wired into tier-1 as a fast
-test (tests/test_observability.py); also runnable directly:
+Exit 0 = in sync; exit 1 prints the drift.  Runnable directly:
 
     python tools/metrics_lint.py
+
+The module-level API (``SCAN``/``README``/``normalize``/
+``emitted_names``/``documented_names``/``run``) is kept verbatim for the
+tier-1 hooks in tests/test_cluster_obs.py and tests/test_observability.py.
 """
 
 from __future__ import annotations
 
-import ast
-import re
 import sys
 from pathlib import Path
-from typing import Dict, Iterable, Set
 
 REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from serf_tpu.analysis import registry as _registry        # noqa: E402
+
 README = REPO / "README.md"
 #: where metric emissions live; tests are deliberately excluded (they
 #: emit throwaway names when exercising the sink itself)
 SCAN = ["serf_tpu", "bench.py"]
-#: a string is a candidate metric name only under this grammar
-NAME_RE = re.compile(r"^(serf|memberlist)\.[a-z0-9_.<>{}-]+$")
-#: README table rows: | `name` | type | ...
-ROW_RE = re.compile(r"^\|\s*`([^`]+)`\s*\|")
-_DYNAMIC = re.compile(r"(\{[^{}]*\}|<[^<>]*>)")
+
+normalize = _registry.normalize
+NAME_RE = _registry.NAME_RE
+ROW_RE = _registry.ROW_RE
 
 
-def normalize(name: str) -> str:
-    """Collapse every dynamic segment ({expr} or <doc>) to ``<>``."""
-    return _DYNAMIC.sub("<>", name)
-
-
-def _joined_str_pattern(node: ast.JoinedStr) -> str:
-    parts = []
-    for v in node.values:
-        if isinstance(v, ast.Constant):
-            parts.append(str(v.value))
-        else:
-            parts.append("{}")
-    return "".join(parts)
-
-
-def emitted_names(paths: Iterable[Path]) -> Dict[str, Set[str]]:
+def emitted_names(paths):
     """{normalized_name: {file:line, ...}} across all scanned sources."""
-    out: Dict[str, Set[str]] = {}
-
-    def add(raw: str, path: Path, lineno: int) -> None:
-        if not NAME_RE.match(normalize(raw).replace("<>", "x")):
-            return
-        out.setdefault(normalize(raw), set()).add(
-            f"{path.relative_to(REPO)}:{lineno}")
-
-    for path in paths:
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            # metrics.incr/gauge/observe("name"...) and f-string variants
-            if (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr in ("incr", "gauge", "observe")
-                    and isinstance(node.func.value, ast.Name)
-                    and node.func.value.id == "metrics"
-                    and node.args):
-                arg = node.args[0]
-                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-                    add(arg.value, path, node.lineno)
-                elif isinstance(arg, ast.JoinedStr):
-                    add(_joined_str_pattern(arg), path, node.lineno)
-            # device-plane emitters: {"name": value, ...} dict literals
-            # inside emit_*_metrics functions (emitted via a loop)
-            elif (isinstance(node, ast.FunctionDef)
-                  and node.name.startswith("emit_")
-                  and node.name.endswith("_metrics")):
-                for sub in ast.walk(node):
-                    if isinstance(sub, ast.Dict):
-                        for key in sub.keys:
-                            if (isinstance(key, ast.Constant)
-                                    and isinstance(key.value, str)):
-                                add(key.value, path, sub.lineno)
-    return out
+    return _registry.emitted_metric_names(paths)
 
 
-def documented_names(readme: Path) -> Dict[str, str]:
+def documented_names(readme: Path):
     """{normalized_name: raw_name} from the README Observability table."""
-    out: Dict[str, str] = {}
-    in_section = False
-    for line in readme.read_text().splitlines():
-        if line.startswith("## "):
-            in_section = line.strip() == "## Observability"
-            continue
-        if not in_section:
-            continue
-        m = ROW_RE.match(line)
-        if m and m.group(1) != "Metric":
-            out[normalize(m.group(1))] = m.group(1)
-    return out
+    return _registry.documented_metric_names(readme)
 
 
 def run() -> int:
@@ -118,27 +60,15 @@ def run() -> int:
         p = REPO / entry
         files.extend(sorted(p.rglob("*.py")) if p.is_dir() else [p])
     emitted = emitted_names(files)
-    documented = documented_names(README)
-    if not documented:
-        print("metrics_lint: no table rows found under '## Observability' "
-              f"in {README}")
-        return 1
-
-    rc = 0
-    for name in sorted(set(emitted) - set(documented)):
-        print(f"metrics_lint: EMITTED BUT UNDOCUMENTED: {name} "
-              f"(at {', '.join(sorted(emitted[name]))}) — add a row to "
-              "README.md '## Observability'")
-        rc = 1
-    for name in sorted(set(documented) - set(emitted)):
-        print(f"metrics_lint: DOCUMENTED BUT NEVER EMITTED: "
-              f"{documented[name]} — delete the README row or restore the "
-              "emission")
-        rc = 1
-    if rc == 0:
-        print(f"metrics_lint: OK — {len(emitted)} metric names, "
-              "README table in sync")
-    return rc
+    drift = _registry.metric_drift_report(files, README, _registry.METRICS,
+                                          emitted=emitted)
+    for line in drift:
+        print(f"metrics_lint: {line}")
+    if not drift:
+        print(f"metrics_lint: OK — {len(emitted)} metric "
+              "names, registry + README table in sync")
+        return 0
+    return 1
 
 
 if __name__ == "__main__":
