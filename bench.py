@@ -533,6 +533,23 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - the lint embed is best-effort
         detail["analysis_error"] = repr(e)[:300]
 
+    # record/replay determinism self-check (ISSUE 9): record a short
+    # seeded device run, replay it from the recording, and require the
+    # per-round membership-view digest streams to be identical — a
+    # nondeterminism regression (or a replay-plane bug) shows up in the
+    # per-round trajectory instead of a user's chaos report
+    try:
+        from serf_tpu.replay.selfcheck import device_roundtrip
+        detail["replay"] = device_roundtrip()
+        if not detail["replay"]["digest_equal"]:
+            where = detail["replay"]["first_divergent_round"]
+            sys.stderr.write(
+                "replay self-check DIVERGED at round %s\n"
+                % ("<none: stream length/step mismatch>"
+                   if where is None else where))
+    except Exception as e:  # noqa: BLE001 - the self-check is best-effort
+        detail["replay_error"] = repr(e)[:300]
+
     detail["platform"] = platform
     sys.stderr.write(json.dumps(detail) + "\n")
     # Only ORCHESTRATED runs write the committed artifact: ad-hoc

@@ -142,6 +142,10 @@ METRICS: tuple = (
     # static analysis (bench embeds the finding trajectory per round)
     "serf.analysis.findings",
     "serf.analysis.baselined",
+    # record/replay plane (serf_tpu/replay)
+    "serf.replay.records",
+    "serf.replay.rounds",
+    "serf.replay.divergence",
 )
 
 #: every flight-recorder event kind (obs/flight.py ``record`` call sites)
@@ -166,6 +170,8 @@ FLIGHT_KINDS: tuple = (
     "query-responses-shed",
     "queue-overflow",
     "queue-shed",
+    "replay-divergence",
+    "replay-recorded",
     "shard-fallback",
     "snapshot-torn-tail",
     "subscriber-drop",

@@ -9,7 +9,12 @@ Two schemas have silently broken consumers twice each (CHANGES.md):
 - the **wire-message field lists** — a re-numbered or added field skews
   the codec between mixed-version nodes.
 
-Both are now FINGERPRINTED from the AST (NamedTuple leaf names for the
+A third persisted surface joined in PR 9: the **record/replay recording
+format** (``serf_tpu/replay/recording.py`` ``RECORDING_SCHEMA`` — the
+JSONL record kinds + field lists), pinned as ``recording`` and stamped
+into every recording header, with load failing closed on a mismatch.
+
+All are FINGERPRINTED from the AST (NamedTuple leaf names for the
 pytree; dataclass field names + wire field numbers + enum registries for
 the wire) and pinned with a version in
 ``serf_tpu/analysis/pins/schema_pins.json``.  Changing either schema
@@ -57,6 +62,12 @@ WIRE_SOURCES: List[str] = [
 #: wire-carried enum registries (member numbering IS wire semantics)
 WIRE_REGISTRIES = ("MessageType", "QueryFlag", "SwimMessageType",
                    "SwimState", "MemberStatus")
+
+#: the record/replay recording format: the declared record-kind -> field
+#: lists literal in the replay plane (``RECORDING_SCHEMA``); a recording
+#: is a persisted cross-version artifact exactly like a checkpoint
+RECORDING_SOURCE = "serf_tpu/replay/recording.py"
+RECORDING_DECL = "RECORDING_SCHEMA"
 
 
 def _fingerprint(obj) -> str:
@@ -153,12 +164,38 @@ def _wire_spec_of(tree: ast.AST, spec: Dict[str, dict]) -> None:
             spec[node.name] = {"fields": fields, "wire": sorted(wire_nums)}
 
 
+def recording_spec(root: Path) -> Dict[str, List[str]]:
+    """Record kinds and their ordered field lists from the
+    ``RECORDING_SCHEMA`` literal (pure AST, like the other specs)."""
+    p = root / RECORDING_SOURCE
+    if not p.exists():
+        return {}
+    for node in ast.walk(ast.parse(p.read_text())):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == RECORDING_DECL \
+                and isinstance(node.value, ast.Dict):
+            out: Dict[str, List[str]] = {}
+            for key, val in zip(node.value.keys, node.value.values):
+                if isinstance(key, ast.Constant) \
+                        and isinstance(val, (ast.Tuple, ast.List)):
+                    out[key.value] = [
+                        e.value for e in val.elts
+                        if isinstance(e, ast.Constant)]
+            return out
+    return {}
+
+
 def pytree_fingerprint(root: Path = REPO) -> str:
     return _fingerprint(pytree_spec(root))
 
 
 def wire_fingerprint(root: Path = REPO) -> str:
     return _fingerprint(wire_spec(root))
+
+
+def recording_fingerprint(root: Path = REPO) -> str:
+    return _fingerprint(recording_spec(root))
 
 
 # ---------------------------------------------------------------------------
@@ -176,14 +213,16 @@ def save_pins(pins: dict, path: Optional[Path] = None) -> None:
 
 
 def bump_pins(root: Path = REPO, path: Optional[Path] = None) -> dict:
-    """The deliberate schema bump: recompute both fingerprints, bump the
-    version of whichever changed (MIGRATION.md documents the workflow)."""
+    """The deliberate schema bump: recompute every fingerprint, bump the
+    version of whichever changed (MIGRATION.md documents the workflow).
+    A kind the pin file predates (e.g. ``recording``) starts at
+    version 0 and bumps to 1 on first stamp."""
     p = path or (root / PINS_NAME)
-    pins = json.loads(p.read_text()) if p.exists() else {
-        "pytree": {"version": 0, "fingerprint": ""},
-        "wire": {"version": 0, "fingerprint": ""}}
+    pins = json.loads(p.read_text()) if p.exists() else {}
     for kind, fp in (("pytree", pytree_fingerprint(root)),
-                     ("wire", wire_fingerprint(root))):
+                     ("wire", wire_fingerprint(root)),
+                     ("recording", recording_fingerprint(root))):
+        pins.setdefault(kind, {"version": 0, "fingerprint": ""})
         if pins[kind]["fingerprint"] != fp:
             pins[kind] = {"version": pins[kind]["version"] + 1,
                           "fingerprint": fp}
@@ -202,6 +241,12 @@ def wire_schema_version() -> int:
     """Runtime accessor (exported as ``serf_tpu.codec
     .WIRE_SCHEMA_VERSION``)."""
     return int(load_pins()["wire"]["version"])
+
+
+def recording_schema_version() -> int:
+    """Runtime accessor (stamped into every record/replay recording
+    header by ``serf_tpu.replay.recording``)."""
+    return int(load_pins()["recording"]["version"])
 
 
 # ---------------------------------------------------------------------------
@@ -237,6 +282,31 @@ def check_pytree_drift(files: List[SourceFile],
         yield _drift_finding("pytree", "schema-pytree-drift", project,
                              current, pins["pytree"],
                              "serf_tpu/models/dissemination.py")
+
+
+@project_rule("schema-recording-drift",
+              "the record/replay recording format (RECORDING_SCHEMA) "
+              "changed without a pinned-version bump — old recordings "
+              "would stop loading as a surprise",
+              "adding a record field, pin untouched")
+def check_recording_drift(files: List[SourceFile],
+                          project: Project) -> Iterable[Finding]:
+    if project.pins_path is None or not project.pins_path.exists():
+        return
+    pins = json.loads(project.pins_path.read_text())
+    current = recording_fingerprint(project.root)
+    pinned = pins.get("recording")
+    if pinned is None:
+        if recording_spec(project.root):
+            yield _drift_finding("recording", "schema-recording-drift",
+                                 project, current,
+                                 {"fingerprint": "<unpinned>",
+                                  "version": 0},
+                                 RECORDING_SOURCE)
+        return
+    if current != pinned["fingerprint"]:
+        yield _drift_finding("recording", "schema-recording-drift",
+                             project, current, pinned, RECORDING_SOURCE)
 
 
 @project_rule("schema-wire-drift",

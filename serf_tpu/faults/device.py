@@ -23,6 +23,7 @@ Lowering semantics (device deviations are explicit, not silent):
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import List, NamedTuple, Optional, Tuple
 
@@ -37,6 +38,12 @@ from serf_tpu.models.swim import (
     cluster_round,
     make_cluster,
 )
+
+
+def _NODE_DIGEST_CAP() -> int:
+    # lazy: the replay plane is only imported when a recorder is attached
+    from serf_tpu.replay.recording import NODE_DIGEST_CAP
+    return NODE_DIGEST_CAP
 
 
 class DeviceFaultSchedule(NamedTuple):
@@ -123,23 +130,54 @@ def lower_plan(plan: FaultPlan, n: Optional[int] = None
 def run_phase(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
               num_rounds: int, group: jnp.ndarray, drop,
               init_alive: jnp.ndarray, down: jnp.ndarray,
-              mesh=None) -> ClusterState:
+              mesh=None, collect_digests: bool = False,
+              include_nodes: bool = True):
     """Scan ``num_rounds`` chaos rounds with one phase's masks applied.
     Jit with ``num_rounds`` static; group/drop/down are traced, so equal-
     length phases reuse the compiled executable.  ``mesh`` runs every
     round on the sharded flagship path (the masks are per-node planes,
-    so they shard with the state — nothing else changes)."""
+    so they shard with the state — nothing else changes).
+
+    ``collect_digests`` (static) additionally emits the record/replay
+    plane's per-round membership-view digest from inside the scan
+    (``replay.digest.state_digest``) and returns
+    ``(final_state, (digest u32[R], node_digests u32[R, N]))`` instead
+    of the bare state.  ``include_nodes`` (static) gates the per-node
+    plane: above ``NODE_DIGEST_CAP`` the recorders discard it anyway, so
+    passing False avoids stacking an R×N scan output at flagship scale
+    (the second element is then an empty ``()``)."""
+    if collect_digests:
+        # lazy for the same reason as _NODE_DIGEST_CAP: the replay plane
+        # only rides along when digests are actually being collected
+        from serf_tpu.replay.digest import state_digest
+
     alive = init_alive & ~down
     st = state._replace(gossip=state.gossip._replace(alive=alive),
                         group=group)
 
     def body(carry, subkey):
-        return cluster_round(carry, cfg, subkey, drop_rate=drop,
-                             mesh=mesh), ()
+        nxt = cluster_round(carry, cfg, subkey, drop_rate=drop, mesh=mesh)
+        if collect_digests:
+            overall, node = state_digest(nxt.gossip, cfg.gossip)
+            return nxt, ((overall, node) if include_nodes
+                         else (overall, ()))
+        return nxt, ()
 
     keys = jax.random.split(key, num_rounds)
-    final, _ = jax.lax.scan(body, st, keys)
-    return final
+    final, out = jax.lax.scan(body, st, keys)
+    return (final, out) if collect_digests else final
+
+
+@functools.lru_cache(maxsize=8)
+def phase_runner(cfg: ClusterConfig, mesh=None):
+    """ONE jitted phase-scan executable per (cfg, mesh), shared across
+    runs in the process: ``jax.jit`` caches on function identity, so a
+    fresh ``partial`` per ``run_device_plan`` call was recompiling the
+    scan every run — record, replay, perturbed replay and repeated
+    chaos plans at the same config now share compiles."""
+    return jax.jit(functools.partial(run_phase, cfg=cfg, mesh=mesh),
+                   static_argnames=("num_rounds", "collect_digests",
+                                    "include_nodes"))
 
 
 @dataclass
@@ -163,7 +201,7 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
                     key: Optional[jax.Array] = None,
                     state: Optional[ClusterState] = None,
                     events_per_phase: int = 2,
-                    mesh=None) -> DeviceChaosResult:
+                    mesh=None, recorder=None) -> DeviceChaosResult:
     """Run ``plan`` against the flagship device cluster and check the
     invariants.  Injects ``events_per_phase`` fresh user events at the
     start of every phase (plus the settle window) so there is always
@@ -173,9 +211,13 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
     initial state is node-sharded (``parallel.mesh.shard_state``), every
     phase scan exchanges under the explicit ICI schedule, and the
     invariant checkers consume the sharded final state unchanged (they
-    are reductions — jax gathers on device_get)."""
-    import functools
+    are reductions — jax gathers on device_get).
 
+    ``recorder`` (a ``replay.recording.RunRecorder``) makes this run a
+    replayable recording: every injection batch and every phase scan's
+    key material is logged as a step, and the scans additionally emit
+    the per-round membership-view digest stream
+    (``replay.replayer.replay_device`` re-executes it bit-exactly)."""
     from serf_tpu.faults import invariants as inv
     from serf_tpu.models.dissemination import (
         K_USER_EVENT,
@@ -185,15 +227,31 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
     plan.validate()
     sched = lower_plan(plan, cfg.n)
     key = key if key is not None else jax.random.key(plan.seed)
+    if recorder is not None:
+        from serf_tpu.replay.recording import (
+            device_config_to_dict,
+            key_to_hex,
+            plan_to_dict,
+        )
+        if state is not None:
+            raise ValueError("recording requires the executor to build "
+                             "the initial state (state= unsupported)")
+        recorder.header(
+            plane="device", plan=plan_to_dict(plan), seed=plan.seed,
+            config=device_config_to_dict(cfg))
     if state is None:
         key, k0 = jax.random.split(key)
         state = make_cluster(cfg, k0)
+        if recorder is not None:
+            recorder.step("init", key=key_to_hex(k0),
+                          events_per_phase=events_per_phase,
+                          mesh_devices=(mesh.size if mesh is not None
+                                        else 1))
     if mesh is not None:
         from serf_tpu.parallel.mesh import shard_state
         state = shard_state(state, mesh)
     init_alive = state.gossip.alive
-    run = jax.jit(functools.partial(run_phase, cfg=cfg, mesh=mesh),
-                  static_argnames=("num_rounds",))
+    run = phase_runner(cfg, mesh)
 
     injected: List[int] = []
     next_eid = 1
@@ -216,12 +274,39 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
             next_eid += chunk
             origins = jax.random.randint(k_chunk, (chunk,), 0, cfg.n,
                                          dtype=jnp.int32)
+            if recorder is not None:
+                # the recording carries the REALIZED batch (not the key
+                # that derived it): the replayer consumes these values
+                # verbatim, so a perturbed recording replays perturbed
+                recorder.step(
+                    "inject", kind=int(K_USER_EVENT),
+                    eids=[int(e) for e in jax.device_get(eids)],
+                    ltimes=[int(e) for e in jax.device_get(eids)],
+                    origins=[int(o) for o in jax.device_get(origins)])
             g = inject_facts_batch(
                 st.gossip, cfg.gossip, eids, K_USER_EVENT,
                 incarnations=jnp.zeros((chunk,), jnp.uint32),
                 ltimes=eids.astype(jnp.uint32),
                 origins=origins, active=jnp.ones((chunk,), bool))
             st = st._replace(gossip=g)
+        return st
+
+    def scan(st: ClusterState, k_run, num_rounds: int, phase: int,
+             group, drop, down, base_round: int) -> ClusterState:
+        """One phase (or settle-chunk) scan; records the step + the
+        per-round digest stream when a recorder is attached."""
+        if recorder is None:
+            return run(st, key=k_run, num_rounds=num_rounds, group=group,
+                       drop=drop, init_alive=init_alive, down=down)
+        from serf_tpu.replay.recording import record_scan_views
+        recorder.step("scan", phase=phase, rounds=num_rounds,
+                      key=key_to_hex(k_run))
+        include_nodes = cfg.n <= _NODE_DIGEST_CAP()
+        st, (dg, dn) = run(st, key=k_run, num_rounds=num_rounds,
+                           group=group, drop=drop, init_alive=init_alive,
+                           down=down, collect_digests=True,
+                           include_nodes=include_nodes)
+        record_scan_views(recorder, base_round, dg, dn, include_nodes)
         return st
 
     total = 0
@@ -243,9 +328,8 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
         state = inject(state, k_inj, events_per_phase + extra)
         if num_rounds <= 0:
             continue
-        state = run(state, key=k_run, num_rounds=num_rounds,
-                    group=sched.group[pi], drop=sched.drop[pi],
-                    init_alive=init_alive, down=sched.down[pi])
+        state = scan(state, k_run, num_rounds, pi, sched.group[pi],
+                     sched.drop[pi], sched.down[pi], total)
         total += num_rounds
     # settle: fault-free rounds for re-convergence (drop 0, no partition,
     # everyone the plan restarted is back up).  Chunked to the phases'
@@ -263,12 +347,13 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
         while left > 0:
             step = min(chunk, left)
             key, k_run = jax.random.split(key)
-            state = run(state, key=k_run, num_rounds=step,
-                        group=no_group, drop=jnp.float32(0.0),
-                        init_alive=init_alive, down=no_down)
+            state = scan(state, k_run, step, -1, no_group,
+                         jnp.float32(0.0), no_down, total)
+            total += step
             left -= step
-        total += plan.settle_rounds
 
+    if recorder is not None:
+        recorder.finish()
     report = inv.check_device(plan, state, cfg, init_alive,
                               rounds_run=total, offered=len(injected),
                               expect_overflow=expect_overflow)

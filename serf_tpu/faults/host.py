@@ -317,7 +317,8 @@ def _load_opts(plan: FaultPlan):
 
 async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
                         opts=None,
-                        traffic_period: float = 0.08) -> HostChaosResult:
+                        traffic_period: float = 0.08,
+                        recorder=None) -> HostChaosResult:
     """Run ``plan`` against a fresh in-process loopback cluster and check
     the invariants.  ``tmp_dir`` enables per-node snapshots (crash →
     restart replays them); without it restarts come back cold.
@@ -326,7 +327,14 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
     per-node subscribers with stallable consumers, a load generator
     firing the offered rates from random live nodes, buffer-bound
     sampling every tick, and a :class:`HostLoadReport` the overload
-    invariants are judged against."""
+    invariants are judged against.
+
+    ``recorder`` (a ``replay.recording.RunRecorder``) captures the run's
+    full ingress — joins, every offered user_event/query (via the
+    ``Serf.set_ingress_tap`` seam), phase/restart/heal transitions — plus
+    a membership-view digest at each convergence barrier, so
+    ``replay.replayer.replay_host`` can re-drive the same run from the
+    recording with virtualized timing."""
     import os
 
     from serf_tpu.faults import invariants as inv
@@ -339,6 +347,29 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
     n = plan.n
     with_load = plan.has_load()
     base_opts = opts or (_load_opts(plan) if with_load else Options.local())
+    if recorder is not None:
+        from serf_tpu.replay.recording import plan_to_dict
+        recorder.header(
+            plane="host", plan=plan_to_dict(plan), seed=plan.seed,
+            # opts must be reconstructible on replay: None means "the
+            # executor defaults" (Options.local / _load_opts per plan);
+            # anything else is marked custom and the replayer refuses
+            config={"options": "default" if opts is None else "custom",
+                    "snapshots": tmp_dir is not None, "n": n})
+    ingress_tap = recorder.ingress_tap() if recorder is not None else None
+    barrier_index = 0
+
+    def record_barrier(stage: str, serfs) -> None:
+        nonlocal barrier_index
+        if recorder is None:
+            return
+        from serf_tpu.replay.digest import host_view_digest
+        recorder.step("barrier", stage=stage, deadline_s=plan.settle_s)
+        digest, node_digests = host_view_digest(serfs)
+        recorder.view(round_=barrier_index, digest=digest,
+                      nodes=node_digests)
+        barrier_index += 1
+
     net = LoopbackNetwork()
     ex = HostFaultExecutor(plan, net)
 
@@ -375,8 +406,11 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
                 old.cancel()
             consumers[i] = spawn_logged(consume(sub, gate),
                                         f"chaos-consume-n{i}")
-        return await Serf.create(net.bind(f"n{i}"), node_opts(i), f"n{i}",
-                                 subscriber=sub)
+        s = await Serf.create(net.bind(f"n{i}"), node_opts(i), f"n{i}",
+                              subscriber=sub)
+        if ingress_tap is not None:
+            s.set_ingress_tap(ingress_tap)
+        return s
 
     base_admitted = _counter_total("serf.overload.ingress_admitted")
     base_shed = _counter_total("serf.overload.ingress_shed")
@@ -493,14 +527,20 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
     try:
         t0 = time.monotonic()
         for i in range(1, n):
+            if recorder is not None:
+                recorder.step("join", node=i, target="n0")
             await nodes[i].join("n0")
         await inv.wait_host_convergence(
             [nodes[i] for i in range(n)], deadline_s=plan.settle_s)
         load.quiet_convergence_s = time.monotonic() - t0
+        record_barrier("quiet", [nodes[i] for i in range(n)])
 
         for pi, phase in enumerate(plan.phases):
             # crash BEFORE installing the phase rule so the rule never
             # references a half-dead node's traffic
+            if recorder is not None:
+                recorder.step("phase", index=pi, name=phase.name,
+                              duration_s=phase.duration_s)
             for i in phase.crash:
                 if nodes[i].state != SerfState.SHUTDOWN:
                     await nodes[i].shutdown()
@@ -512,9 +552,12 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
                     nodes[i] = await make_node(i)
                     seeds = [j for j in nodes if j not in down and j != i
                              and nodes[j].state == SerfState.ALIVE]
-                    if seeds:
+                    seed_addr = f"n{rng.choice(seeds)}" if seeds else None
+                    if recorder is not None:
+                        recorder.step("restart", node=i, seed=seed_addr)
+                    if seed_addr is not None:
                         try:
-                            await nodes[i].join(f"n{rng.choice(seeds)}")
+                            await nodes[i].join(seed_addr)
                         except (ConnectionError, TimeoutError, OSError):
                             pass
             down = ex.down_nodes()
@@ -526,6 +569,8 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
             for i in phase.stall:
                 gates[i].set()      # consumer resumes; backlog drains
 
+        if recorder is not None:
+            recorder.step("heal")
         ex.clear()
         down = frozenset()
         live = [nodes[i] for i in nodes
@@ -533,6 +578,7 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
         t1 = time.monotonic()
         await inv.wait_host_convergence(live, deadline_s=plan.settle_s)
         load.settle_convergence_s = time.monotonic() - t1
+        record_barrier("settle", live)
         sample_clocks()
         sample_buffers()
         # quiesce the traffic tasks BEFORE reading the ingress deltas:
@@ -553,6 +599,8 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
         load.lossless_violations = int(
             _counter_total("serf.subscriber.lossless_violation")
             - base_lossless)
+        if recorder is not None:
+            recorder.finish()
         report = inv.check_host(plan, nodes, samples, generation,
                                 snapshots=tmp_dir is not None,
                                 load=load if with_load else None)

@@ -448,6 +448,10 @@ class Serf:
         self._admission = AdmissionController(self)
         #: non-membership events shed at the inbox bound (accounting)
         self._events_shed = 0
+        # record/replay ingress tap (serf_tpu.replay): when set, every
+        # OFFERED user_event/query is reported before admission — the
+        # recording captures what was asked for, sheds replay as sheds
+        self._ingress_tap = None
 
         self._tasks: List[asyncio.Task] = []
         self._bg: set = set()
@@ -920,10 +924,23 @@ class Serf:
 
     # -- user events --------------------------------------------------------
 
+    def set_ingress_tap(self, fn) -> None:
+        """Install (or clear, with ``None``) the record/replay ingress
+        tap: ``fn(op, node_id, name=..., payload=..., ...)`` is called
+        for every OFFERED ``user_event``/``query`` before validation or
+        admission, in call order — the seam ``serf_tpu.replay`` records
+        a run's ingress through (``RunRecorder.ingress_tap()``).
+        Internal ``_serf_*`` control queries are NOT tapped: they are
+        regenerated by the replay cluster itself."""
+        self._ingress_tap = fn
+
     async def user_event(self, name: str, payload: bytes, coalesce: bool = True) -> None:
         """(reference api.rs:241-299); raises :class:`OverloadError` when
         admission control (token bucket / health floor) sheds the event —
         an explicit fast failure the caller can back off on."""
+        if self._ingress_tap is not None:
+            self._ingress_tap("user-event", self.local_id, name=name,
+                              payload=payload, coalesce=coalesce)
         # size validation FIRST: a rejected oversized event must not
         # drain a rate-limit token nor count as admitted ingress
         size = len(name) + len(payload)
@@ -959,6 +976,13 @@ class Serf:
         (internal ``_serf_*`` control queries are exempt — the operator
         needs the stats plane most while the node is overloaded)."""
         params = params or QueryParam()
+        # internal _serf_* control queries (conflict resolution, stats
+        # sweeps, key ops) are protocol machinery, not user ingress —
+        # recording them would make replay re-issue them ON TOP of the
+        # replay cluster's own internally-generated copies
+        if self._ingress_tap is not None and not name.startswith("_serf_"):
+            self._ingress_tap("query", self.local_id, name=name,
+                              payload=payload, timeout=params.timeout)
         # cheap size pre-check FIRST (raw <= encoded, so raw over the
         # limit can never encode under it): an obviously oversized query
         # must not drain a token nor count as admitted ingress.  The

@@ -28,6 +28,8 @@ Event kinds emitted by the engine (see README "Observability"):
 - ``dial-retry``       a stream dial / join retried after backoff
 - ``corrupt-frame``    an undecodable stream frame was quarantined
 - ``snapshot-torn-tail``  snapshot replay skipped a torn tail
+- ``replay-recorded``  a record/replay recording artifact was written
+- ``replay-divergence`` the replay differ found two digest streams apart
 
 Events recorded while a cross-node trace is active (``obs.trace
 .trace_scope``) carry a ``trace`` field — the hex trace id shared by
@@ -78,20 +80,37 @@ class FlightRecorder:
             self._pos = (self._pos + 1) % self.capacity
 
     def dump(self, kind: Optional[str] = None, node: Optional[str] = None,
-             last: Optional[int] = None) -> List[Dict[str, Any]]:
+             last: Optional[int] = None,
+             since_seq: Optional[int] = None) -> List[Dict[str, Any]]:
         """Retained events oldest-first, optionally filtered by ``kind``
-        and/or ``node``; ``last`` keeps only the newest N after filtering."""
+        and/or ``node``; ``last`` keeps only the newest N after filtering.
+
+        ``since_seq`` returns only events with ``seq > since_seq`` — the
+        incremental-poll contract: every record carries a monotonic
+        per-recorder sequence number, so a poller (or a multi-node dump
+        merger) can resume from the last ``seq`` it saw and merge
+        streams in a stable ``(time, seq)`` order even after ring
+        eviction discarded the overlap (``last_seq`` is the cursor to
+        resume from)."""
         with self._lock:
             if self.recorded >= self.capacity:
                 ordered = self._ring[self._pos:] + self._ring[:self._pos]
             else:
                 ordered = self._ring[:self._pos]
             out = [dict(e) for e in ordered if e is not None]
+        if since_seq is not None:
+            out = [e for e in out if e["seq"] > since_seq]
         if kind is not None:
             out = [e for e in out if e["kind"] == kind]
         if node is not None:
             out = [e for e in out if e.get("node") == node]
         return out[-last:] if last is not None else out
+
+    @property
+    def last_seq(self) -> int:
+        """Sequence number of the newest record (0 = none yet) — the
+        ``since_seq`` cursor for incremental dumps."""
+        return self.recorded
 
     def __len__(self) -> int:
         return min(self.recorded, self.capacity)
@@ -124,5 +143,6 @@ def record(kind: str, node: Optional[str] = None, **fields: Any) -> None:
 
 
 def flight_dump(kind: Optional[str] = None, node: Optional[str] = None,
-                last: Optional[int] = None) -> List[Dict[str, Any]]:
-    return _global.dump(kind, node, last)
+                last: Optional[int] = None,
+                since_seq: Optional[int] = None) -> List[Dict[str, Any]]:
+    return _global.dump(kind, node, last, since_seq=since_seq)

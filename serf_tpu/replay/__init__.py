@@ -1,0 +1,44 @@
+"""Deterministic record/replay plane (ISSUE 9).
+
+Closes the observability loop ROADMAP item 5 calls for: a chaos run's
+non-deterministic ingress — seed, config, joins, user events, queries,
+the FaultPlan phase schedule — is captured as a compact versioned JSONL
+**recording** (``replay.recording``), re-executed bit-exactly on the
+device plane / re-driven with virtualized timing on the host plane
+(``replay.replayer``), and judged round by round with membership-view
+**digests** (``replay.digest``) by the **differ** (``replay.differ``),
+which names the first divergent round and the per-node view delta.
+``tools/replay.py`` is the operator CLI (record / replay / diff);
+``tools/chaos.py --record-on-fail`` turns every red chaos run into a
+shippable repro artifact.  The record/replay-as-debugging discipline
+follows "Rethinking State-Machine Replication for Parallelism"
+(PAPERS.md).
+
+The heavy submodules (replayer, selfcheck) load lazily so importing the
+package for the format/differ never pulls the executors or jax.
+"""
+
+from serf_tpu.replay.differ import DiffReport, diff_recordings  # noqa: F401
+from serf_tpu.replay.recording import (  # noqa: F401
+    RECORDING_SCHEMA,
+    Recording,
+    RecordingError,
+    RunRecorder,
+    load_recording,
+    plan_from_dict,
+    plan_to_dict,
+    recording_schema_version,
+)
+
+
+def __getattr__(name: str):
+    if name in ("replay_device", "replay_host", "replay_recording"):
+        from serf_tpu.replay import replayer
+        return getattr(replayer, name)
+    if name in ("state_digest", "host_view_digest"):
+        from serf_tpu.replay import digest
+        return getattr(digest, name)
+    if name == "device_roundtrip":
+        from serf_tpu.replay.selfcheck import device_roundtrip
+        return device_roundtrip
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,103 @@
+"""Membership-view digests — the bit-exactness ledger of record/replay.
+
+Device plane: :func:`state_digest` folds each node's *knowledge view* —
+its known-fact set (fact identity: subject/kind/incarnation/ltime/valid,
+weighted by ring slot), its ground-truth liveness, incarnation and
+tombstone record — into one u32 per node plus one u32 for the whole
+cluster, computed INSIDE the jitted scan (an FNV-style mix; pure
+elementwise + reductions, so it shards and scans for free).  The
+membership view (``models.membership.intent_views`` /
+``failure.believed_dead``) is a pure function of exactly these inputs,
+so digest equality every round implies view equality every round; the
+digest additionally covers user-event facts, which a flipped replay
+event must perturb.  Deliberately NOT covered: the stamp (age) plane and
+the send caches — retransmit budgets, not view state (two runs that
+agree on every digest agree on what every node believes, which is the
+contract the differ judges; record and replay of the same recording are
+bit-exact on the full state anyway).
+
+Host plane: :func:`host_view_digest` reuses the cluster-plane
+``membership_digest`` (sorted ``(node_id, status)`` pairs per node) and
+folds the per-node digests into one run digest.  Host digests are taken
+at convergence *barriers* only — wall-clock gossip interleaving is not
+deterministic, converged membership is (see README "Record & replay").
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    GossipState,
+    unpack_bits,
+)
+
+_FNV_PRIME = 16777619
+_FNV_BASIS = 2166136261
+#: odd slot/node weights (Knuth + golden-ratio constants) make the
+#: commutative sum position-sensitive: the same fact hash in a different
+#: ring slot, or the same per-node digest on a different node, changes
+#: the fold
+_SLOT_MULT = 2654435761
+_NODE_MULT = 2654435769
+
+
+def _mix(h: jnp.ndarray, x) -> jnp.ndarray:
+    return (h ^ jnp.asarray(x).astype(jnp.uint32)) * jnp.uint32(_FNV_PRIME)
+
+
+def fact_hashes(state: GossipState) -> jnp.ndarray:
+    """u32[K]: one hash per ring slot over the fact's full identity."""
+    f = state.facts
+    h = jnp.full(f.subject.shape, _FNV_BASIS, jnp.uint32)
+    h = _mix(h, f.subject)
+    h = _mix(h, f.kind)
+    h = _mix(h, f.incarnation)
+    h = _mix(h, f.ltime)
+    h = _mix(h, f.valid)
+    return h
+
+
+def state_digest(state: GossipState, cfg: GossipConfig
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(overall u32, per-node u32[N]) knowledge-view digest; jit-safe."""
+    k = cfg.k_facts
+    fh = fact_hashes(state)
+    slot_w = (jnp.uint32(2) * jnp.arange(k, dtype=jnp.uint32)
+              + jnp.uint32(1)) * jnp.uint32(_SLOT_MULT)
+    weighted = fh * slot_w                                   # u32[K]
+    known = unpack_bits(state.known, k)                      # bool[N, K]
+    node = jnp.sum(jnp.where(known, weighted[None, :], jnp.uint32(0)),
+                   axis=1, dtype=jnp.uint32)
+    node = _mix(node, state.alive)
+    node = _mix(node, state.tombstone)
+    node = _mix(node, state.incarnation)
+    n = node.shape[0]
+    node_w = (jnp.uint32(2) * jnp.arange(n, dtype=jnp.uint32)
+              + jnp.uint32(1)) * jnp.uint32(_NODE_MULT)
+    overall = jnp.sum(node * node_w, dtype=jnp.uint32)
+    overall = _mix(overall, state.round)
+    return overall, node
+
+
+def host_view_digest(serfs) -> Tuple[str, Dict[str, str]]:
+    """(overall 16-hex, {node_id: 12-hex}) membership-view digest over
+    the given live Serf nodes (host plane, barrier points only)."""
+    from serf_tpu.obs.cluster import membership_digest
+
+    nodes = {
+        s.local_id: membership_digest(
+            [(m.node.id, m.status.name) for m in s.members()])
+        for s in serfs
+    }
+    h = hashlib.sha256()
+    for nid, d in sorted(nodes.items()):
+        h.update(nid.encode("utf-8", errors="replace"))
+        h.update(b"\x00")
+        h.update(d.encode("ascii"))
+        h.update(b"\x01")
+    return h.hexdigest()[:16], nodes

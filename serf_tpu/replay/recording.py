@@ -1,0 +1,327 @@
+"""Recording format for the deterministic record/replay plane (ISSUE 9).
+
+A recording is versioned JSONL — one JSON object per line — capturing
+everything non-deterministic about a chaos run so it can be re-executed
+bit-exactly (device plane) or re-driven with virtualized timing (host
+plane) and judged round by round:
+
+- ``header`` (first line): recording-format version (``v`` — pinned in
+  ``serf_tpu/analysis/pins/schema_pins.json`` like the checkpoint pytree
+  and wire schemas; see MIGRATION.md "Schema versioning"), plane, the
+  full serialized :class:`~serf_tpu.faults.plan.FaultPlan`, its seed,
+  the executor config (device: the whole ``ClusterConfig``; host: the
+  Options mode) and a fingerprint over both;
+- ``step``: one ingress/driver action in applied order — device:
+  ``init`` (cluster construction key) / ``inject`` (explicit fact
+  batches: eids, origins, ltimes — the replayer consumes THESE, not a
+  re-derivation, so a perturbed recording replays perturbed) / ``scan``
+  (phase index, round count, raw PRNG key material); host: ``join`` /
+  ``user-event`` / ``query`` (via the ``Serf.set_ingress_tap`` seam) /
+  ``phase`` / ``restart`` / ``heal`` / ``barrier``.  Every step carries
+  a ``chain`` hash folding the step content into the previous chain, so
+  the differ can name the exact first divergent step;
+- ``view``: a membership-view digest snapshot (device: one per protocol
+  round from inside the jitted scan; host: one per convergence barrier)
+  — the bit-exactness ledger the differ compares;
+- ``end`` (last line): step/view counts — truncated-file detection.
+
+The record kinds and their field lists are declared in
+``RECORDING_SCHEMA`` below, which serflint AST-fingerprints and pins
+(rule ``schema-recording-drift``): changing the format without
+``python tools/serflint.py --bump-schema`` is a lint failure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from pathlib import Path
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from serf_tpu.faults.plan import EdgeFault, FaultPhase, FaultPlan
+from serf_tpu.obs import flight
+from serf_tpu.utils import metrics
+
+#: the declared record surface: kind -> ordered field names.  serflint's
+#: ``schema-recording-drift`` rule fingerprints THIS literal — adding,
+#: removing or renaming a field is a deliberate, version-bumped act.
+RECORDING_SCHEMA = {
+    "header": ("v", "plane", "plan", "seed", "config", "fingerprint"),
+    "step": ("seq", "op", "args", "chain"),
+    "view": ("seq", "round", "digest", "nodes"),
+    "end": ("seq", "steps", "views"),
+}
+
+#: per-node digests are embedded in ``view`` records only up to this
+#: node count; past it only the overall digest is stored (the differ
+#: then reports the divergent round without a per-node delta)
+NODE_DIGEST_CAP = 4096
+
+
+def recording_schema_version() -> int:
+    """The pinned recording-format version (lazy import so the replay
+    plane never rides the analysis package into runtime processes that
+    do not record)."""
+    from serf_tpu.analysis.schema import recording_schema_version as v
+
+    return v()
+
+
+def _canon(obj: Any) -> str:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+def _fingerprint(obj: Any) -> str:
+    return hashlib.sha256(_canon(obj).encode()).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# plan / config serde
+# ---------------------------------------------------------------------------
+
+
+def plan_to_dict(plan: FaultPlan) -> Dict[str, Any]:
+    return dataclasses.asdict(plan)
+
+
+def plan_from_dict(d: Dict[str, Any]) -> FaultPlan:
+    phases = []
+    for ph in d["phases"]:
+        ph = dict(ph)
+        ph["partitions"] = tuple(tuple(g) for g in ph.get("partitions", ()))
+        ph["edges"] = tuple(EdgeFault(**e) for e in ph.get("edges", ()))
+        for key in ("crash", "pause", "restart", "stall"):
+            ph[key] = tuple(ph.get(key, ()))
+        phases.append(FaultPhase(**ph))
+    plan = FaultPlan(name=d["name"], n=int(d["n"]), phases=tuple(phases),
+                     seed=int(d.get("seed", 0)),
+                     settle_s=float(d.get("settle_s", 8.0)),
+                     settle_rounds=int(d.get("settle_rounds", 40)))
+    plan.validate()
+    return plan
+
+
+def device_config_to_dict(cfg) -> Dict[str, Any]:
+    """Full ``ClusterConfig`` serialization (nested frozen dataclasses)."""
+    return dataclasses.asdict(cfg)
+
+
+def device_config_from_dict(d: Dict[str, Any]):
+    from serf_tpu.models.failure import FailureConfig
+    from serf_tpu.models.swim import ClusterConfig
+    from serf_tpu.models.dissemination import GossipConfig
+    from serf_tpu.models.vivaldi import VivaldiConfig
+
+    top = {k: v for k, v in d.items()
+           if k not in ("gossip", "failure", "vivaldi")}
+    return ClusterConfig(
+        gossip=GossipConfig(**d["gossip"]),
+        failure=FailureConfig(**d["failure"]),
+        vivaldi=VivaldiConfig(**d["vivaldi"]),
+        **top)
+
+
+# ---------------------------------------------------------------------------
+# recordings
+# ---------------------------------------------------------------------------
+
+
+class RecordingError(ValueError):
+    """A recording could not be parsed / replayed (bad version, truncated
+    file, unsupported config)."""
+
+
+class Recording:
+    """A loaded (or just-produced) recording: header + ordered records."""
+
+    def __init__(self, header: Dict[str, Any], records: List[Dict[str, Any]]):
+        self.header = header
+        self.records = records
+
+    @property
+    def plane(self) -> str:
+        return self.header["plane"]
+
+    def steps(self) -> Iterator[Dict[str, Any]]:
+        return (r for r in self.records if r["kind"] == "step")
+
+    def views(self) -> List[Dict[str, Any]]:
+        return [r for r in self.records if r["kind"] == "view"]
+
+    def digest_stream(self) -> List[Dict[str, Any]]:
+        """The ordered comparison surface: step + view records."""
+        return [r for r in self.records if r["kind"] in ("step", "view")]
+
+    def plan(self) -> FaultPlan:
+        return plan_from_dict(self.header["plan"])
+
+    @classmethod
+    def load(cls, path) -> "Recording":
+        lines = Path(path).read_text().splitlines()
+        if not lines:
+            raise RecordingError(f"{path}: empty recording")
+        try:
+            rows = [json.loads(ln) for ln in lines if ln.strip()]
+        except json.JSONDecodeError as e:
+            raise RecordingError(f"{path}: undecodable line: {e}") from e
+        header = rows[0]
+        if header.get("kind") != "header":
+            raise RecordingError(f"{path}: first record is not a header")
+        v = header.get("v")
+        if v != recording_schema_version():
+            raise RecordingError(
+                f"{path}: recording format v{v} != pinned "
+                f"v{recording_schema_version()} (see MIGRATION.md "
+                "'Schema versioning')")
+        records = rows[1:]
+        end = [r for r in records if r.get("kind") == "end"]
+        if not end:
+            raise RecordingError(f"{path}: no end record (truncated file?)")
+        n_steps = sum(1 for r in records if r.get("kind") == "step")
+        n_views = sum(1 for r in records if r.get("kind") == "view")
+        if end[-1].get("steps") != n_steps or end[-1].get("views") != n_views:
+            raise RecordingError(
+                f"{path}: end record counts ({end[-1].get('steps')} steps/"
+                f"{end[-1].get('views')} views) disagree with the file "
+                f"({n_steps}/{n_views}) — truncated or edited recording")
+        return cls(header, [r for r in records if r.get("kind") != "end"]
+                   + end[-1:])
+
+    def save(self, path) -> str:
+        p = Path(path)
+        with p.open("w") as f:
+            f.write(_canon(self.header) + "\n")
+            for r in self.records:
+                f.write(_canon(r) + "\n")
+        metrics.incr("serf.replay.records", 1 + len(self.records))
+        flight.record("replay-recorded", path=str(p),
+                      plane=self.header.get("plane"),
+                      plan=self.header.get("plan", {}).get("name"))
+        return str(p)
+
+
+class RunRecorder:
+    """Builds a recording as a run executes.  The executors
+    (``faults.host.run_host_plan`` / ``faults.device.run_device_plan``)
+    call :meth:`header` once, then :meth:`step` / :meth:`view` in applied
+    order; :meth:`finish` seals the trailer (idempotent)."""
+
+    def __init__(self):
+        self.records: List[Dict[str, Any]] = []
+        self._header: Optional[Dict[str, Any]] = None
+        self._seq = 0
+        self._chain = "0" * 16
+        self._finished = False
+
+    def header(self, plane: str, plan: Dict[str, Any], seed: int,
+               config: Dict[str, Any]) -> None:
+        if self._header is not None:
+            raise RecordingError("recorder header written twice")
+        self._header = {
+            "kind": "header",
+            "v": recording_schema_version(),
+            "plane": plane,
+            "plan": plan,
+            "seed": int(seed),
+            "config": config,
+            "fingerprint": _fingerprint({"plan": plan, "config": config}),
+        }
+        # the chain starts from the run identity, so two recordings of
+        # DIFFERENT runs never share step chains even for equal prefixes
+        self._chain = self._header["fingerprint"]
+
+    def step(self, op: str, **args: Any) -> Dict[str, Any]:
+        self._seq += 1
+        self._chain = hashlib.sha256(
+            (self._chain + _canon({"op": op, "args": args})).encode()
+        ).hexdigest()[:16]
+        rec = {"kind": "step", "seq": self._seq, "op": op, "args": args,
+               "chain": self._chain}
+        self.records.append(rec)
+        return rec
+
+    def view(self, round_: int, digest: str,
+             nodes: Optional[Any] = None) -> Dict[str, Any]:
+        self._seq += 1
+        rec = {"kind": "view", "seq": self._seq, "round": int(round_),
+               "digest": digest, "nodes": nodes}
+        self.records.append(rec)
+        return rec
+
+    def ingress_tap(self) -> Callable:
+        """The callable ``Serf.set_ingress_tap`` expects: records every
+        offered ``user_event``/``query`` as a step (payload hex-encoded)."""
+        def tap(op: str, node: str, **args: Any) -> None:
+            payload = args.pop("payload", b"")
+            self.step(op, node=node, payload=payload.hex(), **args)
+        return tap
+
+    def finish(self) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        n_views = sum(1 for r in self.records if r["kind"] == "view")
+        self._seq += 1
+        self.records.append({
+            "kind": "end", "seq": self._seq,
+            "steps": sum(1 for r in self.records if r["kind"] == "step"),
+            "views": n_views,
+        })
+        metrics.gauge("serf.replay.rounds", n_views)
+
+    def to_recording(self) -> Recording:
+        if self._header is None:
+            raise RecordingError("recorder has no header")
+        self.finish()
+        return Recording(dict(self._header), list(self.records))
+
+    def save(self, path) -> str:
+        return self.to_recording().save(path)
+
+
+def load_recording(path) -> Recording:
+    return Recording.load(path)
+
+
+def record_scan_views(recorder: RunRecorder, base_round: int, dg, dn,
+                      include_nodes: bool) -> None:
+    """Transfer one phase scan's digest stream (``run_phase(...,
+    collect_digests=True)`` output) and append one ``view`` record per
+    round.  This is the ONE formatting path shared by the recorder
+    (``faults.device.run_device_plan``) and ``replay.replayer
+    .replay_device`` — record and replay streams can only compare equal
+    if they are emitted in lockstep, so neither side formats on its
+    own."""
+    import jax
+
+    digests = jax.device_get(dg)
+    node_digests = jax.device_get(dn) if include_nodes else None
+    for j, d in enumerate(digests):
+        recorder.view(
+            round_=base_round + j + 1,
+            digest=f"{int(d):08x}",
+            nodes=([f"{int(x):08x}" for x in node_digests[j]]
+                   if node_digests is not None else None))
+
+
+# ---------------------------------------------------------------------------
+# PRNG key serde (device plane; jax imported lazily so the recording
+# format itself stays importable in host-only / tooling processes)
+# ---------------------------------------------------------------------------
+
+
+def key_to_hex(key) -> str:
+    import jax
+    import numpy as np
+
+    return np.asarray(jax.random.key_data(key)).tobytes().hex()
+
+
+def key_from_hex(h: str):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    data = np.frombuffer(bytes.fromhex(h), np.uint32)
+    return jax.random.wrap_key_data(jnp.asarray(data))

@@ -1,0 +1,268 @@
+"""Re-execute a recording on its plane and re-derive the digest stream.
+
+Device plane (:func:`replay_device`): reconstructs the mask schedule
+from the recorded plan (``faults.device.lower_plan`` is pure), consumes
+the recorded injection batches VERBATIM (not a re-derivation — so a
+perturbed recording replays perturbed) and re-runs the jitted phase
+scans with the recorded PRNG key material, emitting the same per-round
+membership-view digests.  Replay of an unmodified recording is
+bit-exact: every round's digest matches.
+
+Host plane (:func:`replay_host`): stands up a fresh loopback cluster and
+re-drives the recorded ingress — joins, every offered user_event/query,
+phase/restart/heal transitions — in recorded order with VIRTUALIZED
+timing: phase wall durations are preserved, but intra-phase event
+spacing is not (a phase's events are applied back-to-back at phase
+entry).  Membership-view digests are re-taken at the recorded
+convergence barriers, where converged membership is deterministic even
+though gossip interleaving is not (README "Record & replay" states the
+full determinism contract).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional
+
+from serf_tpu.replay.recording import (
+    NODE_DIGEST_CAP,
+    Recording,
+    RecordingError,
+    RunRecorder,
+    device_config_from_dict,
+    key_from_hex,
+    plan_from_dict,
+    record_scan_views,
+)
+
+
+def replay_device(rec: Recording, mesh=None) -> RunRecorder:
+    """Re-execute a device recording; returns the replay's recorder
+    (diff its ``to_recording()`` against the source with
+    ``differ.diff_recordings``)."""
+    import jax.numpy as jnp
+
+    from serf_tpu.faults.device import lower_plan, phase_runner
+    from serf_tpu.models.dissemination import inject_facts_batch
+    from serf_tpu.models.swim import make_cluster
+
+    if rec.plane != "device":
+        raise RecordingError(
+            f"replay_device on a {rec.plane!r}-plane recording")
+    plan = plan_from_dict(rec.header["plan"])
+    cfg = device_config_from_dict(rec.header["config"])
+    sched = lower_plan(plan, cfg.n)
+    out = RunRecorder()
+    out.header(plane="device", plan=rec.header["plan"],
+               seed=rec.header["seed"], config=rec.header["config"])
+
+    run = None
+    state = None
+    init_alive = None
+    no_group = jnp.zeros((cfg.n,), jnp.int32)
+    no_down = jnp.zeros((cfg.n,), bool)
+    total = 0
+    for s in rec.steps():
+        op, a = s["op"], s["args"]
+        if op == "init":
+            if mesh is None and int(a.get("mesh_devices", 1)) > 1:
+                from serf_tpu.parallel.mesh import make_mesh
+                mesh = make_mesh(int(a["mesh_devices"]))
+            state = make_cluster(cfg, key_from_hex(a["key"]))
+            if mesh is not None:
+                from serf_tpu.parallel.mesh import shard_state
+                state = shard_state(state, mesh)
+            init_alive = state.gossip.alive
+            run = phase_runner(cfg, mesh)
+            out.step("init", **a)
+        elif op == "inject":
+            if state is None:
+                raise RecordingError("inject step before init")
+            chunk = len(a["eids"])
+            g = inject_facts_batch(
+                state.gossip, cfg.gossip,
+                jnp.asarray(a["eids"], jnp.int32), int(a["kind"]),
+                incarnations=jnp.zeros((chunk,), jnp.uint32),
+                ltimes=jnp.asarray(a["ltimes"], jnp.uint32),
+                origins=jnp.asarray(a["origins"], jnp.int32),
+                active=jnp.ones((chunk,), bool))
+            state = state._replace(gossip=g)
+            out.step("inject", **a)
+        elif op == "scan":
+            if state is None:
+                raise RecordingError("scan step before init")
+            pi = int(a["phase"])
+            num_rounds = int(a["rounds"])
+            group = sched.group[pi] if pi >= 0 else no_group
+            drop = sched.drop[pi] if pi >= 0 else jnp.float32(0.0)
+            down = sched.down[pi] if pi >= 0 else no_down
+            out.step("scan", **a)
+            include_nodes = cfg.n <= NODE_DIGEST_CAP
+            state, (dg, dn) = run(
+                state, key=key_from_hex(a["key"]), num_rounds=num_rounds,
+                group=group, drop=drop, init_alive=init_alive, down=down,
+                collect_digests=True, include_nodes=include_nodes)
+            record_scan_views(out, total, dg, dn, include_nodes)
+            total += num_rounds
+        else:
+            raise RecordingError(f"unknown device step op {op!r}")
+    out.finish()
+    return out
+
+
+def _host_node(nodes: Dict[int, object], nid) -> Optional[object]:
+    """Map a recorded node reference (``"n3"`` or ``3``) to the current
+    Serf instance (restart replaces entries)."""
+    if isinstance(nid, str) and nid.startswith("n"):
+        nid = nid[1:]
+    try:
+        return nodes.get(int(nid))
+    except (TypeError, ValueError):
+        return None
+
+
+async def replay_host(rec: Recording,
+                      tmp_dir: Optional[str] = None) -> RunRecorder:
+    """Re-drive a host recording against a fresh loopback cluster."""
+    import os
+
+    from serf_tpu.faults import invariants as inv
+    from serf_tpu.faults.host import HostFaultExecutor, _load_opts
+    from serf_tpu.host.query import QueryParam
+    from serf_tpu.host.serf import Serf, SerfState
+    from serf_tpu.host.transport import LoopbackNetwork
+    from serf_tpu.options import Options
+    from serf_tpu.replay.digest import host_view_digest
+
+    if rec.plane != "host":
+        raise RecordingError(
+            f"replay_host on a {rec.plane!r}-plane recording")
+    if rec.header["config"].get("options") != "default":
+        raise RecordingError(
+            "host replay supports executor-default Options only (the "
+            "recording was made with custom opts)")
+    # snapshots change restart semantics (a crashed node comes back warm
+    # from its snapshot), so replay must match the recorded flag exactly:
+    # a snapshot-less recording replays snapshot-less even when the
+    # caller offers a tmp_dir, and a snapshotted one fails closed
+    # without somewhere to put them
+    snapshots = bool(rec.header["config"].get("snapshots"))
+    if snapshots and tmp_dir is None:
+        raise RecordingError(
+            "recording was made with per-node snapshots; replay_host "
+            "needs a tmp_dir to reproduce restart-from-snapshot")
+    plan = plan_from_dict(rec.header["plan"])
+    n = plan.n
+    base_opts = _load_opts(plan) if plan.has_load() else Options.local()
+    out = RunRecorder()
+    out.header(plane="host", plan=rec.header["plan"],
+               seed=rec.header["seed"], config=rec.header["config"])
+    net = LoopbackNetwork()
+    ex = HostFaultExecutor(plan, net)
+    nodes: Dict[int, Serf] = {}
+
+    def node_opts(i: int):
+        if not snapshots:
+            return base_opts
+        return base_opts.replace(
+            snapshot_path=os.path.join(tmp_dir, f"replay-n{i}.snap"))
+
+    async def make_node(i: int) -> Serf:
+        return await Serf.create(net.bind(f"n{i}"), node_opts(i), f"n{i}")
+
+    barrier_index = 0
+    pending_sleep = 0.0
+
+    async def serve_phase_window() -> None:
+        # virtualized timing: the open phase's wall duration is served
+        # when the stream reaches the step that ends it — its events
+        # were applied back-to-back at phase entry
+        nonlocal pending_sleep
+        if pending_sleep > 0:
+            await asyncio.sleep(pending_sleep)
+            pending_sleep = 0.0
+
+    try:
+        for i in range(n):
+            nodes[i] = await make_node(i)
+        for s in rec.steps():
+            op, a = s["op"], s["args"]
+            out.step(op, **a)
+            if op == "join":
+                try:
+                    await nodes[int(a["node"])].join(a["target"])
+                except Exception:  # noqa: BLE001 - replay is best-effort
+                    pass
+            elif op == "user-event":
+                node = _host_node(nodes, a["node"])
+                if node is not None and node.state == SerfState.ALIVE:
+                    try:
+                        await node.user_event(
+                            a["name"], bytes.fromhex(a["payload"]),
+                            coalesce=bool(a.get("coalesce", False)))
+                    except Exception:  # noqa: BLE001
+                        pass
+                await asyncio.sleep(0)
+            elif op == "query":
+                node = _host_node(nodes, a["node"])
+                if node is not None and node.state == SerfState.ALIVE:
+                    try:
+                        # recorded verbatim: 0.0 is QueryParam's "use the
+                        # node's default_query_timeout" sentinel, not a
+                        # missing value
+                        await node.query(
+                            a["name"], bytes.fromhex(a["payload"]),
+                            QueryParam(timeout=float(a.get("timeout",
+                                                           0.0))))
+                    except Exception:  # noqa: BLE001
+                        pass
+                await asyncio.sleep(0)
+            elif op == "phase":
+                await serve_phase_window()
+                pi = int(a["index"])
+                phase = plan.phases[pi]
+                for i in phase.crash:
+                    if nodes[i].state != SerfState.SHUTDOWN:
+                        await nodes[i].shutdown()
+                ex.apply_phase(pi)
+                pending_sleep = phase.duration_s
+            elif op == "restart":
+                i = int(a["node"])
+                if nodes[i].state == SerfState.SHUTDOWN:
+                    nodes[i] = await make_node(i)
+                if a.get("seed"):
+                    try:
+                        await nodes[i].join(a["seed"])
+                    except Exception:  # noqa: BLE001
+                        pass
+            elif op == "heal":
+                await serve_phase_window()
+                ex.clear()
+            elif op == "barrier":
+                await serve_phase_window()
+                live = [nodes[i] for i in nodes
+                        if nodes[i].state == SerfState.ALIVE]
+                await inv.wait_host_convergence(
+                    live, deadline_s=float(a.get("deadline_s",
+                                                 plan.settle_s)))
+                digest, node_digests = host_view_digest(live)
+                out.view(round_=barrier_index, digest=digest,
+                         nodes=node_digests)
+                barrier_index += 1
+            else:
+                raise RecordingError(f"unknown host step op {op!r}")
+        out.finish()
+        return out
+    finally:
+        for s in nodes.values():
+            if s.state != SerfState.SHUTDOWN:
+                await s.shutdown()
+
+
+def replay_recording(rec: Recording, tmp_dir: Optional[str] = None,
+                     mesh=None) -> RunRecorder:
+    """Plane-dispatching convenience: replays on whichever plane the
+    recording was made (host replays inside a private event loop)."""
+    if rec.plane == "device":
+        return replay_device(rec, mesh=mesh)
+    return asyncio.run(replay_host(rec, tmp_dir=tmp_dir))

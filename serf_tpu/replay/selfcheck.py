@@ -1,0 +1,53 @@
+"""Determinism self-check: record a short seeded device run, replay it,
+assert digest equality.  ``bench.py`` embeds the result in
+``BENCH_DETAIL.json`` every round, so a determinism regression (a
+nondeterministic op sneaking into the round, a digest drift, a replay
+bug) shows up in the per-round trajectory, not in a user's bug report.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+def default_replay_cfg(n: int = 48, k_facts: int = 32, **gossip_kw):
+    """The reference small-N device config every replay surface shares —
+    the bench self-check, ``tools/replay.py record`` and the acceptance
+    tests must exercise the SAME configuration or their verdicts stop
+    being comparable."""
+    from serf_tpu.models.dissemination import GossipConfig
+    from serf_tpu.models.failure import FailureConfig
+    from serf_tpu.models.swim import ClusterConfig
+
+    return ClusterConfig(
+        gossip=GossipConfig(n=n, k_facts=k_facts,
+                            peer_sampling="rotation", **gossip_kw),
+        failure=FailureConfig(suspicion_rounds=8, max_new_facts=8,
+                              probe_schedule="round_robin"),
+        push_pull_every=8)
+
+
+def device_roundtrip(n: int = 48, k_facts: int = 32) -> Dict[str, Any]:
+    """Record the tiny ``self-check`` plan on the device plane, replay
+    it, and diff the digest streams.  Returns a compact verdict dict."""
+    from serf_tpu.faults.device import run_device_plan
+    from serf_tpu.faults.plan import named_plan
+    from serf_tpu.replay.differ import diff_recordings
+    from serf_tpu.replay.recording import RunRecorder
+    from serf_tpu.replay.replayer import replay_device
+
+    plan = named_plan("self-check")
+    cfg = default_replay_cfg(n, k_facts)
+    recorder = RunRecorder()
+    result = run_device_plan(plan, cfg, recorder=recorder)
+    recording = recorder.to_recording()
+    replayed = replay_device(recording).to_recording()
+    d = diff_recordings(recording, replayed)
+    return {
+        "plan": plan.name,
+        "n": n,
+        "rounds": d.compared_views,
+        "digest_equal": d.ok,
+        "first_divergent_round": d.first_divergent_round,
+        "invariants_ok": bool(result.report.ok),
+    }

@@ -140,6 +140,32 @@ def test_flight_ring_wraparound_and_filters():
     assert obs.flight_dump(kind="no-such-kind") == []
 
 
+def test_flight_since_seq_incremental_poll():
+    """The multi-node merge contract (ISSUE 9 satellite): every record
+    carries a monotonic seq, ``last_seq`` is the resume cursor, and
+    ``dump(since_seq=)`` returns only newer records — so a poller that
+    missed ring-evicted overlap still merges streams in (time, seq)
+    order without duplicates."""
+    rec = FlightRecorder(capacity=8)
+    obs.set_global_recorder(rec)
+    for i in range(5):
+        obs.record("member-state", i=i)
+    cursor = rec.last_seq
+    assert cursor == 5
+    assert obs.flight_dump(since_seq=cursor) == []
+    for i in range(5, 12):
+        obs.record("member-state", i=i)
+    fresh = obs.flight_dump(since_seq=cursor)
+    assert [e["seq"] for e in fresh] == list(range(6, 13))
+    # even after eviction ate part of the overlap, since_seq never
+    # re-delivers already-seen records (seqs 1-4 evicted, 5 retained)
+    retained = rec.dump()
+    assert retained[0]["seq"] == 5
+    assert all(e["seq"] > cursor for e in rec.dump(since_seq=cursor))
+    # filters compose with the cursor
+    assert rec.dump(kind="member-state", since_seq=10, last=1)[0]["seq"] == 12
+
+
 # -- metrics sink satellites -------------------------------------------------
 
 

@@ -1,0 +1,389 @@
+"""Deterministic record/replay plane (ISSUE 9) — acceptance + units.
+
+Acceptance pins:
+
+- the seeded ``partition-heal-loss`` chaos plan, recorded and replayed
+  on BOTH planes, yields identical membership-view digests every round
+  (device: every protocol round, bit-exact; host: every convergence
+  barrier, virtualized timing);
+- a deliberately perturbed replay (one flipped recorded event) makes
+  ``tools/replay.py diff`` exit nonzero and name the correct FIRST
+  DIVERGENT ROUND plus the per-node view delta at that round;
+- ``tools/chaos.py --record-on-fail`` writes the repro artifact exactly
+  when an invariant fails (green runs keep nothing);
+- the recording format is versioned and fails closed on mismatch /
+  truncation, and its version is schema-pinned (serflint
+  ``schema-recording-drift``).
+
+Budget: the device record+replay pair is a module fixture (one compile,
+small N); the heavy flavor/shard soak is ``@slow``.
+"""
+
+import copy
+import importlib.util
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.asyncio
+
+
+def _device_cfg(n=48, k_facts=32, **gossip_kw):
+    from serf_tpu.replay.selfcheck import default_replay_cfg
+
+    return default_replay_cfg(n, k_facts, **gossip_kw)
+
+
+def _record_device(cfg, plan_name="partition-heal-loss", mesh=None):
+    from serf_tpu.faults.device import run_device_plan
+    from serf_tpu.faults.plan import named_plan
+    from serf_tpu.replay.recording import RunRecorder
+
+    recorder = RunRecorder()
+    result = run_device_plan(named_plan(plan_name), cfg, mesh=mesh,
+                             recorder=recorder)
+    return result, recorder.to_recording()
+
+
+@pytest.fixture(scope="module")
+def device_artifacts():
+    """One recorded + one replayed partition-heal-loss device run,
+    shared by the acceptance/perturbation/CLI tests below."""
+    from serf_tpu.replay.replayer import replay_device
+
+    result, recording = _record_device(_device_cfg())
+    replayed = replay_device(recording).to_recording()
+    return {"result": result, "recording": recording,
+            "replayed": replayed}
+
+
+# ---------------------------------------------------------------------------
+# acceptance: bit-exact record -> replay on both planes
+# ---------------------------------------------------------------------------
+
+
+def test_device_record_replay_bit_exact(device_artifacts):
+    """THE device acceptance pin: every protocol round's membership-view
+    digest from the replay matches the recording exactly."""
+    from serf_tpu.faults.plan import named_plan
+    from serf_tpu.replay.differ import diff_recordings
+
+    result = device_artifacts["result"]
+    rec = device_artifacts["recording"]
+    assert result.report.ok, result.report.format()
+
+    plan = named_plan("partition-heal-loss")
+    views = rec.views()
+    assert len(views) == plan.total_rounds() + plan.settle_rounds
+    assert [v["round"] for v in views] == list(range(1, len(views) + 1))
+    assert all(v["digest"] and len(v["nodes"]) == 48 for v in views)
+
+    d = diff_recordings(rec, device_artifacts["replayed"])
+    assert d.ok, d.format()
+    assert d.compared_views == len(views)
+    assert d.first_divergent_round is None
+
+
+async def test_host_record_replay_bit_exact(tmp_path):
+    """THE host acceptance pin: partition-heal-loss recorded on a live
+    loopback cluster, then re-driven from the recording with virtualized
+    timing — every barrier's membership-view digest matches."""
+    from serf_tpu.faults.host import run_host_plan
+    from serf_tpu.faults.plan import named_plan
+    from serf_tpu.replay.differ import diff_recordings
+    from serf_tpu.replay.recording import RunRecorder
+    from serf_tpu.replay.replayer import replay_host
+
+    plan = named_plan("partition-heal-loss", 4)
+    (tmp_path / "rec").mkdir()
+    (tmp_path / "rep").mkdir()
+    recorder = RunRecorder()
+    result = await run_host_plan(plan, tmp_dir=str(tmp_path / "rec"),
+                                 recorder=recorder)
+    assert result.report.ok, result.report.format()
+    rec = recorder.to_recording()
+    ops = {s["op"] for s in rec.steps()}
+    # the recording captured the whole ingress surface: joins, phases,
+    # tapped user events (background traffic), heal, both barriers
+    assert {"join", "phase", "user-event", "heal", "barrier"} <= ops
+    assert len(rec.views()) == 2          # quiet + settle barriers
+
+    replayed = (await replay_host(
+        rec, tmp_dir=str(tmp_path / "rep"))).to_recording()
+    d = diff_recordings(rec, replayed)
+    assert d.ok, d.format()
+    assert d.compared_views == 2
+    # per-barrier digests carry the per-node 12-hex view digests
+    for v in rec.views():
+        assert set(v["nodes"]) == {f"n{i}" for i in range(4)}
+
+
+# ---------------------------------------------------------------------------
+# acceptance: perturbed replay -> nonzero diff at the right round
+# ---------------------------------------------------------------------------
+
+
+def _perturb_phase1_inject(recording):
+    """Flip one recorded event: the first inject feeding phase 1 (the
+    second scan) gets its first origin shifted by one node."""
+    pert = type(recording)(copy.deepcopy(recording.header),
+                           copy.deepcopy(recording.records))
+    scans_seen = 0
+    for r in pert.records:
+        if r["kind"] != "step":
+            continue
+        if r["op"] == "scan":
+            scans_seen += 1
+        if r["op"] == "inject" and scans_seen == 1:
+            r["args"]["origins"][0] = (r["args"]["origins"][0] + 1) % 48
+            return pert, r["seq"]
+    raise AssertionError("no phase-1 inject step found")
+
+
+def test_perturbed_replay_diverges_at_correct_round(device_artifacts,
+                                                    tmp_path):
+    """One flipped event -> the differ names the flipped STEP and the
+    first divergent ROUND (phase 1 starts at round 13: phase 0 ran 12),
+    with the per-node view delta; the CLI exits nonzero on it."""
+    from serf_tpu.replay.differ import diff_recordings
+    from serf_tpu.replay.replayer import replay_device
+
+    rec = device_artifacts["recording"]
+    pert, pert_seq = _perturb_phase1_inject(rec)
+    replayed = replay_device(pert).to_recording()
+    d = diff_recordings(rec, replayed)
+    assert not d.ok
+    assert d.first_divergent_step["seq"] == pert_seq
+    assert d.first_divergent_round == 13, d.format()
+    assert d.node_delta            # the differ shows WHICH views moved
+
+    # CLI contract: diff exits nonzero and reports the same round
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    rec.save(str(a))
+    replayed.save(str(b))
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "replay.py"),
+         "diff", str(a), str(b), "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["first_divergent_round"] == 13
+    assert out["node_delta"]
+
+    # identical inputs exit 0
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "replay.py"),
+         "diff", str(a), str(a)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# chaos integration: --record-on-fail
+# ---------------------------------------------------------------------------
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"_tool_{name}", REPO / "tools" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_chaos_record_on_fail_writes_artifact_only_when_red(
+        tmp_path, monkeypatch):
+    """A red run writes the repro recording and names it; a green run
+    keeps nothing (the recorder stays in-memory)."""
+    from serf_tpu.faults.invariants import InvariantReport
+    from serf_tpu.replay.recording import Recording, plan_to_dict
+
+    chaos = _load_tool("chaos")
+
+    def fake_run_host(plan, recorder=None, ok=False):
+        rep = InvariantReport(plane="host", plan=plan.name)
+        rep.add("membership-convergence", ok, "stubbed")
+        if recorder is not None:
+            recorder.header(plane="host", plan=plan_to_dict(plan),
+                            seed=plan.seed, config={"options": "default",
+                                                    "snapshots": True,
+                                                    "n": plan.n})
+            recorder.step("join", node=1, target="n0")
+
+        class R:
+            pass
+
+        r = R()
+        r.report = rep
+        r.load = None
+        return r
+
+    argv = ["chaos.py", "--plan", "self-check", "--plane", "host",
+            "--record-on-fail", "--record-dir", str(tmp_path)]
+    monkeypatch.setattr(chaos, "run_host", fake_run_host)
+    monkeypatch.setattr(sys, "argv", argv)
+    assert chaos.main() == 1
+    artifact = tmp_path / "chaos-self-check-host.replay.jsonl"
+    assert artifact.exists()
+    rec = Recording.load(artifact)
+    assert rec.plane == "host" and rec.header["plan"]["name"] == "self-check"
+
+    # green run: same wiring, ok report -> nothing written
+    artifact.unlink()
+    monkeypatch.setattr(chaos, "run_host",
+                        lambda plan, recorder=None:
+                        fake_run_host(plan, recorder, ok=True))
+    assert chaos.main() == 0
+    assert not artifact.exists()
+
+
+# ---------------------------------------------------------------------------
+# format / serde / differ units
+# ---------------------------------------------------------------------------
+
+
+def test_recording_format_versioned_and_truncation_fail_closed(tmp_path):
+    from serf_tpu.replay.recording import (
+        Recording,
+        RecordingError,
+        RunRecorder,
+        recording_schema_version,
+    )
+
+    r = RunRecorder()
+    r.header(plane="device", plan={"name": "x", "n": 2, "phases": []},
+             seed=3, config={"n": 2})
+    r.step("init", key="00")
+    r.view(round_=1, digest="aabbccdd", nodes=["aa", "bb"])
+    p = tmp_path / "r.jsonl"
+    r.save(str(p))
+
+    rec = Recording.load(p)
+    assert rec.header["v"] == recording_schema_version() == 1
+    assert len(rec.views()) == 1 and len(list(rec.steps())) == 1
+
+    # version mismatch fails closed
+    lines = p.read_text().splitlines()
+    hdr = json.loads(lines[0])
+    hdr["v"] = 999
+    (tmp_path / "v.jsonl").write_text(
+        "\n".join([json.dumps(hdr)] + lines[1:]) + "\n")
+    with pytest.raises(RecordingError, match="v999"):
+        Recording.load(tmp_path / "v.jsonl")
+
+    # a truncated file (lost trailer) fails closed
+    (tmp_path / "t.jsonl").write_text("\n".join(lines[:-1]) + "\n")
+    with pytest.raises(RecordingError, match="truncated|no end"):
+        Recording.load(tmp_path / "t.jsonl")
+
+    # a dropped middle record breaks the step/view counts
+    (tmp_path / "m.jsonl").write_text(
+        "\n".join(lines[:1] + lines[2:]) + "\n")
+    with pytest.raises(RecordingError, match="disagree"):
+        Recording.load(tmp_path / "m.jsonl")
+
+
+def test_plan_serde_roundtrip_every_named_plan():
+    from serf_tpu.faults.plan import named_plan, plan_names
+    from serf_tpu.replay.recording import plan_from_dict, plan_to_dict
+
+    for name in plan_names():
+        plan = named_plan(name)
+        assert plan_from_dict(plan_to_dict(plan)) == plan
+
+
+def test_device_config_serde_roundtrip():
+    from serf_tpu.replay.recording import (
+        device_config_from_dict,
+        device_config_to_dict,
+    )
+
+    cfg = _device_cfg(n=64, k_facts=32, pack_stamp=False)
+    assert device_config_from_dict(device_config_to_dict(cfg)) == cfg
+
+
+def test_differ_detects_length_and_header_mismatch():
+    from serf_tpu.replay.differ import diff_recordings
+    from serf_tpu.replay.recording import Recording, RunRecorder
+
+    def make(n_views, plane="device"):
+        r = RunRecorder()
+        r.header(plane=plane, plan={"name": "x"}, seed=1, config={})
+        for i in range(n_views):
+            r.view(round_=i + 1, digest=f"{i:08x}", nodes=None)
+        return r.to_recording()
+
+    same = diff_recordings(make(3), make(3))
+    assert same.ok and same.compared_views == 3
+    short = diff_recordings(make(3), make(2))
+    assert not short.ok and "length" in short.length_note
+    cross = diff_recordings(make(2), make(2, plane="host"))
+    assert not cross.ok and cross.header_notes
+
+
+async def test_host_replay_refuses_custom_options():
+    from serf_tpu.faults.plan import named_plan
+    from serf_tpu.replay.recording import (
+        Recording,
+        RecordingError,
+        RunRecorder,
+        plan_to_dict,
+    )
+    from serf_tpu.replay.replayer import replay_host
+
+    r = RunRecorder()
+    r.header(plane="host", plan=plan_to_dict(named_plan("self-check")),
+             seed=3, config={"options": "custom", "n": 4})
+    with pytest.raises(RecordingError, match="custom"):
+        await replay_host(r.to_recording())
+
+
+def test_recording_schema_is_pinned():
+    """The recording format is the third pinned schema surface: the AST
+    spec matches the live literal and the pin carries version 1."""
+    from serf_tpu.analysis.schema import (
+        load_pins,
+        recording_fingerprint,
+        recording_spec,
+    )
+    from serf_tpu.replay.recording import RECORDING_SCHEMA
+
+    spec = recording_spec(REPO)
+    assert spec == {k: list(v) for k, v in RECORDING_SCHEMA.items()}
+    pins = load_pins()
+    assert pins["recording"]["version"] == 1
+    assert pins["recording"]["fingerprint"] == recording_fingerprint(REPO)
+
+
+# ---------------------------------------------------------------------------
+# heavy soak: both stamp flavors x sharded flagship (redundant cover of
+# the tier-1 path above at other config points)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("pack_stamp", [True, False])
+def test_record_replay_flavors_sharded_soak(vmesh8, pack_stamp):
+    from serf_tpu.replay.differ import diff_recordings
+    from serf_tpu.replay.replayer import replay_device
+
+    cfg = _device_cfg(n=64, k_facts=32, pack_stamp=pack_stamp)
+    result, rec = _record_device(cfg, mesh=vmesh8)
+    assert result.report.ok, result.report.format()
+    replayed = replay_device(rec, mesh=vmesh8).to_recording()
+    d = diff_recordings(rec, replayed)
+    assert d.ok, d.format()
+
+
+@pytest.mark.slow
+def test_selfcheck_roundtrip_verdict():
+    from serf_tpu.replay.selfcheck import device_roundtrip
+
+    out = device_roundtrip(n=48)
+    assert out["digest_equal"] and out["invariants_ok"]
+    assert out["rounds"] == 60

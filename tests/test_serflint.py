@@ -201,16 +201,26 @@ class JoinMessage:
 '''
 
 
+_TOY_RECORDING = '''\
+RECORDING_SCHEMA = {
+    "header": ("v", "plane"),
+    "view": ("seq", "digest"),
+}
+'''
+
+
 def _schema_project(tmp_path):
     project = toy_project(tmp_path, {
         "serf_tpu/models/dissemination.py": _TOY_PYTREE,
         "serf_tpu/types/messages.py": _TOY_WIRE,
+        "serf_tpu/replay/recording.py": _TOY_RECORDING,
     }, pins=True)
     schema_mod.bump_pins(root=tmp_path, path=project.pins_path)
     return project
 
 
-SCHEMA_RULES = ["schema-pytree-drift", "schema-wire-drift"]
+SCHEMA_RULES = ["schema-pytree-drift", "schema-wire-drift",
+                "schema-recording-drift"]
 
 
 def test_schema_pinned_is_silent(tmp_path):
@@ -245,6 +255,18 @@ def test_wire_field_change_without_bump_fails(tmp_path):
     assert rules_fired(report) == {"schema-wire-drift"}
 
 
+def test_recording_field_change_without_bump_fails(tmp_path):
+    project = _schema_project(tmp_path)
+    p = tmp_path / "serf_tpu/replay/recording.py"
+    p.write_text(p.read_text().replace('"seq", "digest"',
+                                       '"seq", "digest", "nodes"'))
+    report = analysis.run_rules(project, rules=SCHEMA_RULES)
+    assert rules_fired(report) == {"schema-recording-drift"}
+    schema_mod.bump_pins(root=tmp_path, path=project.pins_path)
+    report = analysis.run_rules(project, rules=SCHEMA_RULES)
+    assert report.findings == []
+
+
 def test_repo_pins_match_current_sources():
     """The committed pins match the committed schemas — a PR that edits
     GossipState or a wire message without --bump-schema fails HERE
@@ -252,6 +274,8 @@ def test_repo_pins_match_current_sources():
     pins = schema_mod.load_pins()
     assert pins["pytree"]["fingerprint"] == schema_mod.pytree_fingerprint()
     assert pins["wire"]["fingerprint"] == schema_mod.wire_fingerprint()
+    assert pins["recording"]["fingerprint"] \
+        == schema_mod.recording_fingerprint()
     # the specs cover the real surface
     spec = schema_mod.pytree_spec(REPO)
     assert set(spec) == {"FactTable", "GossipState", "VivaldiState",
@@ -447,6 +471,7 @@ def test_rule_registry_is_exactly_the_shipped_set():
         "reg-metric-unknown", "reg-metric-unused", "reg-doc-drift",
         "reg-flight-unknown", "reg-flight-unused",
         "schema-pytree-drift", "schema-wire-drift",
+        "schema-recording-drift",
         "docs-rule-table",
         "suppress-no-reason", "suppress-unused",
         "baseline-stale", "baseline-no-reason",

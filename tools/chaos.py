@@ -25,14 +25,16 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def run_host(plan):
+def run_host(plan, recorder=None):
     from serf_tpu.faults.host import run_host_plan
 
     with tempfile.TemporaryDirectory(prefix="serf-chaos-") as td:
-        return asyncio.run(run_host_plan(plan, tmp_dir=td))
+        return asyncio.run(run_host_plan(plan, tmp_dir=td,
+                                         recorder=recorder))
 
 
-def run_device(plan, n: int, k_facts: int, devices: int = 0):
+def run_device(plan, n: int, k_facts: int, devices: int = 0,
+               recorder=None):
     from serf_tpu.faults.device import run_device_plan
     from serf_tpu.models.dissemination import GossipConfig
     from serf_tpu.models.failure import FailureConfig
@@ -69,7 +71,8 @@ def run_device(plan, n: int, k_facts: int, devices: int = 0):
                 f"count (auto would use {best_device_count(n, visible)})")
         if d > 1:
             mesh = make_mesh(d)
-    return run_device_plan(plan, cfg, mesh=mesh), (d if mesh else 1)
+    return (run_device_plan(plan, cfg, mesh=mesh, recorder=recorder),
+            (d if mesh else 1))
 
 
 def main() -> int:
@@ -87,6 +90,16 @@ def main() -> int:
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--self-check", action="store_true",
                     help="run the tiny self-check plan on both planes")
+    ap.add_argument("--record-on-fail", dest="record_on_fail",
+                    action="store_true", default=None,
+                    help="attach the record/replay recorder and, on any "
+                         "invariant failure, write the run's recording "
+                         "as a repro artifact (default: on for "
+                         "--self-check)")
+    ap.add_argument("--no-record-on-fail", dest="record_on_fail",
+                    action="store_false")
+    ap.add_argument("--record-dir", default=".",
+                    help="directory the failure recording is written to")
     args = ap.parse_args()
 
     from serf_tpu.faults.host import degradation_counters
@@ -113,23 +126,50 @@ def main() -> int:
               f"{', '.join(plan_names())}", file=sys.stderr)
         return 2
 
+    record_on_fail = args.record_on_fail
+    if record_on_fail is None:
+        record_on_fail = args.self_check
+
+    def make_recorder():
+        if not record_on_fail:
+            return None
+        from serf_tpu.replay.recording import RunRecorder
+        return RunRecorder()
+
     reports = []
     notes = []
     overload = {}
+    recordings = {}
     device_mesh = 1
     for plane in planes:
+        recorder = make_recorder()
         if plane == "host":
-            result = run_host(plan)
+            result = run_host(plan, recorder=recorder)
             if result.load is not None:
                 overload["host"] = result.load.to_dict()
         else:
             result, device_mesh = run_device(plan, args.n, args.k_facts,
-                                             args.devices)
+                                             args.devices,
+                                             recorder=recorder)
             notes.extend(result.notes)
             if plan.has_load():
                 overload["device"] = {"offered": result.offered,
                                       "dropped": result.dropped}
         reports.append(result.report)
+        # a red run writes its repro artifact (recording + digest
+        # stream); green runs keep nothing — the recorder was in-memory
+        if recorder is not None and not result.report.ok:
+            path = os.path.join(
+                args.record_dir,
+                f"chaos-{plan.name}-{plane}.replay.jsonl")
+            try:
+                recordings[plane] = recorder.save(path)
+            except OSError as e:
+                # the repro artifact is best-effort: a bad --record-dir
+                # must not eat the invariant report of exactly the red
+                # run it was meant to make debuggable
+                print(f"record-on-fail: could not write {path}: {e}",
+                      file=sys.stderr)
 
     counters = degradation_counters()
     if args.json:
@@ -141,10 +181,14 @@ def main() -> int:
             "lowering_notes": notes,
             "overload": overload,
             "device_mesh_devices": device_mesh,
+            "recordings": recordings,
         }, indent=1, sort_keys=True))
     else:
         for r in reports:
             print(r.format())
+        for plane, path in sorted(recordings.items()):
+            print(f"repro recording [{plane}]: {path} "
+                  "(replay with `python tools/replay.py replay <path>`)")
         if "device" in planes:
             print(f"device mesh: {device_mesh} device(s)"
                   + (" (sharded flagship round)" if device_mesh > 1

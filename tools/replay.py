@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Record, replay and diff deterministic chaos-run recordings.
+
+    python tools/replay.py record --plan partition-heal-loss \\
+        --plane device --out run.jsonl
+    python tools/replay.py replay run.jsonl --out replayed.jsonl
+    python tools/replay.py diff run.jsonl replayed.jsonl --json
+
+``record`` runs a named FaultPlan on one plane with the recorder
+attached and writes the recording (ingress steps + per-round
+membership-view digests).  ``replay`` re-executes a recording on its
+plane and diffs the replayed digest stream against the source —
+exit 0 iff bit-identical.  ``diff`` compares two recordings' digest
+streams and reports the FIRST DIVERGENT ROUND plus the per-node view
+delta at that round; exit is nonzero on any divergence, so a replay
+pipeline can gate on it.  See README "Record & replay" for the format
+spec and the determinism contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def cmd_record(args) -> int:
+    from serf_tpu.faults.plan import named_plan, plan_names
+    from serf_tpu.replay.recording import RunRecorder
+    from serf_tpu.replay.selfcheck import default_replay_cfg
+
+    try:
+        plan = named_plan(args.plan)
+    except KeyError:
+        print(f"unknown plan {args.plan!r}; available: "
+              f"{', '.join(plan_names())}", file=sys.stderr)
+        return 2
+    recorder = RunRecorder()
+    if args.plane == "device":
+        from serf_tpu.faults.device import run_device_plan
+
+        result = run_device_plan(
+            plan, default_replay_cfg(args.n, args.k_facts),
+            recorder=recorder)
+    else:
+        from serf_tpu.faults.host import run_host_plan
+
+        with tempfile.TemporaryDirectory(prefix="serf-replay-") as td:
+            result = asyncio.run(
+                run_host_plan(plan, tmp_dir=td, recorder=recorder))
+    rec = recorder.to_recording()
+    path = rec.save(args.out)
+    views = len(rec.views())
+    if args.json:
+        print(json.dumps({"path": path, "plane": args.plane,
+                          "plan": plan.name, "views": views,
+                          "invariants_ok": bool(result.report.ok)},
+                         indent=1, sort_keys=True))
+    else:
+        print(result.report.format())
+        print(f"recorded {views} view digest(s) -> {path}")
+    return 0 if result.report.ok else 1
+
+
+def cmd_replay(args) -> int:
+    from serf_tpu.replay.differ import diff_recordings
+    from serf_tpu.replay.recording import Recording
+    from serf_tpu.replay.replayer import replay_recording
+
+    rec = Recording.load(args.recording)
+    with tempfile.TemporaryDirectory(prefix="serf-replay-") as td:
+        replayed = replay_recording(
+            rec, tmp_dir=td if rec.plane == "host" else None
+        ).to_recording()
+    if args.out:
+        replayed.save(args.out)
+    rep = diff_recordings(rec, replayed)
+    if args.json:
+        out = rep.to_dict()
+        out["replayed_to"] = args.out
+        print(json.dumps(out, indent=1, sort_keys=True))
+    else:
+        print(rep.format())
+        if args.out:
+            print(f"replay digest stream -> {args.out}")
+    return 0 if rep.ok else 1
+
+
+def cmd_diff(args) -> int:
+    from serf_tpu.replay.differ import diff_recordings
+    from serf_tpu.replay.recording import Recording
+
+    rep = diff_recordings(Recording.load(args.a), Recording.load(args.b))
+    if args.json:
+        print(json.dumps(rep.to_dict(), indent=1, sort_keys=True))
+    else:
+        print(rep.format())
+    return 0 if rep.ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    rec = sub.add_parser("record", help="run a plan and write a recording")
+    rec.add_argument("--plan", default="partition-heal-loss")
+    rec.add_argument("--plane", choices=("host", "device"),
+                     default="device")
+    rec.add_argument("--n", type=int, default=96,
+                     help="device-plane simulated node count")
+    rec.add_argument("--k-facts", type=int, default=32)
+    rec.add_argument("--out", default="serf-replay.jsonl")
+    rec.add_argument("--json", action="store_true")
+    rec.set_defaults(fn=cmd_record)
+
+    rp = sub.add_parser("replay", help="re-execute a recording and "
+                                       "diff against it")
+    rp.add_argument("recording")
+    rp.add_argument("--out", default=None,
+                    help="also write the replayed digest stream here")
+    rp.add_argument("--json", action="store_true")
+    rp.set_defaults(fn=cmd_replay)
+
+    df = sub.add_parser("diff", help="compare two recordings' digest "
+                                     "streams")
+    df.add_argument("a")
+    df.add_argument("b")
+    df.add_argument("--json", action="store_true")
+    df.set_defaults(fn=cmd_diff)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
