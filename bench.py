@@ -533,6 +533,54 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - the lint embed is best-effort
         detail["analysis_error"] = repr(e)[:300]
 
+    # continuous-telemetry rings (ISSUE 10): a short sustained scan with
+    # the per-round telemetry rows collected INSIDE the scan (one
+    # device_get for the whole run) — BENCH_DETAIL carries the ring
+    # summaries, so every bench artifact shows the per-round trajectory
+    # (alive/agreement/coverage/overflow), not just endpoint means.  The
+    # telemetry leg runs at a bounded N so it never eats the driver
+    # window (override with SERF_TPU_BENCH_TS_N).
+    try:
+        from serf_tpu.obs.timeseries import telemetry_to_store
+        ts_n = int(os.environ.get("SERF_TPU_BENCH_TS_N",
+                                  min(N_NODES, 4096)))
+        ts_rounds = 48
+        cfg_ts = flagship_config(ts_n, k_facts=K_FACTS)
+        run_ts = jax.jit(functools.partial(
+            run_cluster_sustained, cfg=cfg_ts,
+            events_per_round=EVENTS_PER_ROUND, collect_telemetry=True),
+            static_argnames=("num_rounds",))
+        with dispatch_timer("bench.telemetry_scan", signature=ts_rounds):
+            _, rows = run_ts(seeded_state(cfg_ts), key=jax.random.key(5),
+                             num_rounds=ts_rounds)
+            rows = jax.device_get(rows)      # THE one transfer (barrier)
+        ts_store = telemetry_to_store(rows)
+        detail["timeseries"] = {"n": ts_n, "rounds": ts_rounds,
+                                "summaries": ts_store.summaries()}
+    except Exception as e:  # noqa: BLE001 - the rings are best-effort
+        detail["timeseries_error"] = repr(e)[:300]
+
+    # SLO verdict on the headline itself (obs/slo.py, the SAME table the
+    # chaos/obswatch CLIs judge): the measured sustained rps must not
+    # exceed the analytic bandwidth ceiling — a number past physics is a
+    # measurement artifact (the round-1 179k-rps class), and this is
+    # where it gets caught permanently
+    try:
+        from serf_tpu.models.accounting import round_traffic
+        from serf_tpu.obs import slo as slo_mod
+        ceiling = round_traffic(cfg).ceiling_rounds_per_sec()
+        v = slo_mod.judge(slo_mod.slo_def("sustained-rps-ceiling"),
+                          "device", sustained_rps / max(ceiling, 1e-9),
+                          detail=f"measured {sustained_rps:.1f} rps vs "
+                                 f"analytic ceiling {ceiling:.1f} rps")
+        detail["slo"] = [v.to_dict()]
+        if not v.ok:
+            sys.stderr.write(
+                "SLO BREACH: measured rps exceeds the analytic ceiling "
+                "— distrust this measurement\n")
+    except Exception as e:  # noqa: BLE001 - the verdict is best-effort
+        detail["slo_error"] = repr(e)[:300]
+
     # record/replay determinism self-check (ISSUE 9): record a short
     # seeded device run, replay it from the recording, and require the
     # per-round membership-view digest streams to be identical — a
@@ -550,6 +598,32 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - the self-check is best-effort
         detail["replay_error"] = repr(e)[:300]
 
+    # --- regression gate (ISSUE 10): score the headline numbers against
+    # the committed BASELINE.json bands (per-platform dotted-path min/max
+    # — format documented in README "Time series & SLOs").  WARN-ONLY by
+    # default so the first round re-baselines instead of failing; set
+    # --strict (env SERF_TPU_BENCH_STRICT=1) for a nonzero exit on a
+    # band violation.
+    gate = None
+    try:
+        from serf_tpu.obs.slo import score_bench
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BASELINE.json")) as f:
+            bands = json.load(f).get("bands")
+        gate = score_bench(detail, bands, "cpu" if on_cpu else "tpu")
+        detail["regression_gate"] = gate
+        if gate["rebaseline"]:
+            sys.stderr.write(
+                "regression gate: no bands for this platform — "
+                "re-baseline round (add them to BASELINE.json)\n")
+        for v in gate["violations"]:
+            row = next(c for c in gate["checked"] if c["metric"] == v)
+            sys.stderr.write(
+                f"REGRESSION-GATE VIOLATION: {v} = {row['value']:g} "
+                f"outside [{row['min']}, {row['max']}]\n")
+    except Exception as e:  # noqa: BLE001 - the gate must never eat the
+        detail["regression_gate_error"] = repr(e)[:300]   # headline
+
     detail["platform"] = platform
     sys.stderr.write(json.dumps(detail) + "\n")
     # Only ORCHESTRATED runs write the committed artifact: ad-hoc
@@ -564,6 +638,11 @@ def main() -> None:
                 json.dump(detail, f, indent=1)
         except OSError:
             pass
+    # strict mode exits nonzero on a band violation — AFTER the headline
+    # was printed and the artifact written, so nothing is ever lost
+    if (os.environ.get("SERF_TPU_BENCH_STRICT") == "1"
+            and gate is not None and not gate["ok"]):
+        sys.exit(4)
 
 
 def probe() -> None:
@@ -685,6 +764,8 @@ def orchestrate() -> None:
                                  "keeping the measured headline\n")
             _save_tpu_last_good(out)
             print(out)
+            if rc == 4:          # --strict regression-gate violation
+                sys.exit(4)
             return
         sys.stderr.write("TPU bench produced no headline (probe had "
                          "passed); falling back to CPU\n")
@@ -707,6 +788,8 @@ def orchestrate() -> None:
             except ValueError:
                 pass
         print(out)
+        if rc == 4:              # --strict regression-gate violation
+            sys.exit(4)
         return
     if rc is None:
         sys.stderr.write("CPU fallback bench also timed out\n")
@@ -725,6 +808,10 @@ def _last_json_line(stdout: str):
 
 
 if __name__ == "__main__":
+    if "--strict" in sys.argv:
+        # regression-gate strictness rides the env so the orchestrator's
+        # measurement children inherit it
+        os.environ["SERF_TPU_BENCH_STRICT"] = "1"
     if "--probe" in sys.argv:
         probe()
     elif "--run" in sys.argv:

@@ -87,6 +87,8 @@ class Registry:
 
     metrics: frozenset
     flight_kinds: frozenset
+    #: declared SLO names (obs/slo.py SLO_TABLE must match, both ways)
+    slos: frozenset = frozenset()
 
 
 @dataclass
@@ -111,7 +113,8 @@ def default_project() -> Project:
         baseline_path=REPO / BASELINE_NAME,
         pins_path=REPO / PINS_NAME,
         registry=Registry(metrics=frozenset(reg.METRICS),
-                          flight_kinds=frozenset(reg.FLIGHT_KINDS)),
+                          flight_kinds=frozenset(reg.FLIGHT_KINDS),
+                          slos=frozenset(reg.SLOS)),
     )
 
 

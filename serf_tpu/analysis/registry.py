@@ -115,6 +115,7 @@ METRICS: tuple = (
     # device plane (emit_*_metrics)
     "serf.device.dispatch-ms",
     "serf.device.dispatch.calls",
+    "serf.model.gossip.agreement",
     "serf.model.gossip.alive",
     "serf.model.gossip.coverage",
     "serf.model.gossip.facts-valid",
@@ -123,6 +124,7 @@ METRICS: tuple = (
     "serf.model.gossip.tombstones",
     "serf.model.swim.accusations-pending",
     "serf.model.swim.dead-facts",
+    "serf.model.swim.false-dead",
     "serf.model.swim.live-suspicions",
     "serf.model.swim.undetected-deaths",
     "serf.model.traffic.bytes-per-round",
@@ -146,6 +148,14 @@ METRICS: tuple = (
     "serf.replay.records",
     "serf.replay.rounds",
     "serf.replay.divergence",
+    # continuous-telemetry plane (obs/timeseries.py sampler)
+    "serf.ts.samples",
+    "serf.ts.points",
+    "serf.ts.downsamples",
+    # SLO plane (obs/slo.py)
+    "serf.slo.ok",
+    "serf.slo.burn",
+    "serf.slo.breach",
 )
 
 #: every flight-recorder event kind (obs/flight.py ``record`` call sites)
@@ -173,11 +183,29 @@ FLIGHT_KINDS: tuple = (
     "replay-divergence",
     "replay-recorded",
     "shard-fallback",
+    "slo-breach",
     "snapshot-torn-tail",
     "subscriber-drop",
     "swim-state",
     "user-event",
 )
+
+#: every SLO name ``serf_tpu/obs/slo.py`` SLO_TABLE defines.  Checked
+#: both ways (``slo-decl-drift``) like the metric registry; every SLO's
+#: watched metrics must be declared above (``slo-metric-unknown``) —
+#: the SLO plane cannot judge metrics nobody emits — and the README
+#: "Time series & SLOs" table carries one row per name
+#: (``slo-doc-drift``).
+SLOS: tuple = (
+    "convergence-settle",
+    "false-dead",
+    "query-p99",
+    "shed-ratio",
+    "sustained-rps-ceiling",
+)
+
+#: the README section the SLO table lives in
+SLO_SECTION = "## Time series & SLOs"
 
 
 # ---------------------------------------------------------------------------
@@ -408,6 +436,144 @@ def check_flight_unused(files: List[SourceFile],
             "reg-flight-unused", "serf_tpu/analysis/registry.py", 1, kind,
             f"registry flight kind {kind!r} is never recorded — delete "
             "the entry or restore the record site")
+
+
+# ---------------------------------------------------------------------------
+# SLO cross-checks (pass family d): the SLO table is registry-governed
+# ---------------------------------------------------------------------------
+
+def _slo_sites(f):
+    """``SLODef(...)`` call sites in one source: a list of
+    ``(name, metrics_tuple, rel, lineno)``.  Pure AST — the SLO module
+    is never imported; names/metrics must be literals (which the frozen
+    dataclass table is by construction).  Cached on the SourceFile like
+    ``_obs_sites`` so the three SLO rules share one walk."""
+    if isinstance(f, SourceFile):
+        cached = getattr(f, "_slo_sites", None)
+        if cached is not None:
+            return cached
+    tree, rel = _tree_of(f)
+    out: List[tuple] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        fn_name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        if fn_name != "SLODef":
+            continue
+        name = None
+        mets: List[str] = []
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            name = node.args[0].value
+        for kw in node.keywords:
+            if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                name = kw.value.value
+            elif kw.arg == "metrics" and isinstance(
+                    kw.value, (ast.Tuple, ast.List)):
+                mets = [e.value for e in kw.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)]
+        if name is not None:
+            out.append((name, tuple(mets), rel, node.lineno))
+    if isinstance(f, SourceFile):
+        f._slo_sites = out
+    return out
+
+
+def slo_defs(files: Iterable) -> List[tuple]:
+    """Every SLODef site across sources, definition-ordered."""
+    out: List[tuple] = []
+    for f in files:
+        out.extend(_slo_sites(f))
+    return out
+
+
+def documented_slo_names(readme: Path) -> Dict[str, int]:
+    """{slo_name: line} from the README "Time series & SLOs" table."""
+    out: Dict[str, int] = {}
+    in_section = False
+    for i, line in enumerate(readme.read_text().splitlines(), start=1):
+        if line.startswith("## "):
+            in_section = line.strip() == SLO_SECTION
+            continue
+        if not in_section:
+            continue
+        m = ROW_RE.match(line)
+        if m and m.group(1) not in ("SLO", "Metric"):
+            out[m.group(1)] = i
+    return out
+
+
+@project_rule("slo-metric-unknown",
+              "an SLO definition watches a metric not declared in the "
+              "registry",
+              'SLODef(name="x", metrics=("serf.not.declared",), ...)')
+def check_slo_metric_unknown(files: List[SourceFile],
+                             project: Project) -> Iterable[Finding]:
+    if project.registry is None:
+        return
+    for name, mets, rel, lineno in slo_defs(
+            _metric_files(files, project)):
+        for m in mets:
+            if normalize(m) not in project.registry.metrics:
+                yield _reg_finding(
+                    "slo-metric-unknown", rel, lineno, f"{name}:{m}",
+                    f"SLO {name!r} watches metric {m!r} which is not "
+                    "declared in serf_tpu/analysis/registry.py METRICS "
+                    "— declare (and emit + document) the metric, or fix "
+                    "the SLO definition")
+
+
+@project_rule("slo-decl-drift",
+              "SLO definitions out of sync with the registry SLOS "
+              "declaration (defined-but-undeclared or vice versa)",
+              "an SLO_TABLE entry with no SLOS tuple entry")
+def check_slo_decl_drift(files: List[SourceFile],
+                         project: Project) -> Iterable[Finding]:
+    if project.registry is None:
+        return
+    defined = {}
+    for name, _mets, rel, lineno in slo_defs(
+            _metric_files(files, project)):
+        defined.setdefault(name, (rel, lineno))
+    for name in sorted(set(defined) - set(project.registry.slos)):
+        rel, lineno = defined[name]
+        yield _reg_finding(
+            "slo-decl-drift", rel, lineno, name,
+            f"SLO {name!r} defined but not declared — add it to "
+            "serf_tpu/analysis/registry.py SLOS (and the README table)")
+    for name in sorted(set(project.registry.slos) - set(defined)):
+        yield _reg_finding(
+            "slo-decl-drift", "serf_tpu/analysis/registry.py", 1, name,
+            f"registry SLO {name!r} has no SLODef anywhere — delete the "
+            "SLOS entry or restore the definition")
+
+
+@project_rule("slo-doc-drift",
+              "README 'Time series & SLOs' table out of sync with the "
+              "declared SLOs (missing or stale row)",
+              "a declared SLO with no README row")
+def check_slo_doc_drift(files: List[SourceFile],
+                        project: Project) -> Iterable[Finding]:
+    if project.registry is None or project.readme is None \
+            or not project.readme.exists():
+        return
+    documented = documented_slo_names(project.readme)
+    readme_rel = project.readme.name
+    for name in sorted(set(project.registry.slos) - set(documented)):
+        yield _reg_finding(
+            "slo-doc-drift", readme_rel, 1, name,
+            f"declared SLO {name!r} has no row in the README "
+            f"'{SLO_SECTION[3:]}' table")
+    for name, line in sorted(documented.items()):
+        if name not in project.registry.slos:
+            yield _reg_finding(
+                "slo-doc-drift", readme_rel, line, name,
+                f"README documents SLO {name!r} but the registry does "
+                "not declare it — delete the row or declare the SLO")
 
 
 # ---------------------------------------------------------------------------

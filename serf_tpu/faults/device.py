@@ -131,7 +131,8 @@ def run_phase(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
               num_rounds: int, group: jnp.ndarray, drop,
               init_alive: jnp.ndarray, down: jnp.ndarray,
               mesh=None, collect_digests: bool = False,
-              include_nodes: bool = True):
+              include_nodes: bool = True,
+              collect_telemetry: bool = False):
     """Scan ``num_rounds`` chaos rounds with one phase's masks applied.
     Jit with ``num_rounds`` static; group/drop/down are traced, so equal-
     length phases reuse the compiled executable.  ``mesh`` runs every
@@ -145,11 +146,22 @@ def run_phase(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
     of the bare state.  ``include_nodes`` (static) gates the per-node
     plane: above ``NODE_DIGEST_CAP`` the recorders discard it anyway, so
     passing False avoids stacking an R×N scan output at flagship scale
-    (the second element is then an empty ``()``)."""
+    (the second element is then an empty ``()``).
+
+    ``collect_telemetry`` (static) additionally stacks one per-round
+    counters row (``models/swim.round_telemetry``: alive, agreement,
+    coverage, overflow ledger, suspicions, false-DEAD) as a scan output
+    — the continuous-telemetry plane's device feed, staying on device
+    until the caller's single per-run ``device_get``.  With both flags
+    the aux output is ``((digest, nodes), rows)``; with one flag the
+    aux shape is unchanged from before (callers that predate telemetry
+    unpack exactly what they always did)."""
     if collect_digests:
         # lazy for the same reason as _NODE_DIGEST_CAP: the replay plane
         # only rides along when digests are actually being collected
         from serf_tpu.replay.digest import state_digest
+    if collect_telemetry:
+        from serf_tpu.models.swim import round_telemetry
 
     alive = init_alive & ~down
     st = state._replace(gossip=state.gossip._replace(alive=alive),
@@ -157,15 +169,22 @@ def run_phase(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
 
     def body(carry, subkey):
         nxt = cluster_round(carry, cfg, subkey, drop_rate=drop, mesh=mesh)
+        dig = None
         if collect_digests:
             overall, node = state_digest(nxt.gossip, cfg.gossip)
-            return nxt, ((overall, node) if include_nodes
-                         else (overall, ()))
+            dig = (overall, node) if include_nodes else (overall, ())
+        if collect_digests and collect_telemetry:
+            return nxt, (dig, round_telemetry(nxt, cfg))
+        if collect_digests:
+            return nxt, dig
+        if collect_telemetry:
+            return nxt, round_telemetry(nxt, cfg)
         return nxt, ()
 
     keys = jax.random.split(key, num_rounds)
     final, out = jax.lax.scan(body, st, keys)
-    return (final, out) if collect_digests else final
+    return (final, out) if (collect_digests or collect_telemetry) \
+        else final
 
 
 @functools.lru_cache(maxsize=8)
@@ -177,7 +196,7 @@ def phase_runner(cfg: ClusterConfig, mesh=None):
     chaos plans at the same config now share compiles."""
     return jax.jit(functools.partial(run_phase, cfg=cfg, mesh=mesh),
                    static_argnames=("num_rounds", "collect_digests",
-                                    "include_nodes"))
+                                    "include_nodes", "collect_telemetry"))
 
 
 @dataclass
@@ -195,13 +214,25 @@ class DeviceChaosResult:
     #: serf.overload.device_offered / serf.overload.device_dropped
     offered: int = 0
     dropped: int = 0
+    #: per-round ring time series (obs.timeseries.SeriesStore keyed by
+    #: declared metric names) when the run collected telemetry — the
+    #: SLO plane's device-side evidence.  Timestamps are round indices.
+    telemetry: object = None
+    #: the EXACT final telemetry row ({field: float}, models/swim
+    #: TELEMETRY_FIELDS) — point verdicts (final agreement, false-DEAD
+    #: count) must come from here, not from the ring, whose overflow
+    #: downsampling pair-merges values (a ≥capacity-round run would
+    #: otherwise read a converged 1.0 averaged with its last
+    #: converging neighbor)
+    telemetry_final: Optional[dict] = None
 
 
 def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
                     key: Optional[jax.Array] = None,
                     state: Optional[ClusterState] = None,
                     events_per_phase: int = 2,
-                    mesh=None, recorder=None) -> DeviceChaosResult:
+                    mesh=None, recorder=None,
+                    collect_telemetry: bool = False) -> DeviceChaosResult:
     """Run ``plan`` against the flagship device cluster and check the
     invariants.  Injects ``events_per_phase`` fresh user events at the
     start of every phase (plus the settle window) so there is always
@@ -291,22 +322,41 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
             st = st._replace(gossip=g)
         return st
 
+    #: telemetry chunks: (base_round, device rows f32[R, F]) per scan —
+    #: transferred by ONE device_get after the whole plan ran (never a
+    #: per-round, never even a per-phase transfer)
+    tele_chunks: List[tuple] = []
+
     def scan(st: ClusterState, k_run, num_rounds: int, phase: int,
              group, drop, down, base_round: int) -> ClusterState:
         """One phase (or settle-chunk) scan; records the step + the
-        per-round digest stream when a recorder is attached."""
-        if recorder is None:
+        per-round digest stream when a recorder is attached, and stacks
+        the per-round telemetry rows when the run collects them."""
+        want_dig = recorder is not None
+        if not want_dig and not collect_telemetry:
             return run(st, key=k_run, num_rounds=num_rounds, group=group,
                        drop=drop, init_alive=init_alive, down=down)
-        from serf_tpu.replay.recording import record_scan_views
-        recorder.step("scan", phase=phase, rounds=num_rounds,
-                      key=key_to_hex(k_run))
-        include_nodes = cfg.n <= _NODE_DIGEST_CAP()
-        st, (dg, dn) = run(st, key=k_run, num_rounds=num_rounds,
-                           group=group, drop=drop, init_alive=init_alive,
-                           down=down, collect_digests=True,
-                           include_nodes=include_nodes)
-        record_scan_views(recorder, base_round, dg, dn, include_nodes)
+        if want_dig:
+            from serf_tpu.replay.recording import record_scan_views
+            recorder.step("scan", phase=phase, rounds=num_rounds,
+                          key=key_to_hex(k_run))
+            include_nodes = cfg.n <= _NODE_DIGEST_CAP()
+        st, out = run(st, key=k_run, num_rounds=num_rounds,
+                      group=group, drop=drop, init_alive=init_alive,
+                      down=down, collect_digests=want_dig,
+                      include_nodes=(include_nodes if want_dig else True),
+                      collect_telemetry=collect_telemetry)
+        if want_dig and collect_telemetry:
+            (dg, dn), rows = out
+        elif want_dig:
+            dg, dn = out
+            rows = None
+        else:
+            rows = out
+        if want_dig:
+            record_scan_views(recorder, base_round, dg, dn, include_nodes)
+        if rows is not None:
+            tele_chunks.append((base_round, rows))
         return st
 
     total = 0
@@ -359,8 +409,24 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
                               expect_overflow=expect_overflow)
     ledger = jax.device_get({"dropped": state.gossip.overflow,
                              "offered": state.gossip.injected})
+    telemetry = None
+    telemetry_final = None
+    if tele_chunks:
+        # THE one telemetry transfer of the run: every scan's stacked
+        # rows come back in a single device_get, then land in the ring
+        # format keyed by declared metric names
+        from serf_tpu.models.swim import TELEMETRY_FIELDS
+        from serf_tpu.obs.timeseries import telemetry_to_store
+        host_rows = jax.device_get([rows for _, rows in tele_chunks])
+        for (base, _), rows in zip(tele_chunks, host_rows):
+            telemetry = telemetry_to_store(rows, base_round=base,
+                                           store=telemetry)
+        telemetry_final = dict(zip(
+            TELEMETRY_FIELDS, (float(v) for v in host_rows[-1][-1])))
     return DeviceChaosResult(plan=plan, schedule=sched, state=state,
                              report=report, rounds_run=total,
                              notes=sched.notes, injected=injected,
                              offered=int(ledger["offered"]),
-                             dropped=int(ledger["dropped"]))
+                             dropped=int(ledger["dropped"]),
+                             telemetry=telemetry,
+                             telemetry_final=telemetry_final)

@@ -1448,9 +1448,17 @@ def emit_gossip_metrics(state: GossipState, cfg: GossipConfig,
     """
     from serf_tpu.utils import metrics
 
+    # local import: antientropy imports from this module at load time
+    from serf_tpu.models.antientropy import knowledge_agreement
+
     valid = state.facts.valid
     n_valid = jnp.maximum(jnp.sum(valid), 1).astype(jnp.float32)
     mean_cov = jnp.sum(jnp.where(valid, coverage(state, cfg), 0.0)) / n_valid
+    # knowledge agreement — THE convergence definition (the invariant
+    # checker and the SLO plane judge the same function); the per-round
+    # telemetry row (models/swim.round_telemetry) inlines it only to
+    # share one unpack with its coverage computation
+    agreement = knowledge_agreement(state, cfg)
     # dissemination fan-out: packets each alive node would select this
     # round (the transmit-limited queue's aggregate depth, vectorized)
     fan_out = jnp.sum(sending_mask(state, cfg)).astype(jnp.float32) \
@@ -1462,6 +1470,7 @@ def emit_gossip_metrics(state: GossipState, cfg: GossipConfig,
         "serf.model.gossip.alive": jnp.sum(state.alive),
         "serf.model.gossip.facts-valid": jnp.sum(valid),
         "serf.model.gossip.coverage": mean_cov,
+        "serf.model.gossip.agreement": agreement,
         "serf.model.gossip.fan-out": fan_out,
         "serf.model.gossip.tombstones": jnp.sum(state.tombstone),
         # the overload ledger (GossipState.overflow/.injected): facts

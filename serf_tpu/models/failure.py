@@ -487,6 +487,11 @@ def emit_swim_metrics(state: GossipState, cfg: GossipConfig,
         "serf.model.swim.undetected-deaths":
             jnp.sum(~state.alive
                     & ~believed_dead(state, cfg, fcfg)),
+        # false-DEAD: responsive (alive) nodes the cluster believes dead
+        # — Lifeguard's refutation path must drive this back to zero
+        # after heal; the SLO plane's false-dead objective watches it
+        "serf.model.swim.false-dead":
+            jnp.sum(believed_dead(state, cfg, fcfg) & state.alive),
     })
     vals = {name: float(v) for name, v in vals.items()}
     for name, v in vals.items():

@@ -31,10 +31,13 @@ from serf_tpu.models.dissemination import (
     rolled_rows,
     round_step,
     sample_offsets,
+    unpack_bits,
 )
 from serf_tpu.models.failure import (
     FailureConfig,
+    believed_dead,
     declare_round,
+    live_suspicions,
     probe_round,
     refute_round,
 )
@@ -303,14 +306,69 @@ def sustained_round(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
 def run_cluster_sustained(state: ClusterState, cfg: ClusterConfig,
                           key: jax.Array, num_rounds: int,
                           events_per_round: int = 2,
-                          mesh=None) -> ClusterState:
+                          mesh=None, collect_telemetry: bool = False):
+    """``collect_telemetry`` (static) additionally stacks one
+    :func:`round_telemetry` row per round as a scan output and returns
+    ``(final_state, rows f32[R, F])`` — the continuous-telemetry plane's
+    device feed.  The rows stay on device until the CALLER's single
+    ``device_get``: one transfer per run, never per round (the PR-9
+    digest-plane pattern)."""
     def body(carry, subkey):
-        return sustained_round(carry, cfg, subkey, events_per_round,
-                               mesh=mesh), ()
+        nxt = sustained_round(carry, cfg, subkey, events_per_round,
+                              mesh=mesh)
+        if collect_telemetry:
+            return nxt, round_telemetry(nxt, cfg)
+        return nxt, ()
 
     keys = jax.random.split(key, num_rounds)
-    final, _ = jax.lax.scan(body, state, keys)
-    return final
+    final, rows = jax.lax.scan(body, state, keys)
+    return (final, rows) if collect_telemetry else final
+
+
+#: field order of the per-round device telemetry row (``f32[F]``) —
+#: :mod:`serf_tpu.obs.timeseries.TELEMETRY_SERIES` maps each field to
+#: its declared metric name.  Values are exact in f32 up to 2^24
+#: (counts at the 1M flagship scale fit; only a pathological
+#: multi-billion-injection ledger would round).
+TELEMETRY_FIELDS = ("alive", "facts_valid", "agreement", "coverage",
+                    "overflow", "injected", "suspicions", "false_dead")
+
+
+def round_telemetry(state: ClusterState, cfg: ClusterConfig) -> jnp.ndarray:
+    """One compact counters row (``f32[len(TELEMETRY_FIELDS)]``) off the
+    current cluster state, cheap enough to ride EVERY round as a scan
+    output: alive count, valid facts, knowledge agreement + mean
+    coverage (one shared ``known``-plane unpack), the overflow/injection
+    ledger, live suspicions, and false-DEAD count (alive nodes the
+    cluster believes dead — the probe/refute outcome the SLO plane
+    judges).  Pure function of the state — safe inside jit/scan, and the
+    quantities agree with ``emit_*_metrics`` by construction."""
+    g = state.gossip
+    known = unpack_bits(g.known, cfg.gossip.k_facts)        # bool[N, K]
+    valid = g.facts.valid
+    alive_col = g.alive[:, None]
+    n_alive = jnp.maximum(jnp.sum(g.alive), 1).astype(jnp.float32)
+    cells = jnp.sum(valid[None, :] & alive_col)
+    hit = jnp.sum(known & valid[None, :] & alive_col)
+    agreement = jnp.where(cells > 0,
+                          hit.astype(jnp.float32)
+                          / jnp.maximum(cells, 1).astype(jnp.float32),
+                          1.0)
+    n_valid = jnp.maximum(jnp.sum(valid), 1).astype(jnp.float32)
+    cov = jnp.sum(known & alive_col, axis=0).astype(jnp.float32) / n_alive
+    mean_cov = jnp.sum(jnp.where(valid, cov, 0.0)) / n_valid
+    false_dead = jnp.sum(
+        believed_dead(g, cfg.gossip, cfg.failure) & g.alive)
+    return jnp.stack([
+        jnp.sum(g.alive).astype(jnp.float32),
+        jnp.sum(valid).astype(jnp.float32),
+        agreement.astype(jnp.float32),
+        mean_cov.astype(jnp.float32),
+        g.overflow.astype(jnp.float32),
+        g.injected.astype(jnp.float32),
+        jnp.sum(live_suspicions(g)).astype(jnp.float32),
+        false_dead.astype(jnp.float32),
+    ])
 
 
 def emit_cluster_metrics(state: ClusterState, cfg: ClusterConfig,

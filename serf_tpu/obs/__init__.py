@@ -29,6 +29,15 @@ package is that surface for the reproduction, spanning BOTH planes:
   (``Serf.cluster_stats()``; rendered by ``tools/obstop.py``).  Trace
   contexts (``obs.trace.TraceContext``) ride query/user-event wire
   messages so spans and flight events correlate across nodes.
+- :mod:`serf_tpu.obs.timeseries` — the TIME axis: bounded per-metric
+  ring series (power-of-two downsampling on overflow, JSON serde), the
+  host-plane ``MetricsSampler`` (sink snapshots + flight ``since_seq``
+  cursor at a cadence), and the device plane's per-round telemetry-row
+  → ring conversion.
+- :mod:`serf_tpu.obs.slo` — the JUDGMENT layer: one declarative SLO
+  table evaluated on both planes (multi-window burn rates, EWMA/MAD
+  anomaly flags, ``slo-breach`` flight events, ``serf.slo.*`` gauges)
+  plus the bench regression gate (``score_bench``).
 
 Everything is process-global with swap-out setters, mirroring the
 ``metrics`` facade already in place.
@@ -77,6 +86,22 @@ from serf_tpu.obs.cluster import (  # noqa: F401
     collect_cluster_stats,
     render_table,
 )
+from serf_tpu.obs.timeseries import (  # noqa: F401
+    MetricsSampler,
+    SeriesStore,
+    TimeSeries,
+    sparkline,
+    telemetry_to_store,
+)
+from serf_tpu.obs.slo import (  # noqa: F401
+    SLO_TABLE,
+    SLODef,
+    SLOVerdict,
+    judge_device_run,
+    judge_host_run,
+    score_bench,
+    slo_names,
+)
 
 __all__ = [
     "Span", "TraceBuffer", "span", "trace_dump",
@@ -91,4 +116,8 @@ __all__ = [
     "HealthScorer", "HealthReport", "UNHEALTHY_THRESHOLD", "serf_sources",
     "ClusterSnapshot", "STATS_QUERY", "collect_cluster_stats",
     "render_table",
+    "TimeSeries", "SeriesStore", "MetricsSampler", "sparkline",
+    "telemetry_to_store",
+    "SLO_TABLE", "SLODef", "SLOVerdict", "judge_host_run",
+    "judge_device_run", "score_bench", "slo_names",
 ]

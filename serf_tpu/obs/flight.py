@@ -30,6 +30,7 @@ Event kinds emitted by the engine (see README "Observability"):
 - ``snapshot-torn-tail``  snapshot replay skipped a torn tail
 - ``replay-recorded``  a record/replay recording artifact was written
 - ``replay-divergence`` the replay differ found two digest streams apart
+- ``slo-breach``       an SLO verdict came back out of objective (obs/slo)
 
 Events recorded while a cross-node trace is active (``obs.trace
 .trace_scope``) carry a ``trace`` field — the hex trace id shared by

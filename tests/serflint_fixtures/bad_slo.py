@@ -1,0 +1,21 @@
+"""serflint fixture: SLO definitions that MUST fire the SLO family.
+
+Linted pure-AST inside a toy project whose registry declares
+``metrics={"serf.toy.counter"}`` and ``slos={"toy-slo"}``:
+
+- ``toy-slo`` watches an undeclared metric → ``slo-metric-unknown``;
+- ``rogue-slo`` is defined but not declared → ``slo-decl-drift``
+  (and the registry's second declared SLO having no definition is the
+  drift in the other direction, exercised by the test directly).
+"""
+
+SLO_TABLE = (
+    SLODef(name="toy-slo",                              # noqa: F821
+           metrics=("serf.not.declared",),
+           planes=("host",), better="lower", objective=1.0,
+           unit="ratio", description="watches a metric nobody declared"),
+    SLODef(name="rogue-slo",                            # noqa: F821
+           metrics=("serf.toy.counter",),
+           planes=("device",), better="lower", objective=0.5,
+           unit="ratio", description="defined but never declared"),
+)

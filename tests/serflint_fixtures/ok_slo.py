@@ -1,0 +1,10 @@
+"""serflint fixture: the clean twin of bad_slo.py — every SLO watches
+a declared metric and matches the registry's SLOS declaration exactly,
+so NO SLO rule may fire."""
+
+SLO_TABLE = (
+    SLODef(name="toy-slo",                              # noqa: F821
+           metrics=("serf.toy.counter",),
+           planes=("host", "device"), better="lower", objective=1.0,
+           unit="ratio", description="a well-governed objective"),
+)

@@ -167,6 +167,87 @@ def test_registry_in_sync_is_silent(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# SLO family (ISSUE 10): the SLO table is registry-governed
+# ---------------------------------------------------------------------------
+
+_TOY_SLO_REGISTRY = dict(
+    metrics=frozenset({"serf.toy.counter"}),
+    flight_kinds=frozenset(),
+    slos=frozenset({"toy-slo", "declared-but-undefined"}))
+
+_README_SLO = '''\
+## Time series & SLOs
+
+| SLO | Planes | Objective | Meaning |
+|---|---|---|---|
+| `toy-slo` | host | 1.0 | fine |
+| `declared-but-undefined` | host | 1.0 | fine |
+'''
+
+
+def test_slo_bad_fixture_fires_the_family(tmp_path):
+    project = toy_project(
+        tmp_path,
+        {"serf_tpu/obs/fake_slo.py": (FIXTURES / "bad_slo.py").read_text()},
+        readme=_README_SLO, registry=Registry(**_TOY_SLO_REGISTRY))
+    report = analysis.run_rules(project)
+    by_key = {(f.rule, f.key) for f in report.findings}
+    # toy-slo watches an undeclared metric
+    assert ("slo-metric-unknown",
+            "toy-slo:serf.not.declared") in by_key
+    # rogue-slo is defined but not declared; the registry's second
+    # declared SLO has no definition — drift both ways
+    assert ("slo-decl-drift", "rogue-slo") in by_key
+    assert ("slo-decl-drift", "declared-but-undefined") in by_key
+    # rogue-slo is also undocumented... but slo-doc-drift judges
+    # declared-vs-documented: the README documents only declared names
+    # here, so no doc finding for rogue-slo (decl drift covers it)
+    assert not any(r == "slo-doc-drift" and k == "rogue-slo"
+                   for r, k in by_key)
+
+
+def test_slo_clean_twin_is_silent(tmp_path):
+    readme = '''\
+## Time series & SLOs
+
+| SLO | Planes | Objective | Meaning |
+|---|---|---|---|
+| `toy-slo` | host+device | 1.0 | fine |
+'''
+    project = toy_project(
+        tmp_path,
+        {"serf_tpu/obs/fake_slo.py": (FIXTURES / "ok_slo.py").read_text()},
+        readme=readme,
+        registry=Registry(metrics=frozenset({"serf.toy.counter"}),
+                          flight_kinds=frozenset(),
+                          slos=frozenset({"toy-slo"})))
+    report = analysis.run_rules(
+        project, rules=["slo-metric-unknown", "slo-decl-drift",
+                        "slo-doc-drift"])
+    assert report.findings == []
+
+
+def test_slo_doc_drift_both_ways(tmp_path):
+    readme = '''\
+## Time series & SLOs
+
+| SLO | Planes | Objective | Meaning |
+|---|---|---|---|
+| `stale-row` | host | 1.0 | no such SLO |
+'''
+    project = toy_project(
+        tmp_path,
+        {"serf_tpu/obs/fake_slo.py": (FIXTURES / "ok_slo.py").read_text()},
+        readme=readme,
+        registry=Registry(metrics=frozenset({"serf.toy.counter"}),
+                          flight_kinds=frozenset(),
+                          slos=frozenset({"toy-slo"})))
+    report = analysis.run_rules(project, rules=["slo-doc-drift"])
+    keys = {f.key for f in report.findings}
+    assert keys == {"toy-slo", "stale-row"}   # missing row + stale row
+
+
+# ---------------------------------------------------------------------------
 # schema family: drift without a bump fails lint; bump clears it
 # ---------------------------------------------------------------------------
 
@@ -470,6 +551,7 @@ def test_rule_registry_is_exactly_the_shipped_set():
         "jax-unhashable-arg",
         "reg-metric-unknown", "reg-metric-unused", "reg-doc-drift",
         "reg-flight-unknown", "reg-flight-unused",
+        "slo-metric-unknown", "slo-decl-drift", "slo-doc-drift",
         "schema-pytree-drift", "schema-wire-drift",
         "schema-recording-drift",
         "docs-rule-table",

@@ -1,0 +1,458 @@
+"""SLO plane (ISSUE 10): burn rates, anomaly flags, judges, the bench
+gate, and the device per-round telemetry contract.
+
+- burn-rate windows + EWMA/MAD anomaly math;
+- judge(): breach fires the `slo-breach` flight event + breach counter,
+  green lands `serf.slo.ok`;
+- the SLO table is registry-governed (names + watched metrics) — the
+  in-process mirror of serflint's `slo-*` rules;
+- device telemetry: row stability at small N (same seed = identical
+  rows, both stamp-packing flavors bit-identical), and the zero extra
+  per-round `device_get` pin (transfer count is independent of round
+  count);
+- obswatch: the green path exits 0, the deliberately degraded plan
+  (loss raised past heal) fires `slo-breach` and exits nonzero;
+- bench regression gate: bands verdicts + the warn-only/re-baseline
+  contract.
+"""
+
+import importlib.util
+import json
+import math
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from serf_tpu.obs import flight, slo  # noqa: E402
+from serf_tpu.obs.timeseries import TimeSeries  # noqa: E402
+from serf_tpu.utils import metrics  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# burn rates + anomalies (pure math)
+# ---------------------------------------------------------------------------
+
+
+def _series(vals, kind="gauge"):
+    ts = TimeSeries("x", kind=kind, capacity=64)
+    for i, v in enumerate(vals):
+        ts.append(float(i), float(v))
+    return ts
+
+
+def test_burn_rates_lower_better():
+    ts = _series([0.5] * 40)
+    b = slo.burn_rates(ts, objective=1.0, better="lower")
+    assert b == {"8": 0.5, "32": 0.5}
+    b = slo.burn_rates(_series([2.0] * 40), 1.0, "lower")
+    assert b["8"] == 2.0 and b["32"] == 2.0
+
+
+def test_burn_rates_higher_better_and_zero_objective():
+    b = slo.burn_rates(_series([0.5] * 40), 1.0, "higher")
+    assert b["8"] == 2.0                       # objective / mean
+    # zero objective (false-dead): clean series burns 0, dirty caps
+    assert slo.burn_rates(_series([0.0] * 40), 0.0, "lower")["8"] == 0.0
+    assert slo.burn_rates(_series([1.0] * 40), 0.0, "lower")["8"] \
+        == slo.BURN_CAP
+
+
+def test_ewma_mad_flags_spike_only():
+    assert slo.ewma_mad_flags([5.0] * 50) == []          # flat: never
+    vals = [10.0 + 0.1 * (i % 3) for i in range(50)]
+    vals[30] = 100.0                                     # the spike
+    flagged = slo.ewma_mad_flags(vals)
+    assert 30 in flagged
+    # the EWMA takes a few ticks to decay back under the MAD threshold,
+    # so flags trail the spike — but nothing BEFORE it may fire
+    assert min(flagged) == 30
+    assert slo.ewma_mad_flags([1.0, 2.0]) == []          # too short
+
+
+# ---------------------------------------------------------------------------
+# judge(): emission contract
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def fresh_obs():
+    """Swap in a fresh global sink + flight recorder, restore after."""
+    old_sink = metrics.global_sink()
+    old_rec = flight.global_recorder()
+    metrics.set_global_sink(metrics.MetricsSink())
+    flight.set_global_recorder(flight.FlightRecorder())
+    yield metrics.global_sink(), flight.global_recorder()
+    metrics.set_global_sink(old_sink)
+    flight.set_global_recorder(old_rec)
+
+
+def test_judge_green_emits_ok_gauge(fresh_obs):
+    sink, rec = fresh_obs
+    d = slo.slo_def("shed-ratio")
+    v = slo.judge(d, "host", 0.1)
+    assert v.ok and not v.skipped
+    assert sink.gauge_value("serf.slo.ok",
+                            {"slo": "shed-ratio", "plane": "host"}) == 1.0
+    assert rec.dump(kind="slo-breach") == []
+
+
+def test_judge_breach_fires_flight_and_counter(fresh_obs):
+    sink, rec = fresh_obs
+    d = slo.slo_def("false-dead")
+    v = slo.judge(d, "device", 3.0, detail="3 believed dead")
+    assert not v.ok
+    evs = rec.dump(kind="slo-breach")
+    assert len(evs) == 1 and evs[0]["slo"] == "false-dead"
+    assert sink.counter("serf.slo.breach",
+                        {"slo": "false-dead", "plane": "device"}) == 1.0
+
+
+def test_judge_unmeasured_is_skipped_green(fresh_obs):
+    v = slo.judge(slo.slo_def("query-p99"), "host", None)
+    assert v.ok and v.skipped and v.value is None
+
+
+def test_verdict_dict_keeps_json_finite(fresh_obs):
+    v = slo.judge(slo.slo_def("convergence-settle"), "device", math.inf)
+    d = v.to_dict()
+    assert d["value"] is None and d["ok"] is False
+    json.dumps(d)                      # strictly serializable
+
+
+# ---------------------------------------------------------------------------
+# the table is registry-governed (in-process mirror of the lint rules)
+# ---------------------------------------------------------------------------
+
+
+def test_slo_table_matches_registry_declaration():
+    from serf_tpu.analysis import registry as reg
+    assert set(slo.slo_names()) == set(reg.SLOS)
+    declared = {reg.normalize(m) for m in reg.METRICS}
+    for d in slo.SLO_TABLE:
+        assert d.better in ("lower", "higher")
+        assert d.planes and set(d.planes) <= {"host", "device"}
+        for m in d.metrics:
+            assert reg.normalize(m) in declared, \
+                f"SLO {d.name} watches undeclared metric {m}"
+
+
+# ---------------------------------------------------------------------------
+# device telemetry: stability + the one-device_get pin
+# ---------------------------------------------------------------------------
+
+
+def _small_cfg(n=32, with_vivaldi=True, **kw):
+    from serf_tpu.models.dissemination import GossipConfig
+    from serf_tpu.models.failure import FailureConfig
+    from serf_tpu.models.swim import ClusterConfig
+    return ClusterConfig(
+        gossip=GossipConfig(n=n, k_facts=32, peer_sampling="rotation",
+                            **kw),
+        failure=FailureConfig(suspicion_rounds=6, max_new_facts=8,
+                              probe_schedule="round_robin"),
+        push_pull_every=8, with_vivaldi=with_vivaldi)
+
+
+@pytest.fixture(scope="module")
+def _telemetry_runner():
+    """One jitted sustained-telemetry runner per cfg for the whole
+    module — the determinism test's second run must reuse the compile
+    (tier-1 budget: one compile per distinct shape)."""
+    import functools
+
+    import jax
+    from serf_tpu.models.swim import run_cluster_sustained
+
+    @functools.lru_cache(maxsize=4)
+    def runner(cfg):
+        return jax.jit(functools.partial(run_cluster_sustained, cfg=cfg,
+                                         events_per_round=1,
+                                         collect_telemetry=True),
+                       static_argnames=("num_rounds",))
+    return runner
+
+
+def _telemetry_rows(cfg, runner, rounds=8):
+    import jax
+    from serf_tpu.models.swim import make_cluster
+    st = make_cluster(cfg, jax.random.key(0))
+    _, rows = runner(cfg)(st, key=jax.random.key(1), num_rounds=rounds)
+    return np.asarray(jax.device_get(rows))
+
+
+def test_device_telemetry_rows_stable_and_sane(_telemetry_runner):
+    from serf_tpu.models.swim import TELEMETRY_FIELDS
+    cfg = _small_cfg()
+    a = _telemetry_rows(cfg, _telemetry_runner)
+    b = _telemetry_rows(cfg, _telemetry_runner)
+    assert a.shape == (8, len(TELEMETRY_FIELDS))
+    np.testing.assert_array_equal(a, b)          # same seed = same rows
+    f = dict(zip(TELEMETRY_FIELDS, a[-1]))
+    assert f["alive"] == 32
+    assert 0.0 <= f["agreement"] <= 1.0 and 0.0 <= f["coverage"] <= 1.0
+    assert f["injected"] >= 8                    # 1 event/round landed
+    assert np.isfinite(a).all()
+
+
+@pytest.mark.slow
+def test_device_telemetry_bit_exact_across_stamp_flavors(
+        _telemetry_runner):
+    """The packed/unpacked stamp planes are bit-exact in every protocol
+    output — the telemetry rows derived from them must agree exactly."""
+    a = _telemetry_rows(_small_cfg(pack_stamp=True), _telemetry_runner,
+                        rounds=12)
+    b = _telemetry_rows(_small_cfg(pack_stamp=False), _telemetry_runner,
+                        rounds=12)
+    np.testing.assert_array_equal(a, b)
+
+
+def _device_get_count_for(settle_rounds, monkeypatch):
+    """run_device_plan with telemetry on a 2-phase + settle plan; count
+    jax.device_get calls.  Phase length is fixed at 4 and settle is a
+    multiple of it, so EVERY plan length reuses the one compiled
+    4-round scan (the chunking rule) — the count difference, if any,
+    could only come from per-round/per-scan transfers."""
+    import jax
+    from serf_tpu.faults.device import run_device_plan
+    from serf_tpu.faults.plan import FaultPhase, FaultPlan
+
+    plan = FaultPlan(
+        name=f"pin-{settle_rounds}", n=8, seed=3,
+        phases=(FaultPhase(name="warm", rounds=4),
+                FaultPhase(name="split", rounds=4,
+                           partitions=((0, 1, 2, 3), (4, 5, 6, 7)))),
+        settle_s=1.0, settle_rounds=settle_rounds)
+    calls = {"n": 0}
+    real = jax.device_get
+
+    def counting(x):
+        calls["n"] += 1
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counting)
+    try:
+        # vivaldi off: the pin is about TRANSFER counts, and the slim
+        # round halves this test's one compile (tier-1 budget)
+        res = run_device_plan(plan, _small_cfg(n=8, with_vivaldi=False),
+                              collect_telemetry=True)
+    finally:
+        monkeypatch.setattr(jax, "device_get", real)
+    assert res.telemetry is not None
+    assert len(res.telemetry.get("serf.model.gossip.agreement")) \
+        == 8 + settle_rounds
+    return calls["n"]
+
+
+def test_telemetry_adds_zero_per_round_device_gets(monkeypatch):
+    """THE acceptance pin: the per-round telemetry plane transfers once
+    per RUN — tripling the round (and scan) count must not change the
+    number of device_get calls."""
+    short = _device_get_count_for(8, monkeypatch)
+    long = _device_get_count_for(40, monkeypatch)
+    assert short == long
+
+
+class _FakeDeviceResult:
+    """Stub DeviceChaosResult for judge-layer unit tests."""
+
+    def __init__(self, store, final, rounds_run, dropped=0, offered=0):
+        self.telemetry = store
+        self.telemetry_final = final
+        self.rounds_run = rounds_run
+        self.dropped = dropped
+        self.offered = offered
+
+
+def test_host_shed_burn_evidence_is_in_ratio_units(fresh_obs):
+    """The burn numbers beside the host shed-ratio verdict must be in
+    the SLO's own units (shed/(admitted+shed) per tick), never raw
+    event counts judged against the 0.95 ratio objective (regression:
+    a green verdict carried breach-scale burn values)."""
+    from serf_tpu.faults.host import HostLoadReport
+    from serf_tpu.faults.plan import named_plan
+    from serf_tpu.obs.timeseries import SeriesStore
+
+    store = SeriesStore(capacity=16)
+    for t in range(10):
+        store.append("serf.overload.ingress_shed", float(t), 10.0,
+                     kind="delta")
+        store.append("serf.overload.ingress_admitted", float(t), 30.0,
+                     kind="delta")
+
+    class R:
+        series = store
+        settle_convergence_s = 0.5
+        settle_converged = True
+        false_dead = 0
+        load = HostLoadReport(events_offered=300, queries_offered=100,
+                              ingress_admitted=300, ingress_shed=100)
+
+    plan = named_plan("query-storm")
+    verdicts = {v.slo: v for v in slo.judge_host_run(R(), plan)}
+    shed = verdicts["shed-ratio"]
+    assert shed.ok and shed.value == pytest.approx(0.25)
+    # running ratio is 10/40 = 0.25 at every tick; burn = 0.25/0.95
+    for b in shed.burn.values():
+        assert b == pytest.approx(0.25 / 0.95, rel=1e-3)
+
+
+def test_host_ratio_series_survives_mixed_downsampling():
+    """The two counter rings start ticks apart and downsample on
+    different schedules — the derived ratio must stay exact because
+    delta downsampling preserves sums (regression: equal-stamp pairing
+    dropped half the points and understated the ratio ~2x)."""
+    from serf_tpu.obs.slo import _host_ratio_series
+    from serf_tpu.obs.timeseries import SeriesStore
+
+    store = SeriesStore(capacity=16)     # tiny: both rings WILL merge
+    for t in range(400):
+        store.append("serf.overload.ingress_admitted", float(t), 1.0,
+                     kind="delta")
+    for t in range(200, 400):
+        store.append("serf.overload.ingress_shed", float(t), 1.0,
+                     kind="delta")
+    assert store.get("serf.overload.ingress_admitted").downsamples \
+        > store.get("serf.overload.ingress_shed").downsamples
+
+    class R:
+        series = store
+
+    ratio = _host_ratio_series(R())
+    assert len(ratio) > 0
+    # true running ratio at the end: 200 shed / (200 shed + 400 adm);
+    # the stride buckets may hold a partial tail, so allow a few ticks
+    assert ratio.last() == pytest.approx(200 / 600, rel=0.08)
+
+
+def test_device_judge_survives_ring_downsampling(fresh_obs):
+    """A converged run longer than the ring capacity: downsampling
+    pair-merges the agreement series so its last STORED point reads
+    < 1.0 — the verdict must come from the exact final row the executor
+    stashed, not the merged ring (regression: long healthy runs were
+    judged 'never re-converged')."""
+    from serf_tpu.faults.plan import FaultPhase, FaultPlan
+    from serf_tpu.obs.timeseries import SeriesStore
+
+    store = SeriesStore(capacity=8)     # tiny ring: downsampling certain
+    rounds = 64
+    for r in range(rounds):
+        ag = min(1.0, r / (rounds - 8))  # converges 8 rounds before end
+        store.append("serf.model.gossip.agreement", float(r + 1), ag)
+        store.append("serf.model.swim.false-dead", float(r + 1), 0.0)
+    merged_last = store.get("serf.model.gossip.agreement").last()
+    assert merged_last is None or merged_last < 1.0 - 1e-6 \
+        or store.get("serf.model.gossip.agreement").stride > 1
+    plan = FaultPlan(name="x", n=4,
+                     phases=(FaultPhase(name="w", rounds=rounds - 16),),
+                     settle_rounds=16)
+    res = _FakeDeviceResult(
+        store, final={"agreement": 1.0, "false_dead": 0.0},
+        rounds_run=rounds)
+    verdicts = {v.slo: v for v in slo.judge_device_run(res, plan)}
+    assert verdicts["convergence-settle"].ok
+    assert verdicts["false-dead"].ok
+    # and the inverse: an honestly-unconverged final row still breaches
+    res_bad = _FakeDeviceResult(
+        store, final={"agreement": 0.7, "false_dead": 2.0},
+        rounds_run=rounds)
+    verdicts = {v.slo: v for v in slo.judge_device_run(res_bad, plan)}
+    assert not verdicts["convergence-settle"].ok
+    assert not verdicts["false-dead"].ok
+
+
+# ---------------------------------------------------------------------------
+# obswatch: green + deliberately degraded (in-process)
+# ---------------------------------------------------------------------------
+
+
+def _obswatch():
+    spec = importlib.util.spec_from_file_location(
+        "obswatch", REPO / "tools" / "obswatch.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_obswatch_self_check_hook(fresh_obs, capsys):
+    """obswatch --self-check --json: the tier-1 SLO-plane hook — both
+    planes judged from the shared table, exit 0, rings present.  Driven
+    in-process (the test_replay chaos.main precedent) so this test and
+    the degraded one below share ONE compiled phase scan instead of
+    paying a subprocess jax startup + duplicate compile against the
+    tier-1 budget."""
+    mod = _obswatch()
+    rc = mod.main(["--self-check", "--json", "--n", "32"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["ok"] is True
+    planes = set(out["verdicts"])
+    assert planes == {"device", "host"}
+    for plane in planes:
+        assert all(v["ok"] for v in out["verdicts"][plane])
+    assert out["rings"]["device"]["serf.model.gossip.agreement"]
+    assert out["slo_breach_events"] == []
+
+
+def test_obswatch_degraded_breaches_and_exits_nonzero(fresh_obs):
+    """Loss raised PAST heal (no settle budget, 90% drop to the end):
+    convergence cannot complete — the run must fire `slo-breach` and
+    exit nonzero.  Same cfg and phase length as the green hook above,
+    so the scan compile is reused."""
+    mod = _obswatch()
+    rc = mod.main(["--device-only", "--degraded", "--n", "32"])
+    assert rc != 0
+    _sink, rec = fresh_obs
+    evs = rec.dump(kind="slo-breach")
+    assert evs and any(e["slo"] == "convergence-settle" for e in evs)
+
+
+# ---------------------------------------------------------------------------
+# bench regression gate
+# ---------------------------------------------------------------------------
+
+
+BANDS = {"cpu": {"cluster_round_sustained_rps": {"min": 2.0},
+                 "sharded.sustained_rps": {"min": 1.0, "max": 1e6}}}
+
+
+def test_score_bench_green_and_violation():
+    detail = {"cluster_round_sustained_rps": 5.0,
+              "sharded": {"sustained_rps": 10.0}}
+    gate = slo.score_bench(detail, BANDS, "cpu")
+    assert gate["ok"] and not gate["rebaseline"]
+    assert len(gate["checked"]) == 2
+    bad = dict(detail, cluster_round_sustained_rps=0.5)
+    gate = slo.score_bench(bad, BANDS, "cpu")
+    assert not gate["ok"]
+    assert gate["violations"] == ["cluster_round_sustained_rps"]
+
+
+def test_score_bench_missing_metric_is_reported_not_violated():
+    gate = slo.score_bench({"cluster_round_sustained_rps": 5.0},
+                           BANDS, "cpu")
+    assert gate["ok"]
+    assert gate["missing"] == ["sharded.sustained_rps"]
+
+
+def test_score_bench_no_bands_is_rebaseline_round():
+    gate = slo.score_bench({"x": 1.0}, BANDS, "tpu")
+    assert gate["ok"] and gate["rebaseline"]
+    gate = slo.score_bench({"x": 1.0}, None, "cpu")
+    assert gate["ok"] and gate["rebaseline"]
+
+
+def test_committed_baseline_bands_parse():
+    """The committed BASELINE.json bands block is well-formed and only
+    names dotted paths with min/max numbers."""
+    bands = json.loads((REPO / "BASELINE.json").read_text())["bands"]
+    for platform in ("cpu", "tpu"):
+        for metric, band in bands.get(platform, {}).items():
+            assert isinstance(metric, str)
+            assert set(band) <= {"min", "max"}
+            for v in band.values():
+                float(v)

@@ -1,0 +1,244 @@
+"""Continuous-telemetry plane (ISSUE 10): ring time series + sampler.
+
+- TimeSeries: the ring NEVER exceeds capacity whatever is thrown at it;
+  power-of-two downsampling preserves delta sums / gauge levels;
+  timestamps stay monotonic (a regressing clock is clamped, counted);
+  JSON serde round-trips and rejects malformed payloads.
+- SeriesStore: named rings, tails, serde.
+- MetricsSampler: counter DELTAS per tick (not cumulative levels),
+  gauge levels, flight-kind rates through the ``dump(since_seq=)``
+  cursor — correct even after the flight ring evicted the overlap —
+  and sink-reset safety.
+
+Pure host-side python — no JAX in this file.
+"""
+
+import asyncio
+import math
+
+import pytest
+
+from serf_tpu.obs.flight import FlightRecorder
+from serf_tpu.obs.timeseries import (
+    MetricsSampler,
+    SeriesStore,
+    TimeSeries,
+    sparkline,
+)
+from serf_tpu.utils.metrics import MetricsSink
+
+
+# ---------------------------------------------------------------------------
+# TimeSeries ring
+# ---------------------------------------------------------------------------
+
+
+def test_ring_never_exceeds_capacity():
+    ts = TimeSeries("x", kind="gauge", capacity=16)
+    for i in range(10_000):
+        ts.append(float(i), float(i))
+        assert len(ts) < 16          # downsample fires AT capacity
+    assert ts.appended == 10_000
+    assert ts.downsamples >= 1
+    # stride is a power of two and covers the history
+    assert ts.stride & (ts.stride - 1) == 0
+    assert ts.stride * 16 >= 10_000 / 2
+
+
+def test_delta_downsample_preserves_sum():
+    ts = TimeSeries("x", kind="delta", capacity=16)
+    n = 1000
+    for i in range(n):
+        ts.append(float(i), 1.0)
+    committed = (n // ts.stride) * ts.stride
+    assert sum(ts.values()) == pytest.approx(committed)
+
+
+def test_gauge_downsample_preserves_level():
+    ts = TimeSeries("x", kind="gauge", capacity=16)
+    for i in range(500):
+        ts.append(float(i), 7.5)
+    assert all(v == pytest.approx(7.5) for v in ts.values())
+
+
+def test_timestamps_monotonic_with_clamping():
+    ts = TimeSeries("x", capacity=16)
+    ts.append(5.0, 1.0)
+    ts.append(3.0, 2.0)               # clock regressed
+    ts.append(6.0, 3.0)
+    t = [p[0] for p in ts.points()]
+    assert t == sorted(t)
+    assert ts.clamped == 1
+
+
+def test_window_aggregates_by_kind():
+    g = TimeSeries("g", kind="gauge", capacity=16)
+    d = TimeSeries("d", kind="delta", capacity=16)
+    for i in range(4):
+        g.append(float(i), float(i))
+        d.append(float(i), 2.0)
+    assert g.window(2) == pytest.approx(2.5)    # mean of 2, 3
+    assert d.window(2) == pytest.approx(4.0)    # sum of 2 + 2
+
+
+def test_serde_round_trip():
+    ts = TimeSeries("serf.events", kind="delta", capacity=32)
+    for i in range(100):
+        ts.append(float(i), float(i % 5))
+    back = TimeSeries.from_json(ts.to_json())
+    assert back.to_dict() == ts.to_dict()
+    assert back.name == "serf.events" and back.kind == "delta"
+
+
+@pytest.mark.parametrize("mutation", [
+    {"t": [1.0, 0.5], "v": [1.0, 2.0]},           # non-monotonic
+    {"t": [1.0], "v": [1.0, 2.0]},                # length mismatch
+    {"t": [float(i) for i in range(99)],
+     "v": [0.0] * 99, "capacity": 8},             # over capacity
+])
+def test_serde_rejects_malformed(mutation):
+    d = TimeSeries("x", capacity=8).to_dict()
+    d.update(mutation)
+    with pytest.raises(ValueError):
+        TimeSeries.from_dict(d)
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError):
+        TimeSeries("x", kind="nope")
+    with pytest.raises(ValueError):
+        TimeSeries("x", capacity=12)              # not a power of two
+    with pytest.raises(ValueError):
+        TimeSeries("x", capacity=4)               # too small
+
+
+# ---------------------------------------------------------------------------
+# SeriesStore
+# ---------------------------------------------------------------------------
+
+
+def test_store_get_or_create_and_tail():
+    st = SeriesStore(capacity=16)
+    st.append("a", 1.0, 10.0, kind="delta")
+    st.append("a", 2.0, 20.0)
+    st.append("b", 1.0, 5.0, kind="gauge")
+    assert st.names() == ["a", "b"]
+    assert st.get("a").kind == "delta"            # kind set at creation
+    tail = st.tail(last=1)
+    assert tail["a"] == [(2.0, 20.0)]
+    back = SeriesStore.from_dict(st.to_dict())
+    assert back.to_dict() == st.to_dict()
+
+
+def test_sparkline_shapes():
+    assert sparkline([]) == ""
+    assert len(sparkline([1, 2, 3], width=16)) == 3
+    assert sparkline([5.0] * 4) == "▁▁▁▁"         # flat = floor blocks
+    s = sparkline(list(range(32)), width=8)
+    assert len(s) == 8 and s[-1] == "█"
+    assert sparkline([0.0, math.inf]) == "▁▁"     # non-finite safe
+
+
+# ---------------------------------------------------------------------------
+# MetricsSampler
+# ---------------------------------------------------------------------------
+
+
+def _sampler():
+    sink = MetricsSink()
+    rec = FlightRecorder(capacity=8)
+    clock = iter(float(i) for i in range(1000))
+    return sink, rec, MetricsSampler(sink=sink, recorder=rec,
+                                     clock=lambda: next(clock))
+
+
+def test_sampler_counter_deltas_and_gauge_levels():
+    sink, _rec, s = _sampler()
+    sink.incr("serf.events", 3)
+    sink.gauge("serf.health.score", 90)
+    s.sample()
+    sink.incr("serf.events", 2)
+    sink.gauge("serf.health.score", 70)
+    s.sample()
+    ev = s.store.get("serf.events")
+    assert ev.kind == "delta" and ev.values() == [3.0, 2.0]
+    hs = s.store.get("serf.health.score")
+    assert hs.kind == "gauge" and hs.values() == [90.0, 70.0]
+
+
+def test_sampler_label_sets_aggregate():
+    sink, _rec, s = _sampler()
+    sink.incr("serf.queries", 1, {"name": "a"})
+    sink.incr("serf.queries", 4, {"name": "b"})
+    sink.gauge("serf.queue.depth", 10, {"q": "a"})
+    sink.gauge("serf.queue.depth", 20, {"q": "b"})
+    s.sample()
+    assert s.store.get("serf.queries").values() == [5.0]      # sum
+    assert s.store.get("serf.queue.depth").values() == [15.0]  # mean
+
+
+def test_sampler_flight_cursor_never_double_counts():
+    _sink, rec, s = _sampler()
+    for _ in range(3):
+        rec.record("queue-overflow")
+    s.sample()
+    # overflow the tiny 8-slot ring: 20 more events arrive, eviction
+    # discards 12 before the tick.  The since_seq cursor counts each
+    # RETAINED event exactly once (a rate floor under eviction — the
+    # evicted 12 are unattributable by design), and never re-reads the
+    # 3 from the first tick.
+    for _ in range(20):
+        rec.record("queue-overflow")
+    s.sample()
+    vs = s.store.get("flight.queue-overflow").values()
+    assert vs == [3.0, 8.0]
+    # a third tick with nothing new records nothing for the kind
+    s.sample()
+    assert s.store.get("flight.queue-overflow").values() == [3.0, 8.0]
+
+
+def test_sampler_baselines_preexisting_counter_totals():
+    """Counters accumulated BEFORE the sampler existed (a shared
+    process-global sink across runs) must not land as a bogus
+    first-tick rate spike — deltas mean 'since this sampler started'
+    (regression: run 2's rings opened with run 1's storm totals)."""
+    sink = MetricsSink()
+    rec = FlightRecorder(capacity=8)
+    sink.incr("serf.overload.ingress_shed", 10_000)   # a previous run
+    clock = iter(float(i) for i in range(100))
+    s = MetricsSampler(sink=sink, recorder=rec,
+                       clock=lambda: next(clock))
+    sink.incr("serf.overload.ingress_shed", 3)
+    s.sample()
+    assert s.store.get("serf.overload.ingress_shed").values() == [3.0]
+
+
+def test_sampler_sink_reset_records_absolute_not_negative():
+    sink, _rec, s = _sampler()
+    sink.incr("serf.events", 10)
+    s.sample()
+    sink.reset()
+    sink.incr("serf.events", 4)
+    s.sample()
+    assert s.store.get("serf.events").values() == [10.0, 4.0]
+
+
+def test_sampler_self_metrics_land_in_global_sink():
+    from serf_tpu.utils import metrics as gm
+    base = gm.global_sink().counter("serf.ts.samples")
+    sink, _rec, s = _sampler()
+    sink.incr("serf.events", 1)
+    s.sample()
+    assert gm.global_sink().counter("serf.ts.samples") == base + 1
+
+
+async def test_sampler_asyncio_task_drives_ticks():
+    sink = MetricsSink()
+    rec = FlightRecorder(capacity=8)
+    s = MetricsSampler(sink=sink, recorder=rec, interval_s=0.02)
+    sink.incr("serf.events", 1)
+    s.start()
+    await asyncio.sleep(0.1)
+    await s.stop()                    # takes one final sample
+    assert s.ticks >= 2
+    assert s.store.get("serf.events") is not None

@@ -34,7 +34,7 @@ def run_host(plan, recorder=None):
 
 
 def run_device(plan, n: int, k_facts: int, devices: int = 0,
-               recorder=None):
+               recorder=None, collect_telemetry: bool = True):
     from serf_tpu.faults.device import run_device_plan
     from serf_tpu.models.dissemination import GossipConfig
     from serf_tpu.models.failure import FailureConfig
@@ -71,7 +71,8 @@ def run_device(plan, n: int, k_facts: int, devices: int = 0,
                 f"count (auto would use {best_device_count(n, visible)})")
         if d > 1:
             mesh = make_mesh(d)
-    return (run_device_plan(plan, cfg, mesh=mesh, recorder=recorder),
+    return (run_device_plan(plan, cfg, mesh=mesh, recorder=recorder,
+                            collect_telemetry=collect_telemetry),
             (d if mesh else 1))
 
 
@@ -136,10 +137,14 @@ def main() -> int:
         from serf_tpu.replay.recording import RunRecorder
         return RunRecorder()
 
+    from serf_tpu.obs import slo
+
     reports = []
     notes = []
     overload = {}
     recordings = {}
+    slo_verdicts = {}
+    ring_summaries = {}
     device_mesh = 1
     for plane in planes:
         recorder = make_recorder()
@@ -147,6 +152,13 @@ def main() -> int:
             result = run_host(plan, recorder=recorder)
             if result.load is not None:
                 overload["host"] = result.load.to_dict()
+            # SLO verdicts from THE shared definition table — judged
+            # beside (not instead of) the invariants.  getattr: the
+            # replay tests drive main() with stub results
+            slo_verdicts[plane] = slo.judge_host_run(result, plan)
+            series = getattr(result, "series", None)
+            if series is not None:
+                ring_summaries[plane] = series.summaries()
         else:
             result, device_mesh = run_device(plan, args.n, args.k_facts,
                                              args.devices,
@@ -155,6 +167,10 @@ def main() -> int:
             if plan.has_load():
                 overload["device"] = {"offered": result.offered,
                                       "dropped": result.dropped}
+            slo_verdicts[plane] = slo.judge_device_run(result, plan)
+            telemetry = getattr(result, "telemetry", None)
+            if telemetry is not None:
+                ring_summaries[plane] = telemetry.summaries()
         reports.append(result.report)
         # a red run writes its repro artifact (recording + digest
         # stream); green runs keep nothing — the recorder was in-memory
@@ -176,7 +192,11 @@ def main() -> int:
         print(json.dumps({
             "plan": plan.name,
             "ok": all(r.ok for r in reports),
+            "slo_ok": all(slo.all_ok(v) for v in slo_verdicts.values()),
             "reports": [r.to_dict() for r in reports],
+            "slo": {p: slo.verdicts_to_dict(v)
+                    for p, v in sorted(slo_verdicts.items())},
+            "ring_summaries": ring_summaries,
             "degradation_counters": counters,
             "lowering_notes": notes,
             "overload": overload,
@@ -184,8 +204,10 @@ def main() -> int:
             "recordings": recordings,
         }, indent=1, sort_keys=True))
     else:
-        for r in reports:
+        for r, plane in zip(reports, planes):
             print(r.format())
+            if plane in slo_verdicts:
+                print(slo.format_verdicts(slo_verdicts[plane], plane))
         for plane, path in sorted(recordings.items()):
             print(f"repro recording [{plane}]: {path} "
                   "(replay with `python tools/replay.py replay <path>`)")

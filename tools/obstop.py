@@ -12,6 +12,16 @@ fold drops fields — this exits non-zero.
     python tools/obstop.py                # 3-node demo, table output
     python tools/obstop.py --nodes 5      # bigger demo cluster
     python tools/obstop.py --json         # machine-readable snapshot
+    python tools/obstop.py --watch 0.5    # periodic refresh off the
+                                          # sampler RINGS (sparklines)
+    python tools/obstop.py --watch 0.5 --json   # ring-tail JSON
+
+``--watch <interval>`` switches from the one-shot ``cluster_stats()``
+scatter to the continuous-telemetry plane: a ``MetricsSampler`` ticks
+at the interval and each refresh renders the ring series — last value
+plus a sparkline of the last-W deltas per aggregate — so rates and
+trends are visible, not just levels.  ``--iterations`` bounds the demo
+(default 3; a live embedding would loop forever).
 
 Embedding against a live cluster is one call on any node:
 ``snap = await serf.cluster_stats()``; ``obs.render_table(snap)``.
@@ -31,9 +41,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
-async def _demo_snapshot(n: int, timeout: float):
+async def _demo_cluster(n: int):
+    """Stand up the joined demo cluster; returns (net, nodes).  On any
+    startup failure the already-created nodes are shut down cleanly
+    before the exception propagates — callers only own cleanup once
+    this returns."""
     from serf_tpu.host import LoopbackNetwork, Serf
-    from serf_tpu.host.query import QueryParam
     from serf_tpu.options import Options
 
     net = LoopbackNetwork()
@@ -44,14 +57,96 @@ async def _demo_snapshot(n: int, timeout: float):
                 net.bind(f"n{i}"), Options.local(), f"node-{i}"))
         for s in nodes[1:]:
             await s.join("n0")
-
         deadline = asyncio.get_running_loop().time() + 10.0
         while asyncio.get_running_loop().time() < deadline:
             if all(len(s.members()) == n for s in nodes):
                 break
             await asyncio.sleep(0.02)
+    except BaseException:
+        for s in nodes:
+            await s.shutdown()
+        raise
+    return net, nodes
 
+
+async def _demo_snapshot(n: int, timeout: float):
+    from serf_tpu.host.query import QueryParam
+
+    _net, nodes = await _demo_cluster(n)
+    try:
         return await nodes[0].cluster_stats(QueryParam(timeout=timeout))
+    finally:
+        for s in nodes:
+            await s.shutdown()
+
+
+#: --watch renders these ring series when present (rates from counter
+#: deltas, levels from gauges); everything else folds into the
+#: "busiest other series" rows
+WATCH_KEY_SERIES = ("serf.events", "serf.messages.sent",
+                    "serf.member.join", "serf.health.score",
+                    "serf.loop.lag-ms")
+WATCH_W = 16
+
+
+def _render_rings(store, iteration: int) -> str:
+    from serf_tpu.obs.timeseries import sparkline
+
+    lines = [f"obstop --watch refresh #{iteration} "
+             f"({len(store.names())} ring series)"]
+    rows = []
+    names = store.names()
+    busiest = sorted(
+        (n for n in names if n not in WATCH_KEY_SERIES),
+        key=lambda n: -abs(store.get(n).window(WATCH_W)
+                           * (1 if store.get(n).kind == 'delta' else 0)))
+    for name in [n for n in WATCH_KEY_SERIES if n in names] + busiest[:6]:
+        s = store.get(name)
+        last = s.last()
+        rows.append((name, s.kind,
+                     f"{last:g}" if last is not None else "-",
+                     sparkline(s.values(), width=WATCH_W)))
+    if rows:
+        w0 = max(len(r[0]) for r in rows)
+        for name, kind, last, spark in rows:
+            lines.append(f"  {name.ljust(w0)}  {kind:<5} {last:>10}  "
+                         f"{spark}")
+    return "\n".join(lines)
+
+
+async def _watch(n: int, interval: float, iterations: int,
+                 as_json: bool, tail: int) -> int:
+    """Periodic refresh off the sampler rings (not a cluster_stats
+    scatter per tick): the cluster runs, the sampler snapshots the sink
+    + flight recorder each interval, and every refresh renders last-W
+    deltas per series."""
+    from serf_tpu.obs.timeseries import MetricsSampler
+
+    if as_json and iterations <= 0:
+        # JSON mode emits ONE ring-tail dump after the loop; an
+        # unbounded loop would silently never produce a byte
+        print("obstop: --watch --json needs a bounded --iterations "
+              "(the ring tail is dumped once, after the last refresh)",
+              file=sys.stderr)
+        return 2
+
+    _net, nodes = await _demo_cluster(n)
+    sampler = MetricsSampler(interval_s=interval)
+    try:
+        i = 0
+        while iterations <= 0 or i < iterations:
+            await asyncio.sleep(interval)
+            sampler.sample()
+            i += 1
+            if not as_json:
+                print(_render_rings(sampler.store, i))
+        if as_json:
+            print(json.dumps({
+                "ticks": sampler.ticks,
+                "series": sampler.store.names(),
+                "tail": sampler.store.tail(last=tail),
+            }, indent=1, sort_keys=True))
+        return 0 if sampler.ticks > 0 and len(sampler.store) > 0 else 1
     finally:
         for s in nodes:
             await s.shutdown()
@@ -65,7 +160,21 @@ def main(argv=None) -> int:
                     help="stats query timeout in seconds (default 2.0)")
     ap.add_argument("--json", action="store_true",
                     help="emit the snapshot as JSON instead of a table")
+    ap.add_argument("--watch", type=float, default=0.0, metavar="SECS",
+                    help="periodic refresh off the sampler rings at "
+                         "this interval (sparkline last-%d deltas per "
+                         "series) instead of a one-shot cluster_stats"
+                         % WATCH_W)
+    ap.add_argument("--iterations", type=int, default=3,
+                    help="refreshes in --watch mode (<=0 = forever; "
+                         "default 3)")
+    ap.add_argument("--tail", type=int, default=16,
+                    help="--watch --json: ring-tail points per series")
     args = ap.parse_args(argv)
+
+    if args.watch > 0:
+        return asyncio.run(_watch(args.nodes, args.watch,
+                                  args.iterations, args.json, args.tail))
 
     from serf_tpu.obs.cluster import render_table
 

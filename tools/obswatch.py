@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""obswatch: run the continuous-telemetry scenario and judge the SLOs.
+
+The SLO plane's CLI + its tier-1 self-check.  One run:
+
+- **device leg** — a seeded partition+loss FaultPlan through the
+  flagship ``cluster_round`` with per-round telemetry collected inside
+  the scan (one ``device_get`` for the whole run), timed so the
+  measured rounds/sec can be judged against the analytic bandwidth
+  ceiling (``models/accounting``);
+- **host leg** — the loopback self-check chaos plan with the
+  ``MetricsSampler`` ticking throughout, so counter/gauge/flight rings
+  carry the run;
+- both planes judged against THE shared ``obs.slo.SLO_TABLE`` —
+  verdicts, burn rates, anomaly flags, ring tails.
+
+    python tools/obswatch.py                   # report, human-readable
+    python tools/obswatch.py --json            # machine-readable
+    python tools/obswatch.py --self-check      # tier-1 hook: exit 0
+                                               # iff every verdict green
+    python tools/obswatch.py --self-check --degraded
+        # deliberately raise loss PAST heal (no settle budget, 90%
+        # loss to the end): convergence cannot complete, the run MUST
+        # fire `slo-breach` and exit nonzero — the test that pins the
+        # breach path actually works
+
+Exit 0 iff every evaluated (non-skipped) SLO verdict on every plane is
+green.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the demo scenario must run on CPU even where a TPU plugin is registered
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def device_plan(degraded: bool = False):
+    """The device-leg scenario: warm → bisect+loss → heal.  ``degraded``
+    keeps 90% loss running past the heal with NO settle budget — the
+    cluster cannot re-converge, by construction (the breach fixture)."""
+    from serf_tpu.faults.plan import FaultPhase, FaultPlan
+
+    phases = [
+        FaultPhase(name="warm", rounds=10),
+        FaultPhase(name="bisect+loss", rounds=10,
+                   partitions=((0, 1), (2, 3)), drop=0.05),
+    ]
+    if degraded:
+        # 10 rounds like every other phase: the whole scenario (green
+        # or degraded) then reuses ONE compiled 10-round phase scan
+        phases.append(FaultPhase(name="loss-past-heal", rounds=10,
+                                 drop=0.9))
+    return FaultPlan(
+        name="obswatch-degraded" if degraded else "obswatch",
+        n=4, seed=5, phases=tuple(phases),
+        settle_s=8.0, settle_rounds=0 if degraded else 40)
+
+
+def run_device_leg(n: int, degraded: bool):
+    """Run the device scenario with telemetry + wall timing; returns
+    (verdict list, ring store, rps, ceiling)."""
+    from serf_tpu.faults.device import run_device_plan
+    from serf_tpu.models.accounting import round_traffic
+    from serf_tpu.models.dissemination import GossipConfig
+    from serf_tpu.models.failure import FailureConfig
+    from serf_tpu.models.swim import ClusterConfig
+    from serf_tpu.obs import slo
+
+    cfg = ClusterConfig(
+        gossip=GossipConfig(n=n, k_facts=32, peer_sampling="rotation"),
+        failure=FailureConfig(suspicion_rounds=8, max_new_facts=8,
+                              probe_schedule="round_robin"),
+        push_pull_every=8)
+    plan = device_plan(degraded)
+    t0 = time.perf_counter()
+    result = run_device_plan(plan, cfg, collect_telemetry=True)
+    elapsed = time.perf_counter() - t0
+    # wall rps INCLUDING compile — an understatement, which is the safe
+    # direction for the measurement-integrity SLO (measured <= ceiling)
+    rps = result.rounds_run / max(elapsed, 1e-9)
+    ceiling = round_traffic(cfg).ceiling_rounds_per_sec()
+    verdicts = slo.judge_device_run(result, plan, rps=rps,
+                                    ceiling=ceiling)
+    return verdicts, result.telemetry, rps, ceiling
+
+
+def run_host_leg():
+    """Run the host self-check chaos plan (sampler rings ride along);
+    returns (verdict list, ring store)."""
+    from serf_tpu.faults.host import run_host_plan
+    from serf_tpu.faults.plan import named_plan
+    from serf_tpu.obs import slo
+
+    plan = named_plan("self-check")
+    with tempfile.TemporaryDirectory(prefix="serf-obswatch-") as td:
+        result = asyncio.run(run_host_plan(plan, tmp_dir=td))
+    return slo.judge_host_run(result, plan), result.series
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n", type=int, default=64,
+                    help="device-leg simulated node count (default 64)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit verdicts + ring tails as JSON")
+    ap.add_argument("--self-check", action="store_true",
+                    help="tier-1 hook (same run; named for symmetry "
+                         "with the chaos/obstop hooks)")
+    ap.add_argument("--degraded", action="store_true",
+                    help="raise loss past heal so the SLOs MUST breach "
+                         "(device leg only; exit becomes nonzero)")
+    ap.add_argument("--device-only", action="store_true",
+                    help="skip the host leg (fast in-process smoke)")
+    ap.add_argument("--tail", type=int, default=16,
+                    help="ring-tail points per series in --json output")
+    args = ap.parse_args(argv)
+
+    from serf_tpu.obs import flight, slo
+
+    verdicts = {}
+    rings = {}
+    dev_verdicts, dev_store, rps, ceiling = run_device_leg(
+        args.n, args.degraded)
+    verdicts["device"] = dev_verdicts
+    if dev_store is not None:
+        rings["device"] = dev_store
+    if not args.device_only and not args.degraded:
+        host_verdicts, host_store = run_host_leg()
+        verdicts["host"] = host_verdicts
+        if host_store is not None:
+            rings["host"] = host_store
+
+    ok = all(slo.all_ok(v) for v in verdicts.values())
+    breaches = flight.flight_dump(kind="slo-breach")
+    if args.json:
+        print(json.dumps({
+            "ok": ok,
+            "device_rps": round(rps, 2),
+            "device_ceiling_rps": round(ceiling, 1),
+            "verdicts": {p: slo.verdicts_to_dict(v)
+                         for p, v in sorted(verdicts.items())},
+            "slo_breach_events": breaches,
+            "rings": {p: s.tail(last=args.tail)
+                      for p, s in sorted(rings.items())},
+        }, indent=1, sort_keys=True))
+    else:
+        for plane in sorted(verdicts):
+            print(slo.format_verdicts(verdicts[plane], plane))
+        print(f"device: {rps:.1f} measured rounds/s vs analytic "
+              f"ceiling {ceiling:.1f}")
+        if breaches:
+            print(f"slo-breach flight events: {len(breaches)}")
+            for e in breaches[-4:]:
+                print(f"  [{e.get('plane')}] {e.get('slo')}: "
+                      f"{e.get('detail')}")
+    if not ok:
+        print("obswatch: FAIL — SLO breach (see verdicts above)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
