@@ -146,6 +146,44 @@ def _time_rounds(jitted, state_factory, key, rounds_per_call, timed_calls,
     return state, steady_rps, active_rps
 
 
+def _control_ab(n: int) -> dict:
+    """Static-vs-controlled device A/B of the control-overload-shed
+    plan (serf_tpu/control) at bench-friendly N: the static leg must
+    breach the shed-ratio SLO, the controlled leg must be all-green
+    with a stable knob trajectory — the adaptive control plane's
+    regression surface (bands in BASELINE.json)."""
+    from serf_tpu.control.profiles import device_ab_config
+    from serf_tpu.faults.device import run_device_plan
+    from serf_tpu.faults.plan import named_plan
+    from serf_tpu.obs import slo
+
+    plan = named_plan("control-overload-shed")
+    out = {"plan": plan.name, "n": n}
+    for leg, controlled in (("static", False), ("controlled", True)):
+        cfg = device_ab_config(plan.name, n, 32, controlled)
+        res = run_device_plan(plan, cfg, collect_telemetry=True)
+        verdicts = slo.judge_device_run(res, plan)
+        breaches = [v.slo for v in verdicts if not v.ok]
+        out[leg] = {
+            "invariants_ok": res.report.ok,
+            "slo_breaches": breaches,
+            "dropped": res.dropped,
+            "offered": res.offered,
+        }
+        if controlled:
+            out[leg]["control_final"] = res.control_final
+            out[leg]["decisions"] = len(res.control_decisions)
+            out[leg]["stability_ok"] = all(
+                r.ok for r in res.report.results
+                if r.name == "control-stability")
+    out["static_breaches"] = len(out["static"]["slo_breaches"])
+    out["controlled_breaches"] = (
+        len(out["controlled"]["slo_breaches"])
+        + (0 if out["controlled"]["invariants_ok"] else 1))
+    out["controlled_breach_names"] = out["controlled"]["slo_breaches"]
+    return out
+
+
 def main() -> None:
     import jax
 
@@ -597,6 +635,23 @@ def main() -> None:
                    if where is None else where))
     except Exception as e:  # noqa: BLE001 - the self-check is best-effort
         detail["replay_error"] = repr(e)[:300]
+
+    # adaptive-control A/B (ISSUE 11): run the control-overload-shed
+    # device plan static vs controlled at small N and embed the verdict
+    # pair — the static leg must BREACH an SLO (that is the scenario's
+    # contract) and the controlled leg must be all-green, and the
+    # regression gate's bands guard both directions forever (a controller
+    # regression reads as controlled_breaches > 0; a scenario gone soft
+    # reads as static_breaches == 0)
+    try:
+        detail["control_ab"] = _control_ab(
+            int(os.environ.get("SERF_TPU_BENCH_CONTROL_N", "96")))
+        if detail["control_ab"]["controlled_breaches"]:
+            sys.stderr.write(
+                "CONTROL A/B: controlled run still breaches "
+                f"{detail['control_ab']['controlled_breach_names']}\n")
+    except Exception as e:  # noqa: BLE001 - the A/B is best-effort
+        detail["control_ab_error"] = repr(e)[:300]
 
     # --- regression gate (ISSUE 10): score the headline numbers against
     # the committed BASELINE.json bands (per-platform dotted-path min/max

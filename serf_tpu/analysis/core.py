@@ -89,6 +89,9 @@ class Registry:
     flight_kinds: frozenset
     #: declared SLO names (obs/slo.py SLO_TABLE must match, both ways)
     slos: frozenset = frozenset()
+    #: declared controller-writable knob names (the control plane's
+    #: KNOB_FIELDS / HOST_KNOBS + law tables must match, both ways)
+    control_knobs: frozenset = frozenset()
 
 
 @dataclass
@@ -114,7 +117,8 @@ def default_project() -> Project:
         pins_path=REPO / PINS_NAME,
         registry=Registry(metrics=frozenset(reg.METRICS),
                           flight_kinds=frozenset(reg.FLIGHT_KINDS),
-                          slos=frozenset(reg.SLOS)),
+                          slos=frozenset(reg.SLOS),
+                          control_knobs=frozenset(reg.CONTROL_KNOBS)),
     )
 
 

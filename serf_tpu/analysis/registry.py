@@ -156,12 +156,17 @@ METRICS: tuple = (
     "serf.slo.ok",
     "serf.slo.burn",
     "serf.slo.breach",
+    # adaptive control plane (serf_tpu/control)
+    "serf.control.knob.<>",
+    "serf.control.steps",
+    "serf.control.shed",
 )
 
 #: every flight-recorder event kind (obs/flight.py ``record`` call sites)
 FLIGHT_KINDS: tuple = (
     "broadcast-retired",
     "circuit-breaker",
+    "control-decision",
     "coordinate-rejected",
     "corrupt-frame",
     "dial-retry",
@@ -206,6 +211,35 @@ SLOS: tuple = (
 
 #: the README section the SLO table lives in
 SLO_SECTION = "## Time series & SLOs"
+
+#: every controller-writable knob the adaptive control plane may
+#: actuate (serf_tpu/control: device ``KNOB_FIELDS`` + host
+#: ``HOST_KNOBS``).  The ``control-knob-drift`` rule cross-checks both
+#: ways: a knob field/law actuating an undeclared name, or a declared
+#: name with no law, fails lint — a knob cannot exist without a control
+#: law, and a law cannot actuate an undeclared knob.
+CONTROL_KNOBS: tuple = (
+    # device plane (control/device.py KNOB_FIELDS)
+    "fanout",
+    "probe_mult",
+    "stretch_q",
+    "inject_limit",
+    # host plane (control/host.py HOST_KNOBS)
+    "user_event_rate",
+    "query_rate",
+    "breaker_cooldown",
+    "suspicion_mult",
+    "probe_interval",
+    "gossip_nodes",
+    "gossip_interval",
+)
+
+#: the control-plane sources the drift rule fingerprints: file ->
+#: (knob-tuple literal, law-table literal)
+CONTROL_SOURCES = {
+    "serf_tpu/control/device.py": ("KNOB_FIELDS", "DEVICE_LAWS"),
+    "serf_tpu/control/host.py": ("HOST_KNOBS", "HOST_LAWS"),
+}
 
 
 # ---------------------------------------------------------------------------
@@ -574,6 +608,104 @@ def check_slo_doc_drift(files: List[SourceFile],
                 "slo-doc-drift", readme_rel, line, name,
                 f"README documents SLO {name!r} but the registry does "
                 "not declare it — delete the row or declare the SLO")
+
+
+# ---------------------------------------------------------------------------
+# control-knob cross-check (pass family d, ISSUE 11): the adaptive
+# control plane is registry-governed like the metrics and SLOs
+# ---------------------------------------------------------------------------
+
+def _tuple_literal(tree: ast.AST, name: str):
+    """Top-level ``NAME = ("a", "b", ...)`` string-tuple literal, or
+    None when absent."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == name \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            return [(e.value, e.lineno) for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+    return None
+
+
+def _law_knobs(tree: ast.AST, name: str):
+    """Knob names actuated by a law-table literal ``NAME = ((signal,
+    knob, direction), ...)`` — the middle element of each entry."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == name \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            for entry in node.value.elts:
+                if isinstance(entry, (ast.Tuple, ast.List)) \
+                        and len(entry.elts) >= 2 \
+                        and isinstance(entry.elts[1], ast.Constant) \
+                        and isinstance(entry.elts[1].value, str):
+                    out.append((entry.elts[1].value, entry.lineno))
+    return out
+
+
+@project_rule("control-knob-drift",
+              "a control knob without a law, a law actuating an "
+              "undeclared knob, or knob fields out of sync with the "
+              "declared registry (checked both ways)",
+              'KNOB_FIELDS gains "new_knob" with no DEVICE_LAWS entry')
+def check_control_knob_drift(files: List[SourceFile],
+                             project: Project) -> Iterable[Finding]:
+    if project.registry is None:
+        return
+    declared = set(project.registry.control_knobs)
+    if not declared:
+        return
+    by_rel = {f.rel: f for f in files}
+    seen_fields: Dict[str, tuple] = {}
+    seen_laws: Dict[str, tuple] = {}
+    found_any = False
+    for rel, (fields_name, laws_name) in CONTROL_SOURCES.items():
+        src = by_rel.get(rel)
+        if src is None:
+            continue
+        fields = _tuple_literal(src.tree, fields_name)
+        laws = _law_knobs(src.tree, laws_name)
+        if fields is None:
+            continue
+        found_any = True
+        for knob, lineno in fields:
+            seen_fields.setdefault(knob, (rel, lineno))
+            if knob not in declared:
+                yield _reg_finding(
+                    "control-knob-drift", rel, lineno, f"field:{knob}",
+                    f"control knob {knob!r} ({fields_name}) is not "
+                    "declared — add it to serf_tpu/analysis/registry.py "
+                    "CONTROL_KNOBS (and give it a law + README row)")
+        law_set = {k for k, _ in laws}
+        for knob, lineno in laws:
+            seen_laws.setdefault(knob, (rel, lineno))
+            if knob not in declared:
+                yield _reg_finding(
+                    "control-knob-drift", rel, lineno, f"law:{knob}",
+                    f"a {laws_name} law actuates undeclared knob "
+                    f"{knob!r} — declare it in CONTROL_KNOBS or fix "
+                    "the law")
+        for knob, lineno in fields:
+            if knob not in law_set:
+                yield _reg_finding(
+                    "control-knob-drift", rel, lineno,
+                    f"lawless:{knob}",
+                    f"control knob {knob!r} has no {laws_name} entry — "
+                    "a knob without a control law is dead config "
+                    "(add a law or delete the knob)")
+    if not found_any:
+        return
+    for knob in sorted(declared - set(seen_fields) - set(seen_laws)):
+        yield _reg_finding(
+            "control-knob-drift", "serf_tpu/analysis/registry.py", 1,
+            f"undefined:{knob}",
+            f"declared control knob {knob!r} appears in no knob-field "
+            "tuple and no law table — delete the CONTROL_KNOBS entry "
+            "or restore the knob")
 
 
 # ---------------------------------------------------------------------------

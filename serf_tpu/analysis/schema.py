@@ -48,6 +48,8 @@ PYTREE_SOURCES: Dict[str, List[str]] = {
     "serf_tpu/models/dissemination.py": ["FactTable", "GossipState"],
     "serf_tpu/models/vivaldi.py": ["VivaldiState"],
     "serf_tpu/models/swim.py": ["ClusterState"],
+    # the adaptive control plane rides the cluster pytree (ISSUE 11)
+    "serf_tpu/control/device.py": ["ControlState"],
 }
 
 #: the wire surface: the serf envelope plane, the SWIM packet plane AND

@@ -1,0 +1,45 @@
+"""serf_tpu.control: the adaptive control plane (ISSUE 11).
+
+One declarative control law, actuated on both planes:
+
+- **device** (``control.device``): a traced :class:`ControlState` row
+  on the cluster pytree, updated inside the jitted scan from the PR-10
+  telemetry row — effective fanout, probe-cadence multiplier,
+  Lifeguard-style suspicion stretch, and a per-round injection
+  admission budget, all bounded-step + hysteresis-gated;
+- **host** (``control.host``): a :class:`ControllerTick` on the PR-10
+  ``MetricsSampler`` actuating the PR-5 admission buckets, the PR-4
+  breaker cooldown, and the memberlist probe/gossip/suspicion knobs.
+
+``control.profiles`` holds the chaos A/B configurations
+(``tools/chaos.py --controller``): per named plan, the static config
+that measurably breaches an SLO and the controlled twin that must
+re-converge to all-green.
+"""
+
+from serf_tpu.control.device import (   # noqa: F401
+    CONTROL_FIELDS,
+    ControlConfig,
+    ControlSignals,
+    ControlState,
+    DEVICE_LAWS,
+    KNOB_FANOUT,
+    KNOB_FIELDS,
+    KNOB_INJECT_LIMIT,
+    KNOB_PROBE_MULT,
+    KNOB_STRETCH_Q,
+    control_row,
+    control_step,
+    decisions_of,
+    emit_control_metrics,
+    gate_injections,
+    knob_bounds,
+    make_control,
+)
+from serf_tpu.control.host import (     # noqa: F401
+    HOST_KNOBS,
+    HOST_LAWS,
+    ControllerTick,
+    HostControlConfig,
+    apply_recorded,
+)

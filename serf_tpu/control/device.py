@@ -1,0 +1,362 @@
+"""Device-plane adaptive control: the SLO signals actuate the knobs.
+
+The telemetry plane (PR 10) made the cluster watch itself; this module
+makes it *act*.  A small :class:`ControlState` row rides the cluster
+pytree and is updated INSIDE the jitted scan (:func:`control_step`) from
+the same per-round telemetry row the SLO plane judges — the
+cluster-wide generalization of Lifeguard's local-health loop (PAPER.md
+§"Lifeguard": a node stretches its own timeouts when its local health
+degrades; here the whole simulated cluster stretches/widens/sheds from
+the live convergence, false-DEAD and overflow signals).
+
+Design rules (the anti-oscillation invariant in
+``faults/invariants.py`` pins them):
+
+- **bounded step** — each knob moves at most ``KNOB_STEP`` units per
+  round, clamped to its ``[min, max]`` band;
+- **hysteresis** — a knob only moves after its signal has pointed the
+  same direction for ``hyst_up``/``hyst_down`` consecutive rounds
+  (protective moves use the shorter window, relaxing moves the longer
+  one, so the controller reacts fast and backs off slowly);
+- **declarative law table** — :data:`DEVICE_LAWS` names every
+  signal → knob → direction edge; serflint's ``control-knob-drift``
+  rule cross-checks it (both ways) against :data:`KNOB_FIELDS` and the
+  declared registry (``analysis/registry.py CONTROL_KNOBS``), so a knob
+  without a law — or a law actuating an undeclared knob — fails lint.
+
+The knobs themselves are the controller-writable subset of the
+formerly-static config, now traced leaves (``KNOB_FIELDS`` order):
+
+- ``fanout`` — effective gossip fan-out in ``[fanout_min,
+  gossip.fanout]``; the static ``gossip.fanout`` is the shape bound
+  (offsets are always sampled for it — same RNG stream either way) and
+  the exchange masks contributions ``f >= fanout`` out;
+- ``probe_mult`` — probe-cadence multiplier: probes (and the declare
+  scan + Vivaldi samples that ride them) run every
+  ``probe_every * probe_mult`` rounds — Lifeguard's "probe slower when
+  the signal is unreliable", cluster-wide;
+- ``stretch_q`` — suspicion stretch in quarter-round stamp ticks,
+  added to ``failure.suspicion_q`` in the declare expiry scan and the
+  ``believed_dead`` judgment (clamped at the AGE_PIN_Q representability
+  bound) — Lifeguard's suspicion-timeout stretch;
+- ``inject_limit`` — per-round fact-injection admission budget
+  (``inject_tokens`` refills to it every round): the device analog of
+  the PR-5 ingress buckets.  :func:`gate_injections` spends the tokens
+  on every injection batch; refusals land in the ``shed`` ledger and
+  ``serf.control.shed``.
+
+With ``ControlConfig.enabled=False`` (the default) none of this is
+read: the control leaves ride the pytree untouched and every round is
+bit-exact with the pre-control static path (pinned by
+tests/test_control.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+#: the controller-writable knob set, in ControlState.knobs order.
+#: serflint's ``control-knob-drift`` holds this literal to the declared
+#: registry (analysis/registry.py CONTROL_KNOBS) and to DEVICE_LAWS.
+KNOB_FIELDS = ("fanout", "probe_mult", "stretch_q", "inject_limit")
+
+#: the declarative control-law table: (signal, knob, direction).  Every
+#: KNOB_FIELDS entry must appear as a law's knob (a knob nobody actuates
+#: is dead config) and every law's knob must be a declared KNOB_FIELDS
+#: entry — both directions lint-enforced.  The README "Adaptive
+#: control" table documents each row with its clamp.
+DEVICE_LAWS = (
+    ("agreement-low", "fanout", "up"),
+    ("agreement-converged", "fanout", "down"),
+    ("false-dead", "probe_mult", "up"),
+    ("false-dead-clear", "probe_mult", "down"),
+    ("false-dead", "stretch_q", "up"),
+    ("false-dead-clear", "stretch_q", "down"),
+    ("overflow-pressure", "inject_limit", "down"),
+    ("overflow-calm", "inject_limit", "up"),
+)
+
+#: per-round control-row field order (``control_row``): the knob vector
+#: plus the shed/actuation ledgers — the trajectory the stability
+#: invariant judges and the PR-9 recording's ``control`` steps carry.
+CONTROL_FIELDS = KNOB_FIELDS + ("shed", "steps")
+
+#: KNOB_FIELDS index constants — every knob READER (cluster_round,
+#: round_telemetry, the executors) must use these, never bare ints, so
+#: a KNOB_FIELDS reorder cannot silently actuate the wrong knob
+KNOB_FANOUT = KNOB_FIELDS.index("fanout")
+KNOB_PROBE_MULT = KNOB_FIELDS.index("probe_mult")
+KNOB_STRETCH_Q = KNOB_FIELDS.index("stretch_q")
+KNOB_INJECT_LIMIT = KNOB_FIELDS.index("inject_limit")
+_FANOUT, _PROBE_MULT, _STRETCH_Q, _INJECT_LIMIT = (
+    KNOB_FANOUT, KNOB_PROBE_MULT, KNOB_STRETCH_Q, KNOB_INJECT_LIMIT)
+
+
+@dataclasses.dataclass(frozen=True)
+class ControlConfig:
+    """Static controller configuration (clamps, thresholds, hysteresis).
+
+    Zeros mean "derive from the protocol config" (resolved by
+    :func:`knob_bounds`): ``fanout_base=0`` starts at ``gossip.fanout``
+    (no headroom — the controller can only relax), ``stretch_max_q=0``
+    uses the full representable headroom ``AGE_PIN_Q - suspicion_q``,
+    ``inject_limit_*=0`` derive from ``k_facts``.
+    """
+
+    enabled: bool = False
+    #: starting effective fanout (0 = gossip.fanout); gossip.fanout is
+    #: the max — give the controller headroom by setting the static
+    #: fanout high and the base low
+    fanout_base: int = 0
+    fanout_min: int = 1
+    probe_mult_max: int = 4
+    stretch_max_q: int = 0
+    inject_limit_base: int = 0      # 0 = 4 * k_facts
+    inject_limit_floor: int = 0     # 0 = max(1, k_facts // 2)
+    inject_limit_step: int = 0      # 0 = max(1, k_facts // 2)
+    #: consecutive signal rounds before a protective move (widen fanout,
+    #: slow probes, stretch suspicion, tighten injection)
+    hyst_up: int = 3
+    #: consecutive signal rounds before a relaxing move back toward the
+    #: base — longer than hyst_up so recovery is deliberate, not jumpy
+    hyst_down: int = 6
+    #: knowledge agreement below this (sustained) = convergence burning
+    agreement_low: float = 0.9
+    #: EWMA of per-round in-window clobbers above this = overflow
+    #: pressure; below ``overflow_hi / 4`` = calm
+    overflow_hi: float = 1.0
+    overflow_alpha: float = 0.125
+
+    def __post_init__(self):
+        if self.hyst_up < 1 or self.hyst_down < 1:
+            raise ValueError("hysteresis windows must be >= 1 round")
+        if not (0.0 < self.agreement_low <= 1.0):
+            raise ValueError(
+                f"agreement_low must be in (0, 1], got {self.agreement_low}")
+        if not (0.0 < self.overflow_alpha <= 1.0):
+            raise ValueError("overflow_alpha must be in (0, 1]")
+
+
+class ControlState(NamedTuple):
+    """The traced control plane: O(knobs) scalars riding the cluster
+    pytree (checkpoint schema surface — growing this bumps the pinned
+    pytree version, see MIGRATION.md)."""
+
+    knobs: jnp.ndarray           # i32[len(KNOB_FIELDS)]
+    streak: jnp.ndarray          # i32[len(KNOB_FIELDS)] signed hysteresis
+                                 # streak per knob (+ = toward "up")
+    inject_tokens: jnp.ndarray   # i32 scalar: remaining per-round
+                                 # injection admission budget
+    shed: jnp.ndarray            # u32 scalar: injections refused by the
+                                 # controller (cumulative)
+    last_overflow: jnp.ndarray   # f32 scalar: overflow ledger at the
+                                 # previous control tick
+    overflow_ewma: jnp.ndarray   # f32 scalar: EWMA of per-round
+                                 # in-window clobbers
+    steps: jnp.ndarray           # u32 scalar: knob actuations (decisions)
+
+
+class ControlSignals(NamedTuple):
+    """The telemetry scalars the law table reads, extracted by the
+    caller (``models/swim.control_tick``) so this module never imports
+    the model layer."""
+
+    agreement: jnp.ndarray       # f32: knowledge agreement after the round
+    false_dead: jnp.ndarray      # f32: alive nodes believed dead
+    overflow: jnp.ndarray        # f32: cumulative in-window clobber ledger
+
+
+def knob_bounds(ccfg: ControlConfig, gcfg, fcfg):
+    """Resolve the per-knob (base, min, max, step) vectors against the
+    protocol config — trace-time numpy (static shapes/clamps).
+    ``gcfg``/``fcfg`` are the GossipConfig/FailureConfig the knobs
+    override."""
+    # lazy: models/swim imports this module at load time (the config
+    # lives on ClusterConfig) — importing the models package here at
+    # module scope would be a cycle
+    from serf_tpu.models.dissemination import AGE_PIN_Q
+
+    k = gcfg.k_facts
+    fan_base = ccfg.fanout_base or gcfg.fanout
+    if not (1 <= ccfg.fanout_min <= fan_base <= gcfg.fanout):
+        raise ValueError(
+            f"control fanout band [{ccfg.fanout_min}, base {fan_base}, "
+            f"max {gcfg.fanout}] is not ordered (gossip.fanout is the "
+            "static max — raise it for controller headroom)")
+    stretch_max = ccfg.stretch_max_q or max(0, AGE_PIN_Q - fcfg.suspicion_q)
+    if fcfg.suspicion_q + stretch_max > AGE_PIN_Q:
+        raise ValueError(
+            f"stretch_max_q {stretch_max} would push the suspicion "
+            f"window past the AGE_PIN_Q={AGE_PIN_Q} stamp representability "
+            "bound")
+    inj_base = ccfg.inject_limit_base or 4 * k
+    inj_floor = ccfg.inject_limit_floor or max(1, k // 2)
+    inj_step = ccfg.inject_limit_step or max(1, k // 2)
+    base = np.array([fan_base, 1, 0, inj_base], np.int32)
+    lo = np.array([ccfg.fanout_min, 1, 0, inj_floor], np.int32)
+    hi = np.array([gcfg.fanout, ccfg.probe_mult_max, stretch_max,
+                   inj_base], np.int32)
+    step = np.array([1, 1, 1, inj_step], np.int32)
+    return base, lo, hi, step
+
+
+def make_control(ccfg: ControlConfig, gcfg, fcfg) -> ControlState:
+    """Neutral initial control state (knobs at their bases)."""
+    base, _lo, _hi, _step = knob_bounds(ccfg, gcfg, fcfg)
+    return ControlState(
+        knobs=jnp.asarray(base),
+        streak=jnp.zeros((len(KNOB_FIELDS),), jnp.int32),
+        inject_tokens=jnp.asarray(int(base[_INJECT_LIMIT]), jnp.int32),
+        shed=jnp.asarray(0, jnp.uint32),
+        last_overflow=jnp.asarray(0.0, jnp.float32),
+        overflow_ewma=jnp.asarray(0.0, jnp.float32),
+        steps=jnp.asarray(0, jnp.uint32),
+    )
+
+
+#: which direction is the PROTECTIVE move per knob (gets hyst_up; the
+#: opposite, relaxing direction gets hyst_down): widen fanout, slow
+#: probes, stretch suspicion, TIGHTEN injection admission
+_PROTECT_DIR = np.array([1, 1, 1, -1], np.int32)
+
+
+def control_step(control: ControlState, sig: ControlSignals,
+                 ccfg: ControlConfig, gcfg, fcfg) -> ControlState:
+    """One control tick (inside the jitted scan, after a protocol
+    round): evaluate the law table on the telemetry signals, advance the
+    hysteresis streaks, and move any knob whose streak crossed its
+    window — by at most one bounded step, inside its clamp band.
+
+    The decision taken after round R is the dynamic config of round
+    R+1 (``cluster_round`` reads ``state.control`` at entry).
+    """
+    base, lo, hi, step = (jnp.asarray(a) for a in
+                          knob_bounds(ccfg, gcfg, fcfg))
+
+    # -- signals -> per-knob desired direction (i32 in {-1, 0, +1}) ---------
+    # agreement-low / agreement-converged -> fanout
+    fan_sig = jnp.where(sig.agreement < ccfg.agreement_low, 1,
+                        jnp.where(sig.agreement >= 1.0 - 1e-6, -1, 0))
+    # false-dead / false-dead-clear -> probe_mult + stretch_q (the two
+    # Lifeguard moves share one signal)
+    fd_sig = jnp.where(sig.false_dead > 0.5, 1, -1)
+    # overflow-pressure / overflow-calm -> inject_limit (direction is
+    # DOWN under pressure: tighten admission)
+    delta = jnp.maximum(sig.overflow - control.last_overflow, 0.0)
+    ewma = ((1.0 - ccfg.overflow_alpha) * control.overflow_ewma
+            + ccfg.overflow_alpha * delta)
+    inj_sig = jnp.where(ewma > ccfg.overflow_hi, -1,
+                        jnp.where(ewma < ccfg.overflow_hi / 4.0, 1, 0))
+    sig_v = jnp.stack([fan_sig, fd_sig, fd_sig, inj_sig]).astype(jnp.int32)
+
+    # -- hysteresis streaks --------------------------------------------------
+    cont = jnp.sign(control.streak) == sig_v
+    streak = jnp.where(sig_v == 0, 0,
+                       jnp.where(cont, control.streak + sig_v, sig_v))
+    protect = sig_v == jnp.asarray(_PROTECT_DIR)
+    window = jnp.where(protect, ccfg.hyst_up, ccfg.hyst_down)
+    fire = (sig_v != 0) & (jnp.abs(streak) >= window)
+
+    # -- bounded actuation ---------------------------------------------------
+    # relaxing moves (opposite of the protective direction) never cross
+    # the BASE: the controller returns to the configured operating
+    # point, it does not overshoot past it
+    relaxing = sig_v == -jnp.asarray(_PROTECT_DIR)
+    lo_eff = jnp.where(relaxing & (sig_v < 0),
+                       jnp.maximum(lo, jnp.minimum(base, control.knobs)), lo)
+    hi_eff = jnp.where(relaxing & (sig_v > 0),
+                       jnp.minimum(hi, jnp.maximum(base, control.knobs)), hi)
+    knobs = jnp.clip(control.knobs + sig_v * step * fire, lo_eff, hi_eff)
+    changed = knobs != control.knobs
+    streak = jnp.where(fire, 0, streak)
+    return control._replace(
+        knobs=knobs,
+        streak=streak,
+        # the per-round injection admission budget refills to the (new)
+        # limit — tokens spent by this round's batches do not carry debt
+        inject_tokens=knobs[_INJECT_LIMIT],
+        last_overflow=jnp.asarray(sig.overflow, jnp.float32),
+        overflow_ewma=ewma.astype(jnp.float32),
+        steps=control.steps + jnp.sum(changed).astype(jnp.uint32),
+    )
+
+
+def gate_injections(control: ControlState, active: jnp.ndarray):
+    """Device-plane injection admission: spend ``inject_tokens`` on an
+    injection batch's ``active`` prefix mask.  Returns ``(admitted,
+    control')`` — ``admitted`` is still a prefix mask (the first
+    ``tokens`` active entries), refusals land in the ``shed`` ledger.
+    Chunked storm bursts all land in one round, so the budget depletes
+    ACROSS batches until the next round's refill — exactly the host
+    plane's token-bucket semantics, vectorized."""
+    pos = jnp.cumsum(active.astype(jnp.int32))          # 1-based among actives
+    admitted = active & (pos <= control.inject_tokens)
+    n_active = jnp.sum(active).astype(jnp.int32)
+    n_admit = jnp.sum(admitted).astype(jnp.int32)
+    return admitted, control._replace(
+        inject_tokens=control.inject_tokens - n_admit,
+        shed=control.shed + (n_active - n_admit).astype(jnp.uint32))
+
+
+def control_row(control: ControlState) -> jnp.ndarray:
+    """f32[len(CONTROL_FIELDS)]: the per-round control trajectory row
+    (knobs + shed + actuation count) — a scan output, transferred with
+    the telemetry rows in the run's single ``device_get``."""
+    return jnp.concatenate([
+        control.knobs.astype(jnp.float32),
+        jnp.stack([control.shed.astype(jnp.float32),
+                   control.steps.astype(jnp.float32)]),
+    ])
+
+
+def decisions_of(prev_row, rows, base_round: int):
+    """Extract the controller DECISIONS (rounds where the knob vector
+    changed) from a host-side stacked row block ``rows[R, C]``.
+
+    Returns ``(decisions, last_row)`` where each decision is a
+    JSON-ready dict — THE one formatting path shared by the recorder
+    (``faults.device.run_device_plan``) and ``replay.replayer
+    .replay_device``, so recorded and replayed ``control`` steps can
+    only compare equal if the derivation is bit-exact (the PR-9
+    ``record_scan_views`` discipline)."""
+    nk = len(KNOB_FIELDS)
+    out = []
+    prev = prev_row
+    for j, row in enumerate(np.asarray(rows)):
+        if prev is not None and np.array_equal(np.asarray(prev)[:nk],
+                                               row[:nk]):
+            prev = row
+            continue
+        out.append({
+            "round": int(base_round + j + 1),
+            "knobs": {name: int(row[i])
+                      for i, name in enumerate(KNOB_FIELDS)},
+            "shed": int(row[nk]),
+        })
+        prev = row
+    return out, prev
+
+
+def emit_control_metrics(final_row, labels=None) -> dict:
+    """Land the final control row on the process sink (pull-based, like
+    the other device emitters): one ``serf.control.knob.<>`` gauge per
+    knob plus the shed ledger.  ``final_row`` is host-side (the run's
+    single transfer already happened)."""
+    from serf_tpu.utils import metrics
+
+    row = np.asarray(final_row)
+    vals = {}
+    for i, name in enumerate(KNOB_FIELDS):
+        vals[f"serf.control.knob.{name}"] = float(row[i])
+        metrics.gauge(f"serf.control.knob.{name}", float(row[i]), labels)
+    shed = float(row[len(KNOB_FIELDS)])
+    vals["serf.control.shed"] = shed
+    metrics.gauge("serf.control.shed", shed, labels)
+    steps = float(row[len(KNOB_FIELDS) + 1])
+    if steps:
+        metrics.incr("serf.control.steps", steps, labels)
+    return vals

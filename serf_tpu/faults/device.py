@@ -132,7 +132,8 @@ def run_phase(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
               init_alive: jnp.ndarray, down: jnp.ndarray,
               mesh=None, collect_digests: bool = False,
               include_nodes: bool = True,
-              collect_telemetry: bool = False):
+              collect_telemetry: bool = False,
+              collect_control: bool = False):
     """Scan ``num_rounds`` chaos rounds with one phase's masks applied.
     Jit with ``num_rounds`` static; group/drop/down are traced, so equal-
     length phases reuse the compiled executable.  ``mesh`` runs every
@@ -152,16 +153,29 @@ def run_phase(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
     counters row (``models/swim.round_telemetry``: alive, agreement,
     coverage, overflow ledger, suspicions, false-DEAD) as a scan output
     — the continuous-telemetry plane's device feed, staying on device
-    until the caller's single per-run ``device_get``.  With both flags
-    the aux output is ``((digest, nodes), rows)``; with one flag the
-    aux shape is unchanged from before (callers that predate telemetry
-    unpack exactly what they always did)."""
+    until the caller's single per-run ``device_get``.
+
+    ``collect_control`` (static) additionally stacks one per-round
+    control-trajectory row (``control.device.control_row``: the knob
+    vector + shed/actuation ledgers) — the adaptive-control plane's
+    evidence feed (stability invariant, recording ``control`` steps,
+    the chaos A/B report).
+
+    Aux-output shape: exactly one flag returns its bare stream; several
+    return a tuple in declared order (digests, telemetry, control) —
+    callers that predate a flag unpack exactly what they always did.
+
+    When ``cfg.control.enabled`` the control law ticks INSIDE the scan
+    every round (``models/swim.control_tick``), sharing the telemetry
+    row with ``collect_telemetry`` — controlled chaos rounds cost zero
+    extra device_gets."""
     if collect_digests:
         # lazy for the same reason as _NODE_DIGEST_CAP: the replay plane
         # only rides along when digests are actually being collected
         from serf_tpu.replay.digest import state_digest
-    if collect_telemetry:
-        from serf_tpu.models.swim import round_telemetry
+    from serf_tpu.models.swim import control_tick, round_telemetry
+    if collect_control:
+        from serf_tpu.control.device import control_row
 
     alive = init_alive & ~down
     st = state._replace(gossip=state.gossip._replace(alive=alive),
@@ -169,22 +183,57 @@ def run_phase(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
 
     def body(carry, subkey):
         nxt = cluster_round(carry, cfg, subkey, drop_rate=drop, mesh=mesh)
-        dig = None
+        row = round_telemetry(nxt, cfg) \
+            if (collect_telemetry or cfg.control.enabled) else None
+        nxt, row = control_tick(nxt, cfg, row)
+        aux = []
         if collect_digests:
             overall, node = state_digest(nxt.gossip, cfg.gossip)
-            dig = (overall, node) if include_nodes else (overall, ())
-        if collect_digests and collect_telemetry:
-            return nxt, (dig, round_telemetry(nxt, cfg))
-        if collect_digests:
-            return nxt, dig
+            aux.append((overall, node) if include_nodes
+                       else (overall, ()))
         if collect_telemetry:
-            return nxt, round_telemetry(nxt, cfg)
-        return nxt, ()
+            aux.append(row)
+        if collect_control:
+            aux.append(control_row(nxt.control))
+        if not aux:
+            return nxt, ()
+        return nxt, (aux[0] if len(aux) == 1 else tuple(aux))
 
     keys = jax.random.split(key, num_rounds)
     final, out = jax.lax.scan(body, st, keys)
-    return (final, out) if (collect_digests or collect_telemetry) \
-        else final
+    return (final, out) if (collect_digests or collect_telemetry
+                            or collect_control) else final
+
+
+@functools.lru_cache(maxsize=16)
+def _inject_runner(cfg: ClusterConfig, gated: bool,
+                   kind: Optional[int] = None):
+    """ONE jitted injection-chunk executable per (cfg, gated, kind),
+    shared across runs: the storm plans inject dozens of ring-capacity
+    chunks per phase, and dispatching ``gate_injections`` +
+    ``inject_facts_batch`` eagerly (~40 ops each) dominated chaos-run
+    wall clock.  Two shapes at most per plan (full chunks + one
+    remainder) — jit caches both.  Ltimes stay an explicit operand so a
+    perturbed recording's ltimes replay perturbed (the PR-9 verbatim
+    contract)."""
+    from serf_tpu.models.dissemination import (
+        K_USER_EVENT,
+        inject_facts_batch,
+    )
+    k = K_USER_EVENT if kind is None else kind
+
+    def run(gossip, control, eids, ltimes, origins, active):
+        if gated:
+            from serf_tpu.control.device import gate_injections
+            active, control = gate_injections(control, active)
+        g = inject_facts_batch(
+            gossip, cfg.gossip, eids, k,
+            incarnations=jnp.zeros(eids.shape, jnp.uint32),
+            ltimes=ltimes,
+            origins=origins, active=active)
+        return g, control
+
+    return jax.jit(run)
 
 
 @functools.lru_cache(maxsize=8)
@@ -196,7 +245,8 @@ def phase_runner(cfg: ClusterConfig, mesh=None):
     chaos plans at the same config now share compiles."""
     return jax.jit(functools.partial(run_phase, cfg=cfg, mesh=mesh),
                    static_argnames=("num_rounds", "collect_digests",
-                                    "include_nodes", "collect_telemetry"))
+                                    "include_nodes", "collect_telemetry",
+                                    "collect_control"))
 
 
 @dataclass
@@ -225,6 +275,13 @@ class DeviceChaosResult:
     #: otherwise read a converged 1.0 averaged with its last
     #: converging neighbor)
     telemetry_final: Optional[dict] = None
+    #: the adaptive-control plane's evidence (cfg.control.enabled runs
+    #: only): the full per-round knob/ledger trajectory
+    #: (np.ndarray[R, len(CONTROL_FIELDS)]), the final row as a dict,
+    #: and the extracted DECISIONS (rounds where the knob vector moved)
+    control_rows: object = None
+    control_final: Optional[dict] = None
+    control_decisions: List[dict] = field(default_factory=list)
 
 
 def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
@@ -250,10 +307,7 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
     the per-round membership-view digest stream
     (``replay.replayer.replay_device`` re-executes it bit-exactly)."""
     from serf_tpu.faults import invariants as inv
-    from serf_tpu.models.dissemination import (
-        K_USER_EVENT,
-        inject_facts_batch,
-    )
+    from serf_tpu.models.dissemination import K_USER_EVENT
 
     plan.validate()
     sched = lower_plan(plan, cfg.n)
@@ -283,25 +337,47 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
         state = shard_state(state, mesh)
     init_alive = state.gossip.alive
     run = phase_runner(cfg, mesh)
+    if cfg.control.enabled:
+        # seed the decision extraction with the BASE control row so the
+        # first in-scan row (no actuation yet) is not a spurious
+        # "decision"
+        import numpy as np
+
+        from serf_tpu.control.device import knob_bounds
+        base, _, _, _ = knob_bounds(cfg.control, cfg.gossip, cfg.failure)
+        _ctl_base_row = np.concatenate(
+            [np.asarray(base, np.float32), np.zeros(2, np.float32)])
+    else:
+        _ctl_base_row = None
 
     injected: List[int] = []
     next_eid = 1
+    want_ctl = cfg.control.enabled
 
     def inject(st: ClusterState, origins_key, m: int) -> ClusterState:
         """Inject ``m`` facts, CHUNKED at ring capacity: a load phase may
         offer far more facts than the ring holds (that is the storm) —
         each chunk recycles the previous one's slots and the model's
-        overflow accountant counts every in-window clobber."""
+        overflow accountant counts every in-window clobber.
+
+        Under adaptive control every chunk passes the controller's
+        per-round admission budget first (``control.gate_injections``):
+        refusals land in the ``shed`` ledger instead of the ring.  The
+        recording still carries the OFFERED batch — the replayer runs
+        the same gate against the same deterministic control state, so
+        admission decisions replay bit-exactly."""
         nonlocal next_eid
         if m <= 0:
             return st
         k = cfg.gossip.k_facts
+        run_inject = _inject_runner(cfg, want_ctl)
         while m > 0:
             chunk = min(m, k)
             m -= chunk
             origins_key, k_chunk = jax.random.split(origins_key)
-            eids = jnp.arange(next_eid, next_eid + chunk, dtype=jnp.int32)
-            injected.extend(range(next_eid, next_eid + chunk))
+            eid_list = list(range(next_eid, next_eid + chunk))
+            eids = jnp.asarray(eid_list, jnp.int32)
+            injected.extend(eid_list)
             next_eid += chunk
             origins = jax.random.randint(k_chunk, (chunk,), 0, cfg.n,
                                          dtype=jnp.int32)
@@ -311,29 +387,32 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
                 # verbatim, so a perturbed recording replays perturbed
                 recorder.step(
                     "inject", kind=int(K_USER_EVENT),
-                    eids=[int(e) for e in jax.device_get(eids)],
-                    ltimes=[int(e) for e in jax.device_get(eids)],
+                    eids=eid_list, ltimes=eid_list,
                     origins=[int(o) for o in jax.device_get(origins)])
-            g = inject_facts_batch(
-                st.gossip, cfg.gossip, eids, K_USER_EVENT,
-                incarnations=jnp.zeros((chunk,), jnp.uint32),
-                ltimes=eids.astype(jnp.uint32),
-                origins=origins, active=jnp.ones((chunk,), bool))
-            st = st._replace(gossip=g)
+            g, ctrl = run_inject(st.gossip, st.control, eids,
+                                 eids.astype(jnp.uint32), origins,
+                                 jnp.ones((chunk,), bool))
+            st = st._replace(gossip=g, control=ctrl)
         return st
 
     #: telemetry chunks: (base_round, device rows f32[R, F]) per scan —
     #: transferred by ONE device_get after the whole plan ran (never a
-    #: per-round, never even a per-phase transfer)
+    #: per-round, never even a per-phase transfer).  Control chunks
+    #: follow the same discipline.
     tele_chunks: List[tuple] = []
+    ctl_chunks: List[tuple] = []
+    #: the previous scan's last control row (host side) — the recorder's
+    #: decision extraction is incremental across scans
+    ctl_prev = [_ctl_base_row]
 
     def scan(st: ClusterState, k_run, num_rounds: int, phase: int,
              group, drop, down, base_round: int) -> ClusterState:
         """One phase (or settle-chunk) scan; records the step + the
         per-round digest stream when a recorder is attached, and stacks
-        the per-round telemetry rows when the run collects them."""
+        the per-round telemetry/control rows when the run collects
+        them."""
         want_dig = recorder is not None
-        if not want_dig and not collect_telemetry:
+        if not want_dig and not collect_telemetry and not want_ctl:
             return run(st, key=k_run, num_rounds=num_rounds, group=group,
                        drop=drop, init_alive=init_alive, down=down)
         if want_dig:
@@ -345,16 +424,31 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
                       group=group, drop=drop, init_alive=init_alive,
                       down=down, collect_digests=want_dig,
                       include_nodes=(include_nodes if want_dig else True),
-                      collect_telemetry=collect_telemetry)
-        if want_dig and collect_telemetry:
-            (dg, dn), rows = out
-        elif want_dig:
-            dg, dn = out
-            rows = None
-        else:
-            rows = out
+                      collect_telemetry=collect_telemetry,
+                      collect_control=want_ctl)
+        parts = list(out) if sum((want_dig, collect_telemetry,
+                                  want_ctl)) > 1 else [out]
+        dg = dn = rows = crows = None
+        if want_dig:
+            dg, dn = parts.pop(0)
+        if collect_telemetry:
+            rows = parts.pop(0)
+        if want_ctl:
+            crows = parts.pop(0)
         if want_dig:
             record_scan_views(recorder, base_round, dg, dn, include_nodes)
+        if crows is not None:
+            if want_dig:
+                # a recorded controlled run interleaves its control
+                # DECISIONS with the view stream per scan — the replayer
+                # emits the same steps from its own re-derived rows
+                # (replay.recording.record_scan_controls is the ONE
+                # shared formatting path)
+                from serf_tpu.replay.recording import record_scan_controls
+                ctl_prev[0] = record_scan_controls(
+                    recorder, base_round, jax.device_get(crows),
+                    ctl_prev[0])
+            ctl_chunks.append((base_round, crows))
         if rows is not None:
             tele_chunks.append((base_round, rows))
         return st
@@ -404,9 +498,44 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
 
     if recorder is not None:
         recorder.finish()
+    stretch_q = None
+    control_rows = None
+    control_final = None
+    control_decisions: List[dict] = []
+    if ctl_chunks:
+        # the control trajectory rides the same single end-of-run
+        # transfer as the telemetry rows
+        import numpy as np
+
+        from serf_tpu.control.device import (
+            CONTROL_FIELDS,
+            decisions_of,
+            emit_control_metrics,
+        )
+        host_ctl = jax.device_get([rows for _, rows in ctl_chunks])
+        control_rows = np.concatenate([np.asarray(r) for r in host_ctl])
+        control_final = dict(zip(
+            CONTROL_FIELDS, (float(v) for v in control_rows[-1])))
+        prev = _ctl_base_row
+        for (base, _), rows in zip(ctl_chunks, host_ctl):
+            decs, prev = decisions_of(prev, rows, base)
+            control_decisions.extend(decs)
+        from serf_tpu.obs import flight
+        for d in control_decisions:
+            flight.record("control-decision", plane="device",
+                          round=d["round"], knobs=d["knobs"],
+                          shed=d["shed"])
+        emit_control_metrics(control_rows[-1], {"plane": "device"})
+        stretch_q = int(control_final["stretch_q"])
     report = inv.check_device(plan, state, cfg, init_alive,
                               rounds_run=total, offered=len(injected),
-                              expect_overflow=expect_overflow)
+                              expect_overflow=expect_overflow,
+                              stretch_q=stretch_q)
+    if control_rows is not None:
+        from serf_tpu.control.device import knob_bounds
+        inv.check_control_device(report, control_rows, cfg.control,
+                                 knob_bounds(cfg.control, cfg.gossip,
+                                             cfg.failure))
     ledger = jax.device_get({"dropped": state.gossip.overflow,
                              "offered": state.gossip.injected})
     telemetry = None
@@ -429,4 +558,7 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
                              offered=int(ledger["offered"]),
                              dropped=int(ledger["dropped"]),
                              telemetry=telemetry,
-                             telemetry_final=telemetry_final)
+                             telemetry_final=telemetry_final,
+                             control_rows=control_rows,
+                             control_final=control_final,
+                             control_decisions=control_decisions)

@@ -276,6 +276,10 @@ class HostChaosResult:
     #: MetricsSampler on the traffic tick): counter deltas, gauge
     #: levels, flight-kind rates — the SLO judge's burn-rate evidence
     series: object = None
+    #: adaptive-control evidence (controller=True runs): the
+    #: ControllerTick's decision log / final values
+    #: (``control.host.ControllerTick.to_dict``)
+    control: Optional[Dict] = None
     #: convergence measurements every run carries (load or not): quiet
     #: join-convergence and post-heal settle, plus whether settle
     #: actually converged (the poll can time out at the deadline)
@@ -332,7 +336,9 @@ def _load_opts(plan: FaultPlan):
 async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
                         opts=None,
                         traffic_period: float = 0.08,
-                        recorder=None) -> HostChaosResult:
+                        recorder=None,
+                        controller: bool = False,
+                        control_cfg=None) -> HostChaosResult:
     """Run ``plan`` against a fresh in-process loopback cluster and check
     the invariants.  ``tmp_dir`` enables per-node snapshots (crash →
     restart replays them); without it restarts come back cold.
@@ -348,7 +354,15 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
     ``Serf.set_ingress_tap`` seam), phase/restart/heal transitions — plus
     a membership-view digest at each convergence barrier, so
     ``replay.replayer.replay_host`` can re-drive the same run from the
-    recording with virtualized timing."""
+    recording with virtualized timing.
+
+    ``controller`` attaches the adaptive control plane
+    (``control.host.ControllerTick``, config via ``control_cfg``): one
+    controller tick per sampler tick reads the burn-rate evidence and
+    actuates the admission buckets, breaker cooldown and probe/gossip/
+    suspicion knobs on every live node.  Decisions ride the recording
+    as ``control`` steps and the report grows the ``control-stability``
+    invariant."""
     import os
 
     from serf_tpu.faults import invariants as inv
@@ -401,13 +415,19 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
     async def consume(sub: EventSubscriber, gate: asyncio.Event) -> None:
         # a stalled gate models the wedged consumer: the subscriber queue
         # fills, drop-oldest fires (counted), and the engine's bounded
-        # tee/inbox absorb the rest — memory must stay bounded throughout
+        # tee/inbox absorb the rest — memory must stay bounded throughout.
+        # Deliberately try_next + sleep, NOT next(timeout=...): with a
+        # backlogged queue (e.g. an admission-widened storm) wait_for's
+        # inner get() completes instantly every iteration, and py3.10's
+        # wait_for swallows a cancellation that lands in that window —
+        # the executor's one-shot task.cancel() would be eaten and
+        # teardown would hang on a task that never dies
         while True:
             await gate.wait()
-            try:
-                await sub.next(timeout=0.05)
-            except asyncio.TimeoutError:
-                continue
+            if sub.try_next() is None:
+                await asyncio.sleep(0.05)
+            else:
+                await asyncio.sleep(0)   # cancellation point per drain
 
     async def make_node(i: int) -> Serf:
         sub = None
@@ -438,6 +458,20 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
 
     for i in range(n):
         nodes[i] = await make_node(i)
+
+    ctl = None
+    if controller:
+        from serf_tpu.control.host import ControllerTick, HostControlConfig
+
+        def _live_serfs():
+            from serf_tpu.host.serf import SerfState as _SS
+            return [nodes[i] for i in nodes
+                    if i not in down and nodes[i].state == _SS.ALIVE]
+
+        ctl = ControllerTick(_live_serfs, sampler.store,
+                             cfg=control_cfg or HostControlConfig(
+                                 enabled=True),
+                             recorder=recorder)
     samples: Dict[str, List[ClockSample]] = {f"n{i}": [] for i in range(n)}
     events_sent = 0
     load = HostLoadReport(
@@ -486,6 +520,8 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
             sample_clocks()
             sample_buffers()
             sampler.sample()
+            if ctl is not None:
+                ctl.tick()
             live = live_indices()
             if live:
                 src = rng.choice(live)
@@ -638,12 +674,16 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
         report = inv.check_host(plan, nodes, samples, generation,
                                 snapshots=tmp_dir is not None,
                                 load=load if with_load else None)
+        if ctl is not None:
+            inv.check_control_host(report, ctl)
         return HostChaosResult(plan=plan, report=report,
                                clock_samples=samples,
                                counters=degradation_counters(),
                                events_sent=events_sent,
                                load=load if with_load else None,
                                series=sampler.store,
+                               control=ctl.to_dict() if ctl is not None
+                               else None,
                                quiet_convergence_s=quiet_convergence_s,
                                settle_convergence_s=load.settle_convergence_s,
                                settle_converged=settle_converged,
@@ -655,9 +695,15 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
                 continue
             t.cancel()
             try:
-                await t
+                # bounded: a task that survives its cancellation (e.g. a
+                # wait_for race swallowing the request) must degrade to a
+                # leaked-task warning, never hang the whole executor
+                await asyncio.wait([t], timeout=2.0)
             except (asyncio.CancelledError, Exception):  # noqa: BLE001
                 pass
+            if not t.done():
+                log.warning("chaos teardown: task %r survived cancel",
+                            t.get_name())
         # the cluster must die on EVERY path — a raise mid-plan must not
         # leave n gossiping nodes running for the rest of the process
         for s in nodes.values():

@@ -257,12 +257,16 @@ def _check_host_overload(rep: InvariantReport, load) -> None:
 
 def check_device(plan: FaultPlan, state, cfg, init_alive,
                  rounds_run: int, offered: int = 0,
-                 expect_overflow: bool = False) -> InvariantReport:
+                 expect_overflow: bool = False,
+                 stretch_q=None) -> InvariantReport:
     """Judge the device-plane invariants on a finished chaos scan.
     ``offered`` is the executor's own injection count;
     ``expect_overflow`` asserts the run included a burst past ring
     capacity, so the overflow ledger MUST be nonzero (otherwise the
-    bound check alone would be unfalsifiable)."""
+    bound check alone would be unfalsifiable).  ``stretch_q`` is the
+    adaptive controller's FINAL suspicion stretch (controlled runs):
+    the false-DEAD judgment honors the semantics the cluster actually
+    ran, same as the telemetry row."""
     import jax
     import jax.numpy as jnp
 
@@ -272,7 +276,8 @@ def check_device(plan: FaultPlan, state, cfg, init_alive,
 
     rep = InvariantReport(plane="device", plan=plan.name)
     g = state.gossip
-    false_dead = believed_dead(g, cfg.gossip, cfg.failure) & g.alive
+    false_dead = believed_dead(g, cfg.gossip, cfg.failure,
+                               stretch_q=stretch_q) & g.alive
     vals = jax.device_get({
         "agreement": knowledge_agreement(g, cfg.gossip),
         "false_dead": jnp.sum(false_dead),
@@ -311,6 +316,8 @@ def check_device(plan: FaultPlan, state, cfg, init_alive,
             f"alive={int(vals['alive'])}/{int(vals['expected_alive'])}")
 
     # 5. overflow accounted (ISSUE 5): the injection-overflow counter —
+    # (control-stability, when the adaptive controller ran, is appended
+    # by the executor via check_control_device)
     # facts clobbered while still inside their transmit window — is the
     # device plane's shed ledger.  It can never exceed the model's own
     # total-injection counter (every clobber retires a previously
@@ -326,3 +333,124 @@ def check_device(plan: FaultPlan, state, cfg, init_alive,
             + (", burst past capacity: nonzero required" if expect_overflow
                else "") + ")")
     return rep
+
+
+# ---------------------------------------------------------------------------
+# adaptive-control stability (ISSUE 11) — both planes
+# ---------------------------------------------------------------------------
+
+#: maximum direction reversals a knob trajectory may show before the
+#: checker calls it a limit cycle.  Calibration: a genuine adaptation
+#: episode (signal appears -> protective moves -> signal clears ->
+#: relax) costs up to 2 reversals, and a chaos plan has at most ~3
+#: episodes (warm-up convergence, the fault window, settle) — so a
+#: healthy trajectory stays <= 6.  A hysteresis-defeating limit cycle
+#: reverses every ~2*hysteresis rounds: 12+ over a typical 72-round
+#: plan — cleanly separated from the bound.
+CONTROL_MAX_REVERSALS = 6
+
+
+def _trajectory_stability(values, steps, lo, hi, min_gap: float,
+                          mult: bool = False):
+    """Judge one knob's actuation trajectory ``[(t, value), ...]``:
+
+    - **bounded step** — each move stays within its per-actuation clamp
+      (additive ``steps``; or a ``steps``-ratio band when ``mult``);
+    - **clamp band** — every value inside ``[lo, hi]`` (small epsilon);
+    - **hysteresis** — consecutive actuations at least ``min_gap``
+      ticks/rounds apart;
+    - **no limit cycle** — direction reversals <= CONTROL_MAX_REVERSALS
+      (monotone tails are fine: a knob still relaxing toward base when
+      the run ends has settled, a knob oscillating has not).
+
+    Returns a list of violation strings (empty = stable)."""
+    out = []
+    eps = 1e-9
+    last_dir = 0
+    reversals = 0
+    last_t = None
+    for (t0, v0), (t1, v1) in zip(values, values[1:]):
+        d = v1 - v0
+        if abs(d) <= eps:
+            continue
+        if mult:
+            ratio = v1 / v0 if v0 else float("inf")
+            if not (1.0 / steps - 1e-6 <= ratio <= steps + 1e-6):
+                out.append(f"step {v0:g}->{v1:g} outside x{steps:g} band")
+        elif abs(d) > steps + eps:
+            out.append(f"step {v0:g}->{v1:g} exceeds +-{steps:g}")
+        if not (lo - eps <= v1 <= hi + eps):
+            out.append(f"value {v1:g} outside [{lo:g}, {hi:g}]")
+        direction = 1 if d > 0 else -1
+        if last_dir and direction != last_dir:
+            reversals += 1
+        last_dir = direction
+        if last_t is not None and (t1 - last_t) < min_gap - eps:
+            out.append(f"actuations {last_t:g} and {t1:g} closer than "
+                       f"the {min_gap:g}-tick hysteresis window")
+        last_t = t1
+    if reversals > CONTROL_MAX_REVERSALS:
+        out.append(f"{reversals} direction reversals "
+                   f"(> {CONTROL_MAX_REVERSALS}): limit cycle")
+    return out
+
+
+def check_control_device(rep: InvariantReport, control_rows, ccfg,
+                         bounds) -> None:
+    """Append the ``control-stability`` invariant to a device report:
+    the per-round knob trajectory (``control.device.control_row``
+    stacking) must show bounded steps inside the clamp bands,
+    hysteresis-spaced actuations, and no limit cycle."""
+    import numpy as np
+
+    from serf_tpu.control.device import KNOB_FIELDS
+
+    base, lo, hi, step = bounds
+    rows = np.asarray(control_rows)
+    problems = []
+    min_gap = float(min(ccfg.hyst_up, ccfg.hyst_down))
+    for i, name in enumerate(KNOB_FIELDS):
+        traj = [(0.0, float(base[i]))] + [
+            (float(r + 1), float(rows[r, i])) for r in range(len(rows))]
+        # collapse to actuation points (value changes) but KEEP the
+        # round timestamps so the hysteresis-gap check is in rounds
+        changes = [traj[0]]
+        for t, v in traj[1:]:
+            if v != changes[-1][1]:
+                changes.append((t, v))
+        for p in _trajectory_stability(changes, float(step[i]),
+                                       float(lo[i]), float(hi[i]),
+                                       min_gap):
+            problems.append(f"{name}: {p}")
+    n_act = sum(1 for r in range(1, len(rows))
+                if not np.array_equal(rows[r, :len(KNOB_FIELDS)],
+                                      rows[r - 1, :len(KNOB_FIELDS)]))
+    rep.add("control-stability", not problems,
+            "; ".join(problems[:4]) if problems else
+            f"{n_act} actuation(s) over {len(rows)} rounds, "
+            f"shed {int(rows[-1, len(KNOB_FIELDS)])}, knobs settled "
+            "inside clamps")
+
+
+def check_control_host(rep: InvariantReport, controller) -> None:
+    """Append the ``control-stability`` invariant to a host report from
+    a ``control.host.ControllerTick`` decision log: bounded
+    (multiplicative or integer) steps inside the clamp bands,
+    hysteresis-spaced ticks, no limit cycle."""
+    from serf_tpu.control.host import _INT_KNOBS
+
+    cfg = controller.cfg
+    bounds = controller.bounds() if controller._base is not None else {}
+    problems = []
+    min_gap = float(min(cfg.hyst_up, cfg.hyst_down))
+    for knob, traj in controller.trajectories().items():
+        if len(traj) < 2:
+            continue
+        lo, hi, step = bounds[knob]
+        for p in _trajectory_stability(
+                traj, step, lo, hi, min_gap, mult=knob not in _INT_KNOBS):
+            problems.append(f"{knob}: {p}")
+    rep.add("control-stability", not problems,
+            "; ".join(problems[:4]) if problems else
+            f"{len(controller.decisions)} actuation(s) over "
+            f"{controller.ticks} ticks, knobs settled inside clamps")

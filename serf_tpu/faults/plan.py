@@ -287,6 +287,59 @@ def _slow_consumer(n: int = 4) -> FaultPlan:
     )
 
 
+def _control_loss_converge(n: int = 8) -> FaultPlan:
+    """Adaptive-control acceptance #1 (ISSUE 11): a long heavy-loss
+    window strands facts past their transmit window at a conservative
+    static fan-out — with anti-entropy off, their coverage freezes below
+    1.0 and the convergence-settle SLO breaches no matter how long the
+    (fault-free) settle runs.  The controller's agreement law widens
+    fan-out IN-FLIGHT (convergence-settle burning → widen fanout, the
+    Lifeguard philosophy cluster-wide), facts disseminate inside their
+    window, and the same plan re-converges to all-green.  A/B via
+    ``tools/chaos.py --plan control-loss-converge --controller ab``
+    (config profiles: serf_tpu/control/profiles.py)."""
+    return FaultPlan(
+        name="control-loss-converge",
+        n=n,
+        seed=23,
+        phases=(
+            FaultPhase(name="warm", duration_s=0.5, rounds=12),
+            FaultPhase(name="loss1", duration_s=0.8, rounds=12, drop=0.55),
+            FaultPhase(name="loss2", duration_s=0.8, rounds=12, drop=0.55),
+            FaultPhase(name="loss3", duration_s=0.8, rounds=12, drop=0.55),
+        ),
+        settle_s=8.0,
+        settle_rounds=24,
+    )
+
+
+def _control_overload_shed(n: int = 6) -> FaultPlan:
+    """Adaptive-control acceptance #2 (ISSUE 11): repeated injection
+    storms far past ring capacity.  Static configs accept everything and
+    clobber nearly all of it mid-flight (device shed-ratio breaches; on
+    the host plane the static admission buckets shed >95% of offered
+    load — breach).  The controller's overflow law tightens the device
+    injection budget (admit what can finish disseminating, shed the
+    rest up front) and the host controller widens the admission buckets
+    while node health holds — both planes re-converge to all-green."""
+    return FaultPlan(
+        name="control-overload-shed",
+        n=n,
+        seed=29,
+        phases=(
+            FaultPhase(name="warm", duration_s=0.5, rounds=12),
+            FaultPhase(name="burst1", duration_s=1.0, rounds=12,
+                       event_rate=900.0),
+            FaultPhase(name="burst2", duration_s=1.0, rounds=12,
+                       event_rate=900.0),
+            FaultPhase(name="burst3", duration_s=1.0, rounds=12,
+                       event_rate=900.0),
+        ),
+        settle_s=8.0,
+        settle_rounds=24,
+    )
+
+
 def _self_check(n: int = 4) -> FaultPlan:
     """Tiny fast plan for ``tools/chaos.py --self-check`` (tier-1)."""
     return FaultPlan(
@@ -310,6 +363,8 @@ _PLANS: Dict[str, object] = {
     "query-storm": _query_storm,
     "slow-consumer": _slow_consumer,
     "self-check": _self_check,
+    "control-loss-converge": _control_loss_converge,
+    "control-overload-shed": _control_overload_shed,
 }
 
 

@@ -1049,7 +1049,7 @@ def select_phase(state: GossipState, cfg: GossipConfig,
 
 def exchange_phase(packets: jnp.ndarray, cfg: GossipConfig,
                    key: jax.Array, group=None,
-                   drop_rate=None) -> jnp.ndarray:
+                   drop_rate=None, eff_fanout=None) -> jnp.ndarray:
     """Phase 3 — pull-exchange: each node ORs ``fanout`` peers' packets.
 
     Rotation mode: fanout random rotations shared by all nodes — peer
@@ -1062,7 +1062,15 @@ def exchange_phase(packets: jnp.ndarray, cfg: GossipConfig,
     plane's per-round delivery mask (serf_tpu.faults.device): each
     (receiver, peer) exchange is independently lost with that
     probability — the device analog of per-edge UDP loss.  None (the
-    default) compiles the fault path out entirely."""
+    default) compiles the fault path out entirely.
+
+    ``eff_fanout`` (optional i32 scalar, may be traced) is the adaptive
+    control plane's effective fan-out (serf_tpu.control.device):
+    contributions ``f >= eff_fanout`` are masked out.  The static
+    ``cfg.fanout`` stays the shape bound and the RNG stream is
+    identical for every value, so the controller changing fan-out never
+    perturbs the peer sampling of the legs it keeps.  None (the
+    default) compiles the mask out entirely."""
     n = packets.shape[0]
     if drop_rate is not None:
         key, k_drop = jax.random.split(key)
@@ -1084,6 +1092,10 @@ def exchange_phase(packets: jnp.ndarray, cfg: GossipConfig,
             if lost is not None:
                 contrib = jnp.where(lost[f][:, None], jnp.uint32(0),
                                     contrib)
+            if eff_fanout is not None:
+                contrib = jnp.where(
+                    jnp.asarray(f, jnp.int32) < eff_fanout, contrib,
+                    jnp.uint32(0))
             incoming = incoming | contrib
         return incoming
     srcs = jax.random.randint(key, (n, cfg.fanout), 0, n)
@@ -1095,6 +1107,10 @@ def exchange_phase(packets: jnp.ndarray, cfg: GossipConfig,
     if drop_rate is not None:
         lost = jax.random.bernoulli(k_drop, drop_rate, (n, cfg.fanout))
         gathered = jnp.where(lost[:, :, None], jnp.uint32(0), gathered)
+    if eff_fanout is not None:
+        fmask = jnp.arange(cfg.fanout, dtype=jnp.int32) < eff_fanout
+        gathered = jnp.where(fmask[None, :, None], gathered,
+                             jnp.uint32(0))
     return jax.lax.reduce(gathered, jnp.uint32(0),
                           jnp.bitwise_or, (1,))       # u32[N, W]
 
@@ -1239,7 +1255,7 @@ def merge_phase(state: GossipState, incoming: jnp.ndarray,
 
 def round_step(state: GossipState, cfg: GossipConfig,
                key: jax.Array, group=None, drop_rate=None,
-               exchange=None, mesh=None) -> GossipState:
+               exchange=None, mesh=None, eff_fanout=None) -> GossipState:
     """One gossip round: select packets, pull-exchange, Lamport-merge
     (the :func:`select_phase`/:func:`exchange_phase`/:func:`merge_phase`
     composition — the profiler jits the same phases in isolation,
@@ -1268,6 +1284,12 @@ def round_step(state: GossipState, cfg: GossipConfig,
     copy of everything around the leg is what keeps the sharded round
     bit-exact with this one by construction.
 
+    ``eff_fanout`` (optional i32 scalar, may be traced) is the adaptive
+    control plane's effective fan-out (serf_tpu.control): forwarded to
+    the exchange leg, which masks contributions ``f >= eff_fanout`` out
+    — the kwarg is only passed when live, so custom exchange hooks that
+    predate it keep working.
+
     ``mesh`` (optional) tells the select/merge phases they are running
     on node-sharded state so the FUSED pallas kernels can run under
     shard_map per chip (the exchange leg stays whatever ``exchange``
@@ -1276,8 +1298,11 @@ def round_step(state: GossipState, cfg: GossipConfig,
     def active(state):
         packets = select_phase(state, cfg, mesh=mesh)
         ex = exchange_phase if exchange is None else exchange
+        # the adaptive fan-out kwarg is only threaded when live, so
+        # custom exchange hooks that predate it keep working unchanged
+        kw = {} if eff_fanout is None else {"eff_fanout": eff_fanout}
         incoming = ex(packets, cfg, key, group=group,
-                      drop_rate=drop_rate)
+                      drop_rate=drop_rate, **kw)
         st = merge_phase(state, incoming, cfg, mesh=mesh)
         return (st.known, st.stamp, st.last_learn, st.sendable,
                 st.sendable_round, st.last_clamp)

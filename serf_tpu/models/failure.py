@@ -338,8 +338,23 @@ def refute_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
                         do, lambda st: st, state)
 
 
+def suspicion_q_of(fcfg: FailureConfig, stretch_q=None) -> jnp.ndarray:
+    """The live suspicion window in q-ticks: the static config value
+    plus the adaptive control plane's stretch (serf_tpu.control.device
+    ``stretch_q`` knob — Lifeguard's timeout stretch, cluster-wide),
+    clamped to the AGE_PIN_Q stamp-representability bound.  THE one
+    definition both the declare expiry scan and the ``believed_dead``
+    judgment use, so stretching the declaration timer and judging
+    false-DEADs can never diverge."""
+    if stretch_q is None:
+        return jnp.uint8(fcfg.suspicion_q)
+    return jnp.clip(jnp.asarray(fcfg.suspicion_q, jnp.int32)
+                    + jnp.asarray(stretch_q, jnp.int32),
+                    1, AGE_PIN_Q).astype(jnp.uint8)
+
+
 def declare_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
-                  key: jax.Array) -> GossipState:
+                  key: jax.Array, stretch_q=None) -> GossipState:
     """Suspicions that aged out without refutation become dead declarations.
 
     Skip-gated on a K-sized predicate: a suspicion can only produce a
@@ -348,18 +363,23 @@ def declare_round(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
     declaration.  Retired-but-valid ring facts fail it, so the gate
     switches the phase OFF again in the post-detection steady state —
     with it every mask in the body is all-False and the round is a
-    bit-exact identity skipping the N×K scans."""
+    bit-exact identity skipping the N×K scans.
+
+    ``stretch_q`` (optional i32 scalar, may be traced) widens the
+    suspicion window by that many quarter-round ticks — the adaptive
+    control plane's Lifeguard stretch (:func:`suspicion_q_of`)."""
     suspect = _facts_about(state, (K_SUSPECT,))
     return jax.lax.cond(
         jnp.any(live_suspicions(state)),
-        lambda st: _declare_round_body(st, cfg, fcfg, suspect, key),
+        lambda st: _declare_round_body(st, cfg, fcfg, suspect, key,
+                                       stretch_q=stretch_q),
         lambda st: st,
         state)
 
 
 def _declare_round_body(state: GossipState, cfg: GossipConfig,
                         fcfg: FailureConfig, suspect: jnp.ndarray,
-                        key: jax.Array) -> GossipState:
+                        key: jax.Array, stretch_q=None) -> GossipState:
     n, k = cfg.n, cfg.k_facts
     refuted = jnp.any(_refutation_matrix(state), axis=1)
     # K-sized fact filter, packed once (suspicions that could declare)
@@ -370,7 +390,7 @@ def _declare_round_body(state: GossipState, cfg: GossipConfig,
     # interleave; see dissemination.pack_pred_words) and gate with the
     # packed known/alive planes before ONE contiguous unpack.  mod_age
     # is garbage where the known bit is clear; the known AND gates it.
-    sq = jnp.uint8(fcfg.suspicion_q)
+    sq = suspicion_q_of(fcfg, stretch_q)
     if cfg.pack_stamp:
         b = state.stamp
         aged_words = nibble_age_pred_words(b & jnp.uint8(0xF), b >> 4,
@@ -423,10 +443,14 @@ def run_swim(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
 # -- views / metrics ---------------------------------------------------------
 
 def believed_dead(state: GossipState, cfg: GossipConfig,
-                  fcfg: FailureConfig) -> jnp.ndarray:
+                  fcfg: FailureConfig, stretch_q=None) -> jnp.ndarray:
     """bool[N, N']→ compressed: for each node i (knower) and table slot j,
     whether i currently believes the fact's subject is dead; reduced to
-    bool[N_subjects] 'every alive node believes subject dead'."""
+    bool[N_subjects] 'every alive node believes subject dead'.
+
+    ``stretch_q`` widens the aged-suspicion evidence window exactly like
+    the declare scan (:func:`suspicion_q_of`): a controlled cluster that
+    stretched its suspicion timers is judged by the semantics it runs."""
     n, k = cfg.n, cfg.k_facts
     known = unpack_bits(state.known, k)
     # an accusation stale w.r.t. the subject's CURRENT incarnation is no
@@ -436,7 +460,8 @@ def believed_dead(state: GossipState, cfg: GossipConfig,
     # member tables ignore stale-incarnation dead messages forever)
     dead_fact = _facts_about(state, (K_DEAD,), inc_current=True)
     aged_suspect = _facts_about(state, (K_SUSPECT,), inc_current=True)
-    aged = mod_age(state, cfg) >= fcfg.suspicion_q  # gated by `known` below
+    aged = mod_age(state, cfg) >= suspicion_q_of(fcfg, stretch_q)
+    # (gated by `known` below)
     evidence = known & (dead_fact[None, :] | (aged_suspect[None, :] & aged))
     # refutation: knower also knows an alive fact about the same subject
     # with strictly higher incarnation

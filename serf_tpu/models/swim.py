@@ -49,6 +49,17 @@ from serf_tpu.models.vivaldi import (
     make_vivaldi,
     vivaldi_update,
 )
+from serf_tpu.control.device import (
+    KNOB_FANOUT,
+    KNOB_PROBE_MULT,
+    KNOB_STRETCH_Q,
+    ControlConfig,
+    ControlSignals,
+    ControlState,
+    control_step,
+    gate_injections,
+    make_control,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -56,6 +67,14 @@ class ClusterConfig:
     gossip: GossipConfig
     failure: FailureConfig = FailureConfig()
     vivaldi: VivaldiConfig = VivaldiConfig()
+    #: the adaptive control plane (serf_tpu.control.device): with
+    #: ``control.enabled`` the controller-writable knob subset —
+    #: effective fanout, probe-cadence multiplier, suspicion stretch,
+    #: injection admission budget — lives as traced ControlState leaves
+    #: updated inside the scan from the per-round telemetry row.
+    #: Disabled (default): the control leaves ride the pytree untouched
+    #: and every round is bit-exact with the static path.
+    control: ControlConfig = ControlConfig()
     push_pull_every: int = 0       # rounds between anti-entropy syncs; 0=off
     #: gossip rounds per probe (and per Vivaldi update, which rides probe
     #: acks in the reference).  1 = probe every round (the conservative
@@ -106,6 +125,13 @@ class ClusterState(NamedTuple):
     vivaldi: VivaldiState
     positions: jnp.ndarray   # f32[N, P] hidden latency-space ground truth
     group: jnp.ndarray       # i32[N] partition group (all zeros = healed)
+    control: ControlState = None  # type: ignore[assignment]
+                             # adaptive-control knobs/streaks/ledgers
+                             # (serf_tpu.control.device) — ALWAYS a real
+                             # ControlState after make_cluster; read only
+                             # when cfg.control.enabled (inert leaves
+                             # otherwise — pinned bit-exact by
+                             # tests/test_control.py)
 
 
 def flagship_config(n: int, k_facts: int = 64) -> ClusterConfig:
@@ -131,6 +157,7 @@ def make_cluster(cfg: ClusterConfig, key: jax.Array) -> ClusterState:
         vivaldi=make_vivaldi(n, cfg.vivaldi),
         positions=positions,
         group=jnp.zeros((n,), jnp.int32),
+        control=make_control(cfg.control, cfg.gossip, cfg.failure),
     )
 
 
@@ -159,8 +186,24 @@ def cluster_round(state: ClusterState, cfg: ClusterConfig,
     k_gossip, k_probe, k_refute, k_declare, k_pp, k_viv, k_peer = \
         jax.random.split(key, 7)
     g = state.gossip
-    probe_tick = (g.round % cfg.probe_every == 0) \
-        if cfg.probe_every > 1 else None
+    # adaptive knobs (serf_tpu.control.device): trace-time gated — the
+    # disabled default never reads the control leaves, so the static
+    # path's jaxpr is exactly the pre-control one
+    ctrl = state.control if cfg.control.enabled else None
+    eff_fanout = None
+    stretch_q = None
+    if ctrl is not None:
+        eff_fanout = ctrl.knobs[KNOB_FANOUT]
+        stretch_q = ctrl.knobs[KNOB_STRETCH_Q]
+        # probe-cadence multiplier: probes (declare + Vivaldi ride the
+        # same tick) run every probe_every * probe_mult rounds — always
+        # the traced-cond path under control
+        probe_tick = (g.round
+                      % (cfg.probe_every * ctrl.knobs[KNOB_PROBE_MULT])
+                      ) == 0
+    else:
+        probe_tick = (g.round % cfg.probe_every == 0) \
+            if cfg.probe_every > 1 else None
     chaos_group = state.group if drop_rate is not None else None
     if mesh is not None:
         # THE one sharded round in the tree (parallel.ring): round_step
@@ -170,10 +213,11 @@ def cluster_round(state: ClusterState, cfg: ClusterConfig,
         from serf_tpu.parallel.ring import sharded_round_step
         g = sharded_round_step(g, cfg.gossip, k_gossip, mesh,
                                schedule=cfg.exchange_schedule,
-                               group=state.group, drop_rate=drop_rate)
+                               group=state.group, drop_rate=drop_rate,
+                               eff_fanout=eff_fanout)
     else:
         g = round_step(g, cfg.gossip, k_gossip, group=state.group,
-                       drop_rate=drop_rate)
+                       drop_rate=drop_rate, eff_fanout=eff_fanout)
     if cfg.with_failure:
         if probe_tick is None:
             g = probe_round(g, cfg.gossip, cfg.failure, k_probe,
@@ -193,7 +237,7 @@ def cluster_round(state: ClusterState, cfg: ClusterConfig,
             g = jax.lax.cond(
                 probe_tick,
                 lambda s: declare_round(s, cfg.gossip, cfg.failure,
-                                        k_declare),
+                                        k_declare, stretch_q=stretch_q),
                 lambda s: s, g)
     if cfg.push_pull_every > 0:
         g = jax.lax.cond(
@@ -213,7 +257,7 @@ def cluster_round(state: ClusterState, cfg: ClusterConfig,
             # coordinate samples ride probe acks (reference delegate
             # ping payloads), so they follow the probe cadence
             viv = jax.lax.cond(probe_tick, viv_step, lambda v: v, viv)
-    return ClusterState(g, viv, state.positions, state.group)
+    return state._replace(gossip=g, vivaldi=viv)
 
 
 def vivaldi_phase(state: ClusterState, cfg: ClusterConfig, k_peer,
@@ -244,10 +288,34 @@ def vivaldi_phase(state: ClusterState, cfg: ClusterConfig, k_peer,
                           active=reachable)
 
 
+def control_tick(state: ClusterState, cfg: ClusterConfig, row=None):
+    """Apply the device control law after a round: extract the law
+    signals from the (post-round) telemetry ``row`` and advance
+    ``state.control`` — the decision feeds forward as round R+1's
+    dynamic config.  Returns ``(state, row)``; ``row`` is computed here
+    when the caller did not already collect telemetry, so the two
+    consumers share ONE N×K unpack per round.  A no-op pass-through
+    when the controller is disabled."""
+    if not cfg.control.enabled:
+        return state, row
+    if row is None:
+        row = round_telemetry(state, cfg)
+    sig = ControlSignals(
+        agreement=row[TELEMETRY_FIELDS.index("agreement")],
+        false_dead=row[TELEMETRY_FIELDS.index("false_dead")],
+        overflow=row[TELEMETRY_FIELDS.index("overflow")],
+    )
+    ctrl = control_step(state.control, sig, cfg.control, cfg.gossip,
+                        cfg.failure)
+    return state._replace(control=ctrl), row
+
+
 def run_cluster(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
                 num_rounds: int, mesh=None) -> ClusterState:
     def body(carry, subkey):
-        return cluster_round(carry, cfg, subkey, mesh=mesh), ()
+        nxt = cluster_round(carry, cfg, subkey, mesh=mesh)
+        nxt, _ = control_tick(nxt, cfg)
+        return nxt, ()
 
     keys = jax.random.split(key, num_rounds)
     final, _ = jax.lax.scan(body, state, keys)
@@ -295,11 +363,18 @@ def sustained_round(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
     # unique, monotonically increasing event ids double as ltimes
     eids = g.round * m + jnp.arange(m, dtype=jnp.int32) + 1
     origins = jax.random.randint(k_org, (m,), 0, cfg.n, dtype=jnp.int32)
+    active = jnp.ones((m,), bool)
+    if cfg.control.enabled:
+        # device-plane admission (control.gate_injections): the
+        # controller's per-round token budget sheds offered load the
+        # ring would only clobber mid-flight anyway
+        active, ctrl = gate_injections(state.control, active)
+        state = state._replace(control=ctrl)
     g = inject_facts_batch(
         g, cfg.gossip, eids, K_USER_EVENT,
         incarnations=jnp.zeros((m,), jnp.uint32),
         ltimes=eids.astype(jnp.uint32),
-        origins=origins, active=jnp.ones((m,), bool))
+        origins=origins, active=active)
     return cluster_round(state._replace(gossip=g), cfg, k_rnd, mesh=mesh)
 
 
@@ -316,8 +391,11 @@ def run_cluster_sustained(state: ClusterState, cfg: ClusterConfig,
     def body(carry, subkey):
         nxt = sustained_round(carry, cfg, subkey, events_per_round,
                               mesh=mesh)
+        row = round_telemetry(nxt, cfg) \
+            if (collect_telemetry or cfg.control.enabled) else None
+        nxt, row = control_tick(nxt, cfg, row)
         if collect_telemetry:
-            return nxt, round_telemetry(nxt, cfg)
+            return nxt, row
         return nxt, ()
 
     keys = jax.random.split(key, num_rounds)
@@ -357,8 +435,14 @@ def round_telemetry(state: ClusterState, cfg: ClusterConfig) -> jnp.ndarray:
     n_valid = jnp.maximum(jnp.sum(valid), 1).astype(jnp.float32)
     cov = jnp.sum(known & alive_col, axis=0).astype(jnp.float32) / n_alive
     mean_cov = jnp.sum(jnp.where(valid, cov, 0.0)) / n_valid
+    # under adaptive control the believed-dead judgment honors the live
+    # suspicion stretch (the knob the false-dead law actuates) so the
+    # signal the controller reads is the semantics it changed
+    stretch = state.control.knobs[KNOB_STRETCH_Q] \
+        if cfg.control.enabled else None
     false_dead = jnp.sum(
-        believed_dead(g, cfg.gossip, cfg.failure) & g.alive)
+        believed_dead(g, cfg.gossip, cfg.failure, stretch_q=stretch)
+        & g.alive)
     return jnp.stack([
         jnp.sum(g.alive).astype(jnp.float32),
         jnp.sum(valid).astype(jnp.float32),

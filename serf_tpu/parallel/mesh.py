@@ -58,8 +58,11 @@ _QUERY_Q_FIELDS = frozenset(
 # needs the whole thing.  slot_round is the overflow accountant's i32[K]
 # clock (PR 5) — sharding it over the node axis would be semantically
 # wrong (it is per ring SLOT, not per node) and forces GSPMD reshards in
-# the inject path.
-_REPLICATED_LEAVES = frozenset({"adj_index", "slot_round"})
+# the inject path.  The adaptive-control vectors (ControlState.knobs/
+# .streak, PR 11) are per-KNOB, cluster-global by definition — one
+# control law for the whole cluster, every chip reads the same values.
+_REPLICATED_LEAVES = frozenset({"adj_index", "slot_round", "knobs",
+                                "streak"})
 # DeviceFaultSchedule (faults.device) chaos masks: [P, N] per-phase
 # group/down planes shard on their SECOND axis; per-phase loss rates
 # ([P]) are replicated.

@@ -75,8 +75,13 @@ def shard_cluster_hybrid(state, mesh: Mesh):
     flat, treedef = jax.tree_util.tree_flatten_with_path(state)
     out = []
     for path, leaf in flat:
-        pstr = jax.tree_util.keystr(path)
-        spec = _spec_for(pstr, leaf)
+        # the PATH TUPLE, not keystr(path): _spec_for dispatches on the
+        # attribute names along the path, and a flattened string made
+        # every rank>=1 leaf look name-less — fact planes were silently
+        # node-sharded on the hybrid mesh (harmless only while every
+        # leading dim happened to divide the device count; the 4-wide
+        # control knob vector turned it into a hard error)
+        spec = _spec_for(path, leaf)
         if spec == P(NODE_AXIS):
             sharding = node_sharding
         else:

@@ -89,7 +89,7 @@ def _ring_scan(pk, grp, resolve, n_devices):
     return resolve(vis_pk, vis_grp, n_devices - 1, acc)
 
 
-def _rotation_ring_leg(pk, offs, grp, lost, *, n, n_local, n_devices,
+def _rotation_ring_leg(pk, offs, grp, lost, eff, *, n, n_local, n_devices,
                        fanout):
     """Rotation sampling over the ring schedule (inside shard_map).
 
@@ -125,13 +125,16 @@ def _rotation_ring_leg(pk, offs, grp, lost, *, n, n_local, n_devices,
                              == grp)
             if lost is not None:
                 sel = sel & ~lost[f]
+            if eff is not None:
+                sel = sel & (jnp.asarray(f, jnp.int32) < eff)
             acc = acc | jnp.where(sel[:, None], rolled, jnp.uint32(0))
         return acc
 
     return _ring_scan(pk, grp, resolve, n_devices)
 
 
-def _rotation_allgather_leg(pk, offs, grp, lost, *, n, n_local, fanout):
+def _rotation_allgather_leg(pk, offs, grp, lost, eff, *, n, n_local,
+                            fanout):
     """Rotation sampling over the all-gather schedule: one collective,
     then the fanout rolled reads are local contiguous slices of the
     (doubled) gathered plane."""
@@ -156,15 +159,20 @@ def _rotation_allgather_leg(pk, offs, grp, lost, *, n, n_local, fanout):
             sel = ~lost[f] if sel is None else (sel & ~lost[f])
         if sel is not None:
             contrib = jnp.where(sel[:, None], contrib, jnp.uint32(0))
+        if eff is not None:
+            contrib = jnp.where(jnp.asarray(f, jnp.int32) < eff,
+                                contrib, jnp.uint32(0))
         acc = acc | contrib
     return acc
 
 
-def _iid_ring_leg(pk, srcs, grp, lost, *, n_local, n_devices):
+def _iid_ring_leg(pk, srcs, grp, lost, eff, *, n_local, n_devices):
     """iid sampling over the ring schedule: rotate blocks; each hop, the
     sampled sources living in the visiting block resolve by local
     gather (u32[Nl, F, W] masked OR-reduce)."""
     me = jax.lax.axis_index(NODE_AXIS)
+    fmask = (jnp.arange(srcs.shape[1], dtype=jnp.int32) < eff
+             if eff is not None else None)
 
     def resolve(vis_pk, vis_grp, h, acc):
         s = (me - h) % n_devices
@@ -176,6 +184,8 @@ def _iid_ring_leg(pk, srcs, grp, lost, *, n_local, n_devices):
             ok = ok & (vis_grp[idx] == grp[:, None])
         if lost is not None:
             ok = ok & ~lost
+        if fmask is not None:
+            ok = ok & fmask[None, :]
         got = jnp.where(ok[:, :, None], got, jnp.uint32(0))
         return acc | jax.lax.reduce(got, jnp.uint32(0),
                                     jnp.bitwise_or, (1,))
@@ -183,7 +193,7 @@ def _iid_ring_leg(pk, srcs, grp, lost, *, n_local, n_devices):
     return _ring_scan(pk, grp, resolve, n_devices)
 
 
-def _iid_allgather_leg(pk, srcs, grp, lost):
+def _iid_allgather_leg(pk, srcs, grp, lost, eff):
     """iid sampling over the all-gather schedule: materialize the plane,
     gather the sampled sources locally, mask, OR-reduce."""
     full = jax.lax.all_gather(pk, NODE_AXIS, tiled=True)        # u32[N, W]
@@ -194,13 +204,18 @@ def _iid_allgather_leg(pk, srcs, grp, lost):
         ok = fgrp[srcs] == grp[:, None]
     if lost is not None:
         ok = ~lost if ok is None else (ok & ~lost)
+    if eff is not None:
+        fmask = (jnp.arange(srcs.shape[1], dtype=jnp.int32)
+                 < eff)[None, :]
+        ok = fmask if ok is None else (ok & fmask)
     if ok is not None:
         got = jnp.where(ok[:, :, None], got, jnp.uint32(0))
     return jax.lax.reduce(got, jnp.uint32(0), jnp.bitwise_or, (1,))
 
 
 def exchange_sharded(packets: jnp.ndarray, cfg: GossipConfig,
-                     key: jax.Array, group=None, drop_rate=None, *,
+                     key: jax.Array, group=None, drop_rate=None,
+                     eff_fanout=None, *,
                      mesh, schedule: str = "ring") -> jnp.ndarray:
     """The sharded exchange leg — a drop-in for
     ``dissemination.exchange_phase`` (``round_step``'s ``exchange``
@@ -223,7 +238,7 @@ def exchange_sharded(packets: jnp.ndarray, cfg: GossipConfig,
         obs.record("shard-fallback", op="exchange_sharded", n=n,
                    devices=d, reason="n % devices != 0; GSPMD lowering")
         return exchange_phase(packets, cfg, key, group=group,
-                              drop_rate=drop_rate)
+                              drop_rate=drop_rate, eff_fanout=eff_fanout)
     n_local = n // d
     if drop_rate is not None:
         key, k_drop = jax.random.split(key)
@@ -247,23 +262,33 @@ def exchange_sharded(packets: jnp.ndarray, cfg: GossipConfig,
     if lost is not None:
         operands.append(lost)
         specs.append(lost_spec)
+    if eff_fanout is not None:
+        # the adaptive fan-out scalar is replicated: every chip masks
+        # the same trailing offsets
+        operands.append(jnp.asarray(eff_fanout, jnp.int32))
+        specs.append(P())
     has_group, has_lost = group is not None, lost is not None
+    has_eff = eff_fanout is not None
 
     def leg(pk, sample, *rest):
-        grp = rest[0] if has_group else None
-        lo = rest[1 if has_group else 0] if has_lost else None
+        i = 0
+        grp = rest[i] if has_group else None
+        i += has_group
+        lo = rest[i] if has_lost else None
+        i += has_lost
+        eff = rest[i] if has_eff else None
         if rotation and schedule == "ring":
-            return _rotation_ring_leg(pk, sample, grp, lo, n=n,
+            return _rotation_ring_leg(pk, sample, grp, lo, eff, n=n,
                                       n_local=n_local, n_devices=d,
                                       fanout=cfg.fanout)
         if rotation:
-            return _rotation_allgather_leg(pk, sample, grp, lo, n=n,
+            return _rotation_allgather_leg(pk, sample, grp, lo, eff, n=n,
                                            n_local=n_local,
                                            fanout=cfg.fanout)
         if schedule == "ring":
-            return _iid_ring_leg(pk, sample, grp, lo, n_local=n_local,
-                                 n_devices=d)
-        return _iid_allgather_leg(pk, sample, grp, lo)
+            return _iid_ring_leg(pk, sample, grp, lo, eff,
+                                 n_local=n_local, n_devices=d)
+        return _iid_allgather_leg(pk, sample, grp, lo, eff)
 
     ex = shard_map(leg, mesh=mesh, in_specs=tuple(specs),
                    out_specs=P(NODE_AXIS, None))
@@ -272,7 +297,8 @@ def exchange_sharded(packets: jnp.ndarray, cfg: GossipConfig,
 
 def sharded_round_step(state: GossipState, cfg: GossipConfig,
                        key: jax.Array, mesh, schedule: str = "ring",
-                       group=None, drop_rate=None) -> GossipState:
+                       group=None, drop_rate=None,
+                       eff_fanout=None) -> GossipState:
     """One gossip round with the explicit sharded exchange — bit-exact
     with ``round_step(state, cfg, key, group, drop_rate)`` by
     construction: it IS ``round_step`` (same select/merge/quiet-gate/
@@ -290,4 +316,4 @@ def sharded_round_step(state: GossipState, cfg: GossipConfig,
                       exchange=functools.partial(exchange_sharded,
                                                  mesh=mesh,
                                                  schedule=schedule),
-                      mesh=mesh)
+                      mesh=mesh, eff_fanout=eff_fanout)

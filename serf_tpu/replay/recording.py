@@ -108,17 +108,20 @@ def device_config_to_dict(cfg) -> Dict[str, Any]:
 
 
 def device_config_from_dict(d: Dict[str, Any]):
+    from serf_tpu.control.device import ControlConfig
     from serf_tpu.models.failure import FailureConfig
     from serf_tpu.models.swim import ClusterConfig
     from serf_tpu.models.dissemination import GossipConfig
     from serf_tpu.models.vivaldi import VivaldiConfig
 
     top = {k: v for k, v in d.items()
-           if k not in ("gossip", "failure", "vivaldi")}
+           if k not in ("gossip", "failure", "vivaldi", "control")}
     return ClusterConfig(
         gossip=GossipConfig(**d["gossip"]),
         failure=FailureConfig(**d["failure"]),
         vivaldi=VivaldiConfig(**d["vivaldi"]),
+        # pre-PR-11 recordings carry no control block: static default
+        control=ControlConfig(**d.get("control", {})),
         **top)
 
 
@@ -282,6 +285,27 @@ class RunRecorder:
 
 def load_recording(path) -> Recording:
     return Recording.load(path)
+
+
+def record_scan_controls(recorder: RunRecorder, base_round: int,
+                         rows, prev_row):
+    """Append one ``control`` step per controller DECISION (round where
+    the knob vector changed) from a host-side stacked control-row block
+    — THE one formatting path shared by the recorder
+    (``faults.device.run_device_plan``) and ``replay.replayer
+    .replay_device``, like :func:`record_scan_views`: the replayer
+    re-DERIVES its control rows from the scan and emits through this
+    same function, so a recorded and a replayed controlled run can only
+    produce identical step chains if the control plane is bit-exact —
+    and a perturbed recording's diff names the first divergent control
+    decision.  Returns the block's last row (the caller threads it into
+    the next scan's extraction)."""
+    from serf_tpu.control.device import decisions_of
+
+    decisions, prev = decisions_of(prev_row, rows, base_round)
+    for d in decisions:
+        recorder.step("control", **d)
+    return prev
 
 
 def record_scan_views(recorder: RunRecorder, base_round: int, dg, dn,

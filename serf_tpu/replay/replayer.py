@@ -40,10 +40,14 @@ def replay_device(rec: Recording, mesh=None) -> RunRecorder:
     """Re-execute a device recording; returns the replay's recorder
     (diff its ``to_recording()`` against the source with
     ``differ.diff_recordings``)."""
+    import jax
     import jax.numpy as jnp
 
-    from serf_tpu.faults.device import lower_plan, phase_runner
-    from serf_tpu.models.dissemination import inject_facts_batch
+    from serf_tpu.faults.device import (
+        _inject_runner,
+        lower_plan,
+        phase_runner,
+    )
     from serf_tpu.models.swim import make_cluster
 
     if rec.plane != "device":
@@ -62,6 +66,15 @@ def replay_device(rec: Recording, mesh=None) -> RunRecorder:
     no_group = jnp.zeros((cfg.n,), jnp.int32)
     no_down = jnp.zeros((cfg.n,), bool)
     total = 0
+    want_ctl = cfg.control.enabled
+    ctl_prev = None
+    if want_ctl:
+        import numpy as np
+
+        from serf_tpu.control.device import knob_bounds
+        base, _, _, _ = knob_bounds(cfg.control, cfg.gossip, cfg.failure)
+        ctl_prev = np.concatenate(
+            [np.asarray(base, np.float32), np.zeros(2, np.float32)])
     for s in rec.steps():
         op, a = s["op"], s["args"]
         if op == "init":
@@ -79,14 +92,19 @@ def replay_device(rec: Recording, mesh=None) -> RunRecorder:
             if state is None:
                 raise RecordingError("inject step before init")
             chunk = len(a["eids"])
-            g = inject_facts_batch(
-                state.gossip, cfg.gossip,
-                jnp.asarray(a["eids"], jnp.int32), int(a["kind"]),
-                incarnations=jnp.zeros((chunk,), jnp.uint32),
-                ltimes=jnp.asarray(a["ltimes"], jnp.uint32),
-                origins=jnp.asarray(a["origins"], jnp.int32),
-                active=jnp.ones((chunk,), bool))
-            state = state._replace(gossip=g)
+            # same jitted chunk executable (and, under control, the same
+            # admission gate) as the recording run: the control state is
+            # deterministic, so the admitted subset is too; eids/ltimes/
+            # origins are consumed VERBATIM (a perturbed recording
+            # replays perturbed)
+            run_inject = _inject_runner(cfg, want_ctl, int(a["kind"]))
+            g, ctrl = run_inject(
+                state.gossip, state.control,
+                jnp.asarray(a["eids"], jnp.int32),
+                jnp.asarray(a["ltimes"], jnp.uint32),
+                jnp.asarray(a["origins"], jnp.int32),
+                jnp.ones((chunk,), bool))
+            state = state._replace(gossip=g, control=ctrl)
             out.step("inject", **a)
         elif op == "scan":
             if state is None:
@@ -98,12 +116,27 @@ def replay_device(rec: Recording, mesh=None) -> RunRecorder:
             down = sched.down[pi] if pi >= 0 else no_down
             out.step("scan", **a)
             include_nodes = cfg.n <= NODE_DIGEST_CAP
-            state, (dg, dn) = run(
+            state, aux = run(
                 state, key=key_from_hex(a["key"]), num_rounds=num_rounds,
                 group=group, drop=drop, init_alive=init_alive, down=down,
-                collect_digests=True, include_nodes=include_nodes)
+                collect_digests=True, include_nodes=include_nodes,
+                collect_control=want_ctl)
+            if want_ctl:
+                (dg, dn), crows = aux
+            else:
+                dg, dn = aux
             record_scan_views(out, total, dg, dn, include_nodes)
+            if want_ctl:
+                from serf_tpu.replay.recording import record_scan_controls
+                ctl_prev = record_scan_controls(
+                    out, total, jax.device_get(crows), ctl_prev)
             total += num_rounds
+        elif op == "control":
+            # recorded controller decisions are DERIVED state, not
+            # ingress: the replay re-computes its own from the scan (and
+            # emitted them above) — the recorded ones are the comparison
+            # surface, never an input
+            continue
         else:
             raise RecordingError(f"unknown device step op {op!r}")
     out.finish()
@@ -235,6 +268,13 @@ async def replay_host(rec: Recording,
                         await nodes[i].join(a["seed"])
                     except Exception:  # noqa: BLE001
                         pass
+            elif op == "control":
+                # re-apply the recorded controller decision at its
+                # stream position: host replay reproduces the recorded
+                # adaptations instead of re-running a controller against
+                # nondeterministic timing
+                from serf_tpu.control.host import apply_recorded
+                apply_recorded(nodes, a["knob"], float(a["value"]))
             elif op == "heal":
                 await serve_phase_window()
                 ex.clear()

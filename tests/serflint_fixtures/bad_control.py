@@ -1,0 +1,20 @@
+"""serflint fixture: control-knob declarations that MUST fire
+``control-knob-drift``.
+
+Linted pure-AST as a toy project's ``serf_tpu/control/device.py`` with
+``registry.control_knobs = {"fanout", "probe_mult"}``:
+
+- ``rogue_knob`` is a KNOB_FIELDS entry nobody declared
+  (``field:rogue_knob``) AND has no law (``lawless:rogue_knob``);
+- a DEVICE_LAWS entry actuates ``undeclared_law_knob``
+  (``law:undeclared_law_knob``);
+- declared ``probe_mult`` appears in no field tuple and no law
+  (``undefined:probe_mult`` — exercised by the test via the registry).
+"""
+
+KNOB_FIELDS = ("fanout", "rogue_knob")
+
+DEVICE_LAWS = (
+    ("some-signal", "fanout", "up"),
+    ("some-signal", "undeclared_law_knob", "down"),
+)

@@ -1,0 +1,11 @@
+"""serflint fixture: the clean twin of bad_control.py — every knob
+declared, every knob lawful, every law on a declared knob (registry
+``control_knobs = {"fanout"}``) — must produce zero
+``control-knob-drift`` findings."""
+
+KNOB_FIELDS = ("fanout",)
+
+DEVICE_LAWS = (
+    ("some-signal", "fanout", "up"),
+    ("other-signal", "fanout", "down"),
+)

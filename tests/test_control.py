@@ -1,0 +1,454 @@
+"""Adaptive control plane (ISSUE 11): controller stability + the A/B
+acceptance — static configs breach, controlled twins re-converge.
+
+Tier-1 contract (all small-N, module-scoped fixtures share the chaos
+runs):
+
+- hysteresis: a signal flickering around its threshold never actuates;
+  a sustained signal actuates exactly once per hysteresis window;
+- bounded step: no knob ever moves more than its per-round clamp, and
+  every value stays inside its band (relaxes never cross the base);
+- controller-off is BIT-EXACT with the static path: a disabled config
+  never reads the control leaves (a mangled ControlState changes no
+  gossip/vivaldi leaf);
+- the two named control plans: static leg breaches an SLO
+  (judge_device_run), controlled leg is all-green with the
+  control-stability invariant;
+- a recorded controlled run replays bit-exactly INCLUDING the control
+  decisions, and a perturbed control step is named by the differ;
+- the sharded controlled round (effective-fanout masking inside the
+  shard_map exchange leg) is bit-exact with the unsharded one;
+- the host ControllerTick: widens admission under shed burn with
+  healthy nodes, tightens under degraded health, hysteresis + clamps
+  pinned, and replay applies recorded decisions.
+"""
+
+import copy
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from serf_tpu.control.device import (
+    CONTROL_FIELDS,
+    ControlConfig,
+    ControlSignals,
+    KNOB_FIELDS,
+    control_step,
+    gate_injections,
+    knob_bounds,
+    make_control,
+)
+from serf_tpu.models.dissemination import GossipConfig
+from serf_tpu.models.failure import FailureConfig
+from serf_tpu.models.swim import (
+    ClusterConfig,
+    make_cluster,
+    run_cluster_sustained,
+)
+
+_FANOUT = KNOB_FIELDS.index("fanout")
+_INJECT = KNOB_FIELDS.index("inject_limit")
+
+
+def _cfg_tuple(n=64, k=32, fanout=4, fanout_base=1, **ctl):
+    ccfg = ControlConfig(enabled=True, fanout_base=fanout_base, **ctl)
+    gcfg = GossipConfig(n=n, k_facts=k, fanout=fanout,
+                        peer_sampling="rotation")
+    fcfg = FailureConfig(suspicion_rounds=8, max_new_facts=8,
+                         probe_schedule="round_robin")
+    return ccfg, gcfg, fcfg
+
+
+def _sig(agreement=1.0, false_dead=0.0, overflow=0.0):
+    return ControlSignals(agreement=jnp.float32(agreement),
+                          false_dead=jnp.float32(false_dead),
+                          overflow=jnp.float32(overflow))
+
+
+def _drive(ctl, sigs, ccfg, gcfg, fcfg):
+    rows = []
+    for s in sigs:
+        ctl = control_step(ctl, s, ccfg, gcfg, fcfg)
+        rows.append(np.asarray(ctl.knobs))
+    return ctl, np.stack(rows)
+
+
+# ---------------------------------------------------------------------------
+# control-law units
+# ---------------------------------------------------------------------------
+
+
+def test_hysteresis_flicker_never_actuates():
+    """A telemetry signal oscillating around the threshold every round
+    resets the streak each flip — the knob must never move."""
+    ccfg, gcfg, fcfg = _cfg_tuple(hyst_up=3)
+    ctl = make_control(ccfg, gcfg, fcfg)
+    sigs = [_sig(agreement=0.5 if i % 2 == 0 else 0.95)
+            for i in range(40)]  # low / neutral / low / neutral ...
+    _, rows = _drive(ctl, sigs, ccfg, gcfg, fcfg)
+    assert np.all(rows[:, _FANOUT] == rows[0, _FANOUT])
+
+
+def test_hysteresis_sustained_signal_actuates_per_window():
+    """A sustained low-agreement signal widens the fan-out exactly once
+    per hyst_up rounds: monotone, evenly spaced — never a jump."""
+    ccfg, gcfg, fcfg = _cfg_tuple(hyst_up=3)
+    ctl = make_control(ccfg, gcfg, fcfg)
+    _, rows = _drive(ctl, [_sig(agreement=0.5)] * 12, ccfg, gcfg, fcfg)
+    fan = rows[:, _FANOUT]
+    # +1 at rounds 3, 6, 9 (1-indexed); clamped at gossip.fanout = 4
+    assert list(fan) == [1, 1, 2, 2, 2, 3, 3, 3, 4, 4, 4, 4]
+
+
+def test_bounded_step_and_clamps_under_random_signals():
+    ccfg, gcfg, fcfg = _cfg_tuple()
+    base, lo, hi, step = knob_bounds(ccfg, gcfg, fcfg)
+    rng = np.random.default_rng(7)
+    sigs = [_sig(agreement=rng.uniform(0.3, 1.0),
+                 false_dead=float(rng.integers(0, 3)),
+                 overflow=float(i * rng.integers(0, 40)))
+            for i in range(120)]
+    ctl = make_control(ccfg, gcfg, fcfg)
+    _, rows = _drive(ctl, sigs, ccfg, gcfg, fcfg)
+    prev = np.asarray(base)
+    for row in rows:
+        assert np.all(np.abs(row - prev) <= step), (row, prev)
+        assert np.all(row >= lo) and np.all(row <= hi), row
+        prev = row
+
+
+def test_relax_never_crosses_base():
+    """After the protective excursion, sustained calm relaxes each knob
+    back to its BASE — never past it."""
+    ccfg, gcfg, fcfg = _cfg_tuple(hyst_up=1, hyst_down=1)
+    base, _, _, _ = knob_bounds(ccfg, gcfg, fcfg)
+    ctl = make_control(ccfg, gcfg, fcfg)
+    # protective excursion: overflow ledger growing 8/round, agreement
+    # low, false-deads present — every knob leaves its base
+    ctl, _ = _drive(ctl, [_sig(agreement=0.2, false_dead=2.0,
+                               overflow=8.0 * (i + 1))
+                          for i in range(10)], ccfg, gcfg, fcfg)
+    # calm: ledger frozen (delta 0 -> EWMA decays), agreement converged
+    ctl, rows = _drive(ctl, [_sig(agreement=1.0, overflow=80.0)] * 60,
+                       ccfg, gcfg, fcfg)
+    assert np.array_equal(rows[-1], np.asarray(base))
+    # monotone return: no overshoot below/above base on the way
+    assert np.all(rows[:, _FANOUT] >= base[_FANOUT])
+    assert np.all(rows[:, _INJECT] <= base[_INJECT])
+
+
+def test_gate_injections_budget_depletes_across_batches():
+    ccfg, gcfg, fcfg = _cfg_tuple(inject_limit_base=5)
+    ctl = make_control(ccfg, gcfg, fcfg)
+    a1, ctl = gate_injections(ctl, jnp.ones((4,), bool))
+    assert int(jnp.sum(a1)) == 4 and int(ctl.inject_tokens) == 1
+    a2, ctl = gate_injections(ctl, jnp.ones((4,), bool))
+    # one token left: exactly the first active admitted (prefix kept)
+    assert list(np.asarray(a2)) == [True, False, False, False]
+    assert int(ctl.shed) == 3
+    a3, ctl = gate_injections(ctl, jnp.ones((2,), bool))
+    assert int(jnp.sum(a3)) == 0 and int(ctl.shed) == 5
+    # refill on the next control tick
+    ctl = control_step(ctl, _sig(), ccfg, gcfg, fcfg)
+    assert int(ctl.inject_tokens) == 5
+
+
+def test_controller_off_never_reads_the_control_leaf():
+    """cfg.control.enabled=False is the static path: mangling every
+    control value changes NO gossip/vivaldi leaf (bit-exact), pinned on
+    the sustained flagship driver."""
+    cfg = ClusterConfig(
+        gossip=GossipConfig(n=48, k_facts=32, peer_sampling="rotation"),
+        failure=FailureConfig(suspicion_rounds=8, max_new_facts=8,
+                              probe_schedule="round_robin"),
+        push_pull_every=4)
+    key = jax.random.key(3)
+    st = make_cluster(cfg, key)
+    mangled = st._replace(control=st.control._replace(
+        knobs=jnp.asarray([4, 7, 8, 1], jnp.int32),
+        inject_tokens=jnp.asarray(0, jnp.int32),
+        shed=jnp.asarray(999, jnp.uint32)))
+    fin_a = run_cluster_sustained(st, cfg, key, 8, events_per_round=2)
+    fin_b = run_cluster_sustained(mangled, cfg, key, 8,
+                                  events_per_round=2)
+    for leaf_a, leaf_b in zip(jax.tree_util.tree_leaves(fin_a.gossip),
+                              jax.tree_util.tree_leaves(fin_b.gossip)):
+        assert bool(jnp.all(leaf_a == leaf_b))
+    for leaf_a, leaf_b in zip(jax.tree_util.tree_leaves(fin_a.vivaldi),
+                              jax.tree_util.tree_leaves(fin_b.vivaldi)):
+        assert bool(jnp.all(leaf_a == leaf_b))
+    # and the mangled leaf rides through untouched
+    assert int(fin_b.control.shed) == 999
+
+
+def test_control_registry_matches_knob_fields():
+    from serf_tpu.analysis.registry import CONTROL_KNOBS
+    from serf_tpu.control.host import HOST_KNOBS
+
+    assert set(CONTROL_KNOBS) == set(KNOB_FIELDS) | set(HOST_KNOBS)
+
+
+# ---------------------------------------------------------------------------
+# the A/B acceptance plans (module-scoped runs, small N)
+# ---------------------------------------------------------------------------
+
+
+def _run_ab(plan_name: str, n: int):
+    from serf_tpu.control.profiles import device_ab_config
+    from serf_tpu.faults.device import run_device_plan
+    from serf_tpu.faults.plan import named_plan
+    from serf_tpu.obs import slo
+
+    plan = named_plan(plan_name)
+    out = {}
+    for controlled in (False, True):
+        cfg = device_ab_config(plan_name, n, 32, controlled)
+        res = run_device_plan(plan, cfg, collect_telemetry=True)
+        out["controlled" if controlled else "static"] = (
+            res, slo.judge_device_run(res, plan, emit=False))
+    return out
+
+
+@pytest.fixture(scope="module")
+def loss_ab():
+    return _run_ab("control-loss-converge", 128)
+
+
+@pytest.fixture(scope="module")
+def shed_ab():
+    return _run_ab("control-overload-shed", 96)
+
+
+def test_loss_plan_static_breaches_convergence(loss_ab):
+    res, verdicts = loss_ab["static"]
+    assert not res.report.ok          # membership-convergence invariant
+    breached = {v.slo for v in verdicts if not v.ok}
+    assert "convergence-settle" in breached
+
+
+def test_loss_plan_controlled_reconverges_all_green(loss_ab):
+    res, verdicts = loss_ab["controlled"]
+    assert res.report.ok, res.report.format()
+    assert all(v.ok for v in verdicts), [v.slo for v in verdicts
+                                         if not v.ok]
+    # the controller actually adapted (widened fan-out past base)
+    assert res.control_decisions
+    assert max(d["knobs"]["fanout"] for d in res.control_decisions) > 1
+    stab = [r for r in res.report.results
+            if r.name == "control-stability"]
+    assert stab and stab[0].ok, stab
+
+
+def test_shed_plan_static_breaches_shed_ratio(shed_ab):
+    res, verdicts = shed_ab["static"]
+    breached = {v.slo for v in verdicts if not v.ok}
+    assert "shed-ratio" in breached
+    assert res.dropped / max(1, res.offered) > 0.95
+
+
+def test_shed_plan_controlled_sheds_up_front_and_is_green(shed_ab):
+    res, verdicts = shed_ab["controlled"]
+    assert res.report.ok, res.report.format()
+    assert all(v.ok for v in verdicts), [v.slo for v in verdicts
+                                         if not v.ok]
+    # admission control moved the loss up front: the controller's shed
+    # ledger is large, the ring's mid-flight clobber ratio is small
+    assert res.control_final["shed"] > 0
+    assert res.dropped / max(1, res.offered) < 0.95
+    # the tightening law actually fired
+    assert min(d["knobs"]["inject_limit"]
+               for d in res.control_decisions) \
+        < res.control_rows[0][KNOB_FIELDS.index("inject_limit")]
+
+
+def test_control_trajectory_row_shape(shed_ab):
+    res, _ = shed_ab["controlled"]
+    assert res.control_rows.shape == (res.rounds_run,
+                                      len(CONTROL_FIELDS))
+    assert res.control_final["steps"] == res.control_rows[-1][-1]
+
+
+# ---------------------------------------------------------------------------
+# record/replay of a controlled run (bit-exact incl. the control row)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def controlled_recording():
+    from serf_tpu.control.profiles import device_ab_config
+    from serf_tpu.faults.device import run_device_plan
+    from serf_tpu.faults.plan import FaultPhase, FaultPlan
+    from serf_tpu.replay.recording import RunRecorder
+    from serf_tpu.replay.replayer import replay_device
+
+    # a mini overload plan (tier-1 budget): one 400-event burst past the
+    # ring + injection budget still produces tighten decisions, at a
+    # third of the named plan's rounds/chunks
+    plan = FaultPlan(
+        name="mini-control-shed", n=4, seed=5,
+        phases=(FaultPhase(name="warm", duration_s=0.2, rounds=8),
+                FaultPhase(name="burst", duration_s=0.5, rounds=8,
+                           event_rate=800.0)),
+        settle_s=1.0, settle_rounds=16)
+    cfg = device_ab_config("control-overload-shed", 64, 32, True)
+    rec = RunRecorder()
+    run_device_plan(plan, cfg, recorder=rec)
+    recording = rec.to_recording()
+    replay = replay_device(recording).to_recording()
+    return recording, replay
+
+
+def test_controlled_replay_bit_exact_including_control(
+        controlled_recording):
+    from serf_tpu.replay.differ import diff_recordings
+
+    recording, replay = controlled_recording
+    ctl_steps = [r for r in recording.records
+                 if r.get("kind") == "step" and r["op"] == "control"]
+    assert ctl_steps, "a controlled storm run must record decisions"
+    rep = diff_recordings(recording, replay)
+    assert rep.ok, rep.format()
+
+
+def test_perturbed_control_decision_is_named_by_the_differ(
+        controlled_recording):
+    from serf_tpu.replay.differ import diff_recordings
+
+    recording, replay = controlled_recording
+    pert = copy.deepcopy(recording)
+    seq = None
+    for r in pert.records:
+        if r.get("kind") == "step" and r["op"] == "control":
+            r["args"]["knobs"]["inject_limit"] += 16
+            r["chain"] = "0" * 16
+            seq = r["seq"]
+            break
+    rep = diff_recordings(pert, replay)
+    assert not rep.ok
+    assert rep.first_divergent_step["seq"] == seq
+    assert rep.first_divergent_step["a"]["op"] == "control"
+
+
+# ---------------------------------------------------------------------------
+# sharded controlled round: the effective-fanout mask composes with the
+# explicit shard_map exchange leg bit-exactly
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_controlled_round_bit_exact(vmesh8):
+    from serf_tpu.parallel.mesh import shard_state
+
+    cfg = ClusterConfig(
+        gossip=GossipConfig(n=96, k_facts=32, fanout=4,
+                            peer_sampling="rotation"),
+        failure=FailureConfig(suspicion_rounds=8, max_new_facts=8,
+                              probe_schedule="round_robin"),
+        push_pull_every=8,
+        control=ControlConfig(enabled=True, fanout_base=2, hyst_up=1,
+                              hyst_down=2))
+    key = jax.random.key(5)
+    st = make_cluster(cfg, key)
+    fin1 = run_cluster_sustained(st, cfg, key, 8, events_per_round=2)
+    fin8 = run_cluster_sustained(shard_state(st, vmesh8), cfg, key, 8,
+                                 events_per_round=2, mesh=vmesh8)
+    for a, b in zip(jax.tree_util.tree_leaves(fin1.gossip),
+                    jax.tree_util.tree_leaves(fin8.gossip)):
+        assert bool(jnp.all(a == b))
+    assert bool(jnp.all(fin1.control.knobs == fin8.control.knobs))
+    assert int(fin1.control.steps) == int(fin8.control.steps)
+
+
+# ---------------------------------------------------------------------------
+# host controller
+# ---------------------------------------------------------------------------
+
+
+async def test_host_controller_widen_tighten_hysteresis_and_clamps():
+    """Drive ControllerTick against two real loopback Serfs with a
+    synthetic ring store: shed burn at green health widens the
+    admission buckets once per hyst_up ticks up to the clamp; degraded
+    health tightens them back (never below min_scale); the decision log
+    satisfies the stability invariant."""
+    from serf_tpu.control.host import ControllerTick, HostControlConfig
+    from serf_tpu.faults.invariants import InvariantReport, \
+        check_control_host
+    from serf_tpu.host.serf import Serf
+    from serf_tpu.host.transport import LoopbackNetwork
+    from serf_tpu.obs.timeseries import SeriesStore
+    from serf_tpu.options import Options
+
+    net = LoopbackNetwork()
+    opts = Options.local(user_event_rate=4.0, user_event_burst=4,
+                         query_rate=4.0, query_burst=4)
+    serfs = [await Serf.create(net.bind(f"c{i}"), opts, f"c{i}")
+             for i in range(2)]
+    try:
+        store = SeriesStore()
+        cfg = HostControlConfig(enabled=True, hyst_up=2, hyst_down=4,
+                                step=2.0, max_scale=4.0)
+        ctl = ControllerTick(lambda: serfs, store, cfg=cfg)
+        base_rate = serfs[0]._admission._buckets["user_event"].rate
+
+        def feed(shed, admitted, t):
+            store.append("serf.overload.ingress_shed", t, shed,
+                         kind="delta")
+            store.append("serf.overload.ingress_admitted", t, admitted,
+                         kind="delta")
+
+        class _Score:
+            def __init__(self, score):
+                self.score = score
+
+        def degrade(score):
+            # the controller samples the nodes' own health scorers (the
+            # admission gate's pattern), not a ring series
+            for s in serfs:
+                s._health.sample = lambda consume=False, _s=score: \
+                    _Score(_s)
+
+        # 8 ticks of heavy shed at green health: widen at ticks 2, 4, 6,
+        # 8 — ×2 each, clamped at 4× base
+        for t in range(8):
+            feed(50, 1, float(t))
+            ctl.tick()
+        rate = serfs[0]._admission._buckets["user_event"].rate
+        assert rate == pytest.approx(base_rate * cfg.max_scale)
+        widen_decisions = [d for d in ctl.decisions
+                           if d[1] == "user_event_rate"]
+        assert len(widen_decisions) == 2          # 2x then clamp at 4x
+        # degraded health tightens (hyst_up window again — protective)
+        degrade(10)
+        for t in range(8, 14):
+            feed(0, 1, float(t))
+            ctl.tick()
+        rate2 = serfs[0]._admission._buckets["user_event"].rate
+        assert rate2 < rate
+        lo = base_rate * cfg.min_scale
+        assert rate2 >= lo - 1e-9
+        rep = InvariantReport(plane="host", plan="unit")
+        check_control_host(rep, ctl)
+        assert rep.ok, rep.format()
+    finally:
+        for s in serfs:
+            await s.shutdown()
+
+
+async def test_host_replay_applies_recorded_control_steps():
+    from serf_tpu.control.host import apply_recorded
+    from serf_tpu.host.serf import Serf
+    from serf_tpu.host.transport import LoopbackNetwork
+    from serf_tpu.options import Options
+
+    net = LoopbackNetwork()
+    s = await Serf.create(net.bind("r0"), Options.local(), "r0")
+    try:
+        apply_recorded({0: s}, "gossip_nodes", 5.0)
+        assert s.memberlist.opts.gossip_nodes == 5
+        apply_recorded({0: s}, "breaker_cooldown", 7.5)
+        assert s.memberlist._breaker.cooldown == 7.5
+        with pytest.raises(ValueError):
+            apply_recorded({0: s}, "not_a_knob", 1.0)
+    finally:
+        await s.shutdown()

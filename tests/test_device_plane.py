@@ -297,10 +297,19 @@ def test_sustained_load_keeps_gate_open_and_disseminates():
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
-def test_sharded_parity_8_devices():
+@pytest.mark.parametrize("n,rounds", [
+    # the 1024-node/30-round GSPMD-lowered parity run was ~18s of tier-1
+    # wall clock — promoted to @slow (ISSUE 11 budget reclaim); the
+    # smaller variant keeps the pure-GSPMD run_cluster parity bar in
+    # tier-1 (the authored-exchange parity crosses live in
+    # test_sharded_round/test_ring)
+    pytest.param(1024, 30, marks=pytest.mark.slow),
+    (256, 16),
+])
+def test_sharded_parity_8_devices(n, rounds):
     """The same simulation sharded over 8 devices must be bit-identical to
     the single-device run (the north-star 'state parity' bar)."""
-    cfg = ClusterConfig(gossip=GossipConfig(n=1024, k_facts=32),
+    cfg = ClusterConfig(gossip=GossipConfig(n=n, k_facts=32),
                         push_pull_every=10)
     key = jax.random.key(0)
     state = make_cluster(cfg, key)
@@ -313,8 +322,8 @@ def test_sharded_parity_8_devices():
                    static_argnames=("num_rounds",), out_shardings=out_sh)
     run1 = jax.jit(functools.partial(run_cluster, cfg=cfg),
                    static_argnames=("num_rounds",))
-    s8 = run8(sharded, key=jax.random.key(2), num_rounds=30)
-    s1 = run1(state, key=jax.random.key(2), num_rounds=30)
+    s8 = run8(sharded, key=jax.random.key(2), num_rounds=rounds)
+    s1 = run1(state, key=jax.random.key(2), num_rounds=rounds)
     assert bool(jnp.all(s1.gossip.known == s8.gossip.known))
     assert bool(jnp.all(s1.gossip.stamp == s8.gossip.stamp))
     assert bool(jnp.allclose(s1.vivaldi.vec, s8.vivaldi.vec, atol=1e-6))
@@ -636,7 +645,14 @@ def test_declare_round_attributes_declarer_per_subject():
     assert origin_of == {10: 20, 11: 30}
 
 
-def test_sharded_query_churn_parity_8_devices():
+@pytest.mark.parametrize("n", [
+    # the 1024-node cross was ~23s of tier-1 wall clock — promoted to
+    # @slow (ISSUE 11 budget reclaim); the 256-node variant keeps the
+    # query+churn+linger sharded parity cross pinned every run
+    pytest.param(1024, marks=pytest.mark.slow),
+    256,
+])
+def test_sharded_query_churn_parity_8_devices(n):
     """Query gather + churn composed with the flagship round — including
     the leave-linger countdown carry the production step ships — sharded
     over 8 devices, must be bit-identical to the single-device run."""
@@ -646,7 +662,7 @@ def test_sharded_query_churn_parity_8_devices():
                                        make_queries, no_filter_mask,
                                        query_round)
 
-    cfg = ClusterConfig(gossip=GossipConfig(n=1024, k_facts=32),
+    cfg = ClusterConfig(gossip=GossipConfig(n=n, k_facts=32),
                         push_pull_every=10)
     ccfg = ChurnConfig(fail_rate=1e-3, leave_rate=1e-3, rejoin_rate=0.05,
                        max_events=4)

@@ -110,15 +110,22 @@ async def test_intent_fixpoint_parity(seed):
             f"device={device[s]} (seed {seed})")
 
 
-async def test_128_node_convergence_parity_with_host_cluster():
-    """Baseline config #1 bridged to the device plane: a real 128-node host
+@pytest.mark.parametrize("n", [
+    # the full baseline-config scale is ~140s of tier-1 wall clock
+    # (128 in-process Serfs + their shutdowns) — promoted to @slow
+    # (ISSUE 11 budget reclaim); the 32-node variant keeps the
+    # host-cluster-vs-device bridge pinned in tier-1 every run
+    pytest.param(128, marks=pytest.mark.slow),
+    32,
+])
+async def test_node_convergence_parity_with_host_cluster(n):
+    """Baseline config #1 bridged to the device plane: a real n-node host
     cluster converges on membership; the device sim with the same join set
     converges to the same member list."""
     import asyncio
     import time
 
     net = LoopbackNetwork()
-    n = 128  # the full baseline-config scale, in-process
     nodes = []
     for i in range(n):
         s = await Serf.create(net.bind(f"a{i}"), Options.cluster(n), f"n{i}")
@@ -138,7 +145,7 @@ async def test_128_node_convergence_parity_with_host_cluster():
             # scheduler, not the protocol.  The bound still catches gross
             # pathology (a convergence stall is minutes/never, not 25 s).
             assert time.monotonic() - t0 < 25.0, \
-                "128-node convergence blew the (3.5x reference) 25s budget"
+                f"{n}-node convergence blew the (3.5x reference) 25s budget"
         host_members = {m.node.id for m in nodes[0].members()}
 
         # device: n nodes, join intents for each, full dissemination

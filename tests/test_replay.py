@@ -205,7 +205,7 @@ def test_chaos_record_on_fail_writes_artifact_only_when_red(
 
     chaos = _load_tool("chaos")
 
-    def fake_run_host(plan, recorder=None, ok=False):
+    def fake_run_host(plan, recorder=None, ok=False, controlled=False):
         rep = InvariantReport(plane="host", plan=plan.name)
         rep.add("membership-convergence", ok, "stubbed")
         if recorder is not None:
@@ -236,7 +236,7 @@ def test_chaos_record_on_fail_writes_artifact_only_when_red(
     # green run: same wiring, ok report -> nothing written
     artifact.unlink()
     monkeypatch.setattr(chaos, "run_host",
-                        lambda plan, recorder=None:
+                        lambda plan, recorder=None, controlled=False:
                         fake_run_host(plan, recorder, ok=True))
     assert chaos.main() == 0
     assert not artifact.exists()

@@ -248,6 +248,40 @@ def test_slo_doc_drift_both_ways(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# control-knob family (ISSUE 11): the adaptive control plane is
+# registry-governed — a knob without a law, or a law on an undeclared
+# knob, fails lint
+# ---------------------------------------------------------------------------
+
+
+def test_control_knob_bad_fixture_fires_every_direction(tmp_path):
+    project = toy_project(
+        tmp_path,
+        {"serf_tpu/control/device.py":
+         (FIXTURES / "bad_control.py").read_text()},
+        registry=Registry(metrics=frozenset(), flight_kinds=frozenset(),
+                         control_knobs=frozenset({"fanout",
+                                                  "probe_mult"})))
+    report = analysis.run_rules(project, rules=["control-knob-drift"])
+    keys = {f.key for f in report.findings}
+    assert "field:rogue_knob" in keys       # undeclared knob field
+    assert "lawless:rogue_knob" in keys     # knob with no law
+    assert "law:undeclared_law_knob" in keys  # law on undeclared knob
+    assert "undefined:probe_mult" in keys   # declared, defined nowhere
+
+
+def test_control_knob_clean_twin_is_silent(tmp_path):
+    project = toy_project(
+        tmp_path,
+        {"serf_tpu/control/device.py":
+         (FIXTURES / "ok_control.py").read_text()},
+        registry=Registry(metrics=frozenset(), flight_kinds=frozenset(),
+                         control_knobs=frozenset({"fanout"})))
+    report = analysis.run_rules(project, rules=["control-knob-drift"])
+    assert report.findings == []
+
+
+# ---------------------------------------------------------------------------
 # schema family: drift without a bump fails lint; bump clears it
 # ---------------------------------------------------------------------------
 
@@ -360,8 +394,9 @@ def test_repo_pins_match_current_sources():
     # the specs cover the real surface
     spec = schema_mod.pytree_spec(REPO)
     assert set(spec) == {"FactTable", "GossipState", "VivaldiState",
-                         "ClusterState"}
+                         "ClusterState", "ControlState"}
     assert "tombstone" in spec["GossipState"]
+    assert "knobs" in spec["ControlState"]
     wire = schema_mod.wire_spec(REPO)
     assert "JoinMessage" in wire and "MessageType" in wire
     assert wire["MessageType"]["members"]["QUERY"] == 5
@@ -552,6 +587,7 @@ def test_rule_registry_is_exactly_the_shipped_set():
         "reg-metric-unknown", "reg-metric-unused", "reg-doc-drift",
         "reg-flight-unknown", "reg-flight-unused",
         "slo-metric-unknown", "slo-decl-drift", "slo-doc-drift",
+        "control-knob-drift",
         "schema-pytree-drift", "schema-wire-drift",
         "schema-recording-drift",
         "docs-rule-table",

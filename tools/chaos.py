@@ -25,27 +25,25 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def run_host(plan, recorder=None):
+def run_host(plan, recorder=None, controlled: bool = False):
+    from serf_tpu.control.profiles import host_ab_profile
     from serf_tpu.faults.host import run_host_plan
 
+    opts, ccfg = host_ab_profile(plan.name, controlled)
     with tempfile.TemporaryDirectory(prefix="serf-chaos-") as td:
-        return asyncio.run(run_host_plan(plan, tmp_dir=td,
-                                         recorder=recorder))
+        return asyncio.run(run_host_plan(plan, tmp_dir=td, opts=opts,
+                                         recorder=recorder,
+                                         controller=controlled,
+                                         control_cfg=ccfg))
 
 
 def run_device(plan, n: int, k_facts: int, devices: int = 0,
-               recorder=None, collect_telemetry: bool = True):
+               recorder=None, collect_telemetry: bool = True,
+               controlled: bool = False):
+    from serf_tpu.control.profiles import device_ab_config
     from serf_tpu.faults.device import run_device_plan
-    from serf_tpu.models.dissemination import GossipConfig
-    from serf_tpu.models.failure import FailureConfig
-    from serf_tpu.models.swim import ClusterConfig
 
-    cfg = ClusterConfig(
-        gossip=GossipConfig(n=n, k_facts=k_facts,
-                            peer_sampling="rotation"),
-        failure=FailureConfig(suspicion_rounds=8, max_new_facts=8,
-                              probe_schedule="round_robin"),
-        push_pull_every=8)
+    cfg = device_ab_config(plan.name, n, k_facts, controlled)
     # sharded flagship path: 0 = auto (largest visible device count that
     # divides n — a single-device host simply runs unsharded), 1 = force
     # unsharded, >1 = exactly that many devices (fail loud rather than
@@ -101,6 +99,14 @@ def main() -> int:
                     action="store_false")
     ap.add_argument("--record-dir", default=".",
                     help="directory the failure recording is written to")
+    ap.add_argument("--controller", choices=("off", "on", "ab"),
+                    default="off",
+                    help="adaptive control plane (serf_tpu.control): "
+                         "'on' runs the plan with the controller "
+                         "actuating the knobs; 'ab' runs each plane "
+                         "twice — static vs controlled — and prints the "
+                         "SLO verdicts side by side (config profiles: "
+                         "serf_tpu/control/profiles.py)")
     args = ap.parse_args()
 
     from serf_tpu.faults.host import degradation_counters
@@ -145,51 +151,89 @@ def main() -> int:
     recordings = {}
     slo_verdicts = {}
     ring_summaries = {}
+    control_info = {}
+    ab = {}
     device_mesh = 1
-    for plane in planes:
-        recorder = make_recorder()
+    #: A/B mode runs each plane twice (static leg first); 'on' replaces
+    #: the single run with the controlled one
+    legs = {"off": (False,), "on": (True,), "ab": (False, True)}[
+        args.controller]
+
+    def run_leg(plane, controlled, recorder):
+        nonlocal device_mesh
         if plane == "host":
-            result = run_host(plan, recorder=recorder)
-            if result.load is not None:
-                overload["host"] = result.load.to_dict()
-            # SLO verdicts from THE shared definition table — judged
-            # beside (not instead of) the invariants.  getattr: the
-            # replay tests drive main() with stub results
-            slo_verdicts[plane] = slo.judge_host_run(result, plan)
-            series = getattr(result, "series", None)
-            if series is not None:
-                ring_summaries[plane] = series.summaries()
+            result = run_host(plan, recorder=recorder,
+                              controlled=controlled)
+            verdicts = slo.judge_host_run(result, plan)
         else:
             result, device_mesh = run_device(plan, args.n, args.k_facts,
                                              args.devices,
-                                             recorder=recorder)
-            notes.extend(result.notes)
-            if plan.has_load():
-                overload["device"] = {"offered": result.offered,
-                                      "dropped": result.dropped}
-            slo_verdicts[plane] = slo.judge_device_run(result, plan)
-            telemetry = getattr(result, "telemetry", None)
-            if telemetry is not None:
-                ring_summaries[plane] = telemetry.summaries()
-        reports.append(result.report)
-        # a red run writes its repro artifact (recording + digest
-        # stream); green runs keep nothing — the recorder was in-memory
-        if recorder is not None and not result.report.ok:
-            path = os.path.join(
-                args.record_dir,
-                f"chaos-{plan.name}-{plane}.replay.jsonl")
-            try:
-                recordings[plane] = recorder.save(path)
-            except OSError as e:
-                # the repro artifact is best-effort: a bad --record-dir
-                # must not eat the invariant report of exactly the red
-                # run it was meant to make debuggable
-                print(f"record-on-fail: could not write {path}: {e}",
-                      file=sys.stderr)
+                                             recorder=recorder,
+                                             controlled=controlled)
+            verdicts = slo.judge_device_run(result, plan)
+        return result, verdicts
+
+    for plane in planes:
+        for controlled in legs:
+            is_final = controlled == legs[-1]
+            recorder = make_recorder() if is_final else None
+            result, verdicts = run_leg(plane, controlled, recorder)
+            if args.controller == "ab":
+                ab.setdefault(plane, {})[
+                    "controlled" if controlled else "static"] = {
+                    "ok": result.report.ok and slo.all_ok(verdicts),
+                    "report": result.report.to_dict(),
+                    "slo": slo.verdicts_to_dict(verdicts),
+                    "breaches": [v.slo for v in verdicts if not v.ok],
+                }
+                if not args.json:
+                    print(_ab_header(plane, plan.name, controlled))
+                    print(result.report.format())
+                    print(slo.format_verdicts(verdicts, plane))
+                if not is_final:
+                    continue
+            if plane == "host":
+                if result.load is not None:
+                    overload["host"] = result.load.to_dict()
+                series = getattr(result, "series", None)
+                if series is not None:
+                    ring_summaries[plane] = series.summaries()
+                if getattr(result, "control", None) is not None:
+                    control_info[plane] = result.control
+            else:
+                notes.extend(result.notes)
+                if plan.has_load():
+                    overload["device"] = {"offered": result.offered,
+                                          "dropped": result.dropped}
+                telemetry = getattr(result, "telemetry", None)
+                if telemetry is not None:
+                    ring_summaries[plane] = telemetry.summaries()
+                if getattr(result, "control_final", None) is not None:
+                    control_info[plane] = {
+                        "final": result.control_final,
+                        "decisions": result.control_decisions,
+                    }
+            slo_verdicts[plane] = verdicts
+            reports.append(result.report)
+            # a red run writes its repro artifact (recording + digest
+            # stream); green runs keep nothing — the recorder stayed
+            # in-memory
+            if recorder is not None and not result.report.ok:
+                path = os.path.join(
+                    args.record_dir,
+                    f"chaos-{plan.name}-{plane}.replay.jsonl")
+                try:
+                    recordings[plane] = recorder.save(path)
+                except OSError as e:
+                    # the repro artifact is best-effort: a bad
+                    # --record-dir must not eat the invariant report of
+                    # exactly the red run it was meant to make debuggable
+                    print(f"record-on-fail: could not write {path}: {e}",
+                          file=sys.stderr)
 
     counters = degradation_counters()
     if args.json:
-        print(json.dumps({
+        out = {
             "plan": plan.name,
             "ok": all(r.ok for r in reports),
             "slo_ok": all(slo.all_ok(v) for v in slo_verdicts.values()),
@@ -202,12 +246,33 @@ def main() -> int:
             "overload": overload,
             "device_mesh_devices": device_mesh,
             "recordings": recordings,
-        }, indent=1, sort_keys=True))
+        }
+        if args.controller != "off":
+            out["controller"] = args.controller
+            out["control"] = control_info
+        if ab:
+            out["control_ab"] = ab
+        print(json.dumps(out, indent=1, sort_keys=True))
     else:
-        for r, plane in zip(reports, planes):
-            print(r.format())
-            if plane in slo_verdicts:
-                print(slo.format_verdicts(slo_verdicts[plane], plane))
+        if args.controller != "ab":
+            # (ab mode printed each leg inline above)
+            for r, plane in zip(reports, planes):
+                print(r.format())
+                if plane in slo_verdicts:
+                    print(slo.format_verdicts(slo_verdicts[plane], plane))
+        else:
+            for plane in planes:
+                st = ab[plane]["static"]
+                ct = ab[plane]["controlled"]
+                print(f"[{plane}] A/B: static "
+                      f"{'GREEN' if st['ok'] else 'BREACHED (' + ', '.join(st['breaches'] + [i['name'] for i in st['report']['invariants'] if not i['ok']]) + ')'}"
+                      f" -> controlled "
+                      f"{'GREEN' if ct['ok'] else 'STILL RED'}")
+        for plane, d in sorted(control_info.items()):
+            decs = d.get("decisions", [])
+            print(f"controller [{plane}]: {len(decs)} decision(s)"
+                  + (f", final {d['final']}" if "final" in d
+                     else f", values {d.get('values')}"))
         for plane, path in sorted(recordings.items()):
             print(f"repro recording [{plane}]: {path} "
                   "(replay with `python tools/replay.py replay <path>`)")
@@ -225,7 +290,17 @@ def main() -> int:
         print("degradation counters:")
         for name in sorted(counters):
             print(f"  {name} = {counters[name]:.0f}")
+    if args.controller == "ab":
+        # A/B verdict: the CONTROLLED legs must be all-green (invariants
+        # AND SLOs) — the static legs are allowed (expected, for the
+        # control-* plans) to breach
+        return 0 if all(ab[p]["controlled"]["ok"] for p in ab) else 1
     return 0 if all(r.ok for r in reports) else 1
+
+
+def _ab_header(plane: str, plan_name: str, controlled: bool) -> str:
+    leg = "CONTROLLED" if controlled else "STATIC"
+    return f"=== [{plane}] {plan_name}: {leg} leg " + "=" * 20
 
 
 if __name__ == "__main__":
