@@ -653,6 +653,63 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - the A/B is best-effort
         detail["control_ab_error"] = repr(e)[:300]
 
+    # host-plane headline (ISSUE 12): events/sec + queries/sec through a
+    # loopback cluster under the query-storm FaultPlan, with the message
+    # lifecycle ledger's per-stage latency decomposition — the hard
+    # before-numbers ROADMAP item 1's throughput rebuild must beat, and
+    # the BASELINE.json host bands guard them forever.  Rates are engine
+    # counter deltas (every node's accepted handlings) over the whole
+    # run wall clock, so they measure the full asyncio + codec pipeline
+    # under storm, not the offered-load constants.
+    try:
+        import asyncio
+
+        from serf_tpu.faults.host import (
+            _counter_total as _ctr,
+            run_host_plan,
+        )
+        from serf_tpu.faults.plan import named_plan
+        from serf_tpu.obs import slo as slo_mod
+
+        host_plan = named_plan("query-storm")
+        base_ev, base_q = _ctr("serf.events"), _ctr("serf.queries")
+        t0 = time.perf_counter()
+        host_result = asyncio.run(run_host_plan(host_plan))
+        host_elapsed = time.perf_counter() - t0
+        host_verdicts = slo_mod.judge_host_run(host_result, host_plan)
+        host_load = host_result.load
+        detail["host_plane"] = {
+            "plan": host_plan.name,
+            "n": host_plan.n,
+            "elapsed_s": round(host_elapsed, 2),
+            "events_per_sec": round(
+                (_ctr("serf.events") - base_ev) / host_elapsed, 1),
+            "queries_per_sec": round(
+                (_ctr("serf.queries") - base_q) / host_elapsed, 1),
+            "events_offered": host_load.events_offered,
+            "queries_offered": host_load.queries_offered,
+            "ingress_admitted": host_load.ingress_admitted,
+            "ingress_shed": host_load.ingress_shed,
+            "invariants_ok": host_result.report.ok,
+            "slo_ok": slo_mod.all_ok(host_verdicts),
+            "slo": slo_mod.verdicts_to_dict(host_verdicts),
+            "lifecycle": host_result.lifecycle,
+        }
+        lcs = host_result.lifecycle or {}
+        sys.stderr.write(
+            "host plane @%d nodes (query-storm): %.0f events/s + %.0f "
+            "queries/s handled; e2e p50 %.2f ms p99 %.2f ms, p99 owner "
+            "%s, attributed %.0f%%\n" % (
+                host_plan.n,
+                detail["host_plane"]["events_per_sec"],
+                detail["host_plane"]["queries_per_sec"],
+                lcs.get("e2e", {}).get("p50_ms", 0.0),
+                lcs.get("e2e", {}).get("p99_ms", 0.0),
+                lcs.get("owner_p99"),
+                100 * (lcs.get("attributed_frac") or 0.0)))
+    except Exception as e:  # noqa: BLE001 - never lose the headline to it
+        detail["host_plane_error"] = repr(e)[:300]
+
     # --- regression gate (ISSUE 10): score the headline numbers against
     # the committed BASELINE.json bands (per-platform dotted-path min/max
     # — format documented in README "Time series & SLOs").  WARN-ONLY by
@@ -681,6 +738,7 @@ def main() -> None:
 
     detail["platform"] = platform
     sys.stderr.write(json.dumps(detail) + "\n")
+    strict_rc = strict_gate_rc(gate)
     # Only ORCHESTRATED runs write the committed artifact: ad-hoc
     # `--run` smoke tests at small N kept clobbering the 1M
     # measured-of-record (twice in round 5) — the orchestrator sets the
@@ -695,9 +753,20 @@ def main() -> None:
             pass
     # strict mode exits nonzero on a band violation — AFTER the headline
     # was printed and the artifact written, so nothing is ever lost
+    if strict_rc:
+        sys.exit(strict_rc)
+
+
+def strict_gate_rc(gate) -> int:
+    """The ``--strict`` exit decision for a scored regression gate
+    (``obs.slo.score_bench`` output): 4 on a band violation when
+    SERF_TPU_BENCH_STRICT=1, else 0.  Factored out so the strict
+    contract is test-pinned (tests/test_lifecycle.py) without running
+    the full bench."""
     if (os.environ.get("SERF_TPU_BENCH_STRICT") == "1"
             and gate is not None and not gate["ok"]):
-        sys.exit(4)
+        return 4
+    return 0
 
 
 def probe() -> None:

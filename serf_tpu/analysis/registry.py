@@ -84,6 +84,7 @@ METRICS: tuple = (
     "serf.query.responses",
     "serf.query.rtt-ms",
     "serf.queue.<>",
+    "serf.queue.age.<>",
     "serf.queue.bytes.<>",
     "serf.snapshot.append_line",
     "serf.snapshot.compact",
@@ -156,6 +157,12 @@ METRICS: tuple = (
     "serf.slo.ok",
     "serf.slo.burn",
     "serf.slo.breach",
+    # message lifecycle ledger (obs/lifecycle.py)
+    "serf.lifecycle.messages",
+    "serf.lifecycle.sampled",
+    "serf.lifecycle.slow",
+    "serf.lifecycle.stage-ms",
+    "serf.lifecycle.e2e-ms",
     # adaptive control plane (serf_tpu/control)
     "serf.control.knob.<>",
     "serf.control.steps",
@@ -189,6 +196,7 @@ FLIGHT_KINDS: tuple = (
     "replay-recorded",
     "shard-fallback",
     "slo-breach",
+    "slow-message",
     "snapshot-torn-tail",
     "subscriber-drop",
     "swim-state",
@@ -202,9 +210,11 @@ FLIGHT_KINDS: tuple = (
 #: "Time series & SLOs" table carries one row per name
 #: (``slo-doc-drift``).
 SLOS: tuple = (
+    "apply-stage-p99",
     "convergence-settle",
     "false-dead",
     "query-p99",
+    "queue-wait-share",
     "shed-ratio",
     "sustained-rps-ceiling",
 )

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import asyncio
 import math
+import time
 from typing import Callable, Dict, List, Optional
 
 from serf_tpu.obs import flight
@@ -34,7 +35,8 @@ from serf_tpu.utils import metrics
 class Broadcast:
     """One queued message."""
 
-    __slots__ = ("msg", "name", "transmits", "notify", "_seq", "decoded")
+    __slots__ = ("msg", "name", "transmits", "notify", "_seq", "decoded",
+                 "enqueued_at")
 
     def __init__(self, msg: bytes, name: Optional[str] = None,
                  notify: Optional[asyncio.Event] = None):
@@ -43,6 +45,9 @@ class Broadcast:
         self.transmits = 0
         self.notify = notify
         self._seq = 0
+        #: monotonic enqueue time, stamped by queue_broadcast — feeds
+        #: the oldest-item age gauges (serf.queue.age.*)
+        self.enqueued_at = 0.0
         #: consumer-owned memo of the decoded message (``msg`` is
         #: immutable, so decoding once is enough — the reaper's pending-
         #: leave index uses this to stop re-decoding every queued intent
@@ -111,6 +116,16 @@ class TransmitLimitedQueue:
         """Total payload bytes currently queued."""
         return self._bytes
 
+    def oldest_age(self, now: Optional[float] = None) -> float:
+        """Age (seconds) of the oldest still-queued broadcast; 0.0 when
+        empty.  O(depth) scan, called on the periodic monitor tick only
+        (depth is bounded by the QueueChecker prune / byte budget)."""
+        if not self._items:
+            return 0.0
+        if now is None:
+            now = time.monotonic()
+        return max(0.0, now - min(b.enqueued_at for b in self._items))
+
     def _gauge_depth(self) -> None:
         if self.name is not None:
             metrics.gauge(f"serf.queue.{self.name}", len(self._items),
@@ -130,6 +145,7 @@ class TransmitLimitedQueue:
                 old.finished()
         self._seq += 1
         b._seq = self._seq
+        b.enqueued_at = time.monotonic()
         self._items.append(b)
         self._bytes += len(b.msg)
         self.mutations += 1

@@ -32,7 +32,7 @@ from serf_tpu.host.keyring import KeyringError, SecretKeyring
 from serf_tpu.host.messages import SwimState
 from serf_tpu.host.transport import Transport
 from serf_tpu.host import wire
-from serf_tpu.obs import flight
+from serf_tpu.obs import flight, lifecycle
 from serf_tpu.obs.trace import span
 from serf_tpu.options import MemberlistOptions
 from serf_tpu.types.member import Node
@@ -198,6 +198,9 @@ class Memberlist:
                        if opts.peer_send_rate > 0 else None)
         self._leaving = False
         self._shutdown = False
+        #: receive timestamp of the packet currently being handled
+        #: (lifecycle ledger `transport` stage anchor)
+        self._pkt_t0 = time.monotonic()
         self._tasks: List[asyncio.Task] = []
         self._bg: set = set()  # dynamic tasks (suspicion timers, stream serves)
         self._started = False
@@ -473,6 +476,12 @@ class Memberlist:
                 src, raw = await self.transport.recv_packet()
             except ConnectionError:
                 return
+            # lifecycle ledger: remember when THIS packet hit the host,
+            # so a sampled serf message it carries can attribute wire
+            # decode + SWIM decode to its `transport` stage.  Kept per
+            # memberlist (not on the shared ledger) because co-located
+            # loopback nodes interleave packet loops at await points.
+            self._pkt_t0 = time.monotonic()
             buf = self._decode_wire(raw)
             if buf is None:
                 continue
@@ -510,6 +519,9 @@ class Memberlist:
         elif isinstance(m, sm.Dead):
             self._handle_dead(m)
         elif isinstance(m, sm.UserMsg):
+            # note the packet timestamp right before the synchronous
+            # serf dispatch chain consumes it (no awaits in between)
+            lifecycle.global_ledger().note_packet(self._pkt_t0)
             self.delegate.notify_message(m.payload)
         else:
             log.debug("unhandled packet-plane message %s", type(m).__name__)
@@ -1018,6 +1030,11 @@ class Memberlist:
                 await stream.send_frame(self._encode_wire(sm.encode_swim(out)))
                 self._merge_remote(msg, msg.join, verified=True)
             elif isinstance(msg, sm.UserMsg):
+                # stream-delivered serf message: the frame was received
+                # + decoded just above — note that as the transport
+                # anchor (begin() consumes the note, so a stale packet
+                # timestamp can never backdate this message's clock)
+                lifecycle.global_ledger().note_packet(time.monotonic())
                 self.delegate.notify_message(msg.payload)
         except VersionError as e:
             log.warning("refusing push/pull from %r: %s", src, e)

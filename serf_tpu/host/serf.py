@@ -19,8 +19,9 @@ import asyncio
 import enum
 import random
 import time
+from collections import deque
 from dataclasses import dataclass, field as dataclass_field, replace
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Deque, Dict, List, Optional, Sequence, Set, Tuple
 
 from serf_tpu import codec
 from serf_tpu.host.admission import (
@@ -82,6 +83,7 @@ from serf_tpu.types.messages import (
 )
 from serf_tpu.types.tags import Tags
 from serf_tpu import obs
+from serf_tpu.obs import lifecycle
 from serf_tpu.obs.health import HealthReport, HealthScorer, serf_sources
 from serf_tpu.obs.trace import new_trace, span, trace_scope
 from serf_tpu.utils import metrics
@@ -169,12 +171,25 @@ class _SerfSwimDelegate(SwimDelegate):
         if s is None or s.state == SerfState.SHUTDOWN:
             return
         metrics.observe("serf.messages.received", len(raw), s._labels)
+        # lifecycle ledger (obs.lifecycle): begin the per-message stage
+        # clock at the transport seam — the memberlist packet loop noted
+        # the packet's receive timestamp, so wire+SWIM decode land in
+        # the `transport` stage and decode_message in `decode`
+        led = lifecycle.global_ledger()
+        clk = led.begin("remote")
         try:
             msg = decode_message(raw)
         except codec.DecodeError as e:
+            led.discard_current()
             log.debug("undecodable serf message: %s", e)
             return
-        s._dispatch(msg, raw)
+        if clk is not None:
+            clk.kind = type(msg).__name__
+            clk.stamp("decode")
+        try:
+            s._dispatch(msg, raw)
+        finally:
+            led.finish_current()
 
     def broadcast_messages(self, overhead: int, limit: int) -> List[bytes]:
         s = self.serf
@@ -439,6 +454,15 @@ class Serf:
         self.snapshotter = None  # wired by serf_tpu.host.snapshot
         self._key_manager = None
 
+        # queue-age tracking (obs.lifecycle satellite): enqueue
+        # timestamps parallel to the event inbox / tee queue, pushed and
+        # popped at exactly the enqueue/dequeue sites, so the monitor
+        # tick can gauge the OLDEST item's age (`serf.queue.age.*`) —
+        # the backpressure signal the ledger's queue-wait numbers
+        # should corroborate
+        self._inbox_enq: Deque[float] = deque()
+        self._tee_enq: Deque[float] = deque()
+
         # health plane (obs.health): sources read engine state lazily
         self._tee_queue: Optional[asyncio.Queue] = None
         self._loop_lag_ewma_ms = 0.0
@@ -573,9 +597,15 @@ class Serf:
         async def tee() -> None:
             while True:
                 ev = await self._event_inbox.get()
-                if ev is not None and self.snapshotter is not None:
-                    self.snapshotter.observe(ev)
+                if ev is not None:
+                    if self._inbox_enq:
+                        self._inbox_enq.popleft()
+                    lifecycle.global_ledger().event_stamp(ev, "queue-wait")
+                    if self.snapshotter is not None:
+                        self.snapshotter.observe(ev)
                 await mid.put(ev)
+                if ev is not None:
+                    self._tee_enq.append(time.monotonic())
                 metrics.gauge("serf.events.tee_depth",
                               mid.qsize() + self._event_inbox.qsize(),
                               gauge_labels)
@@ -591,7 +621,13 @@ class Serf:
                               gauge_labels)
                 if ev is None:
                     return
+                if self._tee_enq:
+                    self._tee_enq.popleft()
                 await self._subscriber.push(ev)
+                # delivery complete: everything since the inbox dequeue
+                # (snapshotter tee, mid-queue hop, subscriber push) is
+                # the pipeline's service time
+                lifecycle.global_ledger().event_finish(ev, "tee")
         finally:
             t.cancel()
 
@@ -600,8 +636,14 @@ class Serf:
             ev = await self._event_inbox.get()
             if ev is None:
                 return
+            if self._inbox_enq:
+                self._inbox_enq.popleft()
+            led = lifecycle.global_ledger()
+            led.event_stamp(ev, "queue-wait")
             if self.snapshotter is not None:
                 self.snapshotter.observe(ev)
+            # no subscriber: the message's life ends here (no tee stage)
+            led.event_finish(ev)
 
     async def _coalesce_pipeline(self, member_c, user_c) -> None:
         """Chain: inbox -> member coalescer -> user coalescer -> subscriber
@@ -615,8 +657,17 @@ class Serf:
         async def tee() -> None:
             while True:
                 ev = await self._event_inbox.get()
-                if self.snapshotter is not None and ev is not None:
-                    self.snapshotter.observe(ev)
+                if ev is not None:
+                    if self._inbox_enq:
+                        self._inbox_enq.popleft()
+                    # coalescers may merge/suppress the event downstream:
+                    # the sampled clock finishes at the queue-wait hop
+                    # (tee service time is unmeasured in coalesce mode)
+                    led = lifecycle.global_ledger()
+                    led.event_stamp(ev, "queue-wait")
+                    led.event_finish(ev)
+                    if self.snapshotter is not None:
+                        self.snapshotter.observe(ev)
                 await mid.put(ev)
                 if ev is None:
                     return
@@ -667,16 +718,22 @@ class Serf:
         sacrifices them, and the snapshotter (fed from this pipeline)
         must not miss an alive-set change."""
         cap = self.opts.event_inbox_max
+        led = lifecycle.global_ledger()
         if (cap > 0 and ev is not None and not isinstance(ev, MemberEvent)
                 and self._event_inbox.qsize() >= cap):
             kind = type(ev).__name__
             self._events_shed += 1
+            led.attach_current(ev, shed=True)
             metrics.incr("serf.overload.event_shed", 1,
                          {**self._labels, "event": kind})
             obs.record("event-shed", node=self.local_id, event=kind,
                        inbox=self._event_inbox.qsize())
             return
+        if ev is not None:
+            led.attach_current(ev)
         self._event_inbox.put_nowait(ev)
+        if ev is not None:
+            self._inbox_enq.append(time.monotonic())
 
     # ------------------------------------------------------------------
     # public API (reference api.rs)
@@ -790,10 +847,29 @@ class Serf:
                                       + 0.2 * lag_ms)
             metrics.gauge("serf.loop.lag-ms", self._loop_lag_ewma_ms,
                           {**self._labels, "node": self.local_id})
+            self._gauge_queue_ages()
             try:
                 self.health_report(consume=True)
             except Exception:  # noqa: BLE001
                 log.exception("health monitor tick failed")
+
+    def _gauge_queue_ages(self) -> None:
+        """Oldest-item age gauges for every bounded queue (sampled on
+        the monitor tick): the three broadcast queues plus the event
+        inbox and the tee queue.  A growing age with flat depth means a
+        stuck consumer, not a burst — the signal the lifecycle ledger's
+        queue-wait stage should corroborate."""
+        now = time.monotonic()
+        labels = {**self._labels, "node": self.local_id}
+        ages = {
+            "intent": self.intent_broadcasts.oldest_age(now),
+            "event": self.event_broadcasts.oldest_age(now),
+            "query": self.query_broadcasts.oldest_age(now),
+            "inbox": (now - self._inbox_enq[0]) if self._inbox_enq else 0.0,
+            "tee": (now - self._tee_enq[0]) if self._tee_enq else 0.0,
+        }
+        for qname, age in ages.items():
+            metrics.gauge(f"serf.queue.age.{qname}", age, labels)
 
     def coordinate(self) -> Optional[Coordinate]:
         return self.coord_client.get_coordinate() if self.coord_client else None
@@ -962,10 +1038,16 @@ class Serf:
             raise ValueError(
                 f"encoded user event exceeds sane limit of {USER_EVENT_SIZE_LIMIT} bytes")
         # metrics are counted once, inside the handler (reference base.rs:818)
-        with trace_scope(tctx), span("serf.user-event", node=self.local_id,
-                                     event=name, bytes=len(raw)):
-            self._handle_user_event(msg, rebroadcast=False)
-            self._queue(self.event_broadcasts, raw)
+        led = lifecycle.global_ledger()
+        led.begin("local", kind="UserEventMessage")
+        try:
+            with trace_scope(tctx), span("serf.user-event",
+                                         node=self.local_id,
+                                         event=name, bytes=len(raw)):
+                self._handle_user_event(msg, rebroadcast=False)
+                self._queue(self.event_broadcasts, raw)
+        finally:
+            led.finish_current()
 
     # -- queries ------------------------------------------------------------
 
@@ -1019,10 +1101,15 @@ class Serf:
         resp = QueryResponse(ltime, qid, timeout, params.request_ack,
                              len(self._members))
         self._admit_query_response((ltime, qid), resp)
-        with trace_scope(tctx), span("serf.query", node=self.local_id,
-                                     query=name, bytes=len(raw)):
-            self._handle_query(msg, rebroadcast=False)
-            self._queue(self.query_broadcasts, raw)
+        led = lifecycle.global_ledger()
+        led.begin("local", kind="QueryMessage")
+        try:
+            with trace_scope(tctx), span("serf.query", node=self.local_id,
+                                         query=name, bytes=len(raw)):
+                self._handle_query(msg, rebroadcast=False)
+                self._queue(self.query_broadcasts, raw)
+        finally:
+            led.finish_current()
         return resp
 
     def _admit_query_response(self, key, resp: QueryResponse) -> None:
@@ -1090,6 +1177,10 @@ class Serf:
     # ------------------------------------------------------------------
 
     def _dispatch(self, msg, raw: bytes) -> None:
+        # stage clock: decode -> here is the `dispatch` hop; the handler
+        # body through to the inbox enqueue is `apply` (stamped by
+        # _emit / finish_current)
+        lifecycle.global_ledger().stamp_current("dispatch")
         if isinstance(msg, LeaveMessage):
             if self._handle_node_leave_intent(msg):
                 self._queue(self.intent_broadcasts, raw)

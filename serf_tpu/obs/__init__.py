@@ -38,6 +38,11 @@ package is that surface for the reproduction, spanning BOTH planes:
   table evaluated on both planes (multi-window burn rates, EWMA/MAD
   anomaly flags, ``slo-breach`` flight events, ``serf.slo.*`` gauges)
   plus the bench regression gate (``score_bench``).
+- :mod:`serf_tpu.obs.lifecycle` — the message lifecycle ledger: sampled
+  (1-in-N) per-message stage clocks decomposing the host hot path
+  (transport → decode → dispatch → apply → queue-wait → tee), with
+  always-on cheap counters, per-stage latency histograms, a
+  critical-path attribution table, and ``slow-message`` flight events.
 
 Everything is process-global with swap-out setters, mirroring the
 ``metrics`` facade already in place.
@@ -102,6 +107,14 @@ from serf_tpu.obs.slo import (  # noqa: F401
     score_bench,
     slo_names,
 )
+from serf_tpu.obs.lifecycle import (  # noqa: F401
+    STAGES as LIFECYCLE_STAGES,
+    LifecycleLedger,
+    StageClock,
+    format_waterfall,
+    global_ledger,
+    set_global_ledger,
+)
 
 __all__ = [
     "Span", "TraceBuffer", "span", "trace_dump",
@@ -120,4 +133,6 @@ __all__ = [
     "telemetry_to_store",
     "SLO_TABLE", "SLODef", "SLOVerdict", "judge_host_run",
     "judge_device_run", "score_bench", "slo_names",
+    "LIFECYCLE_STAGES", "LifecycleLedger", "StageClock",
+    "format_waterfall", "global_ledger", "set_global_ledger",
 ]

@@ -31,6 +31,9 @@ Event kinds emitted by the engine (see README "Observability"):
 - ``replay-recorded``  a record/replay recording artifact was written
 - ``replay-divergence`` the replay differ found two digest streams apart
 - ``slo-breach``       an SLO verdict came back out of objective (obs/slo)
+- ``slow-message``     a lifecycle-sampled message exceeded the slow
+  threshold — the event carries the full per-stage breakdown
+  (obs/lifecycle)
 
 Events recorded while a cross-node trace is active (``obs.trace
 .trace_scope``) carry a ``trace`` field — the hex trace id shared by

@@ -113,6 +113,23 @@ SLO_TABLE: Tuple[SLODef, ...] = (
         description="measured sustained rounds/sec never exceeds the "
                     "analytic bandwidth ceiling — a number past physics "
                     "is a measurement bug, not a win"),
+    # stage-latency SLOs (obs/lifecycle.py ledger — host hot path)
+    SLODef(
+        name="apply-stage-p99",
+        metrics=("serf.lifecycle.stage-ms",),
+        planes=("host",),
+        better="lower", objective=50.0, unit="ms",
+        description="p99 of the event-apply stage over sampled messages "
+                    "(the serial-application budget ROADMAP item 1's "
+                    "parallel-apply rebuild must beat)"),
+    SLODef(
+        name="queue-wait-share",
+        metrics=("serf.lifecycle.stage-ms", "serf.lifecycle.e2e-ms"),
+        planes=("host",),
+        better="lower", objective=0.8, unit="fraction of e2e",
+        description="queue-wait's share of sampled end-to-end message "
+                    "latency — backpressure must not dominate the host "
+                    "hot path"),
 )
 
 
@@ -346,7 +363,41 @@ def judge_host_run(result, plan, emit: bool = True) -> List[SLOVerdict]:
                            "offered", emit=emit))
         elif d.name == "query-p99":
             out.append(judge(d, "host", _host_query_p99(), emit=emit))
+        elif d.name == "apply-stage-p99":
+            lc = getattr(result, "lifecycle", None)
+            apply_row = _lifecycle_stage(lc, "apply")
+            if apply_row is None:
+                out.append(judge(d, "host", None,
+                                 detail="no sampled messages", emit=emit))
+            else:
+                out.append(judge(
+                    d, "host", apply_row["p99_ms"],
+                    detail=f"over {apply_row['count']} sampled "
+                           "message(s)", emit=emit))
+        elif d.name == "queue-wait-share":
+            lc = getattr(result, "lifecycle", None)
+            share = (lc or {}).get("queue_wait_share")
+            if share is None:
+                out.append(judge(d, "host", None,
+                                 detail="no sampled messages", emit=emit))
+            else:
+                out.append(judge(
+                    d, "host", share,
+                    detail=f"queue-wait owns {share:.0%} of sampled "
+                           "e2e latency", emit=emit))
     return out
+
+
+def _lifecycle_stage(lc, stage: str):
+    """The named stage's row from a lifecycle ledger snapshot
+    (``obs.lifecycle.LifecycleLedger.snapshot()``); None when the run
+    carried no snapshot or the stage was never stamped."""
+    if not lc:
+        return None
+    for row in lc.get("stages", ()):
+        if row.get("stage") == stage and row.get("count"):
+            return row
+    return None
 
 
 def _series_of(result, name: str) -> Optional[TimeSeries]:

@@ -329,6 +329,19 @@ def test_sharded_parity_8_devices(n, rounds):
     assert bool(jnp.allclose(s1.vivaldi.vec, s8.vivaldi.vec, atol=1e-6))
 
 
+def test_graft_entry_contract_fast():
+    """entry()'s contract without paying the 16k-node compile: abstract
+    tracing (eval_shape) type-checks the whole round and the output
+    pytree — the full compile + multichip dryrun runs under -m slow."""
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.eval_shape(fn, *args)
+    assert out.gossip.round.shape == ()
+    assert out.gossip.known.shape[0] == args[0].gossip.known.shape[0]
+
+
+@pytest.mark.slow
 def test_graft_entry_smoke():
     import __graft_entry__ as g
 

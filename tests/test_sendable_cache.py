@@ -11,6 +11,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from serf_tpu.models.dissemination import (
     GossipConfig,
@@ -37,22 +38,22 @@ def _gossip_equal(a, b):
                             == getattr(b.facts, name))), f"facts.{name}"
 
 
-def _cluster_cfg(cache: bool) -> ClusterConfig:
+def _cluster_cfg(cache: bool, n: int = 2048) -> ClusterConfig:
     # k_facts=64: at n=2048 the transmit limit is 16 rounds, and
     # sustained_round's fact-lifetime headroom check (ADVICE r5) requires
     # k_facts/events_per_round > transmit_limit
     return ClusterConfig(
-        gossip=GossipConfig(n=2048, k_facts=64, peer_sampling="rotation",
+        gossip=GossipConfig(n=n, k_facts=64, peer_sampling="rotation",
                             use_sendable_cache=cache),
         failure=FailureConfig(suspicion_rounds=8, max_new_facts=8,
                               probe_schedule="round_robin"),
         push_pull_every=8, probe_every=5)
 
 
-def test_sustained_flagship_bit_exact_cache_on_off():
-    """Three sustained scan segments with external churn + injections
-    between them: the full gossip state must match bit-for-bit."""
-    cfgs = {c: _cluster_cfg(c) for c in (True, False)}
+def _drive_cache_on_off(n: int, segments: int, rounds: int) -> None:
+    """Sustained scan segments with external churn + injections between
+    them: the full gossip state must match bit-for-bit, cache on vs off."""
+    cfgs = {c: _cluster_cfg(c, n=n) for c in (True, False)}
     runs = {c: jax.jit(functools.partial(run_cluster_sustained, cfg=cfg,
                                          events_per_round=2),
                        static_argnames=("num_rounds",))
@@ -60,23 +61,23 @@ def test_sustained_flagship_bit_exact_cache_on_off():
     states = {c: make_cluster(cfg, jax.random.key(0))
               for c, cfg in cfgs.items()}
 
-    for seg in range(3):
+    for seg in range(segments):
         for c in (True, False):
             states[c] = runs[c](states[c], key=jax.random.key(10 + seg),
-                                num_rounds=30)
+                                num_rounds=rounds)
         _gossip_equal(states[True].gossip, states[False].gossip)
         # external churn: kill a few nodes, revive one — alive is not
         # folded into the cache, so this must not desync anything
         for c in (True, False):
             g = states[c].gossip
             g = g._replace(alive=g.alive.at[
-                jnp.asarray([7 + seg, 300 + seg])].set(False))
+                jnp.asarray([7 + seg, (n // 7) + seg])].set(False))
             g = g._replace(alive=g.alive.at[5].set(True))
             # out-of-band injection (the host plane can inject between
             # scan segments): preserves cache validity by construction
             g = inject_facts_batch(
                 g, cfgs[c].gossip,
-                subjects=jnp.asarray([1000 + seg], jnp.int32),
+                subjects=jnp.asarray([(n // 2) + seg], jnp.int32),
                 kind=K_USER_EVENT,
                 incarnations=jnp.zeros((1,), jnp.uint32),
                 ltimes=jnp.asarray([900 + seg], jnp.uint32),
@@ -85,6 +86,17 @@ def test_sustained_flagship_bit_exact_cache_on_off():
             states[c] = states[c]._replace(gossip=g)
 
     _gossip_equal(states[True].gossip, states[False].gossip)
+
+
+def test_sustained_bit_exact_cache_on_off_fast():
+    """Tier-1 pin at small N (same drive, compile-bound cost shrunk);
+    the flagship-scale 2048x3x30 soak runs under -m slow."""
+    _drive_cache_on_off(n=256, segments=2, rounds=12)
+
+
+@pytest.mark.slow
+def test_sustained_flagship_bit_exact_cache_on_off():
+    _drive_cache_on_off(n=2048, segments=3, rounds=30)
 
 
 def test_swim_only_bit_exact_cache_on_off():

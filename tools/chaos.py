@@ -152,6 +152,7 @@ def main() -> int:
     slo_verdicts = {}
     ring_summaries = {}
     control_info = {}
+    lifecycle_info = {}
     ab = {}
     device_mesh = 1
     #: A/B mode runs each plane twice (static leg first); 'on' replaces
@@ -195,6 +196,9 @@ def main() -> int:
             if plane == "host":
                 if result.load is not None:
                     overload["host"] = result.load.to_dict()
+                lc = getattr(result, "lifecycle", None)
+                if lc is not None:
+                    lifecycle_info[plane] = lc
                 series = getattr(result, "series", None)
                 if series is not None:
                     ring_summaries[plane] = series.summaries()
@@ -244,6 +248,7 @@ def main() -> int:
             "degradation_counters": counters,
             "lowering_notes": notes,
             "overload": overload,
+            "lifecycle": lifecycle_info,
             "device_mesh_devices": device_mesh,
             "recordings": recordings,
         }
@@ -287,6 +292,16 @@ def main() -> int:
             for plane, data in sorted(overload.items()):
                 row = ", ".join(f"{k}={v}" for k, v in sorted(data.items()))
                 print(f"  [{plane}] {row}")
+        if lifecycle_info:
+            # the per-stage latency decomposition of the host hot path
+            # (obs/lifecycle.py), printed beside the invariant and SLO
+            # verdicts it contextualizes
+            from serf_tpu.obs.lifecycle import format_waterfall
+            for plane, lc in sorted(lifecycle_info.items()):
+                print(f"[{plane}] {format_waterfall(lc)}")
+                if lc.get("slow"):
+                    print(f"  slow-message flight events: {lc['slow']} "
+                          f"(> {lc['slow_ms']:g} ms e2e)")
         print("degradation counters:")
         for name in sorted(counters):
             print(f"  {name} = {counters[name]:.0f}")

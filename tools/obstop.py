@@ -20,8 +20,11 @@ fold drops fields — this exits non-zero.
 scatter to the continuous-telemetry plane: a ``MetricsSampler`` ticks
 at the interval and each refresh renders the ring series — last value
 plus a sparkline of the last-W deltas per aggregate — so rates and
-trends are visible, not just levels.  ``--iterations`` bounds the demo
-(default 3; a live embedding would loop forever).
+trends are visible, not just levels — followed by the message-lifecycle
+stage waterfall (``obs/lifecycle.py``: per-stage latency bars,
+transport/decode/dispatch/apply/queue-wait/tee, over sampled
+messages).  ``--iterations`` bounds the demo (default 3; a live
+embedding would loop forever).
 
 Embedding against a live cluster is one call on any node:
 ``snap = await serf.cluster_stats()``; ``obs.render_table(snap)``.
@@ -119,7 +122,11 @@ async def _watch(n: int, interval: float, iterations: int,
     """Periodic refresh off the sampler rings (not a cluster_stats
     scatter per tick): the cluster runs, the sampler snapshots the sink
     + flight recorder each interval, and every refresh renders last-W
-    deltas per series."""
+    deltas per series plus the message-lifecycle stage waterfall
+    (obs/lifecycle.py: per-stage latency bars over sampled messages —
+    the demo fires one user event per refresh so the ledger has
+    traffic to decompose)."""
+    from serf_tpu.obs import lifecycle
     from serf_tpu.obs.timeseries import MetricsSampler
 
     if as_json and iterations <= 0:
@@ -130,26 +137,41 @@ async def _watch(n: int, interval: float, iterations: int,
               file=sys.stderr)
         return 2
 
+    # sample every message: a three-node demo has little traffic, and
+    # the waterfall should render from the first refresh
+    led = lifecycle.LifecycleLedger(sample_n=1)
+    prev_led = lifecycle.set_global_ledger(led)
     _net, nodes = await _demo_cluster(n)
     sampler = MetricsSampler(interval_s=interval)
     try:
         i = 0
         while iterations <= 0 or i < iterations:
+            try:
+                await nodes[0].user_event(f"obstop-watch-{i}", b"",
+                                          coalesce=False)
+            except Exception:  # noqa: BLE001 - demo traffic, best-effort
+                pass
             await asyncio.sleep(interval)
             sampler.sample()
             i += 1
             if not as_json:
                 print(_render_rings(sampler.store, i))
+                print(lifecycle.format_waterfall(led.snapshot()))
         if as_json:
             print(json.dumps({
                 "ticks": sampler.ticks,
                 "series": sampler.store.names(),
                 "tail": sampler.store.tail(last=tail),
+                "lifecycle": led.snapshot(),
             }, indent=1, sort_keys=True))
         return 0 if sampler.ticks > 0 and len(sampler.store) > 0 else 1
     finally:
+        # teardown first, restore after: shutdown traffic must land on
+        # the demo's scoped ledger, not leak onto the restored one
+        # (same ordering rule as run_host_plan)
         for s in nodes:
             await s.shutdown()
+        lifecycle.set_global_ledger(prev_led)
 
 
 def main(argv=None) -> int:

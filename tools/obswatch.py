@@ -95,8 +95,10 @@ def run_device_leg(n: int, degraded: bool):
 
 
 def run_host_leg():
-    """Run the host self-check chaos plan (sampler rings ride along);
-    returns (verdict list, ring store)."""
+    """Run the host self-check chaos plan (sampler rings + the message
+    lifecycle ledger ride along); returns (verdict list — including the
+    stage-latency rows judged from the ledger snapshot —, ring store,
+    lifecycle snapshot)."""
     from serf_tpu.faults.host import run_host_plan
     from serf_tpu.faults.plan import named_plan
     from serf_tpu.obs import slo
@@ -104,7 +106,8 @@ def run_host_leg():
     plan = named_plan("self-check")
     with tempfile.TemporaryDirectory(prefix="serf-obswatch-") as td:
         result = asyncio.run(run_host_plan(plan, tmp_dir=td))
-    return slo.judge_host_run(result, plan), result.series
+    return (slo.judge_host_run(result, plan), result.series,
+            result.lifecycle)
 
 
 def main(argv=None) -> int:
@@ -134,8 +137,9 @@ def main(argv=None) -> int:
     verdicts["device"] = dev_verdicts
     if dev_store is not None:
         rings["device"] = dev_store
+    lifecycle_snap = None
     if not args.device_only and not args.degraded:
-        host_verdicts, host_store = run_host_leg()
+        host_verdicts, host_store, lifecycle_snap = run_host_leg()
         verdicts["host"] = host_verdicts
         if host_store is not None:
             rings["host"] = host_store
@@ -152,10 +156,14 @@ def main(argv=None) -> int:
             "slo_breach_events": breaches,
             "rings": {p: s.tail(last=args.tail)
                       for p, s in sorted(rings.items())},
+            "lifecycle": lifecycle_snap,
         }, indent=1, sort_keys=True))
     else:
         for plane in sorted(verdicts):
             print(slo.format_verdicts(verdicts[plane], plane))
+        if lifecycle_snap is not None:
+            from serf_tpu.obs.lifecycle import format_waterfall
+            print(format_waterfall(lifecycle_snap))
         print(f"device: {rps:.1f} measured rounds/s vs analytic "
               f"ceiling {ceiling:.1f}")
         if breaches:
