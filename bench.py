@@ -694,6 +694,30 @@ def main() -> None:
             "slo_ok": slo_mod.all_ok(host_verdicts),
             "slo": slo_mod.verdicts_to_dict(host_verdicts),
             "lifecycle": host_result.lifecycle,
+            # HISTORICAL captures, not measured by this run: the PR-12
+            # box's pre-rebuild numbers and the rebuild box's own
+            # same-box before (2026-08-04) — kept beside every fresh
+            # decomposition so the before/after story travels with the
+            # artifact, explicitly labeled so a future box's run can't
+            # be misread as having re-measured them
+            "before_captures": {
+                "_doc": "historical pre-rebuild captures; NOT measured "
+                        "by this bench run",
+                "pr12_capture": {
+                    "events_per_sec": [182, 249],
+                    "queries_per_sec": [89, 125],
+                    "queue_wait_share": 0.42,
+                    "queue_wait_p99_ms": 100.0,
+                    "owner_p99": "queue-wait",
+                },
+                "rebuild_box_2026-08-04": {
+                    "events_per_sec": 71.3,
+                    "queries_per_sec": 23.8,
+                    "events_offered": 36,    # the load gen itself was
+                    "queries_offered": 12,   # starved by the old seam
+                    "tee_p99_ms": 1243.0,
+                },
+            },
         }
         lcs = host_result.lifecycle or {}
         sys.stderr.write(

@@ -221,3 +221,68 @@ def _unlocked_mutations(method: ast.AsyncFunctionDef,
 
     visit(method, False)
     return out
+
+
+# ---------------------------------------------------------------------------
+# host event-pipeline seam (host-plane throughput rebuild)
+# ---------------------------------------------------------------------------
+
+#: host modules that legitimately OWN an asyncio queue seam: the MPMC
+#: pipeline itself, the subscriber channel, the query response streams,
+#: and the transport planes.  Everything else in serf_tpu/host must
+#: hand events through ``EventPipeline.offer`` — a fresh queue or a
+#: direct put is exactly the serial side-channel the rebuild removed.
+_PIPELINE_OWNERS = frozenset({
+    "pipeline.py", "events.py", "query.py",
+    "transport.py", "net.py", "dstream.py",
+})
+
+_QUEUE_CTORS = frozenset({
+    "asyncio.Queue", "Queue", "asyncio.PriorityQueue",
+    "asyncio.LifoQueue",
+})
+
+#: EventPipeline internals no caller may reach through (`x._pipeline.
+#: _ready` etc.) — the offer()/depth() surface is the API
+_PIPELINE_INTERNALS = frozenset({"_chains", "_ready", "_wake", "_inflight"})
+
+
+def _in_guarded_host_module(src: SourceFile) -> bool:
+    return src.rel.startswith("serf_tpu/host/") \
+        and src.rel.rsplit("/", 1)[-1] not in _PIPELINE_OWNERS
+
+
+@rule("pipeline-bypass",
+      "manual `asyncio.Queue` construction or direct `put_nowait`/`put` "
+      "in a host module that doesn't own a queue seam, or a reach into "
+      "`_pipeline` internals — events must go through "
+      "`EventPipeline.offer`",
+      "self.inbox = asyncio.Queue()\nself.inbox.put_nowait(ev)")
+def check_pipeline_bypass(src: SourceFile,
+                          project: Project) -> Iterable[Finding]:
+    guarded = _in_guarded_host_module(src)
+    for node in ast.walk(src.tree):
+        if guarded and isinstance(node, ast.Call) \
+                and call_name(node.func) in _QUEUE_CTORS:
+            yield finding(
+                "pipeline-bypass", src, node,
+                "manual queue construction outside the queue-owning "
+                "modules — hand events to the MPMC pipeline "
+                "(EventPipeline.offer) instead of a side-channel queue")
+        elif guarded and isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("put_nowait", "put"):
+            yield finding(
+                "pipeline-bypass", src, node,
+                f"direct `{node.func.attr}` bypasses the MPMC hand-off "
+                "API — use EventPipeline.offer (bounded, dependency-"
+                "keyed, shed-accounted)")
+        elif isinstance(node, ast.Attribute) \
+                and node.attr in _PIPELINE_INTERNALS \
+                and isinstance(node.value, ast.Attribute) \
+                and node.value.attr == "_pipeline" \
+                and src.rel.rsplit("/", 1)[-1] != "pipeline.py":
+            yield finding(
+                "pipeline-bypass", src, node,
+                f"reach into pipeline internals (`._pipeline.{node.attr}`)"
+                " — offer()/depth()/oldest_age() are the API surface")

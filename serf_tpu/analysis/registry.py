@@ -62,6 +62,11 @@ METRICS: tuple = (
     "serf.degraded.dial_retry",
     "serf.degraded.join_retry",
     "serf.degraded.pushpull_skipped",
+    # batched codec (host-plane throughput rebuild)
+    "serf.codec.batch",
+    "serf.codec.batch-messages",
+    "serf.codec.decode-cache-hit",
+    "serf.codec.decode-cache-miss",
     "serf.events",
     "serf.events.<>",
     "serf.events.tee_depth",
@@ -78,6 +83,10 @@ METRICS: tuple = (
     "serf.messages.sent",
     "serf.queries",
     "serf.queries.<>",
+    # MPMC event pipeline (host/pipeline.py)
+    "serf.pipeline.depth",
+    "serf.pipeline.keys",
+    "serf.pipeline.batch",
     "serf.query.acks",
     "serf.query.duplicate_acks",
     "serf.query.duplicate_responses",

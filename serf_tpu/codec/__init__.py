@@ -99,6 +99,38 @@ def decode_varint(buf: bytes, pos: int = 0) -> Tuple[int, int]:
         shift += 7
 
 
+#: sanity bound on frames decoded from one batch payload — a crafted
+#: tiny packet must not cost a million-object allocation
+BATCH_MAX_FRAMES = 65536
+
+
+def encode_frames(payloads) -> bytes:
+    """Concatenate N opaque payloads as varint-length-prefixed frames —
+    the framing primitive under the batched-codec entry point
+    (``types.messages.encode_message_batch`` / ``BatchMessage``)."""
+    out = bytearray()
+    for p in payloads:
+        out += encode_varint(len(p))
+        out += p
+    return bytes(out)
+
+
+def decode_frames(buf: bytes, pos: int = 0) -> list:
+    """Inverse of :func:`encode_frames`; fails closed with
+    ``DecodeError`` on truncation or an implausible frame count."""
+    parts = []
+    n = len(buf)
+    while pos < n:
+        if len(parts) >= BATCH_MAX_FRAMES:
+            raise DecodeError("batch frame count exceeds bound")
+        ln, pos = decode_varint(buf, pos)
+        if pos + ln > n:
+            raise DecodeError("truncated batch frame")
+        parts.append(buf[pos:pos + ln])
+        pos += ln
+    return parts
+
+
 def zigzag_encode(value: int) -> int:
     return (value << 1) ^ (value >> 63) if value < 0 else value << 1
 

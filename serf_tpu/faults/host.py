@@ -522,7 +522,7 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
             load.max_query_responses = max(load.max_query_responses,
                                            len(s._query_responses))
             load.max_event_inbox = max(load.max_event_inbox,
-                                       s._event_inbox.qsize())
+                                       s.pipeline_depth())
 
     def live_indices() -> List[int]:
         return [i for i in nodes

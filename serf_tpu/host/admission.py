@@ -56,6 +56,17 @@ INBOX_PRESSURE_FRACTION = 0.9
 #: the unbounded map it exists to prevent)
 PACER_MAX_PEERS = 4096
 
+#: bound on distinct (op, name-class) tenant buckets, stalest-evicted —
+#: per-tenant fairness must not itself be an unbounded map under a
+#: storm of invented tenant names.  Documented tradeoff: an adversary
+#: minting fresh name classes gets each new bucket's burst before its
+#: first shed, and an evicted-then-returning tenant comes back with a
+#: full bucket — any bounded keyed limiter has this; the GLOBAL
+#: per-op bucket stays the hard backstop (it is checked on every call
+#: and cannot be churned away), and eviction picks the least-recently
+#:-USED bucket, so an active tenant's drained budget is never reset.
+TENANT_MAX_BUCKETS = 1024
+
 
 class OverloadError(RuntimeError):
     """An ingress operation was shed by admission control.
@@ -120,6 +131,13 @@ class AdmissionController:
         if opts.query_rate > 0:
             self._buckets["query"] = TokenBucket(
                 opts.query_rate, opts.query_burst)
+        #: per-tenant fairness config + bounded bucket map (keyed by
+        #: (op, name-class); rate 0 = the whole plane is off)
+        self._tenant_cfg = {
+            "user_event": (opts.tenant_event_rate, opts.tenant_event_burst),
+            "query": (opts.tenant_query_rate, opts.tenant_query_burst),
+        }
+        self._tenants: Dict[tuple, TokenBucket] = {}
         self.min_health = opts.admission_min_health
         self._health_at = -1e9
         self._health_score = 100
@@ -145,7 +163,7 @@ class AdmissionController:
         """Responder-side self-awareness: should this node fast-fail
         user queries rather than serve them late (or never)?"""
         cap = self._serf.opts.event_inbox_max
-        if cap > 0 and (self._serf._event_inbox.qsize()
+        if cap > 0 and (self._serf.pipeline_depth()
                         >= INBOX_PRESSURE_FRACTION * cap):
             return True
         if self.min_health <= 0:
@@ -154,14 +172,48 @@ class AdmissionController:
 
     # -- ingress ------------------------------------------------------------
 
-    def admit(self, op: str) -> Optional[str]:
-        """None = admitted; otherwise the shed reason."""
+    def admit(self, op: str, name: Optional[str] = None) -> Optional[str]:
+        """None = admitted; otherwise the shed reason.  ``name`` (the
+        event/query name) engages the per-tenant fairness buckets when
+        configured: the tenant identity is the NAME CLASS
+        (``host.pipeline.name_class`` — ``storm-17`` → ``storm``), so
+        one chatty tenant exhausts its own budget while the others keep
+        their full rate.  Tenant sheds drain NO global token (the
+        global bucket is checked last) and report reason ``tenant``."""
         if self.min_health > 0 and self._score() < self.min_health:
             return "health"
+        tenant_bucket = None
+        if name is not None:
+            admitted, tenant_bucket = self._tenant_admit(op, name)
+            if not admitted:
+                return "tenant"
         bucket = self._buckets.get(op)
         if bucket is not None and not bucket.try_take():
+            # fairness holds in BOTH directions: a global-rate shed must
+            # not leave the tenant's budget drained (or a quiet tenant
+            # would pay for a storm it never joined) — refund the token
+            if tenant_bucket is not None:
+                tenant_bucket.tokens = min(tenant_bucket.burst,
+                                           tenant_bucket.tokens + 1.0)
             return "rate"
         return None
+
+    def _tenant_admit(self, op: str, name: str):
+        """(admitted, bucket-or-None) — the bucket is returned so a
+        downstream global-rate shed can refund the tenant token."""
+        rate, burst = self._tenant_cfg.get(op, (0.0, 1))
+        if rate <= 0:
+            return True, None
+        from serf_tpu.host.pipeline import name_class
+        key = (op, name_class(name))
+        bucket = self._tenants.get(key)
+        if bucket is None:
+            if len(self._tenants) >= TENANT_MAX_BUCKETS:
+                stalest = min(self._tenants,
+                              key=lambda k: self._tenants[k]._last)
+                del self._tenants[stalest]
+            bucket = self._tenants[key] = TokenBucket(rate, burst)
+        return bucket.try_take(), bucket
 
 
 def record_ingress(labels: Dict[str, str], node: str, op: str,

@@ -214,6 +214,11 @@ class MemberEventCoalescer:
         self.latest: Dict[str, MemberEventType] = {}
         self.members: Dict[str, Member] = {}
 
+    def pending(self) -> int:
+        """Buffered entries awaiting a flush (bounded by the pipeline's
+        coalesce stage — see host.pipeline.CoalesceStage)."""
+        return len(self.latest)
+
     def handle(self, ev) -> bool:
         if not isinstance(ev, MemberEvent):
             return False
@@ -240,6 +245,11 @@ class UserEventCoalescer:
 
     def __init__(self):
         self.seen: Dict[Tuple[int, str], UserEvent] = {}
+
+    def pending(self) -> int:
+        """Buffered entries awaiting a flush (bounded by the pipeline's
+        coalesce stage — see host.pipeline.CoalesceStage)."""
+        return len(self.seen)
 
     def handle(self, ev) -> bool:
         if not (isinstance(ev, UserEvent) and ev.coalesce):

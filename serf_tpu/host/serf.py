@@ -19,9 +19,8 @@ import asyncio
 import enum
 import random
 import time
-from collections import deque
 from dataclasses import dataclass, field as dataclass_field, replace
-from typing import Deque, Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from serf_tpu import codec
 from serf_tpu.host.admission import (
@@ -40,8 +39,8 @@ from serf_tpu.host.events import (
     QueryEvent,
     UserEvent,
     UserEventCoalescer,
-    coalesce_loop,
 )
+from serf_tpu.host.pipeline import CoalesceStage, EventPipeline, name_class
 from serf_tpu.host.keyring import SecretKeyring
 from serf_tpu.host.memberlist import Memberlist, NodeState
 from serf_tpu.host.messages import SwimState
@@ -70,6 +69,7 @@ from serf_tpu.types.messages import (
     ConflictResponseMessage,
     JoinMessage,
     LeaveMessage,
+    MessageType,
     PushPullMessage,
     QueryFlag,
     QueryMessage,
@@ -78,6 +78,8 @@ from serf_tpu.types.messages import (
     UserEventMessage,
     UserEvents,
     decode_message,
+    decode_message_batch,
+    decode_message_cached,
     encode_message,
     encode_relay_message,
 )
@@ -102,11 +104,6 @@ INTERNAL_REMOVE_KEY = "_serf_remove_key"
 INTERNAL_LIST_KEYS = "_serf_list_keys"
 INTERNAL_STATS = "_serf_stats"       # cluster stats aggregation (obs.cluster)
 PING_VERSION = 1
-
-#: bound on the event tee queue between the protocol and the delivery
-#: pipeline — a wedged LOSSLESS subscriber backpressures the pipeline
-#: task at this depth instead of growing process memory without limit
-TEE_QUEUE_MAX = 4096
 
 #: bound on user events deferred while a join(ignore_old=True) is still
 #: computing its event-time cutoff (joins are sub-second; this is ample)
@@ -170,15 +167,32 @@ class _SerfSwimDelegate(SwimDelegate):
         s = self.serf
         if s is None or s.state == SerfState.SHUTDOWN:
             return
+        if raw and raw[0] == int(MessageType.BATCH):
+            # batched codec: one SWIM frame carried N serf messages —
+            # unwrap once, then run each part through the normal
+            # per-message path (every part gets its own lifecycle
+            # clock; the packet-timestamp note anchors the first)
+            try:
+                parts = decode_message_batch(raw)
+            except codec.DecodeError as e:
+                log.debug("undecodable serf batch: %s", e)
+                return
+            for part in parts:
+                self._notify_one(part)
+            return
+        self._notify_one(raw)
+
+    def _notify_one(self, raw: bytes) -> None:
+        s = self.serf
         metrics.observe("serf.messages.received", len(raw), s._labels)
         # lifecycle ledger (obs.lifecycle): begin the per-message stage
         # clock at the transport seam — the memberlist packet loop noted
         # the packet's receive timestamp, so wire+SWIM decode land in
-        # the `transport` stage and decode_message in `decode`
+        # the `transport` stage and the codec pass in `decode`
         led = lifecycle.global_ledger()
         clk = led.begin("remote")
         try:
-            msg = decode_message(raw)
+            msg = decode_message_cached(raw)
         except codec.DecodeError as e:
             led.discard_current()
             log.debug("undecodable serf message: %s", e)
@@ -449,22 +463,18 @@ class Serf:
         if not opts.disable_coordinates:
             self.coord_client = CoordinateClient(CoordinateOptions(), rng=self.rng)
 
-        self._event_inbox: asyncio.Queue = asyncio.Queue()
+        #: the MPMC event pipeline (host/pipeline.py): bounded keyed
+        #: intake + N applier workers, wired in ``create()`` once the
+        #: subscriber/coalescer topology is known.  Queue-age tracking
+        #: rides the pipeline's own entries (each carries its enqueue
+        #: timestamp), so a shed entry can never leave a stale
+        #: timestamp behind on a side-deque.
+        self._pipeline: Optional[EventPipeline] = None
         self._subscriber: Optional[EventSubscriber] = None
         self.snapshotter = None  # wired by serf_tpu.host.snapshot
         self._key_manager = None
 
-        # queue-age tracking (obs.lifecycle satellite): enqueue
-        # timestamps parallel to the event inbox / tee queue, pushed and
-        # popped at exactly the enqueue/dequeue sites, so the monitor
-        # tick can gauge the OLDEST item's age (`serf.queue.age.*`) —
-        # the backpressure signal the ledger's queue-wait numbers
-        # should corroborate
-        self._inbox_enq: Deque[float] = deque()
-        self._tee_enq: Deque[float] = deque()
-
         # health plane (obs.health): sources read engine state lazily
-        self._tee_queue: Optional[asyncio.Queue] = None
         self._loop_lag_ewma_ms = 0.0
         self._health = HealthScorer(serf_sources(self))
         # admission control (host/admission.py): ingress token buckets +
@@ -512,18 +522,7 @@ class Serf:
         tasks, auto-rejoin (reference Serf::new + new_in)."""
         s = cls(transport, opts, node_id, user_delegate, keyring, rng)
         s._subscriber = subscriber
-
-        # event pipeline: inbox -> (coalescers) -> subscriber
-        if subscriber is not None:
-            member_c = MemberEventCoalescer() if opts.coalesce_period > 0 else None
-            user_c = UserEventCoalescer() if opts.user_coalesce_period > 0 else None
-            if member_c or user_c:
-                s._track(s._coalesce_pipeline(member_c, user_c),
-                         f"serf-coalesce-{node_id}")
-            else:
-                s._track(s._passthrough_pipeline(), f"serf-events-{node_id}")
-        else:
-            s._track(s._drain_pipeline(), f"serf-drain-{node_id}")
+        s._pipeline = s._build_pipeline()
 
         # snapshot replay (reference base.rs:130-155)
         replay_nodes: List[Node] = []
@@ -570,141 +569,74 @@ class Serf:
         return s
 
     # ------------------------------------------------------------------
-    # event pipelines
+    # event pipeline (host/pipeline.py: bounded MPMC + dependency keys)
     # ------------------------------------------------------------------
 
-    async def _passthrough_pipeline(self) -> None:
-        # The snapshotter is a non-blocking tee (reference snapshot.rs
-        # tee_stream): it must observe every event even while a LOSSLESS
-        # subscriber backpressures the delivery stage — otherwise a
-        # stalled consumer would freeze snapshot persistence and a crash
-        # in that window would replay a stale alive-set.
-        #
-        # The tee queue is BOUNDED (advisor finding: it was unbounded):
-        # the snapshotter observes each event BEFORE the awaited put, so
-        # everything buffered in the tee is already persisted.  The bound
-        # caps THIS buffer and moves the backpressure point: once a
-        # wedged lossless consumer holds the tee at TEE_QUEUE_MAX, the
-        # tee task blocks and later events wait in ``_event_inbox``
-        # (not yet snapshotter-observed) — which is why the depth gauge
-        # and the health-score ``tee`` component (``event_tee_fill``)
-        # count BOTH stages: the signal saturates while the wedge is
-        # forming instead of after memory is already gone.
-        mid: asyncio.Queue = asyncio.Queue(maxsize=TEE_QUEUE_MAX)
-        self._tee_queue = mid
-        gauge_labels = {**self._labels, "node": self.local_id}
+    def _build_pipeline(self) -> EventPipeline:
+        """Assemble the delivery topology onto the MPMC pipeline.
 
-        async def tee() -> None:
-            while True:
-                ev = await self._event_inbox.get()
-                if ev is not None:
-                    if self._inbox_enq:
-                        self._inbox_enq.popleft()
-                    lifecycle.global_ledger().event_stamp(ev, "queue-wait")
-                    if self.snapshotter is not None:
-                        self.snapshotter.observe(ev)
-                await mid.put(ev)
-                if ev is not None:
-                    self._tee_enq.append(time.monotonic())
-                metrics.gauge("serf.events.tee_depth",
-                              mid.qsize() + self._event_inbox.qsize(),
-                              gauge_labels)
-                if ev is None:
+        The snapshotter is a non-blocking tee (reference snapshot.rs
+        tee_stream) run as the workers' ``observe`` hook: it sees every
+        event BEFORE the (possibly blocking, if lossless) subscriber
+        push, so a stalled consumer can never freeze snapshot
+        persistence for events already picked up.  Events still waiting
+        in the bounded intake are not yet persisted — the ``tee`` health
+        component (``event_tee_fill``) therefore counts intake + in-
+        service, so the signal saturates while a wedge is FORMING, not
+        after memory is gone.  Coalescers (when configured) are fan-out
+        stages fed synchronously by the workers; non-coalescable events
+        push straight through, exactly the reference's channel-wrapper
+        chain (base.rs:88-115) minus the serial hop-per-stage."""
+        out = self._subscriber
+        member_stage = user_stage = None
+        if out is not None:
+            if self.opts.coalesce_period > 0:
+                member_stage = CoalesceStage(
+                    MemberEventCoalescer(), out.push,
+                    self.opts.coalesce_period, self.opts.quiescent_period,
+                    self._track, f"serf-coalesce-m-{self.local_id}")
+            if self.opts.user_coalesce_period > 0:
+                user_stage = CoalesceStage(
+                    UserEventCoalescer(), out.push,
+                    self.opts.user_coalesce_period,
+                    self.opts.user_quiescent_period,
+                    self._track, f"serf-coalesce-u-{self.local_id}")
+
+        deliver = deliver_sync = None
+        if out is None:
+            # drain mode: no subscriber — observe-only, fully sync
+            def deliver_sync(ev):
+                return None
+        elif out.lossless:
+            # lossless push AWAITS for room (the backpressure contract):
+            # delivery must stay async, contention queues at the intake
+            async def deliver(ev):
+                if member_stage is not None and member_stage.feed(ev):
                     return
-
-        t = spawn_logged(tee(), f"serf-tee-{self.local_id}")
-        try:
-            while True:
-                ev = await mid.get()
-                metrics.gauge("serf.events.tee_depth",
-                              mid.qsize() + self._event_inbox.qsize(),
-                              gauge_labels)
-                if ev is None:
+                if user_stage is not None and user_stage.feed(ev):
                     return
-                if self._tee_enq:
-                    self._tee_enq.popleft()
-                await self._subscriber.push(ev)
-                # delivery complete: everything since the inbox dequeue
-                # (snapshotter tee, mid-queue hop, subscriber push) is
-                # the pipeline's service time
-                lifecycle.global_ledger().event_finish(ev, "tee")
-        finally:
-            t.cancel()
+                await out.push(ev)
+        else:
+            # drop-oldest push and coalescer feeds never await: the
+            # pipeline's run-to-completion fast path applies idle-chain
+            # events inline (zero queue-wait — the collapse the PR-12
+            # ledger demanded), queuing only under per-key contention
+            def deliver_sync(ev):
+                if member_stage is not None and member_stage.feed(ev):
+                    return
+                if user_stage is not None and user_stage.feed(ev):
+                    return
+                out._push(ev)
 
-    async def _drain_pipeline(self) -> None:
-        while True:
-            ev = await self._event_inbox.get()
-            if ev is None:
-                return
-            if self._inbox_enq:
-                self._inbox_enq.popleft()
-            led = lifecycle.global_ledger()
-            led.event_stamp(ev, "queue-wait")
+        def observe(ev) -> None:
             if self.snapshotter is not None:
                 self.snapshotter.observe(ev)
-            # no subscriber: the message's life ends here (no tee stage)
-            led.event_finish(ev)
 
-    async def _coalesce_pipeline(self, member_c, user_c) -> None:
-        """Chain: inbox -> member coalescer -> user coalescer -> subscriber
-        (reference wires coalescers as channel wrappers, base.rs:88-115).
-        The relay queues are bounded like the passthrough tee: a wedged
-        consumer backpressures the pipeline task at TEE_QUEUE_MAX instead
-        of growing process memory without limit."""
-        mid: asyncio.Queue = asyncio.Queue(maxsize=TEE_QUEUE_MAX)
-        out = self._subscriber
-
-        async def tee() -> None:
-            while True:
-                ev = await self._event_inbox.get()
-                if ev is not None:
-                    if self._inbox_enq:
-                        self._inbox_enq.popleft()
-                    # coalescers may merge/suppress the event downstream:
-                    # the sampled clock finishes at the queue-wait hop
-                    # (tee service time is unmeasured in coalesce mode)
-                    led = lifecycle.global_ledger()
-                    led.event_stamp(ev, "queue-wait")
-                    led.event_finish(ev)
-                    if self.snapshotter is not None:
-                        self.snapshotter.observe(ev)
-                await mid.put(ev)
-                if ev is None:
-                    return
-
-        t = spawn_logged(tee(), f"serf-coalesce-tee-{self.local_id}")
-        try:
-            if member_c and user_c:
-                mid2: asyncio.Queue = asyncio.Queue(maxsize=TEE_QUEUE_MAX)
-                relay = EventSubscriber()
-
-                async def pump() -> None:
-                    while True:
-                        ev = await relay._q.get()
-                        await mid2.put(ev)
-
-                p = spawn_logged(pump(), f"serf-coalesce-pump-{self.local_id}")
-                try:
-                    await asyncio.gather(
-                        coalesce_loop(mid, relay, member_c,
-                                      self.opts.coalesce_period,
-                                      self.opts.quiescent_period),
-                        coalesce_loop(mid2, out, user_c,
-                                      self.opts.user_coalesce_period,
-                                      self.opts.user_quiescent_period),
-                    )
-                finally:
-                    p.cancel()
-            elif member_c:
-                await coalesce_loop(mid, out, member_c,
-                                    self.opts.coalesce_period,
-                                    self.opts.quiescent_period)
-            else:
-                await coalesce_loop(mid, out, user_c,
-                                    self.opts.user_coalesce_period,
-                                    self.opts.user_quiescent_period)
-        finally:
-            t.cancel()
+        return EventPipeline(
+            spawn=self._track, observe=observe, deliver=deliver,
+            deliver_sync=deliver_sync,
+            workers=self.opts.pipeline_workers,
+            labels=self._labels, node=self.local_id)
 
     def _emit(self, ev) -> None:
         """Enqueue an event for the delivery pipeline, shedding under
@@ -717,23 +649,27 @@ class Serf:
         and are ALWAYS enqueued — the shedding priority order never
         sacrifices them, and the snapshotter (fed from this pipeline)
         must not miss an alive-set change."""
+        if self._pipeline is None:
+            # direct-constructed engine (Serf() without create(), e.g.
+            # handler-level test oracles): build the delivery topology
+            # on first emit — drain mode is fully synchronous, so no
+            # running loop is required until something queues
+            self._pipeline = self._build_pipeline()
         cap = self.opts.event_inbox_max
         led = lifecycle.global_ledger()
         if (cap > 0 and ev is not None and not isinstance(ev, MemberEvent)
-                and self._event_inbox.qsize() >= cap):
+                and self._pipeline.depth() >= cap):
             kind = type(ev).__name__
             self._events_shed += 1
             led.attach_current(ev, shed=True)
             metrics.incr("serf.overload.event_shed", 1,
                          {**self._labels, "event": kind})
             obs.record("event-shed", node=self.local_id, event=kind,
-                       inbox=self._event_inbox.qsize())
+                       inbox=self._pipeline.depth())
             return
         if ev is not None:
             led.attach_current(ev)
-        self._event_inbox.put_nowait(ev)
-        if ev is not None:
-            self._inbox_enq.append(time.monotonic())
+        self._pipeline.offer(ev)
 
     # ------------------------------------------------------------------
     # public API (reference api.rs)
@@ -794,15 +730,22 @@ class Serf:
     # -- health / cluster observability -------------------------------------
 
     def event_tee_fill(self) -> float:
-        """Fill fraction of the event delivery path: tee queue PLUS the
-        inbox behind it (events the blocked tee has not yet persisted),
-        over the tee bound — so the health signal keeps climbing past
-        1.0-clamp territory while a wedged consumer backs the whole
-        pipeline up.  0.0 when the passthrough pipeline is not running."""
-        q = self._tee_queue
-        if q is None or q.maxsize <= 0:
+        """Fill fraction of the event delivery path: pipeline intake
+        (events not yet snapshotter-persisted) plus in-service entries,
+        over the intake bound — the health signal climbs while a wedged
+        consumer backs the pipeline up, not after memory is gone.  0.0
+        when the intake is unbounded or the pipeline is not running."""
+        p = self._pipeline
+        cap = self.opts.event_inbox_max
+        if p is None or cap <= 0:
             return 0.0
-        return (q.qsize() + self._event_inbox.qsize()) / q.maxsize
+        return (p.depth() + p.inflight()) / cap
+
+    def pipeline_depth(self) -> int:
+        """Events offered to the MPMC pipeline and not yet picked up by
+        an applier worker (the bounded-intake backpressure signal)."""
+        p = self._pipeline
+        return 0 if p is None else p.depth()
 
     def loop_lag_ms(self) -> float:
         """EWMA of event-loop scheduling lag (ms), fed by the health
@@ -861,15 +804,22 @@ class Serf:
         queue-wait stage should corroborate."""
         now = time.monotonic()
         labels = {**self._labels, "node": self.local_id}
+        p = self._pipeline
         ages = {
             "intent": self.intent_broadcasts.oldest_age(now),
             "event": self.event_broadcasts.oldest_age(now),
             "query": self.query_broadcasts.oldest_age(now),
-            "inbox": (now - self._inbox_enq[0]) if self._inbox_enq else 0.0,
-            "tee": (now - self._tee_enq[0]) if self._tee_enq else 0.0,
+            # pipeline entries carry their own enqueue timestamp: the
+            # intake's oldest waiting entry and the oldest entry still
+            # in service (shed entries never skew either — there is no
+            # parallel timestamp deque to fall out of sync)
+            "inbox": p.oldest_age(now) if p is not None else 0.0,
+            "tee": p.oldest_service_age(now) if p is not None else 0.0,
         }
         for qname, age in ages.items():
             metrics.gauge(f"serf.queue.age.{qname}", age, labels)
+        if p is not None:
+            p.gauge()
 
     def coordinate(self) -> Optional[Coordinate]:
         return self.coord_client.get_coordinate() if self.coord_client else None
@@ -1026,7 +976,7 @@ class Serf:
                 f"{self.opts.max_user_event_size} bytes before encoding")
         if size > USER_EVENT_SIZE_LIMIT:
             raise ValueError(f"user event exceeds sane limit of {USER_EVENT_SIZE_LIMIT} bytes")
-        reason = self._admission.admit("user_event")
+        reason = self._admission.admit("user_event", name)
         record_ingress(self._labels, self.local_id, "user_event", reason)
         if reason is not None:
             raise OverloadError("user_event", reason)
@@ -1073,7 +1023,7 @@ class Serf:
             raise ValueError(
                 f"query exceeds limit of {self.opts.query_size_limit} bytes")
         if not name.startswith("_serf_"):
-            reason = self._admission.admit("query")
+            reason = self._admission.admit("query", name)
             record_ingress(self._labels, self.local_id, "query", reason)
             if reason is not None:
                 raise OverloadError("query", reason)
@@ -1203,7 +1153,7 @@ class Serf:
     def _handle_relay(self, msg: RelayMessage) -> None:
         if msg.node.id == self.local_id or msg.node.addr == self.memberlist.local_node().addr:
             try:
-                inner = decode_message(msg.payload)
+                inner = decode_message_cached(msg.payload)
             except codec.DecodeError as e:
                 log.debug("bad relayed message: %s", e)
                 return
@@ -1450,7 +1400,10 @@ class Serf:
         else:
             self._event_buffer[idx] = UserEvents(msg.ltime, (msg,))
         metrics.incr("serf.events", 1, self._labels)
-        metrics.incr(f"serf.events.{msg.name}", 1, self._labels)
+        # keyed by NAME CLASS, not raw name: a storm of sequence-named
+        # events ("storm-1", "storm-2", ...) must not grow the metrics
+        # sink without bound (every sampler tick walks the whole sink)
+        metrics.incr(f"serf.events.{name_class(msg.name)}", 1, self._labels)
         with trace_scope(msg.tctx):
             # trace-stamped while the event's context is active: the same
             # trace id lands in the flight ring of every node that accepts
@@ -1483,7 +1436,8 @@ class Serf:
             self._query_buffer[idx] = (msg.ltime, {msg.id})
         rebroadcast_out = not msg.no_broadcast()
         metrics.incr("serf.queries", 1, self._labels)
-        metrics.incr(f"serf.queries.{msg.name}", 1, self._labels)
+        # name-class key: bounded cardinality (see _handle_user_event)
+        metrics.incr(f"serf.queries.{name_class(msg.name)}", 1, self._labels)
         if not should_process_query(msg.filters, self.local_id, self._tags):
             return rebroadcast_out
         # the trace scope covers flight recording, the ack send, and —

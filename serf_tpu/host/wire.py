@@ -439,6 +439,14 @@ def decode_wire(buf: bytes, compression: Optional[str],
     return buf
 
 
+# Batch amortization note (host-plane throughput rebuild): ONE
+# encode_wire pass — compress + checksum + encrypt — already covers a
+# whole gossip packet (the SWIM compound), and the serf codec's BATCH
+# envelope (types/messages.encode_message_batch, framing primitive in
+# serf_tpu.codec.encode_frames/decode_frames) packs N queued broadcasts
+# into one message inside it — the per-message wire cost is amortized
+# at both layers, so no separate wire-level framing API lives here.
+
 # worst-case expansion headroom per compressor on packet-sized payloads
 # (zlib: header+adler; lz4: varint size prefix + token overhead n/255+16,
 # ~27B at the 1400B UDP budget; snappy: preamble + literal tags n/60;

@@ -233,14 +233,24 @@ class Options:
     #: handlers (replaces the per-query expiry task — a query storm must
     #: not be a task storm)
     query_sweep_interval: float = 1.0
-    #: bound on the protocol->pipeline event inbox; non-membership events
+    #: bound on the protocol->pipeline event intake; non-membership events
     #: beyond it are shed (member events are membership state: never shed)
     event_inbox_max: int = 8192
+    #: applier workers draining the MPMC event pipeline (host/pipeline.py):
+    #: per-dependency-key serial, cross-key parallel application
+    pipeline_workers: int = 4
     #: ingress token buckets (host/admission.py); rate 0 = unlimited
     user_event_rate: float = 0.0
     user_event_burst: int = 64
     query_rate: float = 0.0
     query_burst: int = 32
+    #: per-tenant fairness buckets keyed by event/query NAME CLASS
+    #: (host/pipeline.name_class): one noisy tenant drains its own
+    #: bucket, not the cluster's; rate 0 = disabled
+    tenant_event_rate: float = 0.0
+    tenant_event_burst: int = 32
+    tenant_query_rate: float = 0.0
+    tenant_query_burst: int = 16
     #: health floor: when the obs.health score drops below this, user
     #: ingress is shed and inbound user queries are fast-failed with an
     #: explicit OVERLOADED response (0 = disabled)
@@ -272,10 +282,14 @@ class Options:
             raise ValueError("max_query_responses must be >= 1")
         if self.query_sweep_interval <= 0:
             raise ValueError("query_sweep_interval must be positive")
-        if self.user_event_rate < 0 or self.query_rate < 0:
+        if self.user_event_rate < 0 or self.query_rate < 0 \
+                or self.tenant_event_rate < 0 or self.tenant_query_rate < 0:
             raise ValueError("ingress rates must be >= 0 (0 = unlimited)")
-        if self.user_event_burst < 1 or self.query_burst < 1:
+        if self.user_event_burst < 1 or self.query_burst < 1 \
+                or self.tenant_event_burst < 1 or self.tenant_query_burst < 1:
             raise ValueError("ingress bursts must be >= 1")
+        if self.pipeline_workers < 1:
+            raise ValueError("pipeline_workers must be >= 1")
         if not 0 <= self.admission_min_health <= 100:
             raise ValueError("admission_min_health must be in [0, 100]")
         self.memberlist.validate()

@@ -10,13 +10,19 @@ bit-exact: every round's digest matches.
 
 Host plane (:func:`replay_host`): stands up a fresh loopback cluster and
 re-drives the recorded ingress — joins, every offered user_event/query,
-phase/restart/heal transitions — in recorded order with VIRTUALIZED
-timing: phase wall durations are preserved, but intra-phase event
-spacing is not (a phase's events are applied back-to-back at phase
-entry).  Membership-view digests are re-taken at the recorded
-convergence barriers, where converged membership is deterministic even
-though gossip interleaving is not (README "Record & replay" states the
-full determinism contract).
+phase/restart/heal transitions — with VIRTUALIZED timing: phase wall
+durations are preserved, but intra-phase event spacing is not (a
+phase's events are applied back-to-back at phase entry).  Re-drive is
+PARALLEL by the same dependency analysis that makes the host pipeline's
+MPMC consumption safe (``host.pipeline.dependency_key``): consecutive
+ingress steps with the same key (one tenant's events/queries) re-drive
+serially in recorded order, while cross-key steps are gathered
+concurrently — commutative ingress reorders freely, exactly as it did
+live.  Membership-view digests are re-taken at the recorded convergence
+barriers, where converged membership is deterministic even though
+gossip interleaving is not (README "Record & replay" states the full
+determinism contract); pre-rebuild recordings replay to identical
+barrier digests through the parallel path.
 """
 
 from __future__ import annotations
@@ -215,41 +221,66 @@ async def replay_host(rec: Recording,
             await asyncio.sleep(pending_sleep)
             pending_sleep = 0.0
 
+    # -- dependency-aware parallel re-drive ---------------------------------
+    # consecutive ingress steps accumulate into per-dependency-key
+    # chains (host.pipeline.dependency_key semantics: tenant name class);
+    # a flush re-drives every chain concurrently, each chain serially in
+    # recorded order.  Any non-ingress step is a barrier for the window.
+    from serf_tpu.host.pipeline import name_class
+
+    ingress_window: Dict[tuple, list] = {}
+
+    async def _drive_one(a: dict, is_query: bool) -> None:
+        node = _host_node(nodes, a["node"])
+        if node is None or node.state != SerfState.ALIVE:
+            return
+        try:
+            if is_query:
+                # recorded verbatim: 0.0 is QueryParam's "use the
+                # node's default_query_timeout" sentinel, not a
+                # missing value
+                await node.query(
+                    a["name"], bytes.fromhex(a["payload"]),
+                    QueryParam(timeout=float(a.get("timeout", 0.0))))
+            else:
+                await node.user_event(
+                    a["name"], bytes.fromhex(a["payload"]),
+                    coalesce=bool(a.get("coalesce", False)))
+        except Exception:  # noqa: BLE001 - replay is best-effort (sheds
+            # replay as sheds: an OverloadError here IS fidelity)
+            pass
+
+    async def _drive_chain(steps: list) -> None:
+        for a, is_query in steps:          # per-key: recorded order
+            await _drive_one(a, is_query)
+            await asyncio.sleep(0)
+
+    async def flush_ingress() -> None:
+        if not ingress_window:
+            return
+        chains = list(ingress_window.values())
+        ingress_window.clear()
+        await asyncio.gather(*(_drive_chain(c) for c in chains))
+
     try:
         for i in range(n):
             nodes[i] = await make_node(i)
         for s in rec.steps():
             op, a = s["op"], s["args"]
             out.step(op, **a)
+            if op in ("user-event", "query"):
+                is_query = op == "query"
+                key = ("query" if is_query else "user",
+                       name_class(a["name"]))
+                ingress_window.setdefault(key, []).append((a, is_query))
+                continue
+            # every other step is an ordering barrier for the window
+            await flush_ingress()
             if op == "join":
                 try:
                     await nodes[int(a["node"])].join(a["target"])
                 except Exception:  # noqa: BLE001 - replay is best-effort
                     pass
-            elif op == "user-event":
-                node = _host_node(nodes, a["node"])
-                if node is not None and node.state == SerfState.ALIVE:
-                    try:
-                        await node.user_event(
-                            a["name"], bytes.fromhex(a["payload"]),
-                            coalesce=bool(a.get("coalesce", False)))
-                    except Exception:  # noqa: BLE001
-                        pass
-                await asyncio.sleep(0)
-            elif op == "query":
-                node = _host_node(nodes, a["node"])
-                if node is not None and node.state == SerfState.ALIVE:
-                    try:
-                        # recorded verbatim: 0.0 is QueryParam's "use the
-                        # node's default_query_timeout" sentinel, not a
-                        # missing value
-                        await node.query(
-                            a["name"], bytes.fromhex(a["payload"]),
-                            QueryParam(timeout=float(a.get("timeout",
-                                                           0.0))))
-                    except Exception:  # noqa: BLE001
-                        pass
-                await asyncio.sleep(0)
             elif op == "phase":
                 await serve_phase_window()
                 pi = int(a["index"])
@@ -291,6 +322,7 @@ async def replay_host(rec: Recording,
                 barrier_index += 1
             else:
                 raise RecordingError(f"unknown host step op {op!r}")
+        await flush_ingress()
         out.finish()
         return out
     finally:

@@ -14,6 +14,7 @@ from typing import Dict, List, Optional, Tuple
 
 from serf_tpu import codec
 from serf_tpu.types.trace import TraceContext
+from serf_tpu.utils import metrics
 from serf_tpu.types.clock import LamportTime
 from serf_tpu.types.member import Member, Node
 from serf_tpu.types.filters import Filter, decode_filter
@@ -30,7 +31,12 @@ def _decode_tctx(buf: bytes) -> Optional[TraceContext]:
 
 
 class MessageType(enum.IntEnum):
-    """Envelope tags (reference message.rs:17-124 uses the same registry)."""
+    """Envelope tags (reference message.rs:17-124 uses the same registry).
+
+    ``BATCH`` is this reproduction's extension (host-plane throughput
+    rebuild): one envelope carrying N already-encoded messages, so the
+    gossip drain amortizes one wire encode + one SWIM frame + one sendto
+    over every queued broadcast instead of paying per message."""
 
     LEAVE = 1
     JOIN = 2
@@ -42,6 +48,7 @@ class MessageType(enum.IntEnum):
     RELAY = 8
     KEY_REQUEST = 9
     KEY_RESPONSE = 10
+    BATCH = 11
 
 
 class QueryFlag(enum.IntFlag):
@@ -424,6 +431,45 @@ class KeyResponseMessage:
         return cls(res, msg, tuple(keys), pk)
 
 
+@dataclass(frozen=True)
+class BatchMessage:
+    """N already-encoded messages in one envelope (this reproduction's
+    extension; no reference analog).  ``parts`` are raw per-message
+    bytes, each with its own type byte — the receiver dispatches them
+    individually, so batching is transparent to every handler.  The
+    envelope body is the shared varint frame sequence
+    (``serf_tpu.codec.encode_frames``), not numbered fields: framing
+    overhead per message is 1-2 bytes."""
+
+    parts: Tuple[bytes, ...] = ()
+
+    TYPE = MessageType.BATCH
+
+    def encode_body(self) -> bytes:
+        return codec.encode_frames(self.parts)
+
+    @classmethod
+    def decode_body(cls, buf: bytes) -> "BatchMessage":
+        return cls(tuple(codec.decode_frames(buf)))
+
+
+def encode_message_batch(raws) -> bytes:
+    """One ``BATCH`` envelope around N already-encoded messages — the
+    broadcast-drain entry point: the queued broadcasts' bytes are
+    reused verbatim (zero re-encode), and the whole batch costs ONE
+    SWIM frame + ONE wire encode + ONE sendto downstream."""
+    return bytes([int(MessageType.BATCH)]) + codec.encode_frames(raws)
+
+
+def decode_message_batch(buf: bytes) -> List[bytes]:
+    """The raw per-message parts of an encoded ``BATCH`` envelope
+    (each still carries its own type byte — feed them back through
+    :func:`decode_message` / the cached variant individually)."""
+    if not buf or buf[0] != int(MessageType.BATCH):
+        raise codec.DecodeError("not a BATCH envelope")
+    return codec.decode_frames(buf, 1)
+
+
 _DECODERS = {
     MessageType.LEAVE: LeaveMessage.decode_body,
     MessageType.JOIN: JoinMessage.decode_body,
@@ -434,6 +480,7 @@ _DECODERS = {
     MessageType.CONFLICT_RESPONSE: ConflictResponseMessage.decode_body,
     MessageType.KEY_REQUEST: KeyRequestMessage.decode_body,
     MessageType.KEY_RESPONSE: KeyResponseMessage.decode_body,
+    MessageType.BATCH: BatchMessage.decode_body,
 }
 
 Message = object  # union of the dataclasses above
@@ -489,3 +536,50 @@ def decode_message(buf: bytes):
         raise
     except (AttributeError, TypeError, UnicodeDecodeError, ValueError) as e:
         raise codec.DecodeError(f"malformed {ty.name} body: {e}") from e
+
+
+# ---------------------------------------------------------------------------
+# decode memo (host-plane throughput rebuild)
+# ---------------------------------------------------------------------------
+
+#: bounded FIFO memo for :func:`decode_message_cached`
+_DECODE_CACHE_MAX = 4096
+_decode_cache: Dict[bytes, object] = {}
+
+#: the rebroadcast-heavy envelope types whose decoded dataclasses are
+#: DEEPLY IMMUTABLE (frozen, tuple/bytes/str/Node fields) and therefore
+#: safe to share between deliveries and co-located nodes.  PUSH_PULL is
+#: deliberately excluded (it carries a mutable dict and is never
+#: rebroadcast); RELAY/BATCH are containers whose inner parts get their
+#: own cache entries.
+_CACHEABLE_TYPES = frozenset({
+    int(MessageType.LEAVE), int(MessageType.JOIN),
+    int(MessageType.USER_EVENT), int(MessageType.QUERY),
+    int(MessageType.QUERY_RESPONSE),
+})
+
+
+def decode_message_cached(buf: bytes):
+    """:func:`decode_message` with a bounded memo keyed on the raw
+    bytes.
+
+    Gossip redundancy makes the host plane decode the SAME bytes many
+    times: each broadcast is retransmitted ``retransmit_mult×log(n)``
+    times and arrives at every peer each time — under the query-storm
+    bench the hot path decoded ~20× more messages than there were
+    distinct payloads, and the Python codec pass was the single largest
+    loop cost.  Decoded messages are immutable (see
+    ``_CACHEABLE_TYPES``), so one decode can serve every arrival.
+    FIFO eviction keeps the memo bounded; a miss costs one dict probe
+    over the plain decode."""
+    msg = _decode_cache.get(buf)
+    if msg is not None:
+        metrics.incr("serf.codec.decode-cache-hit")
+        return msg
+    msg = decode_message(buf)
+    if buf[0] in _CACHEABLE_TYPES:
+        if len(_decode_cache) >= _DECODE_CACHE_MAX:
+            _decode_cache.pop(next(iter(_decode_cache)))
+        _decode_cache[bytes(buf)] = msg
+    metrics.incr("serf.codec.decode-cache-miss")
+    return msg

@@ -1,0 +1,24 @@
+"""serflint golden fixture: every pipeline-bypass pattern MUST fire.
+
+Placed (by the test) at serf_tpu/host/fake.py — a host module that does
+not own a queue seam.
+"""
+
+import asyncio
+
+
+class SneakyEngine:
+    def __init__(self):
+        # manual queue construction: a side-channel around the pipeline
+        self.inbox = asyncio.Queue()
+
+    def emit(self, ev):
+        # direct put bypasses the bounded, dependency-keyed hand-off
+        self.inbox.put_nowait(ev)
+
+    async def emit_blocking(self, ev):
+        await self.inbox.put(ev)
+
+    def jump_the_queue(self, serf, key):
+        # reaching into EventPipeline internals
+        serf._pipeline._ready.append(key)

@@ -1,0 +1,15 @@
+"""serflint golden fixture: the clean twin of bad_pipeline.py — events
+go through the MPMC hand-off API; no finding may fire."""
+
+
+class PoliteEngine:
+    def __init__(self, pipeline):
+        self._pipeline = pipeline
+
+    def emit(self, ev):
+        # the one hand-off API: bounded, dependency-keyed, shed-accounted
+        if self._pipeline.depth() < 8192:
+            self._pipeline.offer(ev)
+
+    def backlog_age(self):
+        return self._pipeline.oldest_age()

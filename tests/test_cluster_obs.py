@@ -367,8 +367,11 @@ async def test_user_event_trace_propagates():
 
 
 @pytest.mark.asyncio
-async def test_passthrough_tee_queue_is_bounded():
-    from serf_tpu.host.serf import TEE_QUEUE_MAX
+async def test_event_pipeline_is_bounded_and_gauged():
+    """The delivery path between protocol and subscriber is the bounded
+    MPMC pipeline (host/pipeline.py): its intake bound comes from
+    ``event_inbox_max``, fill settles to 0 when idle, and the tee-depth
+    gauge is refreshed from the monitor hook."""
     from serf_tpu.host import LoopbackNetwork, Serf, EventSubscriber
     from serf_tpu.options import Options
 
@@ -377,30 +380,23 @@ async def test_passthrough_tee_queue_is_bounded():
     s = await Serf.create(net.bind("a"), Options.local(), "node-a",
                           subscriber=sub)
     try:
-        # the pipeline task installs the queue on its first scheduling
-        deadline = asyncio.get_running_loop().time() + 5.0
-        while s._tee_queue is None \
-                and asyncio.get_running_loop().time() < deadline:
-            await asyncio.sleep(0.01)
-        assert s._tee_queue is not None
-        assert s._tee_queue.maxsize == TEE_QUEUE_MAX
+        assert s._pipeline is not None
+        assert s.opts.event_inbox_max > 0     # the intake bound governs
         # own-join events may still be draining; fill settles to 0
         deadline = asyncio.get_running_loop().time() + 5.0
         while s.event_tee_fill() > 0.0 \
                 and asyncio.get_running_loop().time() < deadline:
             await asyncio.sleep(0.01)
         assert s.event_tee_fill() == 0.0
-        # the depth gauge is emitted as events move through the tee
+        assert s.pipeline_depth() == 0
+        # the depth gauge is emitted on the periodic monitor hook
         await s.user_event("ping", b"")
+        s._gauge_queue_ages()
         labels = {"node": "node-a"}
-        deadline = asyncio.get_running_loop().time() + 5.0
-        while asyncio.get_running_loop().time() < deadline:
-            if metrics.global_sink().gauge_value(
-                    "serf.events.tee_depth", labels) is not None:
-                break
-            await asyncio.sleep(0.01)
         assert metrics.global_sink().gauge_value(
             "serf.events.tee_depth", labels) is not None
+        assert metrics.global_sink().gauge_value(
+            "serf.pipeline.depth", labels) is not None
     finally:
         await s.shutdown()
 

@@ -329,32 +329,60 @@ def test_stage_slo_rows_judge_from_ledger_snapshot(fresh_obs):
 
 
 async def test_slow_consumer_plan_fires_slow_messages(fresh_obs):
-    """Acceptance: slow-message flight events fire with full stage
-    breakdowns under the slow-consumer plan (aggressive threshold so
-    the pin is deterministic; the chaos CLI default is 50 ms)."""
+    """Acceptance, re-anchored onto the MPMC pipeline: the rebuilt
+    delivery path applies drop-oldest subscribers inline, so the
+    slow-consumer PLAN no longer produces multi-ms deliveries (that is
+    the rebuild's point) — the plan run now pins invariants + the SLO
+    rows judging from the scoped ledger, and the slow-message machinery
+    is pinned where slowness still genuinely exists: a wedged LOSSLESS
+    subscriber backpressuring the pipeline's async path."""
     from serf_tpu.faults.host import run_host_plan
     from serf_tpu.faults.plan import named_plan
+    from serf_tpu.host import EventSubscriber, LoopbackNetwork, Serf
+    from serf_tpu.options import Options
 
     result = await run_host_plan(named_plan("slow-consumer"),
                                  lifecycle_slow_ms=2.0)
     assert result.report.ok
     lc = result.lifecycle
     assert lc is not None and lc["sampled"] > 0
-    assert lc["slow"] > 0
-    slow = flight.flight_dump(kind="slow-message")
-    assert slow, "no slow-message flight events under slow-consumer"
-    for e in slow[-3:]:
-        assert e["e2e_ms"] > e["threshold_ms"]
-        assert e["stages_ms"]                     # full stage breakdown
-        assert set(e["stages_ms"]) <= set(lifecycle.STAGES)
     # the run's ledger was scoped: the global ledger is untouched
     assert lifecycle.global_ledger().seen == 0
-    # and the stage-latency SLO rows judge from the run's snapshot
+    # the stage-latency SLO rows judge from the run's snapshot
     verdicts = {v.slo: v
                 for v in slo.judge_host_run(result,
                                             named_plan("slow-consumer"))}
     assert not verdicts["apply-stage-p99"].skipped
     assert not verdicts["queue-wait-share"].skipped
+
+    # slow-message flight events still fire, with full breakdowns,
+    # where delivery is genuinely slow: a lossless consumer that only
+    # drains after a wedge (every sampled message, slow_ms=2)
+    led = lifecycle.set_global_ledger(
+        lifecycle.LifecycleLedger(sample_n=1, slow_ms=2.0))
+    try:
+        net = LoopbackNetwork()
+        sub = EventSubscriber(maxsize=1, lossless=True)
+        s = await Serf.create(net.bind("sl0"), Options.local(), "sl0",
+                              subscriber=sub)
+        try:
+            for i in range(6):
+                await s.user_event(f"wedge-{i}", b"", coalesce=False)
+            await asyncio.sleep(0.05)        # workers block on the push
+            while sub.try_next() is not None:
+                await asyncio.sleep(0.01)    # slow drain past slow_ms
+        finally:
+            await s.shutdown()
+        run_led = lifecycle.global_ledger()
+        assert run_led.slow > 0
+    finally:
+        lifecycle.set_global_ledger(led)
+    slow = flight.flight_dump(kind="slow-message")
+    assert slow, "no slow-message flight events from the wedged reader"
+    for e in slow[-3:]:
+        assert e["e2e_ms"] > e["threshold_ms"]
+        assert e["stages_ms"]                     # full stage breakdown
+        assert set(e["stages_ms"]) <= set(lifecycle.STAGES)
 
 
 # ---------------------------------------------------------------------------

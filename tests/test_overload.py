@@ -285,25 +285,32 @@ async def test_query_responses_capacity_evicts_earliest_deadline():
 
 
 async def test_event_inbox_sheds_user_events_never_member_events():
+    # a LOSSLESS subscriber keeps delivery on the pipeline's ASYNC path
+    # (no run-to-completion inline fast path), so a synchronous burst
+    # genuinely fills the bounded intake — the shed semantics under test
     net = LoopbackNetwork()
+    sub = EventSubscriber(maxsize=1, lossless=True)
     s = await Serf.create(net.bind("i0"),
-                          Options.local(event_inbox_max=8), "i0")
+                          Options.local(event_inbox_max=8), "i0",
+                          subscriber=sub)
     base = _counter("serf.overload.event_shed")
     try:
-        # let the drain pipeline consume the startup self-join event
-        while s._event_inbox.qsize():
+        # let the pipeline apply the startup self-join event
+        while s.pipeline_depth():
             await asyncio.sleep(0.01)
-        # synchronous burst: the pipeline task gets no loop turns, so the
-        # inbox genuinely fills
+        while sub.try_next() is not None:
+            pass
+        # synchronous burst: the applier workers get no loop turns, so
+        # the intake genuinely fills
         for i in range(50):
             s._emit(UserEvent(i, f"u{i}", b""))
-        assert s._event_inbox.qsize() <= 8
+        assert s.pipeline_depth() <= 8
         shed = _counter("serf.overload.event_shed") - base
         assert shed == 50 - 8
         # membership state is NEVER shed, even over the cap
         me = MemberEvent(MemberEventType.JOIN, (s.local_member(),))
         s._emit(me)
-        assert s._event_inbox.qsize() == 9
+        assert s.pipeline_depth() == 9
     finally:
         await s.shutdown()
 
@@ -332,26 +339,27 @@ async def _pump_slow_reader(n_events: int, inbox_max: int):
 
 
 async def test_slow_lossless_reader_memory_bounded_and_gauge_tracks():
-    # the delivery path absorbs subscriber(16) + tee(TEE_QUEUE_MAX) +
-    # inbox(64) before shedding starts — pump past all of it
+    # the delivery path absorbs subscriber(16) + in-service workers +
+    # intake(64) before shedding starts — pump past all of it
     inbox_max = 64
     n = 5000
     net, s, sub, shed = await _pump_slow_reader(n, inbox_max)
     try:
         # memory stays bounded end to end: subscriber queue at its cap,
-        # tee + inbox at theirs, everything else shed AND counted
+        # pipeline intake at its, everything else shed AND counted
         assert sub.qsize() <= 16
-        assert s._event_inbox.qsize() <= inbox_max
+        assert s.pipeline_depth() <= inbox_max
         assert shed > 0
-        assert sub.qsize() + s._event_inbox.qsize() \
-            + s._tee_queue.qsize() + shed >= n - 32
+        assert sub.qsize() + s.pipeline_depth() \
+            + s._pipeline.inflight() + shed >= n - 32
         # the tee gauge tracked the backlog (health input)
+        s._gauge_queue_ages()
         g = metrics.global_sink().gauge_value(
             "serf.events.tee_depth", {"node": "w0"})
         assert g is not None and g > 0
         assert s.event_tee_fill() > 0
         # the LOSSLESS contract held: shedding happened at the bounded
-        # inbox (admission), never by drop-oldest on the channel
+        # intake (admission), never by drop-oldest on the channel
         assert sub.dropped == 0 and sub.lossless_violations == 0
     finally:
         await s.shutdown()
@@ -377,9 +385,10 @@ async def test_slow_reader_soak_heavy():
     net, s, sub, shed = await _pump_slow_reader(10_000, inbox_max)
     try:
         assert sub.qsize() <= 16
-        assert s._event_inbox.qsize() <= inbox_max
+        assert s.pipeline_depth() <= inbox_max
         assert sub.lossless_violations == 0
-        # - 4 slack: the tee/delivery tasks each hold one event in hand
-        assert shed >= 10_000 - 16 - inbox_max - s._tee_queue.maxsize - 4
+        # slack: each applier worker holds at most one event in hand
+        assert shed >= 10_000 - 16 - inbox_max \
+            - s.opts.pipeline_workers - 4
     finally:
         await s.shutdown()

@@ -82,6 +82,37 @@ def test_async_clean_twin_is_silent(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# pipeline-bypass (the MPMC hand-off seam)
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_bad_fixture_fires_every_pattern(tmp_path):
+    project = toy_project(tmp_path, {
+        "serf_tpu/host/fake.py": (FIXTURES / "bad_pipeline.py").read_text()})
+    report = analysis.run_rules(project, rules=["pipeline-bypass"])
+    # queue ctor + put_nowait + put + internals reach
+    assert count(report, "pipeline-bypass") == 4
+
+
+def test_pipeline_clean_twin_is_silent(tmp_path):
+    project = toy_project(tmp_path, {
+        "serf_tpu/host/fake.py": (FIXTURES / "ok_pipeline.py").read_text()})
+    report = analysis.run_rules(project, rules=["pipeline-bypass"])
+    assert count(report, "pipeline-bypass") == 0
+
+
+def test_pipeline_rule_exempts_queue_owning_modules(tmp_path):
+    """The SAME bad file inside a queue-owning module (the subscriber
+    channel, the transports) fires only the internals-reach pattern —
+    those modules legitimately construct/drive their own queues."""
+    project = toy_project(tmp_path, {
+        "serf_tpu/host/events.py": (FIXTURES / "bad_pipeline.py")
+        .read_text()})
+    report = analysis.run_rules(project, rules=["pipeline-bypass"])
+    assert count(report, "pipeline-bypass") == 1      # _pipeline._ready
+
+
+# ---------------------------------------------------------------------------
 # JAX family (scoped to serf_tpu/models|ops|parallel paths)
 # ---------------------------------------------------------------------------
 
@@ -581,7 +612,7 @@ def test_rule_registry_is_exactly_the_shipped_set():
     on purpose — every rule ships with its golden fixtures."""
     assert set(analysis.ALL_RULES) == {
         "async-fire-forget", "async-blocking-call", "async-lock-await",
-        "async-shared-mut",
+        "async-shared-mut", "pipeline-bypass",
         "jax-python-branch", "jax-host-concretize", "jax-host-transfer",
         "jax-unhashable-arg",
         "reg-metric-unknown", "reg-metric-unused", "reg-doc-drift",
