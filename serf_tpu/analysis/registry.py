@@ -87,6 +87,11 @@ METRICS: tuple = (
     "serf.pipeline.depth",
     "serf.pipeline.keys",
     "serf.pipeline.batch",
+    "serf.pipeline.occupancy",
+    "serf.pipeline.inline-share",
+    "serf.pipeline.ready-depth",
+    "serf.pipeline.chain-p50",
+    "serf.pipeline.chain-max",
     "serf.query.acks",
     "serf.query.duplicate_acks",
     "serf.query.duplicate_responses",
@@ -259,6 +264,19 @@ CONTROL_SOURCES = {
     "serf_tpu/control/device.py": ("KNOB_FIELDS", "DEVICE_LAWS"),
     "serf_tpu/control/host.py": ("HOST_KNOBS", "HOST_LAWS"),
 }
+
+#: the telemetry-row source the ``telemetry-field-drift`` rule
+#: fingerprints: file -> (field-tuple literal, merge-dict literal).  The
+#: README section below carries one table row per field (| `field` |
+#: merge | ... ) — enforced both ways like the metric table.
+TELEMETRY_SOURCES = {
+    "serf_tpu/models/swim.py": ("TELEMETRY_FIELDS", "TELEMETRY_MERGE"),
+}
+TELEMETRY_SECTION = "## Zero-cost telemetry & timeline export"
+#: the merge ops the in-collective legs implement
+#: (parallel/ring.round_telemetry_sharded): psum / pmax / pmin legs, or
+#: replicated per-chip computation
+TELEMETRY_MERGE_OPS = ("sum", "max", "min", "replicated")
 
 
 # ---------------------------------------------------------------------------
@@ -725,6 +743,110 @@ def check_control_knob_drift(files: List[SourceFile],
             f"declared control knob {knob!r} appears in no knob-field "
             "tuple and no law table — delete the CONTROL_KNOBS entry "
             "or restore the knob")
+
+
+# ---------------------------------------------------------------------------
+# telemetry-row cross-check (pass family d, ISSUE 15): the in-collective
+# telemetry contract is registry-governed like the knobs and SLOs
+# ---------------------------------------------------------------------------
+
+def _dict_literal(tree: ast.AST, name: str):
+    """Top-level ``NAME = {"k": "v", ...}`` string-dict literal as
+    ``[(key, value, lineno), ...]``, or None when absent."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == name \
+                and isinstance(node.value, ast.Dict):
+            out = []
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    out.append((k.value,
+                                v.value if isinstance(v, ast.Constant)
+                                else None, k.lineno))
+            return out
+    return None
+
+
+def documented_telemetry_fields(readme: Path) -> Dict[str, int]:
+    """{field: line} from the README telemetry table (the
+    ``TELEMETRY_SECTION`` section's first column)."""
+    out: Dict[str, int] = {}
+    in_section = False
+    for i, line in enumerate(readme.read_text().splitlines(), start=1):
+        if line.startswith("## "):
+            in_section = line.strip() == TELEMETRY_SECTION
+            continue
+        if not in_section:
+            continue
+        m = ROW_RE.match(line)
+        if m and m.group(1) not in ("Field", "Metric"):
+            out[m.group(1)] = i
+    return out
+
+
+@project_rule("telemetry-field-drift",
+              "the telemetry row, its in-collective merge contract, and "
+              "the README telemetry table out of sync (a field added to "
+              "the row but not reduced, reduced but undeclared, an "
+              "unknown merge op, or a missing/stale README row)",
+              'TELEMETRY_FIELDS gains "new_field" with no '
+              "TELEMETRY_MERGE entry")
+def check_telemetry_field_drift(files: List[SourceFile],
+                                project: Project) -> Iterable[Finding]:
+    by_rel = {f.rel: f for f in files}
+    for rel, (fields_name, merge_name) in TELEMETRY_SOURCES.items():
+        src = by_rel.get(rel)
+        if src is None:
+            continue
+        fields = _tuple_literal(src.tree, fields_name)
+        merge = _dict_literal(src.tree, merge_name)
+        if fields is None:
+            continue
+        merge = merge or []
+        merge_keys = {k for k, _v, _ln in merge}
+        field_set = {f for f, _ln in fields}
+        for f_name, lineno in fields:
+            if f_name not in merge_keys:
+                yield _reg_finding(
+                    "telemetry-field-drift", rel, lineno,
+                    f"unreduced:{f_name}",
+                    f"telemetry field {f_name!r} ({fields_name}) has no "
+                    f"{merge_name} entry — a row field the in-collective "
+                    "legs do not reduce silently breaks the sharded row "
+                    "(declare its merge op, or drop the field)")
+        for k, op, lineno in merge:
+            if k not in field_set:
+                yield _reg_finding(
+                    "telemetry-field-drift", rel, lineno,
+                    f"undeclared:{k}",
+                    f"{merge_name} reduces {k!r} which is not a "
+                    f"{fields_name} entry — dead merge leg (add the row "
+                    "field or delete the entry)")
+            if op not in TELEMETRY_MERGE_OPS:
+                yield _reg_finding(
+                    "telemetry-field-drift", rel, lineno,
+                    f"bad-op:{k}",
+                    f"{merge_name}[{k!r}] declares unknown merge op "
+                    f"{op!r} (one of {TELEMETRY_MERGE_OPS}) — the "
+                    "collective legs cannot implement it")
+        if project.readme is not None and project.readme.exists():
+            documented = documented_telemetry_fields(project.readme)
+            readme_rel = project.readme.name
+            for f_name in sorted(field_set - set(documented)):
+                yield _reg_finding(
+                    "telemetry-field-drift", readme_rel, 1,
+                    f"undocumented:{f_name}",
+                    f"telemetry field {f_name!r} has no row in the "
+                    f"README '{TELEMETRY_SECTION[3:]}' table")
+            for f_name, line in sorted(documented.items()):
+                if f_name not in field_set:
+                    yield _reg_finding(
+                        "telemetry-field-drift", readme_rel, line,
+                        f"stale-row:{f_name}",
+                        f"README documents telemetry field {f_name!r} "
+                        "but the row does not carry it — delete the row "
+                        "or restore the field")
 
 
 # ---------------------------------------------------------------------------

@@ -24,6 +24,7 @@ Lowering semantics (device deviations are explicit, not silent):
 from __future__ import annotations
 
 import functools
+import time
 from dataclasses import dataclass, field
 from typing import List, NamedTuple, Optional, Tuple
 
@@ -183,9 +184,9 @@ def run_phase(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
 
     def body(carry, subkey):
         nxt = cluster_round(carry, cfg, subkey, drop_rate=drop, mesh=mesh)
-        row = round_telemetry(nxt, cfg) \
+        row = round_telemetry(nxt, cfg, mesh=mesh) \
             if (collect_telemetry or cfg.control.enabled) else None
-        nxt, row = control_tick(nxt, cfg, row)
+        nxt, row = control_tick(nxt, cfg, row, mesh=mesh)
         aux = []
         if collect_digests:
             overall, node = state_digest(nxt.gossip, cfg.gossip)
@@ -282,6 +283,14 @@ class DeviceChaosResult:
     control_rows: object = None
     control_final: Optional[dict] = None
     control_decisions: List[dict] = field(default_factory=list)
+    #: per-scan-chunk wall stamps ``(base_round, rounds, t0, t1)`` —
+    #: the timeline exporter's piecewise round→wall-clock anchors
+    #: (obs/timeline.PiecewiseAnchors).  Stamps bracket the DISPATCH of
+    #: each chunk (no added barrier — the one-device_get-per-run
+    #: discipline holds), so on an async backend t1 trails dispatch,
+    #: and the FIRST chunk's window includes the phase-scan compile;
+    #: later chunks reuse the executable and map tightly.
+    scan_walls: List[tuple] = field(default_factory=list)
 
 
 def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
@@ -401,6 +410,7 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
     #: follow the same discipline.
     tele_chunks: List[tuple] = []
     ctl_chunks: List[tuple] = []
+    scan_walls: List[tuple] = []
     #: the previous scan's last control row (host side) — the recorder's
     #: decision extraction is incremental across scans
     ctl_prev = [_ctl_base_row]
@@ -413,19 +423,24 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
         them."""
         want_dig = recorder is not None
         if not want_dig and not collect_telemetry and not want_ctl:
-            return run(st, key=k_run, num_rounds=num_rounds, group=group,
-                       drop=drop, init_alive=init_alive, down=down)
+            t0 = time.time()
+            st = run(st, key=k_run, num_rounds=num_rounds, group=group,
+                     drop=drop, init_alive=init_alive, down=down)
+            scan_walls.append((base_round, num_rounds, t0, time.time()))
+            return st
         if want_dig:
             from serf_tpu.replay.recording import record_scan_views
             recorder.step("scan", phase=phase, rounds=num_rounds,
                           key=key_to_hex(k_run))
             include_nodes = cfg.n <= _NODE_DIGEST_CAP()
+        t0 = time.time()
         st, out = run(st, key=k_run, num_rounds=num_rounds,
                       group=group, drop=drop, init_alive=init_alive,
                       down=down, collect_digests=want_dig,
                       include_nodes=(include_nodes if want_dig else True),
                       collect_telemetry=collect_telemetry,
                       collect_control=want_ctl)
+        scan_walls.append((base_round, num_rounds, t0, time.time()))
         parts = list(out) if sum((want_dig, collect_telemetry,
                                   want_ctl)) > 1 else [out]
         dg = dn = rows = crows = None
@@ -561,4 +576,5 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
                              telemetry_final=telemetry_final,
                              control_rows=control_rows,
                              control_final=control_final,
-                             control_decisions=control_decisions)
+                             control_decisions=control_decisions,
+                             scan_walls=scan_walls)

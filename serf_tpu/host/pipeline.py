@@ -224,6 +224,11 @@ class EventPipeline:
         self._offers = 0
         #: events fully applied (observe + deliver complete)
         self.applied = 0
+        #: applied INLINE at offer() (the run-to-completion fast path:
+        #: zero queue-wait, no worker wake) — ``applied - inline_applied``
+        #: is the queued MPMC remainder; the split is the
+        #: ``serf.pipeline.inline-share`` gauge on the monitor tick
+        self.inline_applied = 0
         #: per-worker enqueue timestamp of the entry being serviced
         self._inflight: Dict[int, float] = {}
         self._wake = asyncio.Event()
@@ -296,6 +301,7 @@ class EventPipeline:
                           type(ev).__name__)
         ledger.event_finish(ev, "tee")
         self.applied += 1
+        self.inline_applied += 1
 
     # -- consumer side ------------------------------------------------------
 
@@ -393,8 +399,31 @@ class EventPipeline:
                       self._pending + len(self._inflight), self._labels)
 
     def gauge(self) -> None:
-        """Refresh the depth gauges (periodic monitor hook)."""
+        """Refresh the pipeline gauges (periodic monitor hook): depth/
+        keys plus the PR-15 observability-gap set — per-worker occupancy
+        (what fraction of appliers are mid-delivery), the inline-vs-
+        queued delivery split (how often the run-to-completion fast path
+        wins), the ready-ring depth (keys waiting for a worker), and the
+        per-dependency-key chain length p50/max (is one tenant's chain
+        the backlog, or is it broad?).  O(keys) work, monitor-tick
+        cadence only — never per event."""
+        from serf_tpu.utils.metrics import percentile_of
+
         self._gauge()
+        metrics.gauge("serf.pipeline.occupancy",
+                      len(self._inflight) / self._nworkers, self._labels)
+        if self.applied:
+            metrics.gauge("serf.pipeline.inline-share",
+                          self.inline_applied / self.applied,
+                          self._labels)
+        metrics.gauge("serf.pipeline.ready-depth", len(self._ready),
+                      self._labels)
+        lens = sorted(len(c) for c in self._chains.values())
+        metrics.gauge("serf.pipeline.chain-p50",
+                      percentile_of(lens, 50) if lens else 0.0,
+                      self._labels)
+        metrics.gauge("serf.pipeline.chain-max",
+                      float(lens[-1]) if lens else 0.0, self._labels)
 
     async def aclose(self, timeout: float = 2.0) -> None:
         """Graceful stop: drain everything already offered, then stop

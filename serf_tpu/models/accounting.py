@@ -412,6 +412,61 @@ def kernel_path_summary(cfg, regime: str = "sustained",
     return out
 
 
+def telemetry_leg_traffic(cfg, n_devices: int = 8) -> dict:
+    """Byte/ICI model of the in-collective telemetry legs
+    (``parallel.ring.round_telemetry_sharded``) — the arithmetic behind
+    the ~0-extra-bytes claim (ISSUE 15 / ROADMAP item 4 in-network
+    aggregation): the per-round cluster row costs **O(fields)** bytes
+    per chip at ANY node count, vs the O(N)-plane gather the same row
+    would otherwise require.
+
+    The three legs and their payloads (K = ``k_facts``):
+
+    - ``pmax`` subject-incarnation assembly: u32[K]  (4K bytes)
+    - fused ``psum`` stage-1 partials:       i32[1 + 2K]
+    - ``psum`` false-DEAD scalar:            i32[1]
+
+    Each all-reduce of ``p`` payload bytes moves ~``2 p (D-1)/D`` bytes
+    per chip (reduce-scatter + all-gather decomposition).  The gathered
+    alternative is priced as the N-planes the row actually reads
+    (known + stamp + alive + incarnation + tombstone) landing on one
+    chip — what a naive ``device_get``/gather implementation ships.
+
+    Returns a dict with both sides and their ratio; the pinned test
+    (tests/test_accounting.py) holds the leg bytes independent of ``n``
+    and ≤ a per-mille of the exchange block."""
+    g: GossipConfig = cfg.gossip
+    n, k, w, d = g.n, g.k_facts, g.words, max(1, n_devices)
+    payloads = {
+        "pmax_subject_incarnations": 4 * k,
+        "psum_stage1_partials": 4 * (1 + 2 * k),
+        "psum_false_dead": 4,
+    }
+    factor = 2.0 * (d - 1) / d
+    per_leg = {name: factor * p for name, p in payloads.items()}
+    total = sum(per_leg.values())
+    stamp_plane = float(n * (k // 2 if g.pack_stamp else k))
+    gathered = (d - 1) / d * float(
+        n * w * 4          # known bitset u32[N, W]
+        + stamp_plane      # stamp plane u8
+        + n                # alive bool[N]
+        + n * 4            # incarnation u32[N]
+        + n)               # tombstone bool[N]
+    return {
+        "n": n, "n_devices": d, "k_facts": k,
+        "payload_bytes": payloads,
+        "bytes_per_chip_per_round": total,
+        "per_leg_bytes_per_chip": per_leg,
+        "ici_us": total / V5E_ICI_BYTES_PER_S * 1e6,
+        "collective_launches": 3,
+        "gathered_alternative_bytes_per_chip": gathered,
+        "fraction_of_gather": total / gathered if gathered else 0.0,
+        "rule": "payloads are O(k_facts), never O(n): the row rides the "
+                "exchange collective as fused psum/pmax legs — "
+                "cluster-wide observability at ~0 extra bytes at any D",
+    }
+
+
 def ici_round_traffic(cfg, n_devices: int = 8) -> dict:
     """Per-phase, per-chip byte attribution for one flagship round under
     node sharding — the arithmetic behind the 8-chip throughput claim
@@ -535,6 +590,11 @@ def ici_round_traffic(cfg, n_devices: int = 8) -> dict:
                 "launches — i.e. at flagship scale; allgather wins at "
                 "small blocks where launch latency dominates",
     }
+
+    # the in-collective telemetry legs (ISSUE 15): O(fields) bytes per
+    # chip beside the exchange's O(N/D) packet blocks — priced here so
+    # the ~0-extra-bytes claim is part of the same per-phase attribution
+    out["telemetry"] = telemetry_leg_traffic(cfg, d)
 
     # the round is bound by the slower of HBM and ICI (they overlap at
     # best); the implied D-chip sustained ceiling uses the rotation path

@@ -83,21 +83,39 @@ def rotation_offset(round_, n: int) -> jnp.ndarray:
                             * jnp.uint32(2654435761)) % jnp.uint32(max(1, n - 1))
 
 
-def _facts_about(state: GossipState, kinds, inc_current: bool = False):
+def subject_incarnations(state: GossipState) -> jnp.ndarray:
+    """u32[K]: each fact subject's CURRENT ground-truth incarnation —
+    the staleness-gate operand of ``_facts_about(inc_current=True)``.
+
+    Factored out for the in-collective telemetry leg (parallel.ring):
+    with the incarnation plane node-sharded, each chip contributes the
+    incarnations of the subjects living in its shard and a K-sized
+    ``pmax`` assembles the same vector this global gather produces —
+    O(K) on the wire instead of gathering an N-plane."""
+    subj = jnp.clip(state.facts.subject, 0)
+    return state.incarnation[subj]
+
+
+def _facts_about(state: GossipState, kinds, inc_current: bool = False,
+                 subj_inc=None):
     """bool[K]: table slots that are valid facts of one of ``kinds``.
 
     ``inc_current=True`` additionally requires the fact's incarnation to
     be >= its subject's current ground-truth incarnation — THE
     staleness gate (single definition): a fact whose subject has since
     bumped past it (a refutation happened, even if the K_ALIVE fact was
-    recycled out of the ring) is no longer current evidence."""
+    recycled out of the ring) is no longer current evidence.
+    ``subj_inc`` (u32[K]) overrides the subject-incarnation lookup with
+    a precomputed vector (the sharded telemetry leg's pmax-assembled
+    one); None keeps the direct ``incarnation[subject]`` gather."""
     m = jnp.zeros_like(state.facts.valid)
     for k in kinds:
         m = m | (state.facts.kind == k)
     m = m & state.facts.valid
     if inc_current:
-        subj = jnp.clip(state.facts.subject, 0)
-        m = m & (state.facts.incarnation >= state.incarnation[subj])
+        if subj_inc is None:
+            subj_inc = subject_incarnations(state)
+        m = m & (state.facts.incarnation >= subj_inc)
     return m
 
 
@@ -137,16 +155,19 @@ def _refutation_matrix(state: GossipState) -> jnp.ndarray:
     return same_subject & alive_facts[None, :] & higher_inc
 
 
-def live_suspicions(state: GossipState) -> jnp.ndarray:
+def live_suspicions(state: GossipState, subj_inc=None) -> jnp.ndarray:
     """bool[K]: suspicion facts that could still produce a declaration —
     neither refuted (alive fact, same subject, higher incarnation) nor
     already covered by a dead declaration.  The declare_round skip-gate;
-    all-False makes the phase a bit-exact identity."""
+    all-False makes the phase a bit-exact identity.  ``subj_inc``
+    forwards to the staleness gate (see :func:`subject_incarnations`) —
+    only the sharded telemetry leg passes it."""
     suspect = _facts_about(state, (K_SUSPECT,))
     refuted = jnp.any(_refutation_matrix(state), axis=1)
     same_subject = (state.facts.subject[:, None]
                     == state.facts.subject[None, :])
-    dead_slot = _facts_about(state, (K_DEAD,), inc_current=True)
+    dead_slot = _facts_about(state, (K_DEAD,), inc_current=True,
+                             subj_inc=subj_inc)
     dead_covered = jnp.any(same_subject & dead_slot[None, :], axis=1)
     return suspect & ~refuted & ~dead_covered
 
@@ -442,6 +463,75 @@ def run_swim(state: GossipState, cfg: GossipConfig, fcfg: FailureConfig,
 
 # -- views / metrics ---------------------------------------------------------
 
+def believer_counts(state: GossipState, cfg: GossipConfig,
+                    fcfg: FailureConfig, stretch_q=None,
+                    subj_inc=None, known=None,
+                    evidence_facts=None) -> jnp.ndarray:
+    """i32[K]: per-fact count of ALIVE believers among (this shard of)
+    the cluster — the stage-1 partial of the believed-dead judgment.
+
+    Associative under elementwise ``+``: the knower axis reductions are
+    plain integer sums, so partials computed over disjoint node shards
+    psum to exactly the global count (the in-collective telemetry leg,
+    ``parallel.ring.round_telemetry_sharded``, relies on this).
+    ``subj_inc``/``known``/``evidence_facts`` let the caller supply the
+    pmax-assembled subject incarnations / an already-unpacked known
+    plane / already-computed ``(dead_fact, aged_suspect)`` masks (the
+    telemetry path's skip-gate computed them for its predicate).
+    """
+    k = cfg.k_facts
+    if known is None:
+        known = unpack_bits(state.known, k)
+    # an accusation stale w.r.t. the subject's CURRENT incarnation is no
+    # evidence: the incarnation plane is the durable record of a
+    # refutation (the K_ALIVE fact itself may have been recycled out of
+    # the ring — the dual of the tombstone plane for deaths; reference
+    # member tables ignore stale-incarnation dead messages forever)
+    if evidence_facts is not None:
+        dead_fact, aged_suspect = evidence_facts
+    else:
+        dead_fact = _facts_about(state, (K_DEAD,), inc_current=True,
+                                 subj_inc=subj_inc)
+        aged_suspect = _facts_about(state, (K_SUSPECT,),
+                                    inc_current=True, subj_inc=subj_inc)
+    aged = mod_age(state, cfg) >= suspicion_q_of(fcfg, stretch_q)
+    # (gated by `known` below)
+    evidence = known & (dead_fact[None, :] | (aged_suspect[None, :] & aged))
+    # refutation: knower also knows an alive fact about the same subject
+    # with strictly higher incarnation.  knower_refutes[n, j] =
+    # any_k(known[n, k] & refutes[j, k]) — computed as bit overlap
+    # against the ALREADY-PACKED known words instead of the former
+    # [N,K]·[K,K] float einsum: K/32 u32 AND-passes replace N·K·K MACs
+    # (identical booleans: a 0/1 dot product is > 0 iff some bit is
+    # shared), which keeps the telemetry row's gate-open cost a
+    # fraction of a round instead of a multiple of one
+    refutes = _refutation_matrix(state)                      # [K, K]
+    words = k // 32
+    r3 = refutes.reshape(k, words, 32).astype(jnp.uint32)
+    packed = jnp.sum(r3 << jnp.arange(32, dtype=jnp.uint32),
+                     axis=-1)                                # u32[K, W]
+    knower_refutes = jnp.zeros(known.shape, bool)
+    for w in range(words):
+        knower_refutes = knower_refutes | (
+            (state.known[:, w][:, None] & packed[None, :, w]) != 0)
+    active = evidence & ~knower_refutes                  # bool[N(l), K]
+    return jnp.sum(active & state.alive[:, None], axis=0)
+
+
+def believed_subjects(state: GossipState, n: int, believer_cnt,
+                      alive_cnt) -> jnp.ndarray:
+    """bool[N]: stage-2 of the believed-dead judgment from GLOBALLY
+    reduced counts — 'every alive node believes subject dead' scattered
+    onto the subject axis.  A pure function of the (replicated) fact
+    table and two reduced count operands, so every shard of a sharded
+    cluster computes it identically; the tombstone OR stays with the
+    caller (the tombstone plane is node-sharded)."""
+    all_believe = believer_cnt >= jnp.maximum(alive_cnt, 1)
+    subj = jnp.clip(state.facts.subject, 0)
+    return jnp.zeros((n,), bool).at[subj].max(
+        all_believe & state.facts.valid)
+
+
 def believed_dead(state: GossipState, cfg: GossipConfig,
                   fcfg: FailureConfig, stretch_q=None) -> jnp.ndarray:
     """bool[N, N']→ compressed: for each node i (knower) and table slot j,
@@ -450,31 +540,15 @@ def believed_dead(state: GossipState, cfg: GossipConfig,
 
     ``stretch_q`` widens the aged-suspicion evidence window exactly like
     the declare scan (:func:`suspicion_q_of`): a controlled cluster that
-    stretched its suspicion timers is judged by the semantics it runs."""
-    n, k = cfg.n, cfg.k_facts
-    known = unpack_bits(state.known, k)
-    # an accusation stale w.r.t. the subject's CURRENT incarnation is no
-    # evidence: the incarnation plane is the durable record of a
-    # refutation (the K_ALIVE fact itself may have been recycled out of
-    # the ring — the dual of the tombstone plane for deaths; reference
-    # member tables ignore stale-incarnation dead messages forever)
-    dead_fact = _facts_about(state, (K_DEAD,), inc_current=True)
-    aged_suspect = _facts_about(state, (K_SUSPECT,), inc_current=True)
-    aged = mod_age(state, cfg) >= suspicion_q_of(fcfg, stretch_q)
-    # (gated by `known` below)
-    evidence = known & (dead_fact[None, :] | (aged_suspect[None, :] & aged))
-    # refutation: knower also knows an alive fact about the same subject
-    # with strictly higher incarnation
-    refutes = _refutation_matrix(state)                      # [K, K]
-    knower_refutes = jnp.einsum("nk,jk->nj", known.astype(jnp.float32),
-                                refutes.astype(jnp.float32)) > 0
-    active = evidence & ~knower_refutes                      # bool[N, K]
-    subj = jnp.clip(state.facts.subject, 0)
-    alive_n = jnp.maximum(jnp.sum(state.alive), 1)
-    per_fact_believers = jnp.sum(active & state.alive[:, None], axis=0)
-    all_believe = per_fact_believers >= alive_n
-    believed = jnp.zeros((n,), bool).at[subj].max(
-        all_believe & state.facts.valid)
+    stretched its suspicion timers is judged by the semantics it runs.
+
+    Staged through :func:`believer_counts` / :func:`believed_subjects`
+    so the sharded telemetry leg can psum the stage-1 partials instead
+    of gathering the knower planes — this unsharded composition is the
+    bit-identical reference the sharded row is pinned against."""
+    per_fact_believers = believer_counts(state, cfg, fcfg, stretch_q)
+    believed = believed_subjects(state, cfg.n, per_fact_believers,
+                                 jnp.sum(state.alive))
     # durable record: a fully-disseminated death whose ring slot has
     # recycled lives on in the tombstone plane (GossipState.tombstone)
     return believed | state.tombstone

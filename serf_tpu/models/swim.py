@@ -35,11 +35,16 @@ from serf_tpu.models.dissemination import (
 )
 from serf_tpu.models.failure import (
     FailureConfig,
-    believed_dead,
+    K_DEAD,
+    K_SUSPECT,
+    _facts_about,
+    believed_subjects,
+    believer_counts,
     declare_round,
     live_suspicions,
     probe_round,
     refute_round,
+    subject_incarnations,
 )
 from serf_tpu.models.vivaldi import (
     VivaldiConfig,
@@ -288,18 +293,21 @@ def vivaldi_phase(state: ClusterState, cfg: ClusterConfig, k_peer,
                           active=reachable)
 
 
-def control_tick(state: ClusterState, cfg: ClusterConfig, row=None):
+def control_tick(state: ClusterState, cfg: ClusterConfig, row=None,
+                 mesh=None):
     """Apply the device control law after a round: extract the law
     signals from the (post-round) telemetry ``row`` and advance
     ``state.control`` — the decision feeds forward as round R+1's
     dynamic config.  Returns ``(state, row)``; ``row`` is computed here
     when the caller did not already collect telemetry, so the two
-    consumers share ONE N×K unpack per round.  A no-op pass-through
-    when the controller is disabled."""
+    consumers share ONE N×K unpack per round.  ``mesh`` routes that
+    computation through the in-collective sharded leg (the sharded
+    flagship's controller reads the SAME bit-identical row).  A no-op
+    pass-through when the controller is disabled."""
     if not cfg.control.enabled:
         return state, row
     if row is None:
-        row = round_telemetry(state, cfg)
+        row = round_telemetry(state, cfg, mesh=mesh)
     sig = ControlSignals(
         agreement=row[TELEMETRY_FIELDS.index("agreement")],
         false_dead=row[TELEMETRY_FIELDS.index("false_dead")],
@@ -314,7 +322,7 @@ def run_cluster(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
                 num_rounds: int, mesh=None) -> ClusterState:
     def body(carry, subkey):
         nxt = cluster_round(carry, cfg, subkey, mesh=mesh)
-        nxt, _ = control_tick(nxt, cfg)
+        nxt, _ = control_tick(nxt, cfg, mesh=mesh)
         return nxt, ()
 
     keys = jax.random.split(key, num_rounds)
@@ -391,9 +399,9 @@ def run_cluster_sustained(state: ClusterState, cfg: ClusterConfig,
     def body(carry, subkey):
         nxt = sustained_round(carry, cfg, subkey, events_per_round,
                               mesh=mesh)
-        row = round_telemetry(nxt, cfg) \
+        row = round_telemetry(nxt, cfg, mesh=mesh) \
             if (collect_telemetry or cfg.control.enabled) else None
-        nxt, row = control_tick(nxt, cfg, row)
+        nxt, row = control_tick(nxt, cfg, row, mesh=mesh)
         if collect_telemetry:
             return nxt, row
         return nxt, ()
@@ -411,8 +419,129 @@ def run_cluster_sustained(state: ClusterState, cfg: ClusterConfig,
 TELEMETRY_FIELDS = ("alive", "facts_valid", "agreement", "coverage",
                     "overflow", "injected", "suspicions", "false_dead")
 
+#: THE in-collective merge contract (ISSUE 15): how each telemetry
+#: field's per-chip partial combines across the node shards when the row
+#: is computed INSIDE the sharded exchange collective
+#: (``parallel.ring.round_telemetry_sharded``):
+#:
+#: - ``"sum"``  — the field is assembled from integer partial sums that
+#:   ride a fused ``lax.psum`` leg (ratios like agreement/coverage psum
+#:   their numerator/denominator counts and divide AFTER the reduce, so
+#:   the float math runs once on globally-identical integers);
+#: - ``"max"`` / ``"min"`` — the partial rides a ``lax.pmax`` /
+#:   ``lax.pmin`` leg (the subject-incarnation staleness gate uses the
+#:   pmax shape internally; row fields may too);
+#: - ``"replicated"`` — computed identically on every chip from
+#:   replicated inputs only (fact-table K-planes, scalar ledgers): no
+#:   collective at all.
+#:
+#: A NEW FIELD MUST BE ASSOCIATIVE (and commutative) under its declared
+#: op — partials from disjoint node shards must combine to exactly the
+#: global value in any order — or it cannot ride the collective and has
+#: no place in this row.  serflint's ``telemetry-field-drift`` rule
+#: holds this table, TELEMETRY_FIELDS, and the README telemetry table
+#: to each other, both ways.
+TELEMETRY_MERGE = {
+    "alive": "sum",
+    "facts_valid": "replicated",
+    "agreement": "sum",
+    "coverage": "sum",
+    "overflow": "replicated",
+    "injected": "replicated",
+    "suspicions": "replicated",
+    "false_dead": "sum",
+}
 
-def round_telemetry(state: ClusterState, cfg: ClusterConfig) -> jnp.ndarray:
+
+def telemetry_counts(g: GossipState, cfg: ClusterConfig, stretch_q=None,
+                     subj_inc=None):
+    """Stage-1 of the telemetry row: the integer partials over (this
+    shard of) the cluster — ``(alive_cnt, colcnt i32[K],
+    believers i32[K])``, every one a plain integer sum over the node
+    axis, so partials over disjoint shards psum to exactly the global
+    counts (the TELEMETRY_MERGE "sum" contract).  ``subj_inc`` forwards
+    the pmax-assembled subject incarnations on the sharded path.
+
+    Cost discipline (the ``obs_overhead`` bench band): the row rides
+    EVERY round, so its heavy stage — the believed-dead evidence pass
+    ([N, K] staleness/age planes + the knower-refutation product) — is
+    skip-gated exactly like the round's own detection phases: with no
+    current-incarnation dead/suspect fact in the ring (the sustained
+    steady state once detection completes and the ring recycles), the
+    evidence plane is identically zero, so the gated branch returns the
+    zero vector the full computation would — bit-exact, paying one
+    K-plane predicate instead of the [N, K] passes.  ``agreement``'s
+    numerator/denominator need no planes of their own: they are exact
+    K-sized integer folds of ``colcnt``/``alive_cnt`` (see
+    :func:`telemetry_finish`)."""
+    known = unpack_bits(g.known, cfg.gossip.k_facts)     # bool[N(l), K]
+    alive_col = g.alive[:, None]
+    alive_cnt = jnp.sum(g.alive)
+    colcnt = jnp.sum(known & alive_col, axis=0)          # i32[K]
+    if subj_inc is None:
+        subj_inc = subject_incarnations(g)
+    dead_fact = _facts_about(g, (K_DEAD,), inc_current=True,
+                             subj_inc=subj_inc)
+    aged_suspect = _facts_about(g, (K_SUSPECT,), inc_current=True,
+                                subj_inc=subj_inc)
+    k = cfg.gossip.k_facts
+    believers = jax.lax.cond(
+        jnp.any(dead_fact | aged_suspect),
+        lambda: believer_counts(
+            g, cfg.gossip, cfg.failure, stretch_q=stretch_q,
+            subj_inc=subj_inc, known=known,
+            evidence_facts=(dead_fact, aged_suspect)).astype(colcnt.dtype),
+        lambda: jnp.zeros((k,), colcnt.dtype))
+    return alive_cnt, colcnt, believers
+
+
+def telemetry_finish(g: GossipState, cfg: ClusterConfig, alive_cnt,
+                     colcnt, false_dead, subj_inc=None) -> jnp.ndarray:
+    """Stage-2 of the telemetry row: assemble ``f32[F]`` from globally
+    reduced integer counts plus the replicated fields.  The float math
+    (agreement/coverage ratios) runs here, AFTER the reduce, on
+    integers every chip agrees on — which is what makes the sharded row
+    bit-identical to the gathered one.  ``agreement``'s counts are
+    exact integer folds of the reduced operands: ``hit = Σ_k valid[k] ·
+    colcnt[k]`` re-associates the same bool sum per-fact-column first
+    (integer addition — exact in any order) and ``cells = alive · valid``
+    is the same product the masked [N, K] sum computes."""
+    valid = g.facts.valid
+    n_valid_i = jnp.sum(valid)
+    cells = alive_cnt * n_valid_i
+    hit = jnp.sum(jnp.where(valid, colcnt, 0))
+    n_alive = jnp.maximum(alive_cnt, 1).astype(jnp.float32)
+    agreement = jnp.where(cells > 0,
+                          hit.astype(jnp.float32)
+                          / jnp.maximum(cells, 1).astype(jnp.float32),
+                          1.0)
+    n_valid = jnp.maximum(n_valid_i, 1).astype(jnp.float32)
+    cov = colcnt.astype(jnp.float32) / n_alive
+    mean_cov = jnp.sum(jnp.where(valid, cov, 0.0)) / n_valid
+    return jnp.stack([
+        alive_cnt.astype(jnp.float32),
+        n_valid_i.astype(jnp.float32),
+        agreement.astype(jnp.float32),
+        mean_cov.astype(jnp.float32),
+        g.overflow.astype(jnp.float32),
+        g.injected.astype(jnp.float32),
+        jnp.sum(live_suspicions(g, subj_inc=subj_inc))
+           .astype(jnp.float32),
+        false_dead.astype(jnp.float32),
+    ])
+
+
+def telemetry_stretch(state: ClusterState, cfg: ClusterConfig):
+    """The live suspicion-stretch knob the believed-dead judgment must
+    honor (None when the controller is disabled): under adaptive
+    control the signal the controller reads is the semantics it
+    changed."""
+    return state.control.knobs[KNOB_STRETCH_Q] \
+        if cfg.control.enabled else None
+
+
+def round_telemetry(state: ClusterState, cfg: ClusterConfig,
+                    mesh=None) -> jnp.ndarray:
     """One compact counters row (``f32[len(TELEMETRY_FIELDS)]``) off the
     current cluster state, cheap enough to ride EVERY round as a scan
     output: alive count, valid facts, knowledge agreement + mean
@@ -420,39 +549,27 @@ def round_telemetry(state: ClusterState, cfg: ClusterConfig) -> jnp.ndarray:
     ledger, live suspicions, and false-DEAD count (alive nodes the
     cluster believes dead — the probe/refute outcome the SLO plane
     judges).  Pure function of the state — safe inside jit/scan, and the
-    quantities agree with ``emit_*_metrics`` by construction."""
+    quantities agree with ``emit_*_metrics`` by construction.
+
+    ``mesh`` (the sharded flagship round's mesh) computes the SAME row
+    in-collective (``parallel.ring.round_telemetry_sharded``): each chip
+    reduces its own node shard and O(fields)-sized psum/pmax legs
+    assemble the cluster row — no N-plane gather, bit-identical by the
+    stage-1/stage-2 split above (integer partials reduce exactly; the
+    float math runs after the reduce on identical operands)."""
+    if mesh is not None:
+        from serf_tpu.parallel.ring import round_telemetry_sharded
+        return round_telemetry_sharded(state, cfg, mesh)
     g = state.gossip
-    known = unpack_bits(g.known, cfg.gossip.k_facts)        # bool[N, K]
-    valid = g.facts.valid
-    alive_col = g.alive[:, None]
-    n_alive = jnp.maximum(jnp.sum(g.alive), 1).astype(jnp.float32)
-    cells = jnp.sum(valid[None, :] & alive_col)
-    hit = jnp.sum(known & valid[None, :] & alive_col)
-    agreement = jnp.where(cells > 0,
-                          hit.astype(jnp.float32)
-                          / jnp.maximum(cells, 1).astype(jnp.float32),
-                          1.0)
-    n_valid = jnp.maximum(jnp.sum(valid), 1).astype(jnp.float32)
-    cov = jnp.sum(known & alive_col, axis=0).astype(jnp.float32) / n_alive
-    mean_cov = jnp.sum(jnp.where(valid, cov, 0.0)) / n_valid
-    # under adaptive control the believed-dead judgment honors the live
-    # suspicion stretch (the knob the false-dead law actuates) so the
-    # signal the controller reads is the semantics it changed
-    stretch = state.control.knobs[KNOB_STRETCH_Q] \
-        if cfg.control.enabled else None
-    false_dead = jnp.sum(
-        believed_dead(g, cfg.gossip, cfg.failure, stretch_q=stretch)
-        & g.alive)
-    return jnp.stack([
-        jnp.sum(g.alive).astype(jnp.float32),
-        jnp.sum(valid).astype(jnp.float32),
-        agreement.astype(jnp.float32),
-        mean_cov.astype(jnp.float32),
-        g.overflow.astype(jnp.float32),
-        g.injected.astype(jnp.float32),
-        jnp.sum(live_suspicions(g)).astype(jnp.float32),
-        false_dead.astype(jnp.float32),
-    ])
+    stretch = telemetry_stretch(state, cfg)
+    subj_inc = subject_incarnations(g)
+    alive_cnt, colcnt, believers = telemetry_counts(
+        g, cfg, stretch_q=stretch, subj_inc=subj_inc)
+    believed = believed_subjects(g, cfg.n, believers, alive_cnt) \
+        | g.tombstone
+    false_dead = jnp.sum(believed & g.alive)
+    return telemetry_finish(g, cfg, alive_cnt, colcnt, false_dead,
+                            subj_inc=subj_inc)
 
 
 def emit_cluster_metrics(state: ClusterState, cfg: ClusterConfig,

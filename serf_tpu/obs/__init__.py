@@ -43,6 +43,14 @@ package is that surface for the reproduction, spanning BOTH planes:
   (transport → decode → dispatch → apply → queue-wait → tee), with
   always-on cheap counters, per-stage latency histograms, a
   critical-path attribution table, and ``slow-message`` flight events.
+- :mod:`serf_tpu.obs.timeline` — the CORRELATED view: every surface
+  above (plus device round telemetry mapped onto the wall clock through
+  run anchors, control decisions, and SLO verdicts) exported as one
+  Chrome-trace-event / Perfetto-loadable JSON bundle with per-node
+  process lanes and per-surface thread lanes; ``tools/obsexport.py``,
+  ``tools/chaos.py --export-timeline`` and ``bench.py
+  --export-timeline`` are the drivers, ``validate_timeline`` the
+  tier-1-pinned schema gate.
 
 Everything is process-global with swap-out setters, mirroring the
 ``metrics`` facade already in place.

@@ -145,27 +145,74 @@ def _scalar(d: Dict[str, Any], key: str) -> float:
     return float(d.get(key, 0.0))
 
 
+@dataclass(frozen=True)
+class StatsPartial:
+    """A mergeable partial fold of ``_serf_stats`` answers — the host
+    twin of the device plane's in-collective telemetry partials
+    (``models/swim.TELEMETRY_MERGE``): both aggregation planes share
+    ONE contract — *partials over disjoint responder sets combine
+    associatively and commutatively to exactly the fold of the union*.
+
+    The partial carries the decoded per-node reports keyed by node id
+    (bounded by responder count — the same 1 KiB-payload scale the
+    query plane already bounds), so ``merge`` is a node-id-keyed dict
+    union and ``finish`` computes min/p50/max over the merged reports —
+    EXACT, not an approximation, which is exactly why the reports ride
+    the partial instead of a (non-mergeable) pre-computed percentile.
+    Associativity/commutativity holds over partials whose shared node
+    ids carry the same report (one node answers with one payload; a
+    node reached through two relay paths is the same answer) — pinned
+    by tests/test_cluster_obs.py: any grouping and order of merges
+    finishes to the direct fold of the union.  A relay tier (the
+    multi-host DCN direction, ROADMAP item 4) can therefore fold its
+    subtree's answers locally and ship one partial upward, exactly like
+    the device row rides the exchange collective."""
+
+    nodes: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+
+    @classmethod
+    def of(cls, reports: Dict[str, Dict[str, Any]]) -> "StatsPartial":
+        return cls(nodes=dict(reports))
+
+    def merge(self, other: "StatsPartial") -> "StatsPartial":
+        """Associative + commutative: dict union keyed by node id (a
+        node id answering through two paths is the SAME answer — first
+        writer wins, order-independent for well-formed responders)."""
+        merged = dict(other.nodes)
+        merged.update(self.nodes)
+        return StatsPartial(nodes=merged)
+
+    def finish(self, origin: str, expected: int) -> ClusterSnapshot:
+        """Close the fold: exact min/p50/max per aggregate key over the
+        merged multiset, unhealthy list, digest divergence."""
+        nodes = self.nodes
+        aggregates: Dict[str, Dict[str, float]] = {}
+        for key in AGGREGATE_KEYS:
+            vals = sorted(_scalar(d, key) for d in nodes.values())
+            if not vals:
+                continue
+            aggregates[key] = {
+                "min": vals[0],
+                "p50": percentile_of(vals, 50),
+                "max": vals[-1],
+            }
+        unhealthy = sorted(nid for nid, d in nodes.items()
+                           if d["health"] < UNHEALTHY_THRESHOLD)
+        digests = {nid: d.get("digest", "") for nid, d in nodes.items()}
+        divergent = len(set(digests.values())) > 1
+        return ClusterSnapshot(origin=origin, expected=expected,
+                               nodes=nodes, aggregates=aggregates,
+                               unhealthy=unhealthy, digests=digests,
+                               divergent=divergent)
+
+
 def fold_snapshot(origin: str, expected: int,
                   nodes: Dict[str, Dict[str, Any]]) -> ClusterSnapshot:
     """Fold decoded self-reports into one snapshot: min/p50/max per
-    aggregate key, unhealthy-node list, view-digest divergence."""
-    aggregates: Dict[str, Dict[str, float]] = {}
-    for key in AGGREGATE_KEYS:
-        vals = sorted(_scalar(d, key) for d in nodes.values())
-        if not vals:
-            continue
-        aggregates[key] = {
-            "min": vals[0],
-            "p50": percentile_of(vals, 50),
-            "max": vals[-1],
-        }
-    unhealthy = sorted(nid for nid, d in nodes.items()
-                       if d["health"] < UNHEALTHY_THRESHOLD)
-    digests = {nid: d.get("digest", "") for nid, d in nodes.items()}
-    divergent = len(set(digests.values())) > 1
-    return ClusterSnapshot(origin=origin, expected=expected, nodes=nodes,
-                           aggregates=aggregates, unhealthy=unhealthy,
-                           digests=digests, divergent=divergent)
+    aggregate key, unhealthy-node list, view-digest divergence.  One
+    call = build a partial and finish it; multi-tier callers build
+    partials per subtree and ``merge`` before ``finish``."""
+    return StatsPartial.of(nodes).finish(origin, expected)
 
 
 async def collect_cluster_stats(serf, params=None) -> ClusterSnapshot:

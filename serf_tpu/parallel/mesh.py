@@ -108,6 +108,18 @@ def _spec_for(path, arr) -> P:
     return P(NODE_AXIS)
 
 
+def partition_specs(state):
+    """A pytree of raw ``PartitionSpec``s matching ``state`` — the same
+    per-leaf placement rules as :func:`state_shardings`, shaped for
+    ``shard_map`` ``in_specs`` (which takes specs, not NamedShardings).
+    The in-collective telemetry leg (``parallel.ring``) feeds the whole
+    GossipState through one shard_map with these specs so its placement
+    can never drift from the state sharding that GSPMD runs."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+    return jax.tree_util.tree_unflatten(
+        treedef, [_spec_for(path, leaf) for path, leaf in flat])
+
+
 def state_shardings(state, mesh: Mesh):
     """A pytree of NamedShardings matching ``state`` (works for
     ClusterState, GossipState, QueryState, or any composite of them)."""

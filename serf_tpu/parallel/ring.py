@@ -295,6 +295,113 @@ def exchange_sharded(packets: jnp.ndarray, cfg: GossipConfig,
     return ex(*operands)
 
 
+def round_telemetry_sharded(state, cfg, mesh) -> jnp.ndarray:
+    """The in-collective telemetry row (ISSUE 15 tentpole): the SAME
+    ``f32[len(TELEMETRY_FIELDS)]`` row ``models/swim.round_telemetry``
+    computes, produced as fused O(fields) collective legs on the
+    exchange mesh instead of reducing over gathered N-planes.
+
+    Three legs, every payload O(K_facts), none O(N):
+
+    1. ``pmax`` — each chip scatters the current incarnations of the
+       fact subjects living in ITS node shard into a u32[K] vector
+       (zero elsewhere); the element-wise max assembles exactly the
+       ``incarnation[subject]`` gather of the unsharded staleness gate
+       (incarnations are unsigned; each subject lives on exactly one
+       chip).
+    2. ``psum`` (the fused sum leg) — the stage-1 integer partials
+       (``swim.telemetry_counts``: alive count, per-fact coverage
+       columns, per-fact believer counts — agreement's cells/hit are
+       exact integer folds of these after the reduce) ride ONE
+       i32[1 + 2K] psum.  Integer addition is associative, so the
+       reduced vector is bit-equal to the global sums.
+    3. ``psum`` — the false-DEAD count: stage 2 recomputes the
+       (replicated) believed-subjects judgment from the reduced counts,
+       each chip slices its own rows, ORs its tombstone shard, counts,
+       and one scalar psum closes it.
+
+    The float math (ratios) runs AFTER the reduces on integers every
+    chip agrees on — that is the bit-identity argument, and
+    tests/test_telemetry_collective.py pins it per round against the
+    gathered row for both schedules × both stamp flavors × controller
+    on/off.  ``accounting.telemetry_leg_traffic`` prices these legs at
+    O(fields) bytes per chip per round (~0 vs the exchange's packet
+    blocks) — the in-network-aggregation claim of ROADMAP item 4.
+
+    Falls back to the gathered row (loud ``shard-fallback`` flight
+    event) when the mesh does not divide ``n``, mirroring
+    :func:`exchange_sharded`.
+    """
+    from serf_tpu.models.failure import believed_subjects
+    from serf_tpu.models.swim import (
+        round_telemetry,
+        telemetry_counts,
+        telemetry_finish,
+        telemetry_stretch,
+    )
+    from serf_tpu.parallel.mesh import partition_specs
+
+    n = cfg.n
+    d = mesh.shape[NODE_AXIS]
+    if d > 1 and n % d != 0:
+        from serf_tpu import obs
+        obs.record("shard-fallback", op="round_telemetry_sharded", n=n,
+                   devices=d, reason="n % devices != 0; gathered row")
+        return round_telemetry(state, cfg)
+    n_local = n // d
+    g = state.gossip
+    stretch = telemetry_stretch(state, cfg)
+    has_stretch = stretch is not None
+    k_facts = cfg.gossip.k_facts
+
+    def leg(gs, *rest):
+        st = rest[0] if has_stretch else None
+        # leg 1 (pmax): assemble the subject-incarnation vector from
+        # each chip's shard — the staleness gate's N-gather, made O(K)
+        me = jax.lax.axis_index(NODE_AXIS)
+        gstart = me * n_local
+        subj = jnp.clip(gs.facts.subject, 0)
+        local = subj - gstart
+        mine = (local >= 0) & (local < n_local)
+        contrib = jnp.where(
+            mine, gs.incarnation[jnp.clip(local, 0, n_local - 1)],
+            jnp.uint32(0))
+        subj_inc = contrib if d == 1 \
+            else jax.lax.pmax(contrib, NODE_AXIS)
+        # leg 2 (fused psum): the stage-1 integer partials
+        alive_cnt, colcnt, believers = telemetry_counts(
+            gs, cfg, stretch_q=st, subj_inc=subj_inc)
+        stage1 = jnp.concatenate(
+            [alive_cnt[None], colcnt, believers])
+        if d > 1:
+            stage1 = jax.lax.psum(stage1, NODE_AXIS)
+        alive_cnt = stage1[0]
+        colcnt = stage1[1:1 + k_facts]
+        believers = stage1[1 + k_facts:]
+        # leg 3 (psum): believed-subjects is a pure function of the
+        # replicated fact table + the reduced counts (every chip
+        # computes the same bool[N]); each chip counts its own rows
+        believed = believed_subjects(gs, n, believers, alive_cnt)
+        rows = jax.lax.dynamic_slice_in_dim(believed, gstart, n_local)
+        fd = jnp.sum((rows | gs.tombstone) & gs.alive)
+        false_dead = fd if d == 1 else jax.lax.psum(fd, NODE_AXIS)
+        return telemetry_finish(gs, cfg, alive_cnt, colcnt, false_dead,
+                                subj_inc=subj_inc)
+
+    operands = [g]
+    specs = [partition_specs(g)]
+    if has_stretch:
+        operands.append(jnp.asarray(stretch, jnp.int32))
+        specs.append(P())
+    # check_rep off: the leg mixes device-varying shards with values
+    # provably replicated only through psum/pmax and the fact table —
+    # the replication argument is the docstring's, pinned by the
+    # bit-identity tests, not re-derivable by shard_map's checker
+    tele = shard_map(leg, mesh=mesh, in_specs=tuple(specs),
+                     out_specs=P(), check_rep=False)
+    return tele(*operands)
+
+
 def sharded_round_step(state: GossipState, cfg: GossipConfig,
                        key: jax.Array, mesh, schedule: str = "ring",
                        group=None, drop_rate=None,

@@ -1,0 +1,24 @@
+"""serflint fixture: telemetry-row declarations that MUST fire
+``telemetry-field-drift``.
+
+Linted pure-AST as a toy project's ``serf_tpu/models/swim.py``:
+
+- ``orphan_field`` is a TELEMETRY_FIELDS entry with no TELEMETRY_MERGE
+  entry (``unreduced:orphan_field`` — a row field the in-collective
+  legs would silently drop);
+- TELEMETRY_MERGE reduces ``ghost_field`` which is not a row field
+  (``undeclared:ghost_field`` — a dead merge leg);
+- ``alive`` declares merge op ``"mean"`` which no collective leg
+  implements (``bad-op:alive`` — means are not associative without a
+  count partial; declare the counts as "sum" fields instead);
+- the toy README documents ``stale_field`` which the row does not carry
+  (``stale-row:stale_field``) and has no row for ``orphan_field``
+  (``undocumented:orphan_field``).
+"""
+
+TELEMETRY_FIELDS = ("alive", "orphan_field")
+
+TELEMETRY_MERGE = {
+    "alive": "mean",
+    "ghost_field": "sum",
+}

@@ -1,0 +1,11 @@
+"""serflint fixture: the clean twin of bad_telemetry.py — every row
+field has a merge entry with a legal op, every merge entry is a row
+field, and the toy README table carries exactly these rows — must
+produce zero ``telemetry-field-drift`` findings."""
+
+TELEMETRY_FIELDS = ("alive", "agreement")
+
+TELEMETRY_MERGE = {
+    "alive": "sum",
+    "agreement": "sum",
+}

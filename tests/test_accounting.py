@@ -111,6 +111,42 @@ def test_ici_per_phase_per_chip_attribution():
     assert m["implied_sustained_ceiling_rps"] > 2 * 10_000
 
 
+def test_telemetry_leg_is_o_fields_not_o_n():
+    """ISSUE 15 acceptance: the in-collective telemetry legs cost
+    O(fields) bytes per chip — INDEPENDENT of node count — and a
+    vanishing fraction of both the exchange block and the N-plane
+    gather they replace.  Pinned beside the per-phase closure test so
+    the ~0-extra-bytes claim lives in the same attribution."""
+    from serf_tpu.models.accounting import telemetry_leg_traffic
+
+    d = 8
+    small = telemetry_leg_traffic(flagship_config(8192), d)
+    big = telemetry_leg_traffic(flagship_config(1_000_000), d)
+    # O(fields): the leg bytes do not move when N grows 122x
+    assert small["bytes_per_chip_per_round"] == \
+        big["bytes_per_chip_per_round"]
+    # ...while the gathered alternative grows linearly with N
+    assert big["gathered_alternative_bytes_per_chip"] > \
+        100 * small["gathered_alternative_bytes_per_chip"]
+    # ~0 extra bytes: under 2 KiB/chip/round at the flagship config,
+    # < 0.2% of one exchange block, < 1e-4 of the gather it replaces
+    assert big["bytes_per_chip_per_round"] < 2048
+    block = 1_000_000 * flagship_config(1_000_000).gossip.words * 4 / d
+    assert big["bytes_per_chip_per_round"] < 2e-3 * block
+    assert big["fraction_of_gather"] < 1e-4
+    # payloads are exactly the documented legs (K = 64 at the flagship)
+    k = flagship_config(1_000_000).gossip.k_facts
+    assert big["payload_bytes"] == {
+        "pmax_subject_incarnations": 4 * k,
+        "psum_stage1_partials": 4 * (1 + 2 * k),
+        "psum_false_dead": 4,
+    }
+    # and the leg rides ici_round_traffic's attribution
+    m = ici_round_traffic(flagship_config(1_000_000), d)
+    assert m["telemetry"]["bytes_per_chip_per_round"] == \
+        big["bytes_per_chip_per_round"]
+
+
 def test_kernel_path_model_fused_vs_phased():
     """ISSUE 7 acceptance arithmetic: the fused kernel family removes
     the selection's full stamp-plane pass from the kernel dispatch path

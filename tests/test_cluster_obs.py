@@ -198,6 +198,42 @@ def test_unhealthy_threshold_partitions_fold():
 # -- stats payload / fold ----------------------------------------------------
 
 
+def _report(nid, health, lag=0.0, digest="aaa"):
+    return {"v": 1, "id": nid, "health": health, "hc": {},
+            "q": [0, 0, 0], "lag": lag, "digest": digest}
+
+
+def test_stats_partial_merge_is_fold_of_union():
+    """The partial-merge contract (ISSUE 15: the host twin of the
+    device TELEMETRY_MERGE legs): any grouping AND order of merges over
+    disjoint responder subsets finishes to exactly the direct fold of
+    the union — min/p50/max, unhealthy list, digest divergence all."""
+    from serf_tpu.obs.cluster import StatsPartial
+
+    nodes = {f"n{i}": _report(f"n{i}", health=40 + 10 * i, lag=float(i),
+                              digest="aaa" if i % 2 else "bbb")
+             for i in range(6)}
+    direct = fold_snapshot("n0", 6, nodes)
+    a = StatsPartial.of({k: nodes[k] for k in ("n0", "n1")})
+    b = StatsPartial.of({k: nodes[k] for k in ("n2", "n3")})
+    c = StatsPartial.of({k: nodes[k] for k in ("n4", "n5")})
+    groupings = (
+        a.merge(b).merge(c),              # left fold
+        a.merge(b.merge(c)),              # right fold (associativity)
+        c.merge(a).merge(b),              # reordered (commutativity)
+        b.merge(c.merge(a)),
+    )
+    for p in groupings:
+        snap = p.finish("n0", 6)
+        assert snap.to_dict() == direct.to_dict()
+    # a node id reached through two paths is the same answer: merging
+    # overlapping partials does not double-count it
+    overlap = a.merge(StatsPartial.of({"n1": nodes["n1"],
+                                       "n2": nodes["n2"]})).merge(c)
+    merged = overlap.merge(b).finish("n0", 6)
+    assert merged.to_dict() == direct.to_dict()
+
+
 def test_membership_digest_is_order_insensitive_and_status_sensitive():
     a = membership_digest([("n1", "ALIVE"), ("n2", "ALIVE")])
     b = membership_digest([("n2", "ALIVE"), ("n1", "ALIVE")])

@@ -172,6 +172,63 @@ async def test_inline_fast_path_applies_synchronously():
     await p.aclose()
 
 
+async def test_monitor_gauges_cover_the_pr15_observability_gap():
+    """The rebuilt seam's monitor-tick gauges (ISSUE 15 satellite):
+    per-worker occupancy, the inline-vs-queued delivery split, the
+    ready-ring depth, and the per-dependency-key chain length p50/max
+    — emitted by ``gauge()``, never per event."""
+    from serf_tpu.utils import metrics
+
+    prev = metrics.global_sink()
+    sink = metrics.MetricsSink()
+    metrics.set_global_sink(sink)
+    try:
+        gate = asyncio.Event()
+
+        async def deliver(ev):
+            await gate.wait()
+
+        p = EventPipeline(spawn=_spawn, deliver=deliver, workers=2,
+                          node="t")
+        # two hot keys with uneven chains + one worker-held entry each
+        for i in range(5):
+            p.offer(UserEvent(i, "storm-1", b""))
+        p.offer(UserEvent(9, "deploy-1", b""))
+        await asyncio.sleep(0.05)        # both workers block in deliver
+        p.gauge()
+
+        def g(name):
+            return sink.gauges[(name, (("node", "t"),))]
+
+        from serf_tpu.utils.metrics import percentile_of
+
+        assert g("serf.pipeline.occupancy") == 1.0   # 2 of 2 workers busy
+        assert g("serf.pipeline.chain-max") == 4.0   # storm minus in-service
+        # chains at this instant: storm=4 queued, deploy=0 (in service)
+        assert g("serf.pipeline.chain-p50") == percentile_of([0, 4], 50)
+        assert g("serf.pipeline.ready-depth") == 0.0  # both keys in service
+        gate.set()
+        await asyncio.sleep(0.05)
+        p.gauge()
+        # all six applied through the queued path: inline share is 0
+        assert p.applied == 6 and p.inline_applied == 0
+        assert g("serf.pipeline.inline-share") == 0.0
+        assert g("serf.pipeline.occupancy") == 0.0
+        await p.aclose()
+
+        # the sync-delivery pipeline takes the inline fast path -> 1.0
+        p2 = EventPipeline(spawn=_spawn, deliver_sync=lambda ev: None,
+                           workers=2, node="t2")
+        p2.offer(UserEvent(1, "ping-1", b""))
+        p2.gauge()
+        assert p2.inline_applied == 1
+        assert sink.gauges[("serf.pipeline.inline-share",
+                            (("node", "t2"),))] == 1.0
+        await p2.aclose()
+    finally:
+        metrics.set_global_sink(prev)
+
+
 async def test_entries_carry_their_own_timestamps():
     """oldest_age reads the queued entries themselves; a wedged lossless
     delivery grows it, a drain zeroes it (no side-deque to skew)."""

@@ -313,6 +313,48 @@ def test_control_knob_clean_twin_is_silent(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# telemetry-field-drift (the in-collective merge contract, ISSUE 15)
+# ---------------------------------------------------------------------------
+
+_README_TELEMETRY = """\
+## Zero-cost telemetry & timeline export
+
+| Field | Merge | Notes |
+|---|---|---|
+| `alive` | sum | fine |
+| `stale_field` | sum | row removed from the code |
+"""
+
+
+def test_telemetry_bad_fixture_fires_every_direction(tmp_path):
+    project = toy_project(
+        tmp_path,
+        {"serf_tpu/models/swim.py":
+         (FIXTURES / "bad_telemetry.py").read_text()},
+        readme=_README_TELEMETRY)
+    report = analysis.run_rules(project, rules=["telemetry-field-drift"])
+    keys = {f.key for f in report.findings}
+    assert "unreduced:orphan_field" in keys    # row field, no merge leg
+    assert "undeclared:ghost_field" in keys    # merge leg, no row field
+    assert "bad-op:alive" in keys              # op no leg implements
+    assert "undocumented:orphan_field" in keys # row field, no README row
+    assert "stale-row:stale_field" in keys     # README row, no field
+
+
+def test_telemetry_clean_twin_is_silent(tmp_path):
+    readme = ("## Zero-cost telemetry & timeline export\n\n"
+              "| Field | Merge | Notes |\n|---|---|---|\n"
+              "| `alive` | sum | — |\n| `agreement` | sum | — |\n")
+    project = toy_project(
+        tmp_path,
+        {"serf_tpu/models/swim.py":
+         (FIXTURES / "ok_telemetry.py").read_text()},
+        readme=readme)
+    report = analysis.run_rules(project, rules=["telemetry-field-drift"])
+    assert report.findings == []
+
+
+# ---------------------------------------------------------------------------
 # schema family: drift without a bump fails lint; bump clears it
 # ---------------------------------------------------------------------------
 
@@ -618,7 +660,7 @@ def test_rule_registry_is_exactly_the_shipped_set():
         "reg-metric-unknown", "reg-metric-unused", "reg-doc-drift",
         "reg-flight-unknown", "reg-flight-unused",
         "slo-metric-unknown", "slo-decl-drift", "slo-doc-drift",
-        "control-knob-drift",
+        "control-knob-drift", "telemetry-field-drift",
         "schema-pytree-drift", "schema-wire-drift",
         "schema-recording-drift",
         "docs-rule-table",

@@ -184,10 +184,24 @@ def test_device_chaos_plan_green_on_sharded_path(vmesh8):
 def test_roundprof_mesh_smoke(vmesh8, capsys):
     """tools/roundprof.py --mesh: the sharded per-phase profile honors
     the JSON contract, labels the mesh, and keeps the ≥90% byte
-    attribution self-check on the sharded path."""
+    attribution self-check on the sharded path.  n=64/warm=1 keeps the
+    nine shard_map phase compiles inside the tier-1 budget (ISSUE 15
+    audit: the n=256/warm=2 build was a 19s test — promoted to -m slow
+    below, same assertions)."""
+    _roundprof_mesh_check(capsys, n="64", warm="1")
+
+
+@pytest.mark.slow
+def test_roundprof_mesh_smoke_full_n(vmesh8, capsys):
+    """The original n=256/warm=2 sharded-profile build (redundant with
+    the fast tier-1 variant above — same contract, same bar)."""
+    _roundprof_mesh_check(capsys, n="256", warm="2")
+
+
+def _roundprof_mesh_check(capsys, n: str, warm: str) -> None:
     import tools.roundprof as roundprof
 
-    rc = roundprof.main(["--n", "256", "--calls", "1", "--warm", "2",
+    rc = roundprof.main(["--n", n, "--calls", "1", "--warm", warm,
                          "--mesh", "8", "--schedule", "ring", "--json"])
     assert rc == 0
     prof = json.loads(capsys.readouterr().out)

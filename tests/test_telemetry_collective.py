@@ -1,0 +1,120 @@
+"""In-collective telemetry (ISSUE 15 tentpole a, acceptance-pinned):
+the sharded row — fused psum/pmax legs inside the exchange mesh,
+``parallel.ring.round_telemetry_sharded`` — is BIT-IDENTICAL per round
+to the gathered PR-10 row for both ICI schedules × both stamp flavors ×
+controller on/off; the leg ships no N-plane collective (jaxpr-pinned);
+and the same equality holds across a full chaos plan
+(partition-heal-loss) on the sharded executor path.
+
+Budget discipline: one tiny config (n=64, K=32), 10-round scans, the
+unsharded reference memoized per (stamp flavor, controller) since the
+ICI schedule cannot affect it.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from serf_tpu.control.device import ControlConfig
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    K_USER_EVENT,
+    inject_fact,
+)
+from serf_tpu.models.failure import FailureConfig
+from serf_tpu.models.swim import (
+    ClusterConfig,
+    make_cluster,
+    round_telemetry,
+    run_cluster_sustained,
+)
+from serf_tpu.parallel.mesh import make_mesh, shard_state
+
+N, K, ROUNDS = 64, 32, 10
+
+
+def _cfg(pack=True, schedule="ring", control=False):
+    return ClusterConfig(
+        gossip=GossipConfig(n=N, k_facts=K, peer_sampling="rotation",
+                            pack_stamp=pack),
+        failure=FailureConfig(suspicion_rounds=8, max_new_facts=8,
+                              probe_schedule="round_robin"),
+        control=ControlConfig(enabled=control),
+        push_pull_every=8, probe_every=2, exchange_schedule=schedule)
+
+
+def _seeded(cfg):
+    st = make_cluster(cfg, jax.random.key(0))
+    g = inject_fact(st.gossip, cfg.gossip, subject=3, kind=K_USER_EVENT,
+                    incarnation=0, ltime=5, origin=0)
+    # two silent crashes: detection traffic (suspicions, declarations,
+    # false-DEAD judgments) is part of the row being pinned
+    g = g._replace(alive=g.alive.at[jnp.asarray([7, N // 2])].set(False))
+    return st._replace(gossip=g)
+
+
+def _ref_rows(pack, control):
+    """Unsharded reference telemetry trajectory, memoized per (stamp
+    flavor, controller) — the exchange schedule cannot affect it."""
+    cache = _ref_rows.__dict__.setdefault("cache", {})
+    key = (pack, control)
+    if key not in cache:
+        cfg = _cfg(pack=pack, control=control)
+        run = jax.jit(lambda s, k: run_cluster_sustained(
+            s, cfg, k, ROUNDS, 2, collect_telemetry=True))
+        _, rows = run(_seeded(cfg), jax.random.key(3))
+        cache[key] = jax.device_get(rows)
+    return cache[key]
+
+
+@pytest.mark.parametrize("pack", [True, False])
+@pytest.mark.parametrize("schedule", ["ring", "allgather"])
+@pytest.mark.parametrize("control", [False, True])
+def test_in_collective_row_bit_identical(vmesh8, pack, schedule, control):
+    cfg = _cfg(pack=pack, schedule=schedule, control=control)
+    run = jax.jit(lambda s, k: run_cluster_sustained(
+        s, cfg, k, ROUNDS, 2, mesh=vmesh8, collect_telemetry=True))
+    _, rows = run(shard_state(_seeded(cfg), vmesh8), jax.random.key(3))
+    sharded = jax.device_get(rows)
+    ref = _ref_rows(pack, control)
+    assert sharded.shape == ref.shape
+    assert (sharded == ref).all(), (
+        "sharded in-collective row diverged from the gathered row at "
+        f"rounds {sorted(set(int(i) for i, _ in zip(*((sharded != ref).nonzero()))))}")
+
+
+def test_telemetry_leg_ships_no_nplane_collective(vmesh8):
+    """The acceptance 'zero additional per-round gathers': the traced
+    in-collective telemetry computation contains psum + pmax legs and
+    NO all_gather / gather-of-N anywhere — the O(fields) claim at the
+    jaxpr level, beside the accounting model that prices it."""
+    cfg = _cfg()
+    st = shard_state(_seeded(cfg), vmesh8)
+    jaxpr = str(jax.make_jaxpr(
+        lambda s: round_telemetry(s, cfg, mesh=vmesh8))(st))
+    assert "psum" in jaxpr
+    assert "pmax" in jaxpr
+    assert "all_gather" not in jaxpr
+    assert "all_to_all" not in jaxpr
+
+
+def test_chaos_plan_rows_match_sharded_vs_gathered(vmesh8):
+    """Satellite pin: under a full chaos plan (partition-heal-loss —
+    partitions, loss, heal, settle) the sharded executor's in-collective
+    per-round rows equal the unsharded executor's gathered rows, ring
+    series and final row both."""
+    from serf_tpu.faults.device import run_device_plan
+    from serf_tpu.faults.plan import named_plan
+
+    plan = named_plan("partition-heal-loss")
+    cfg = _cfg()
+    r_ref = run_device_plan(plan, cfg, collect_telemetry=True)
+    r_shard = run_device_plan(plan, cfg, mesh=vmesh8,
+                              collect_telemetry=True)
+    assert r_ref.telemetry_final == r_shard.telemetry_final
+    names = r_ref.telemetry.names()
+    assert names == r_shard.telemetry.names()
+    for name in names:
+        assert r_ref.telemetry.get(name).points() == \
+            r_shard.telemetry.get(name).points(), name
+    assert r_shard.report.ok, r_shard.report.format()

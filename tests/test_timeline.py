@@ -1,0 +1,170 @@
+"""The unified cross-plane timeline (ISSUE 15 tentpole b,
+acceptance-pinned): exporter output validates against the trace-event
+schema (sorted ts, matched B/E pairs, stable pid/tid mapping), survives
+a JSON round-trip, and a loopback query-storm run's exported bundle
+carries all six surfaces — spans, flight, lifecycle, device rounds,
+control, SLO — on one correlated timebase."""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from serf_tpu.obs.timeline import (
+    SURFACES,
+    DeviceRunAnchors,
+    TimelineBuilder,
+    validate_timeline,
+)
+
+T0 = 1_700_000_000.0
+
+
+def _synthetic_builder():
+    b = TimelineBuilder(meta={"test": True})
+    b.add_spans([
+        {"name": "outer", "start": T0, "duration_ms": 5.0, "depth": 0,
+         "attrs": {"node": "n1"}, "status": "ok"},
+        {"name": "inner", "start": T0 + 0.001, "duration_ms": 1.0,
+         "depth": 1, "attrs": {"node": "n1"}},
+        # OVERLAPS outer without nesting: must land on its own sub-lane
+        {"name": "overlap", "start": T0 + 0.003, "duration_ms": 5.0,
+         "attrs": {"node": "n1"}},
+        # zero-duration span on the cluster process
+        {"name": "blip", "start": T0, "duration_ms": 0.0, "attrs": {}},
+    ])
+    b.add_flight([
+        {"seq": 1, "time": T0 + 0.01, "kind": "probe-failed",
+         "node": "n1", "peer": "n2"},
+        {"seq": 2, "time": T0 + 0.02, "kind": "slo-breach",
+         "slo": "false-dead"},
+        {"seq": 3, "time": T0 + 0.03, "kind": "control-decision",
+         "knobs": {"fanout": 3}},
+        {"seq": 4, "time": T0 + 0.5, "kind": "slow-message",
+         "node": "n1", "message": "user-event", "e2e_ms": 300.0,
+         "stages_ms": {"transport": 100.0, "apply": 150.0,
+                       "tee": 50.0}},
+    ])
+    b.add_lifecycle(
+        {"stages": [{"stage": "apply", "mean_ms": 1.0, "p99_ms": 2.0,
+                     "share": 0.5}],
+         "e2e": {"p50_ms": 1.0, "p99_ms": 2.0}},
+        T0 + 0.6, node="n1")
+    anchors = DeviceRunAnchors(wall_start=T0, wall_end=T0 + 1.0, rounds=2)
+    b.add_device_telemetry([[1, 2, 3, 4, 5, 6, 7, 8],
+                            [2, 3, 4, 5, 6, 7, 8, 9]], anchors)
+    b.add_control_decisions(
+        [{"round": 1, "knobs": {"fanout": 4}, "shed": 0}], anchors)
+    b.add_slo_verdicts([{"slo": "false-dead", "ok": True}], T0 + 0.7)
+    return b
+
+
+def test_synthetic_bundle_validates_with_all_surfaces():
+    doc = _synthetic_builder().build()
+    assert validate_timeline(doc) == []
+    assert set(doc["otherData"]["surfaces"]) == set(SURFACES)
+
+
+def test_overlapping_spans_keep_be_pairs_matched():
+    """The 'overlap' span partially overlaps 'outer' — naive single-lane
+    B/E emission would interleave B-outer B-overlap E-outer and fail the
+    stack check; the sub-lane packer must keep every lane nested."""
+    doc = _synthetic_builder().build()
+    assert validate_timeline(doc) == []
+    # the overlapping span really did move to an overflow lane
+    span_tids = {e["tid"] for e in doc["traceEvents"]
+                 if e.get("cat") == "span"}
+    assert len(span_tids) >= 2
+
+
+def test_json_round_trip_and_stable_pid_tid_mapping():
+    d1 = _synthetic_builder().build()
+    d2 = json.loads(json.dumps(_synthetic_builder().build()))
+    assert validate_timeline(d2) == []
+    # deterministic: two independent builds of the same inputs produce
+    # the identical bundle — pid/tid assignment cannot depend on dict
+    # order or wall clock
+    assert d1 == d2
+    # every named process appears exactly once in metadata
+    names = [e["args"]["name"] for e in d1["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "process_name"]
+    assert sorted(names) == sorted(set(names))
+    assert "node:n1" in names and "device-plane" in names
+
+
+def test_validator_rejects_broken_bundles():
+    doc = _synthetic_builder().build()
+    events = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+    # unsorted timestamps
+    broken = dict(doc, traceEvents=list(reversed(doc["traceEvents"])))
+    assert any("not sorted" in p for p in validate_timeline(broken))
+    # unmatched B: drop the first E event
+    no_e = dict(doc, traceEvents=[e for e in doc["traceEvents"]
+                                  if e.get("ph") != "E"])
+    assert any("unmatched B" in p for p in validate_timeline(no_e))
+    # unnamed pid: strip process metadata
+    no_meta = dict(doc, traceEvents=events)
+    assert any("process_name" in p for p in validate_timeline(no_meta))
+
+
+def test_device_anchor_round_mapping_is_clamped_linear():
+    a = DeviceRunAnchors(wall_start=100.0, wall_end=200.0, rounds=50,
+                         base_round=10)
+    assert a.round_wall(10) == 100.0
+    assert a.round_wall(60) == 200.0
+    assert a.round_wall(35) == 150.0
+    assert a.round_wall(9) == 100.0      # clamped below
+    assert a.round_wall(1000) == 200.0   # clamped above
+
+
+def test_query_storm_bundle_has_all_six_surfaces(tmp_path):
+    """THE acceptance pin: a loopback query-storm run (host leg with the
+    adaptive controller attached + a small device leg with telemetry)
+    exports one Perfetto-loadable bundle containing spans, flight,
+    lifecycle, device rounds, control, and SLO verdicts on one
+    correlated timebase — validated by schema, not by hand."""
+    from serf_tpu.faults.device import run_device_plan
+    from serf_tpu.faults.host import run_host_plan
+    from serf_tpu.faults.plan import named_plan
+    from serf_tpu.models.swim import ClusterConfig
+    from serf_tpu.models.dissemination import GossipConfig
+    from serf_tpu.models.failure import FailureConfig
+    from serf_tpu.obs import slo
+    from serf_tpu.obs.timeline import export_run_timeline
+
+    plan = named_plan("query-storm")
+    host_result = asyncio.run(
+        run_host_plan(plan, tmp_dir=str(tmp_path), controller=True))
+    host_verdicts = slo.judge_host_run(host_result, plan)
+
+    cfg = ClusterConfig(
+        gossip=GossipConfig(n=64, k_facts=32, peer_sampling="rotation"),
+        failure=FailureConfig(suspicion_rounds=8, max_new_facts=8,
+                              probe_schedule="round_robin"),
+        push_pull_every=8, probe_every=2)
+    t0 = time.time()
+    dev_result = run_device_plan(plan, cfg, collect_telemetry=True)
+    anchors = DeviceRunAnchors(wall_start=t0, wall_end=time.time(),
+                               rounds=dev_result.rounds_run)
+    dev_verdicts = slo.judge_device_run(dev_result, plan)
+
+    out = str(tmp_path / "storm.trace.json")
+    export_run_timeline(out, host_result=host_result,
+                        host_verdicts=host_verdicts,
+                        device_result=dev_result, device_anchors=anchors,
+                        device_verdicts=dev_verdicts,
+                        meta={"plan": plan.name})
+    with open(out) as f:
+        doc = json.load(f)
+    assert validate_timeline(doc) == []
+    surfaces = set(doc["otherData"]["surfaces"])
+    assert set(SURFACES) <= surfaces, (
+        f"missing surfaces: {set(SURFACES) - surfaces}")
+    # one correlated timebase: device counter events interleave with
+    # host events inside one sorted stream (not appended at the end)
+    events = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+    assert events, "empty bundle"
+    cats = {e["cat"] for e in events}
+    assert {"span", "flight", "lifecycle", "device", "control",
+            "slo"} <= cats

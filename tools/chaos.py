@@ -99,6 +99,13 @@ def main() -> int:
                     action="store_false")
     ap.add_argument("--record-dir", default=".",
                     help="directory the failure recording is written to")
+    ap.add_argument("--export-timeline", metavar="PATH", default=None,
+                    help="write the unified cross-plane trace-event "
+                         "timeline bundle (obs/timeline.py: spans, "
+                         "flight, lifecycle, device rounds, control "
+                         "decisions, SLO verdicts on one wall-clock "
+                         "axis) to PATH — open it at "
+                         "https://ui.perfetto.dev")
     ap.add_argument("--controller", choices=("off", "on", "ab"),
                     default="off",
                     help="adaptive control plane (serf_tpu.control): "
@@ -160,6 +167,12 @@ def main() -> int:
     legs = {"off": (False,), "on": (True,), "ab": (False, True)}[
         args.controller]
 
+    #: final-leg results + device wall anchors for --export-timeline
+    import time as _time
+    final_results = {}
+    final_verdicts = {}
+    device_anchor = {}
+
     def run_leg(plane, controlled, recorder):
         nonlocal device_mesh
         if plane == "host":
@@ -167,11 +180,15 @@ def main() -> int:
                               controlled=controlled)
             verdicts = slo.judge_host_run(result, plan)
         else:
+            t0 = _time.time()
             result, device_mesh = run_device(plan, args.n, args.k_facts,
                                              args.devices,
                                              recorder=recorder,
                                              controlled=controlled)
+            device_anchor[plane] = (t0, _time.time())
             verdicts = slo.judge_device_run(result, plan)
+        final_results[plane] = result
+        final_verdicts[plane] = verdicts
         return result, verdicts
 
     for plane in planes:
@@ -235,6 +252,45 @@ def main() -> int:
                     print(f"record-on-fail: could not write {path}: {e}",
                           file=sys.stderr)
 
+    timeline_path = None
+    if args.export_timeline:
+        # one bundle for the whole invocation: spans/flight ride the
+        # process-global rings (added once), the host leg contributes
+        # its lifecycle + SLO lanes, the device leg its round series +
+        # control decisions mapped through the measured wall anchors
+        from serf_tpu.obs.timeline import (
+            DeviceRunAnchors,
+            PiecewiseAnchors,
+            export_run_timeline,
+        )
+        dev = final_results.get("device")
+        try:
+            anchors = None
+            if dev is not None:
+                if getattr(dev, "scan_walls", None):
+                    # per-chunk stamps: a first-chunk compile skews
+                    # only that chunk, not the whole run's round→wall
+                    # mapping
+                    anchors = PiecewiseAnchors(dev.scan_walls)
+                elif "device" in device_anchor:
+                    t0, t1 = device_anchor["device"]
+                    anchors = DeviceRunAnchors(wall_start=t0, wall_end=t1,
+                                               rounds=dev.rounds_run)
+            timeline_path = export_run_timeline(
+                args.export_timeline,
+                host_result=final_results.get("host"),
+                host_verdicts=final_verdicts.get("host"),
+                device_result=dev, device_anchors=anchors,
+                device_verdicts=final_verdicts.get("device"),
+                meta={"plan": plan.name, "planes": list(planes),
+                      "controller": args.controller})
+        except Exception as e:  # noqa: BLE001 - same best-effort
+            # contract as --record-on-fail: the artifact (bad path OR
+            # exporter bug) must not eat the invariant report of the
+            # run it was meant to make debuggable
+            print(f"export-timeline: could not write "
+                  f"{args.export_timeline}: {e!r}", file=sys.stderr)
+
     counters = degradation_counters()
     if args.json:
         out = {
@@ -251,6 +307,7 @@ def main() -> int:
             "lifecycle": lifecycle_info,
             "device_mesh_devices": device_mesh,
             "recordings": recordings,
+            "timeline": timeline_path,
         }
         if args.controller != "off":
             out["controller"] = args.controller
@@ -281,6 +338,9 @@ def main() -> int:
         for plane, path in sorted(recordings.items()):
             print(f"repro recording [{plane}]: {path} "
                   "(replay with `python tools/replay.py replay <path>`)")
+        if timeline_path:
+            print(f"timeline bundle: {timeline_path} "
+                  "(open at https://ui.perfetto.dev)")
         if "device" in planes:
             print(f"device mesh: {device_mesh} device(s)"
                   + (" (sharded flagship round)" if device_mesh > 1

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Export the unified cross-plane observability timeline.
+
+    # run a named FaultPlan and ship its six-surface timeline bundle
+    python tools/obsexport.py --plan query-storm --plane host -o run.trace.json
+    python tools/obsexport.py --plan partition-heal-loss --plane both \
+        -o chaos.trace.json
+
+    # validate an existing bundle (exit 0 iff schema-clean)
+    python tools/obsexport.py --validate run.trace.json
+
+The bundle is Chrome-trace-event JSON: open it at https://ui.perfetto.dev
+(or chrome://tracing) — one process lane per node plus a device-plane
+process, per-surface thread lanes (spans, flight, lifecycle stages,
+control, SLO).  ``tools/chaos.py --export-timeline`` and ``bench.py
+--export-timeline`` write the same bundle beside their own reports; this
+tool is the standalone driver + validator.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _export_plan(plan_name: str, plane: str, out: str, n: int,
+                 k_facts: int) -> int:
+    from serf_tpu.faults.plan import named_plan, plan_names
+    from serf_tpu.obs import slo
+    from serf_tpu.obs.timeline import (
+        DeviceRunAnchors,
+        PiecewiseAnchors,
+        TimelineBuilder,
+        export_run_timeline,
+        validate_timeline,
+    )
+
+    try:
+        plan = named_plan(plan_name)
+    except KeyError:
+        print(f"unknown plan {plan_name!r}; available: "
+              f"{', '.join(plan_names())}", file=sys.stderr)
+        return 2
+
+    host_result = host_verdicts = None
+    device_result = device_anchors = device_verdicts = None
+    if plane in ("host", "both"):
+        from serf_tpu.faults.host import run_host_plan
+        with tempfile.TemporaryDirectory(prefix="serf-obsexport-") as td:
+            host_result = asyncio.run(run_host_plan(plan, tmp_dir=td))
+        host_verdicts = slo.judge_host_run(host_result, plan)
+    if plane in ("device", "both"):
+        from serf_tpu.faults.device import run_device_plan
+        from serf_tpu.models.swim import flagship_config
+        cfg = flagship_config(n, k_facts=k_facts)
+        t0 = time.time()
+        device_result = run_device_plan(plan, cfg, collect_telemetry=True)
+        device_anchors = (
+            PiecewiseAnchors(device_result.scan_walls)
+            if device_result.scan_walls else DeviceRunAnchors(
+                wall_start=t0, wall_end=time.time(),
+                rounds=device_result.rounds_run))
+        device_verdicts = slo.judge_device_run(device_result, plan)
+
+    path = export_run_timeline(
+        out, host_result=host_result, host_verdicts=host_verdicts,
+        device_result=device_result, device_anchors=device_anchors,
+        device_verdicts=device_verdicts,
+        meta={"plan": plan.name, "plane": plane},
+        builder=TimelineBuilder(meta={"plan": plan.name, "plane": plane}))
+    with open(path) as f:
+        doc = json.load(f)
+    problems = validate_timeline(doc)
+    n_events = sum(1 for e in doc["traceEvents"] if e.get("ph") != "M")
+    print(f"wrote {path}: {n_events} events, surfaces "
+          f"{doc['otherData']['surfaces']} "
+          f"({'valid' if not problems else 'INVALID: ' + problems[0]})")
+    print("open at https://ui.perfetto.dev (Open trace file)")
+    return 0 if not problems else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--plan", default="query-storm",
+                    help="named FaultPlan to run and export")
+    ap.add_argument("--plane", choices=("host", "device", "both"),
+                    default="host")
+    ap.add_argument("-o", "--out", default="serf.trace.json",
+                    help="output bundle path")
+    ap.add_argument("--n", type=int, default=256,
+                    help="device-plane simulated node count")
+    ap.add_argument("--k-facts", type=int, default=32)
+    ap.add_argument("--validate", metavar="PATH",
+                    help="validate an existing bundle instead of running")
+    args = ap.parse_args()
+
+    if args.validate:
+        from serf_tpu.obs.timeline import validate_timeline
+        with open(args.validate) as f:
+            doc = json.load(f)
+        problems = validate_timeline(doc)
+        for p in problems:
+            print(p, file=sys.stderr)
+        print(f"{args.validate}: "
+              f"{'valid' if not problems else f'{len(problems)} problem(s)'}")
+        return 0 if not problems else 1
+    return _export_plan(args.plan, args.plane, args.out, args.n,
+                        args.k_facts)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
