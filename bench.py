@@ -611,6 +611,60 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - the rings are best-effort
         detail["timeseries_error"] = repr(e)[:300]
 
+    # propagation observatory (ISSUE 16): trace the first injected batch
+    # as sentinel facts through a short sustained scan at the same
+    # bounded N as the telemetry leg, and price the useful-vs-redundant
+    # byte split of the round floor — measured redundancy + coverage
+    # marks at small N, analytic redundancy/t99 at the 1M flagship (the
+    # numbers the BASELINE.json propagation bands pin)
+    try:
+        from serf_tpu.models.accounting import propagation_split
+        from serf_tpu.obs.propagation import (
+            analytic_redundancy,
+            analytic_rounds_to_coverage,
+            emit_propagation_metrics,
+            summarize_propagation,
+        )
+        pr_n = int(os.environ.get("SERF_TPU_BENCH_TS_N",
+                                  min(N_NODES, 4096)))
+        pr_rounds = 48
+        cfg_pr = flagship_config(pr_n, k_facts=K_FACTS)
+        run_pr = jax.jit(functools.partial(
+            run_cluster_sustained, cfg=cfg_pr,
+            events_per_round=EVENTS_PER_ROUND,
+            collect_propagation=True),
+            static_argnames=("num_rounds",))
+        with dispatch_timer("bench.propagation_scan", signature=pr_rounds):
+            _, prop_pair = run_pr(
+                seeded_state(cfg_pr), key=jax.random.key(6),
+                num_rounds=pr_rounds)
+            prop_rows, prop_cov = jax.device_get(prop_pair)
+        psum = summarize_propagation(prop_rows, prop_cov)
+        emit_propagation_metrics(psum, {"plane": "device"})
+        g1m = flagship_config(1_000_000).gossip
+        split_1m = propagation_split(flagship_config(1_000_000))
+        detail["propagation"] = {
+            "n": pr_n, "rounds": pr_rounds,
+            "sentinels": psum.sentinels,
+            "time_to": psum.to_dict()["time_to"],
+            "final_coverage": round(psum.final_coverage, 4),
+            "redundancy": round(psum.redundancy, 4),
+            "slots_sent": psum.slots_sent,
+            "slots_learned": psum.slots_learned,
+            "model_redundancy_1m": round(analytic_redundancy(
+                g1m.transmit_window_rounds, g1m.fanout), 4),
+            "model_t99_rounds_1m": analytic_rounds_to_coverage(
+                g1m.n, g1m.fanout),
+            "split_1m": {
+                "total_bytes": split_1m["total_bytes"],
+                "dissemination_bytes": split_1m["dissemination_bytes"],
+                "useful_bytes": round(split_1m["useful_bytes"], 1),
+                "redundant_bytes": round(split_1m["redundant_bytes"], 1),
+            },
+        }
+    except Exception as e:  # noqa: BLE001 - the tracer leg is best-effort
+        detail["propagation_error"] = repr(e)[:300]
+
     # SLO verdict on the headline itself (obs/slo.py, the SAME table the
     # chaos/obswatch CLIs judge): the measured sustained rps must not
     # exceed the analytic bandwidth ceiling — a number past physics is a

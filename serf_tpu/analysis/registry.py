@@ -171,6 +171,22 @@ METRICS: tuple = (
     "serf.slo.ok",
     "serf.slo.burn",
     "serf.slo.breach",
+    # propagation observatory (obs/propagation.py): device sentinel
+    # tracer gauges + host provenance-ledger counters/probe gauges
+    "serf.propagation.cov-max",
+    "serf.propagation.cov-mean",
+    "serf.propagation.cov-min",
+    "serf.propagation.coverage",
+    "serf.propagation.dup-ratio",
+    "serf.propagation.duplicates",
+    "serf.propagation.events-seen",
+    "serf.propagation.rebroadcasts",
+    "serf.propagation.redundancy",
+    "serf.propagation.slots-learned",
+    "serf.propagation.slots-redundant",
+    "serf.propagation.slots-sent",
+    "serf.propagation.t99-rounds",
+    "serf.propagation.time-to-all-ms",
     # message lifecycle ledger (obs/lifecycle.py)
     "serf.lifecycle.messages",
     "serf.lifecycle.sampled",
@@ -199,6 +215,7 @@ FLIGHT_KINDS: tuple = (
     "packet-dropped",
     "pallas-fallback",
     "probe-failed",
+    "propagation-trace",
     "query-fastfail",
     "query-overloaded-response",
     "query-received",
@@ -226,9 +243,11 @@ FLIGHT_KINDS: tuple = (
 SLOS: tuple = (
     "apply-stage-p99",
     "convergence-settle",
+    "coverage-settle",
     "false-dead",
     "query-p99",
     "queue-wait-share",
+    "redundancy-ceiling",
     "shed-ratio",
     "sustained-rps-ceiling",
 )
@@ -277,6 +296,20 @@ TELEMETRY_SECTION = "## Zero-cost telemetry & timeline export"
 #: (parallel/ring.round_telemetry_sharded): psum / pmax / pmin legs, or
 #: replicated per-chip computation
 TELEMETRY_MERGE_OPS = ("sum", "max", "min", "replicated")
+
+#: the propagation-row source the ``propagation-field-drift`` rule
+#: fingerprints (ISSUE 16): file -> (field-tuple literal, merge-dict
+#: literal), same shape as the telemetry contract — one README table
+#: row per field under the section below, enforced both ways.
+PROPAGATION_SOURCES = {
+    "serf_tpu/obs/propagation.py": ("PROPAGATION_FIELDS",
+                                    "PROPAGATION_MERGE"),
+}
+PROPAGATION_SECTION = "## Propagation observability"
+#: the propagation row's globalization contract: count fields are
+#: GSPMD-exact integer sums outside the shard_map body, coverage
+#: fields fold the already-psum'd colcnt partials (replicated)
+PROPAGATION_MERGE_OPS = ("sum", "replicated")
 
 
 # ---------------------------------------------------------------------------
@@ -845,6 +878,92 @@ def check_telemetry_field_drift(files: List[SourceFile],
                         "telemetry-field-drift", readme_rel, line,
                         f"stale-row:{f_name}",
                         f"README documents telemetry field {f_name!r} "
+                        "but the row does not carry it — delete the row "
+                        "or restore the field")
+
+
+# ---------------------------------------------------------------------------
+# propagation-row cross-check (pass family d, ISSUE 16): the propagation
+# observatory's row contract is registry-governed like the telemetry row
+# ---------------------------------------------------------------------------
+
+def documented_propagation_fields(readme: Path) -> Dict[str, int]:
+    """{field: line} from the README propagation table (the
+    ``PROPAGATION_SECTION`` section's first column)."""
+    out: Dict[str, int] = {}
+    in_section = False
+    for i, line in enumerate(readme.read_text().splitlines(), start=1):
+        if line.startswith("## "):
+            in_section = line.strip() == PROPAGATION_SECTION
+            continue
+        if not in_section:
+            continue
+        m = ROW_RE.match(line)
+        if m and m.group(1) not in ("Field", "Metric"):
+            out[m.group(1)] = i
+    return out
+
+
+@project_rule("propagation-field-drift",
+              "the propagation row, its merge contract, and the README "
+              "propagation table out of sync (a field added to the row "
+              "but not reduced, reduced but undeclared, an unknown merge "
+              "op, or a missing/stale README row)",
+              'PROPAGATION_FIELDS gains "new_field" with no '
+              "PROPAGATION_MERGE entry")
+def check_propagation_field_drift(files: List[SourceFile],
+                                  project: Project) -> Iterable[Finding]:
+    by_rel = {f.rel: f for f in files}
+    for rel, (fields_name, merge_name) in PROPAGATION_SOURCES.items():
+        src = by_rel.get(rel)
+        if src is None:
+            continue
+        fields = _tuple_literal(src.tree, fields_name)
+        merge = _dict_literal(src.tree, merge_name)
+        if fields is None:
+            continue
+        merge = merge or []
+        merge_keys = {k for k, _v, _ln in merge}
+        field_set = {f for f, _ln in fields}
+        for f_name, lineno in fields:
+            if f_name not in merge_keys:
+                yield _reg_finding(
+                    "propagation-field-drift", rel, lineno,
+                    f"unreduced:{f_name}",
+                    f"propagation field {f_name!r} ({fields_name}) has "
+                    f"no {merge_name} entry — a row field without a "
+                    "declared globalization silently breaks the sharded "
+                    "row (declare its merge op, or drop the field)")
+        for k, op, lineno in merge:
+            if k not in field_set:
+                yield _reg_finding(
+                    "propagation-field-drift", rel, lineno,
+                    f"undeclared:{k}",
+                    f"{merge_name} reduces {k!r} which is not a "
+                    f"{fields_name} entry — dead merge leg (add the row "
+                    "field or delete the entry)")
+            if op not in PROPAGATION_MERGE_OPS:
+                yield _reg_finding(
+                    "propagation-field-drift", rel, lineno,
+                    f"bad-op:{k}",
+                    f"{merge_name}[{k!r}] declares unknown merge op "
+                    f"{op!r} (one of {PROPAGATION_MERGE_OPS}) — the "
+                    "propagation fold cannot implement it")
+        if project.readme is not None and project.readme.exists():
+            documented = documented_propagation_fields(project.readme)
+            readme_rel = project.readme.name
+            for f_name in sorted(field_set - set(documented)):
+                yield _reg_finding(
+                    "propagation-field-drift", readme_rel, 1,
+                    f"undocumented:{f_name}",
+                    f"propagation field {f_name!r} has no row in the "
+                    f"README '{PROPAGATION_SECTION[3:]}' table")
+            for f_name, line in sorted(documented.items()):
+                if f_name not in field_set:
+                    yield _reg_finding(
+                        "propagation-field-drift", readme_rel, line,
+                        f"stale-row:{f_name}",
+                        f"README documents propagation field {f_name!r} "
                         "but the row does not carry it — delete the row "
                         "or restore the field")
 

@@ -134,7 +134,9 @@ def run_phase(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
               mesh=None, collect_digests: bool = False,
               include_nodes: bool = True,
               collect_telemetry: bool = False,
-              collect_control: bool = False):
+              collect_control: bool = False,
+              collect_propagation: bool = False,
+              sentinels=None):
     """Scan ``num_rounds`` chaos rounds with one phase's masks applied.
     Jit with ``num_rounds`` static; group/drop/down are traced, so equal-
     length phases reuse the compiled executable.  ``mesh`` runs every
@@ -162,9 +164,19 @@ def run_phase(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
     evidence feed (stability invariant, recording ``control`` steps,
     the chaos A/B report).
 
+    ``collect_propagation`` (static) additionally stacks the
+    propagation observatory's per-round evidence (``models/swim
+    .propagation_row``): the gossip exchange's redundancy-ledger pair
+    plus per-sentinel coverage for the traced fact ids in ``sentinels``
+    (i32[M], a traced operand — the executor passes the first injected
+    batch's eids).  Shares the telemetry row's known-plane unpack
+    (``round_telemetry(with_cols=True)``) and the same
+    stay-on-device-until-one-device_get discipline.
+
     Aux-output shape: exactly one flag returns its bare stream; several
-    return a tuple in declared order (digests, telemetry, control) —
-    callers that predate a flag unpack exactly what they always did.
+    return a tuple in declared order (digests, telemetry, control,
+    propagation) — callers that predate a flag unpack exactly what they
+    always did.
 
     When ``cfg.control.enabled`` the control law ticks INSIDE the scan
     every round (``models/swim.control_tick``), sharing the telemetry
@@ -177,15 +189,24 @@ def run_phase(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
     from serf_tpu.models.swim import control_tick, round_telemetry
     if collect_control:
         from serf_tpu.control.device import control_row
+    if collect_propagation:
+        from serf_tpu.models.swim import propagation_row
 
     alive = init_alive & ~down
     st = state._replace(gossip=state.gossip._replace(alive=alive),
                         group=group)
 
     def body(carry, subkey):
-        nxt = cluster_round(carry, cfg, subkey, drop_rate=drop, mesh=mesh)
-        row = round_telemetry(nxt, cfg, mesh=mesh) \
-            if (collect_telemetry or cfg.control.enabled) else None
+        if collect_propagation:
+            nxt, pair = cluster_round(carry, cfg, subkey, drop_rate=drop,
+                                      mesh=mesh, collect_propagation=True)
+            row, colcnt, alive_cnt = round_telemetry(
+                nxt, cfg, mesh=mesh, with_cols=True)
+        else:
+            nxt = cluster_round(carry, cfg, subkey, drop_rate=drop,
+                                mesh=mesh)
+            row = round_telemetry(nxt, cfg, mesh=mesh) \
+                if (collect_telemetry or cfg.control.enabled) else None
         nxt, row = control_tick(nxt, cfg, row, mesh=mesh)
         aux = []
         if collect_digests:
@@ -196,6 +217,9 @@ def run_phase(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
             aux.append(row)
         if collect_control:
             aux.append(control_row(nxt.control))
+        if collect_propagation:
+            aux.append(propagation_row(nxt.gossip, pair, colcnt,
+                                       alive_cnt, sentinels))
         if not aux:
             return nxt, ()
         return nxt, (aux[0] if len(aux) == 1 else tuple(aux))
@@ -203,7 +227,8 @@ def run_phase(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
     keys = jax.random.split(key, num_rounds)
     final, out = jax.lax.scan(body, st, keys)
     return (final, out) if (collect_digests or collect_telemetry
-                            or collect_control) else final
+                            or collect_control
+                            or collect_propagation) else final
 
 
 @functools.lru_cache(maxsize=16)
@@ -247,7 +272,8 @@ def phase_runner(cfg: ClusterConfig, mesh=None):
     return jax.jit(functools.partial(run_phase, cfg=cfg, mesh=mesh),
                    static_argnames=("num_rounds", "collect_digests",
                                     "include_nodes", "collect_telemetry",
-                                    "collect_control"))
+                                    "collect_control",
+                                    "collect_propagation"))
 
 
 @dataclass
@@ -283,6 +309,14 @@ class DeviceChaosResult:
     control_rows: object = None
     control_final: Optional[dict] = None
     control_decisions: List[dict] = field(default_factory=list)
+    #: the propagation observatory's device evidence (runs with
+    #: ``collect_propagation``): ``{"rows": np[R, P], "coverage":
+    #: np[R, M], "summary": PropagationSummary.to_dict(),
+    #: "base_round": int}`` — per-round redundancy-ledger rows
+    #: (obs/propagation.PROPAGATION_FIELDS order) and the per-sentinel
+    #: coverage curve, fetched by the SAME end-of-run device_get as the
+    #: telemetry rows (zero extra transfers)
+    propagation: Optional[dict] = None
     #: per-scan-chunk wall stamps ``(base_round, rounds, t0, t1)`` —
     #: the timeline exporter's piecewise round→wall-clock anchors
     #: (obs/timeline.PiecewiseAnchors).  Stamps bracket the DISPATCH of
@@ -298,7 +332,9 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
                     state: Optional[ClusterState] = None,
                     events_per_phase: int = 2,
                     mesh=None, recorder=None,
-                    collect_telemetry: bool = False) -> DeviceChaosResult:
+                    collect_telemetry: bool = False,
+                    collect_propagation: bool = False
+                    ) -> DeviceChaosResult:
     """Run ``plan`` against the flagship device cluster and check the
     invariants.  Injects ``events_per_phase`` fresh user events at the
     start of every phase (plus the settle window) so there is always
@@ -362,6 +398,19 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
     injected: List[int] = []
     next_eid = 1
     want_ctl = cfg.control.enabled
+    if collect_propagation:
+        if events_per_phase < 1:
+            raise ValueError("collect_propagation traces the first "
+                             "injected event batch as sentinel facts; "
+                             "events_per_phase must be >= 1")
+        # sentinels = the FIRST phase's injected batch: inject() assigns
+        # eids sequentially from 1, so the first min(events, k_facts)
+        # facts of the run are the traced population (a batch past ring
+        # capacity wraps — only the resident slice is traceable)
+        n_sent = min(events_per_phase, cfg.gossip.k_facts)
+        sentinels = jnp.arange(1, n_sent + 1, dtype=jnp.int32)
+    else:
+        sentinels = None
 
     def inject(st: ClusterState, origins_key, m: int) -> ClusterState:
         """Inject ``m`` facts, CHUNKED at ring capacity: a load phase may
@@ -410,6 +459,7 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
     #: follow the same discipline.
     tele_chunks: List[tuple] = []
     ctl_chunks: List[tuple] = []
+    prop_chunks: List[tuple] = []
     scan_walls: List[tuple] = []
     #: the previous scan's last control row (host side) — the recorder's
     #: decision extraction is incremental across scans
@@ -422,7 +472,8 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
         the per-round telemetry/control rows when the run collects
         them."""
         want_dig = recorder is not None
-        if not want_dig and not collect_telemetry and not want_ctl:
+        if (not want_dig and not collect_telemetry and not want_ctl
+                and not collect_propagation):
             t0 = time.time()
             st = run(st, key=k_run, num_rounds=num_rounds, group=group,
                      drop=drop, init_alive=init_alive, down=down)
@@ -439,17 +490,22 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
                       down=down, collect_digests=want_dig,
                       include_nodes=(include_nodes if want_dig else True),
                       collect_telemetry=collect_telemetry,
-                      collect_control=want_ctl)
+                      collect_control=want_ctl,
+                      collect_propagation=collect_propagation,
+                      sentinels=sentinels)
         scan_walls.append((base_round, num_rounds, t0, time.time()))
         parts = list(out) if sum((want_dig, collect_telemetry,
-                                  want_ctl)) > 1 else [out]
-        dg = dn = rows = crows = None
+                                  want_ctl, collect_propagation)) > 1 \
+            else [out]
+        dg = dn = rows = crows = prows = None
         if want_dig:
             dg, dn = parts.pop(0)
         if collect_telemetry:
             rows = parts.pop(0)
         if want_ctl:
             crows = parts.pop(0)
+        if collect_propagation:
+            prows = parts.pop(0)
         if want_dig:
             record_scan_views(recorder, base_round, dg, dn, include_nodes)
         if crows is not None:
@@ -466,6 +522,8 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
             ctl_chunks.append((base_round, crows))
         if rows is not None:
             tele_chunks.append((base_round, rows))
+        if prows is not None:
+            prop_chunks.append((base_round, prows))
         return st
 
     total = 0
@@ -555,18 +613,50 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
                              "offered": state.gossip.injected})
     telemetry = None
     telemetry_final = None
-    if tele_chunks:
+    propagation = None
+    if tele_chunks or prop_chunks:
         # THE one telemetry transfer of the run: every scan's stacked
-        # rows come back in a single device_get, then land in the ring
-        # format keyed by declared metric names
-        from serf_tpu.models.swim import TELEMETRY_FIELDS
-        from serf_tpu.obs.timeseries import telemetry_to_store
-        host_rows = jax.device_get([rows for _, rows in tele_chunks])
-        for (base, _), rows in zip(tele_chunks, host_rows):
-            telemetry = telemetry_to_store(rows, base_round=base,
-                                           store=telemetry)
-        telemetry_final = dict(zip(
-            TELEMETRY_FIELDS, (float(v) for v in host_rows[-1][-1])))
+        # telemetry AND propagation rows come back in a single
+        # device_get (never a per-round, never even a per-phase
+        # transfer — the propagation observatory rides for free), then
+        # land in the ring format keyed by declared metric names
+        host_rows, host_prop = jax.device_get(
+            ([rows for _, rows in tele_chunks],
+             [p for _, p in prop_chunks]))
+        if tele_chunks:
+            from serf_tpu.models.swim import TELEMETRY_FIELDS
+            from serf_tpu.obs.timeseries import telemetry_to_store
+            for (base, _), rows in zip(tele_chunks, host_rows):
+                telemetry = telemetry_to_store(rows, base_round=base,
+                                               store=telemetry)
+            telemetry_final = dict(zip(
+                TELEMETRY_FIELDS, (float(v) for v in host_rows[-1][-1])))
+        if prop_chunks:
+            import numpy as np
+
+            from serf_tpu.obs import flight
+            from serf_tpu.obs.propagation import (
+                emit_propagation_metrics,
+                propagation_to_store,
+                summarize_propagation,
+            )
+            for (base, _), (prow, _) in zip(prop_chunks, host_prop):
+                telemetry = propagation_to_store(prow, base_round=base,
+                                                 store=telemetry)
+            all_rows = np.concatenate([np.asarray(p) for p, _ in host_prop])
+            all_cov = np.concatenate([np.asarray(c) for _, c in host_prop])
+            summary = summarize_propagation(all_rows, all_cov)
+            emit_propagation_metrics(summary, {"plane": "device"})
+            flight.record(
+                "propagation-trace", plane="device",
+                sentinels=int(summary.sentinels),
+                rounds=int(summary.rounds),
+                t99=summary.time_to.get(99),
+                redundancy=round(float(summary.redundancy), 4),
+                final_coverage=round(float(summary.final_coverage), 4))
+            propagation = {"rows": all_rows, "coverage": all_cov,
+                           "summary": summary.to_dict(),
+                           "base_round": prop_chunks[0][0]}
     return DeviceChaosResult(plan=plan, schedule=sched, state=state,
                              report=report, rounds_run=total,
                              notes=sched.notes, injected=injected,
@@ -577,4 +667,5 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
                              control_rows=control_rows,
                              control_final=control_final,
                              control_decisions=control_decisions,
+                             propagation=propagation,
                              scan_walls=scan_walls)

@@ -295,6 +295,69 @@ class HostChaosResult:
     #: decomposition, attribution, slow-message count — the evidence the
     #: stage-latency SLO rows are judged from
     lifecycle: Optional[Dict] = None
+    #: propagation-observatory evidence (every run): a traced probe
+    #: user_event fired after the settle barrier, polled to coverage
+    #: across live nodes, plus the run's cumulative ledger fold —
+    #: ``{"coverage", "time_to_all_ms", "reached", "nodes", "seen",
+    #: "duplicates", "rebroadcasts", "dup_ratio", "trace"}``
+    propagation: Optional[Dict] = None
+
+
+async def measure_propagation(live, deadline_s: float = 5.0) -> Dict:
+    """Host-plane dissemination probe: fire ONE traced user_event from a
+    live node, then poll every live node's propagation ledger
+    (``obs.propagation.PropagationLedger``) until the probe's trace id
+    has been first-seen everywhere (or the deadline passes).  Returns
+    the coverage verdict plus the run's cumulative cluster ledger fold
+    — the evidence the host-side coverage-settle / redundancy-ceiling
+    SLO rows are judged from — and emits the ``serf.propagation.*``
+    gauges and a ``propagation-trace`` flight event."""
+    from serf_tpu.obs.propagation import fold_propagation
+
+    out: Dict = {"coverage": 0.0, "time_to_all_ms": None, "reached": 0,
+                 "nodes": len(live), "seen": 0, "duplicates": 0,
+                 "rebroadcasts": 0, "dup_ratio": 0.0, "trace": None}
+    if not live:
+        return out
+    origin = live[0]
+    t0 = time.monotonic()
+    try:
+        await origin.user_event("prop-probe", b"", coalesce=False)
+    except Exception:  # noqa: BLE001 — admission shed / teardown race:
+        return out     # no probe this run, the fold below still reports
+    trace_hex = next(reversed(origin.prop_ledger._recent), None)
+    out["trace"] = trace_hex
+    reached = 0
+    if trace_hex is not None:
+        while True:
+            reached = sum(
+                1 for s in live
+                if s.prop_ledger.first_seen(trace_hex) is not None)
+            if reached >= len(live):
+                out["time_to_all_ms"] = round(
+                    (time.monotonic() - t0) * 1e3, 1)
+                break
+            if time.monotonic() - t0 > deadline_s:
+                break
+            await asyncio.sleep(0.02)
+    out["reached"] = reached
+    out["coverage"] = reached / len(live)
+    fold = fold_propagation(
+        {s.local_id: s.prop_ledger.summary() for s in live})
+    out.update(seen=fold["seen"], duplicates=fold["duplicates"],
+               rebroadcasts=fold["rebroadcasts"],
+               dup_ratio=fold["dup_ratio"])
+    metrics.gauge("serf.propagation.coverage", out["coverage"])
+    if out["time_to_all_ms"] is not None:
+        metrics.gauge("serf.propagation.time-to-all-ms",
+                      out["time_to_all_ms"])
+    metrics.gauge("serf.propagation.dup-ratio", out["dup_ratio"])
+    flight.record("propagation-trace", plane="host", trace=trace_hex,
+                  coverage=round(out["coverage"], 4),
+                  time_to_all_ms=out["time_to_all_ms"],
+                  reached=reached, nodes=len(live),
+                  dup_ratio=round(out["dup_ratio"], 4))
+    return out
 
 
 def degradation_counters() -> Dict[str, float]:
@@ -695,6 +758,13 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
         load.lossless_violations = int(
             _counter_total("serf.subscriber.lossless_violation")
             - base_lossless)
+        # propagation probe: one traced user_event AFTER the heal +
+        # settle barrier (the healed fabric is what the coverage SLO
+        # judges), polled to full coverage across the live set — fired
+        # only once the ingress deltas above are read, so the probe's
+        # own admission does not skew the shed-accounting invariant
+        propagation = await measure_propagation(
+            live, deadline_s=max(1.0, min(plan.settle_s, 5.0)))
         if recorder is not None:
             recorder.finish()
         report = inv.check_host(plan, nodes, samples, generation,
@@ -714,7 +784,8 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
                                settle_convergence_s=load.settle_convergence_s,
                                settle_converged=settle_converged,
                                false_dead=false_dead,
-                               lifecycle=led.snapshot())
+                               lifecycle=led.snapshot(),
+                               propagation=propagation)
     finally:
         stop.set()
         for t in (bg, lg, *consumers.values()):

@@ -87,6 +87,7 @@ from serf_tpu.types.tags import Tags
 from serf_tpu import obs
 from serf_tpu.obs import lifecycle
 from serf_tpu.obs.health import HealthReport, HealthScorer, serf_sources
+from serf_tpu.obs.propagation import PropagationLedger
 from serf_tpu.obs.trace import new_trace, span, trace_scope
 from serf_tpu.utils import metrics
 from serf_tpu.utils.tasks import log_task_exception, spawn_logged
@@ -477,6 +478,10 @@ class Serf:
         # health plane (obs.health): sources read engine state lazily
         self._loop_lag_ewma_ms = 0.0
         self._health = HealthScorer(serf_sources(self))
+        # propagation provenance (obs.propagation): how the gossip
+        # fabric treats user-event broadcasts at this node — folded
+        # cluster-wide through the _serf_stats partials
+        self.prop_ledger = PropagationLedger()
         # admission control (host/admission.py): ingress token buckets +
         # health-aware shedding; all knobs default off
         self._admission = AdmissionController(self)
@@ -1139,6 +1144,9 @@ class Serf:
                 self._queue(self.intent_broadcasts, raw)
         elif isinstance(msg, UserEventMessage):
             if self._handle_user_event(msg):
+                self.prop_ledger.rebroadcast(msg.tctx)
+                metrics.incr("serf.propagation.rebroadcasts", 1,
+                             self._labels)
                 self._queue(self.event_broadcasts, self._hop_raw(msg, raw))
         elif isinstance(msg, QueryMessage):
             if self._handle_query(msg):
@@ -1394,6 +1402,12 @@ class Serf:
         if cell is not None and cell.ltime == msg.ltime:
             for prev in cell.events:
                 if prev.name == msg.name and prev.payload == msg.payload:
+                    # dedup-ring hit: the host analog of a redundant
+                    # wire slot — the propagation observatory's
+                    # redundancy evidence on this plane
+                    self.prop_ledger.duplicate(msg.tctx)
+                    metrics.incr("serf.propagation.duplicates", 1,
+                                 self._labels)
                     return False
             self._event_buffer[idx] = UserEvents(
                 cell.ltime, cell.events + (msg,))
@@ -1404,6 +1418,10 @@ class Serf:
         # events ("storm-1", "storm-2", ...) must not grow the metrics
         # sink without bound (every sampler tick walks the whole sink)
         metrics.incr(f"serf.events.{name_class(msg.name)}", 1, self._labels)
+        # first sight of this event at this node: provenance for the
+        # cluster-wide coverage fold (trace id + first-seen clock)
+        self.prop_ledger.accept(msg.tctx)
+        metrics.incr("serf.propagation.events-seen", 1, self._labels)
         with trace_scope(msg.tctx):
             # trace-stamped while the event's context is active: the same
             # trace id lands in the flight ring of every node that accepts

@@ -467,6 +467,63 @@ def telemetry_leg_traffic(cfg, n_devices: int = 8) -> dict:
     }
 
 
+def propagation_split(cfg, regime: str = "sustained",
+                      sustained_rate: int = 2, path: str = "xla",
+                      measured_redundancy: Optional[float] = None) -> dict:
+    """The useful-vs-redundant byte split of the flagship round floor
+    (ISSUE 16): extend the comm-cost decomposition from bytes-by-phase
+    to bytes-by-*usefulness*.
+
+    The dissemination leg (selection + exchange + merge — the phases
+    that exist to move facts) re-ships each fact from every knower for
+    ``transmit_window_rounds`` rounds at ``fanout`` reads per round,
+    while each receiver learns it exactly once: the analytic useful
+    fraction is ``1/(window · fanout)``
+    (``obs.propagation.analytic_redundancy``), ~1.2% at the 1M flagship
+    — the ~217 MB/round floor is overwhelmingly epidemic re-teaching,
+    which is the redundancy robustness is paid for.  The split prices
+    exactly that: how many of the floor's bytes taught someone
+    something, judged against the device tracer's MEASURED cumulative
+    redundancy when one is passed (``run_cluster_sustained(...,
+    collect_propagation=True)``).
+
+    Returns the dissemination/other byte decomposition, the analytic
+    and effective useful fractions, and the resulting byte split of the
+    full round total."""
+    from serf_tpu.obs.propagation import analytic_redundancy
+
+    g: GossipConfig = cfg.gossip
+    report = round_traffic(cfg, regime=regime,
+                           sustained_rate=sustained_rate, path=path)
+    by_phase = report.by_phase()
+    dissemination_phases = ("selection", "exchange", "merge")
+    diss_bytes = sum(by_phase.get(p, 0.0) for p in dissemination_phases)
+    other_bytes = report.total_bytes - diss_bytes
+    analytic = analytic_redundancy(g.transmit_window_rounds, g.fanout)
+    redundancy = (analytic if measured_redundancy is None
+                  else float(measured_redundancy))
+    useful_frac = 1.0 - redundancy
+    return {
+        "n": g.n, "k_facts": g.k_facts, "regime": regime, "path": path,
+        "total_bytes": report.total_bytes,
+        "dissemination_bytes": diss_bytes,
+        "other_bytes": other_bytes,
+        "by_phase": {p: by_phase.get(p, 0.0)
+                     for p in dissemination_phases},
+        "analytic_redundancy": analytic,
+        "redundancy": redundancy,
+        "redundancy_source": ("measured" if measured_redundancy
+                              is not None else "analytic"),
+        "useful_bytes": diss_bytes * useful_frac,
+        "redundant_bytes": diss_bytes * redundancy,
+        "rule": "useful fraction of the dissemination leg is "
+                "1/(transmit_window_rounds x fanout): each knower "
+                "re-ships a fact for the whole transmit window at "
+                "`fanout` reads per round, each receiver learns it "
+                "once — the epidemic floor is re-teaching by design",
+    }
+
+
 def ici_round_traffic(cfg, n_devices: int = 8) -> dict:
     """Per-phase, per-chip byte attribution for one flagship round under
     node sharding — the arithmetic behind the 8-chip throughput claim

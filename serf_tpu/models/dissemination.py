@@ -1255,7 +1255,8 @@ def merge_phase(state: GossipState, incoming: jnp.ndarray,
 
 def round_step(state: GossipState, cfg: GossipConfig,
                key: jax.Array, group=None, drop_rate=None,
-               exchange=None, mesh=None, eff_fanout=None) -> GossipState:
+               exchange=None, mesh=None, eff_fanout=None,
+               collect_propagation: bool = False):
     """One gossip round: select packets, pull-exchange, Lamport-merge
     (the :func:`select_phase`/:func:`exchange_phase`/:func:`merge_phase`
     composition — the profiler jits the same phases in isolation,
@@ -1294,6 +1295,23 @@ def round_step(state: GossipState, cfg: GossipConfig,
     on node-sharded state so the FUSED pallas kernels can run under
     shard_map per chip (the exchange leg stays whatever ``exchange``
     says — the kernels never swallow the cross-chip leg).
+
+    ``collect_propagation`` (static, default off) makes the round also
+    return the cluster-wide redundancy-ledger pair ``(slots_sent,
+    slots_learned)`` — two i32 scalars folded from planes the round
+    already materializes (``packets``/``incoming``), so the traced path
+    adds reductions only, never a transfer.  ``slots_sent`` is the
+    wire-slot count: ``eff_fanout × Σ popcount(packets)`` (exact under
+    rotation sampling, where every rotation leg is a permutation read of
+    the packet plane; the expectation under iid sampling).  Slots lost
+    to partition masks or injected drop stay IN ``slots_sent`` — a wire
+    slot that taught nobody is redundant by definition, which is exactly
+    the ledger's point of view.  ``slots_learned`` recomputes the merge
+    pass's learn plane definitionally (``incoming & ~known & alive``) so
+    it is bit-exact across the XLA / fused-pallas / standalone-kernel
+    merge paths.  Off (the default) the function body is untouched
+    Python — the jaxpr is identical to the untraced round, the house
+    bit-exactness invariant.
     """
     def active(state):
         packets = select_phase(state, cfg, mesh=mesh)
@@ -1304,17 +1322,38 @@ def round_step(state: GossipState, cfg: GossipConfig,
         incoming = ex(packets, cfg, key, group=group,
                       drop_rate=drop_rate, **kw)
         st = merge_phase(state, incoming, cfg, mesh=mesh)
-        return (st.known, st.stamp, st.last_learn, st.sendable,
-                st.sendable_round, st.last_clamp)
+        out = (st.known, st.stamp, st.last_learn, st.sendable,
+               st.sendable_round, st.last_clamp)
+        if collect_propagation:
+            eff = (jnp.asarray(cfg.fanout, jnp.int32) if eff_fanout is None
+                   else jnp.asarray(eff_fanout, jnp.int32))
+            sent = eff * jnp.sum(
+                jax.lax.population_count(packets).astype(jnp.int32))
+            alive_col = state.alive[:, None]
+            new_words = incoming & ~state.known & jnp.where(
+                alive_col, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+            learned = jnp.sum(
+                jax.lax.population_count(new_words).astype(jnp.int32))
+            out = out + (sent, learned)
+        return out
 
     def quiet(state):
-        return (state.known, state.stamp, state.last_learn,
-                state.sendable, state.sendable_round, state.last_clamp)
+        out = (state.known, state.stamp, state.last_learn,
+               state.sendable, state.sendable_round, state.last_clamp)
+        if collect_propagation:
+            # sending set provably empty: nothing shipped, nothing learned
+            zero = jnp.asarray(0, jnp.int32)
+            out = out + (zero, zero)
+        return out
 
-    known, stamp, last_learn, sendable, sendable_round, last_clamp = \
-        jax.lax.cond(state.round - state.last_learn
-                     < cfg.transmit_window_rounds,
-                     active, quiet, state)
+    res = jax.lax.cond(state.round - state.last_learn
+                       < cfg.transmit_window_rounds,
+                       active, quiet, state)
+    if collect_propagation:
+        (known, stamp, last_learn, sendable, sendable_round, last_clamp,
+         slots_sent, slots_learned) = res
+    else:
+        known, stamp, last_learn, sendable, sendable_round, last_clamp = res
 
     # standalone wraparound guard: runs only when no streaming pass has
     # clamped for CLAMP_EVERY rounds (quiet/no-learn windows — the merge
@@ -1324,9 +1363,12 @@ def round_step(state: GossipState, cfg: GossipConfig,
     # non-sendable before AND after — the sendable invariant holds.
     stamp, last_clamp = clamp_stamps(stamp, state.round + 1, last_clamp,
                                      cfg)
-    return state._replace(known=known, stamp=stamp, last_learn=last_learn,
-                          sendable=sendable, sendable_round=sendable_round,
-                          last_clamp=last_clamp, round=state.round + 1)
+    nxt = state._replace(known=known, stamp=stamp, last_learn=last_learn,
+                         sendable=sendable, sendable_round=sendable_round,
+                         last_clamp=last_clamp, round=state.round + 1)
+    if collect_propagation:
+        return nxt, (slots_sent, slots_learned)
+    return nxt
 
 
 def run_rounds(state: GossipState, cfg: GossipConfig, key: jax.Array,

@@ -167,8 +167,8 @@ def make_cluster(cfg: ClusterConfig, key: jax.Array) -> ClusterState:
 
 
 def cluster_round(state: ClusterState, cfg: ClusterConfig,
-                  key: jax.Array, drop_rate=None, mesh=None
-                  ) -> ClusterState:
+                  key: jax.Array, drop_rate=None, mesh=None,
+                  collect_propagation: bool = False):
     """One full protocol round for every simulated node.
 
     ``drop_rate`` (optional f32 scalar, may be traced) is the chaos
@@ -187,7 +187,14 @@ def cluster_round(state: ClusterState, cfg: ClusterConfig,
     elementwise or rolled, which GSPMD keeps chip-local over
     node-sharded state (``parallel.mesh.shard_state``).  Bit-exact with
     the unsharded round for the same keys — the exchange hook swaps the
-    collective schedule, never the arithmetic."""
+    collective schedule, never the arithmetic.
+
+    ``collect_propagation`` (static, default off) threads the redundancy
+    ledger flag into the gossip leg and returns ``(state, (slots_sent,
+    slots_learned))`` — see :func:`round_step`; the ledger scopes the
+    gossip exchange leg only (probe/refute/push-pull traffic is priced
+    by ``models.accounting``, not traced here).  Off, this function is
+    byte-identical Python to the untraced round."""
     k_gossip, k_probe, k_refute, k_declare, k_pp, k_viv, k_peer = \
         jax.random.split(key, 7)
     g = state.gossip
@@ -210,6 +217,7 @@ def cluster_round(state: ClusterState, cfg: ClusterConfig,
         probe_tick = (g.round % cfg.probe_every == 0) \
             if cfg.probe_every > 1 else None
     chaos_group = state.group if drop_rate is not None else None
+    prop = None
     if mesh is not None:
         # THE one sharded round in the tree (parallel.ring): round_step
         # with only the exchange leg swapped for the explicit shard_map
@@ -219,10 +227,14 @@ def cluster_round(state: ClusterState, cfg: ClusterConfig,
         g = sharded_round_step(g, cfg.gossip, k_gossip, mesh,
                                schedule=cfg.exchange_schedule,
                                group=state.group, drop_rate=drop_rate,
-                               eff_fanout=eff_fanout)
+                               eff_fanout=eff_fanout,
+                               collect_propagation=collect_propagation)
     else:
         g = round_step(g, cfg.gossip, k_gossip, group=state.group,
-                       drop_rate=drop_rate, eff_fanout=eff_fanout)
+                       drop_rate=drop_rate, eff_fanout=eff_fanout,
+                       collect_propagation=collect_propagation)
+    if collect_propagation:
+        g, prop = g
     if cfg.with_failure:
         if probe_tick is None:
             g = probe_round(g, cfg.gossip, cfg.failure, k_probe,
@@ -262,7 +274,10 @@ def cluster_round(state: ClusterState, cfg: ClusterConfig,
             # coordinate samples ride probe acks (reference delegate
             # ping payloads), so they follow the probe cadence
             viv = jax.lax.cond(probe_tick, viv_step, lambda v: v, viv)
-    return state._replace(gossip=g, vivaldi=viv)
+    nxt = state._replace(gossip=g, vivaldi=viv)
+    if collect_propagation:
+        return nxt, prop
+    return nxt
 
 
 def vivaldi_phase(state: ClusterState, cfg: ClusterConfig, k_peer,
@@ -331,7 +346,8 @@ def run_cluster(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
 
 
 def sustained_round(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
-                    events_per_round: int, mesh=None) -> ClusterState:
+                    events_per_round: int, mesh=None,
+                    collect_propagation: bool = False):
     """``cluster_round`` under continuous dissemination load: inject
     ``events_per_round`` fresh user events at uniform random origins, then
     run the round.
@@ -383,32 +399,68 @@ def sustained_round(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
         incarnations=jnp.zeros((m,), jnp.uint32),
         ltimes=eids.astype(jnp.uint32),
         origins=origins, active=active)
-    return cluster_round(state._replace(gossip=g), cfg, k_rnd, mesh=mesh)
+    return cluster_round(state._replace(gossip=g), cfg, k_rnd, mesh=mesh,
+                         collect_propagation=collect_propagation)
 
 
 def run_cluster_sustained(state: ClusterState, cfg: ClusterConfig,
                           key: jax.Array, num_rounds: int,
                           events_per_round: int = 2,
-                          mesh=None, collect_telemetry: bool = False):
+                          mesh=None, collect_telemetry: bool = False,
+                          collect_propagation: bool = False):
     """``collect_telemetry`` (static) additionally stacks one
     :func:`round_telemetry` row per round as a scan output and returns
     ``(final_state, rows f32[R, F])`` — the continuous-telemetry plane's
     device feed.  The rows stay on device until the CALLER's single
     ``device_get``: one transfer per run, never per round (the PR-9
-    digest-plane pattern)."""
+    digest-plane pattern).
+
+    ``collect_propagation`` (static) additionally traces dissemination
+    itself (the PR-16 propagation observatory): the first injected batch
+    becomes the M sentinel facts (their event ids are derived from the
+    entry round, so the contract survives resumed runs), and every round
+    stacks one :func:`propagation_row` — the redundancy-ledger pair from
+    the gossip exchange plus sentinel coverage folded from the SAME
+    ``colcnt`` partials the telemetry row already reduces (one
+    known-plane unpack serves both rows; ``with_cols`` below).  Appends
+    ``(prop_rows f32[R, P], sentinel_cov f32[R, M])`` to the return
+    tuple, after the telemetry rows when both are on; same
+    one-device_get discipline."""
+    if collect_propagation and events_per_round <= 0:
+        raise ValueError(
+            "collect_propagation traces the first injected batch as "
+            "sentinel facts — it needs events_per_round >= 1")
+    if collect_propagation:
+        m = events_per_round
+        # scan-invariant sentinel ids: exactly the eids sustained_round
+        # assigns to the FIRST round's batch (round r0: r0*m + 1..m)
+        sentinels = (state.gossip.round * m
+                     + jnp.arange(m, dtype=jnp.int32) + 1)
+
     def body(carry, subkey):
-        nxt = sustained_round(carry, cfg, subkey, events_per_round,
-                              mesh=mesh)
-        row = round_telemetry(nxt, cfg, mesh=mesh) \
-            if (collect_telemetry or cfg.control.enabled) else None
+        if collect_propagation:
+            nxt, pair = sustained_round(carry, cfg, subkey,
+                                        events_per_round, mesh=mesh,
+                                        collect_propagation=True)
+            row, colcnt, alive_cnt = round_telemetry(nxt, cfg, mesh=mesh,
+                                                     with_cols=True)
+        else:
+            nxt = sustained_round(carry, cfg, subkey, events_per_round,
+                                  mesh=mesh)
+            row = round_telemetry(nxt, cfg, mesh=mesh) \
+                if (collect_telemetry or cfg.control.enabled) else None
         nxt, row = control_tick(nxt, cfg, row, mesh=mesh)
+        out = ()
         if collect_telemetry:
-            return nxt, row
-        return nxt, ()
+            out = out + (row,)
+        if collect_propagation:
+            out = out + (propagation_row(nxt.gossip, pair, colcnt,
+                                         alive_cnt, sentinels),)
+        return nxt, out
 
     keys = jax.random.split(key, num_rounds)
-    final, rows = jax.lax.scan(body, state, keys)
-    return (final, rows) if collect_telemetry else final
+    final, out = jax.lax.scan(body, state, keys)
+    return (final,) + tuple(out) if out else final
 
 
 #: field order of the per-round device telemetry row (``f32[F]``) —
@@ -541,7 +593,7 @@ def telemetry_stretch(state: ClusterState, cfg: ClusterConfig):
 
 
 def round_telemetry(state: ClusterState, cfg: ClusterConfig,
-                    mesh=None) -> jnp.ndarray:
+                    mesh=None, with_cols: bool = False):
     """One compact counters row (``f32[len(TELEMETRY_FIELDS)]``) off the
     current cluster state, cheap enough to ride EVERY round as a scan
     output: alive count, valid facts, knowledge agreement + mean
@@ -556,10 +608,18 @@ def round_telemetry(state: ClusterState, cfg: ClusterConfig,
     reduces its own node shard and O(fields)-sized psum/pmax legs
     assemble the cluster row — no N-plane gather, bit-identical by the
     stage-1/stage-2 split above (integer partials reduce exactly; the
-    float math runs after the reduce on identical operands)."""
+    float math runs after the reduce on identical operands).
+
+    ``with_cols`` (static) additionally returns the globally-reduced
+    stage-1 operands the row was folded from — ``(row, colcnt i32[K],
+    alive_cnt i32)`` — so a rider (the propagation observatory's
+    sentinel-coverage fold) shares the one known-plane unpack instead of
+    paying its own; on the sharded path the extras are the post-psum
+    replicated partials, already exactly global."""
     if mesh is not None:
         from serf_tpu.parallel.ring import round_telemetry_sharded
-        return round_telemetry_sharded(state, cfg, mesh)
+        return round_telemetry_sharded(state, cfg, mesh,
+                                       with_cols=with_cols)
     g = state.gossip
     stretch = telemetry_stretch(state, cfg)
     subj_inc = subject_incarnations(g)
@@ -568,8 +628,53 @@ def round_telemetry(state: ClusterState, cfg: ClusterConfig,
     believed = believed_subjects(g, cfg.n, believers, alive_cnt) \
         | g.tombstone
     false_dead = jnp.sum(believed & g.alive)
-    return telemetry_finish(g, cfg, alive_cnt, colcnt, false_dead,
-                            subj_inc=subj_inc)
+    row = telemetry_finish(g, cfg, alive_cnt, colcnt, false_dead,
+                           subj_inc=subj_inc)
+    if with_cols:
+        return row, colcnt, alive_cnt
+    return row
+
+
+def propagation_row(g: GossipState, pair, colcnt, alive_cnt,
+                    sentinels: jnp.ndarray):
+    """Stage-2 of the propagation observatory's per-round row
+    (``serf_tpu.obs.propagation.PROPAGATION_FIELDS`` order — hardcoded
+    stack below, exactly the :func:`telemetry_finish` convention):
+    the redundancy-ledger pair from the round's gossip exchange plus
+    sentinel coverage folded from the telemetry row's OWN globally
+    reduced ``colcnt`` partials (``round_telemetry(..., with_cols=True)``
+    — no second known-plane unpack, no collective of its own).
+
+    Sentinel coverage is a fact-identity match: ``cov_i = Σ_k
+    [subject_k == sentinel_i ∧ valid_k] · colcnt[k]`` — an [M, K]
+    compare against replicated fact-table planes, so the fold is
+    bit-identical sharded vs. not.  A sentinel whose ring slot has
+    recycled matches nothing and reads 0 — callers monotonize the
+    coverage curve host-side (cummax over rounds) before reading
+    time-to-X% off it.  Returns ``(row f32[P], cov f32[M])`` with
+    coverage as a fraction of the current alive count, clamped to 1.0
+    (``colcnt`` counts every holder's known bit, so when holders die
+    after learning the raw ratio exceeds one)."""
+    sent, learned = pair
+    match = (g.facts.subject[None, :] == sentinels[:, None]) \
+        & g.facts.valid[None, :]
+    cov_cnt = jnp.sum(jnp.where(match, colcnt[None, :], 0), axis=1)
+    n_alive = jnp.maximum(alive_cnt, 1).astype(jnp.float32)
+    cov = jnp.minimum(cov_cnt.astype(jnp.float32) / n_alive, 1.0)
+    sentf = sent.astype(jnp.float32)
+    learnedf = learned.astype(jnp.float32)
+    redundant = sentf - learnedf
+    row = jnp.stack([
+        sentf,
+        learnedf,
+        redundant,
+        redundant / jnp.maximum(sentf, 1.0),
+        alive_cnt.astype(jnp.float32),
+        jnp.min(cov),
+        jnp.mean(cov),
+        jnp.max(cov),
+    ])
+    return row, cov
 
 
 def emit_cluster_metrics(state: ClusterState, cfg: ClusterConfig,

@@ -123,6 +123,20 @@ from serf_tpu.obs.lifecycle import (  # noqa: F401
     global_ledger,
     set_global_ledger,
 )
+from serf_tpu.obs.propagation import (  # noqa: F401
+    PROPAGATION_FIELDS,
+    PROPAGATION_MERGE,
+    PROPAGATION_SERIES,
+    PropagationLedger,
+    PropagationSummary,
+    analytic_redundancy,
+    analytic_rounds_to_coverage,
+    fold_propagation,
+    format_propagation,
+    propagation_to_store,
+    render_coverage,
+    summarize_propagation,
+)
 
 __all__ = [
     "Span", "TraceBuffer", "span", "trace_dump",
@@ -143,4 +157,9 @@ __all__ = [
     "judge_device_run", "score_bench", "slo_names",
     "LIFECYCLE_STAGES", "LifecycleLedger", "StageClock",
     "format_waterfall", "global_ledger", "set_global_ledger",
+    "PROPAGATION_FIELDS", "PROPAGATION_MERGE", "PROPAGATION_SERIES",
+    "PropagationLedger", "PropagationSummary", "analytic_redundancy",
+    "analytic_rounds_to_coverage", "fold_propagation",
+    "format_propagation", "propagation_to_store", "render_coverage",
+    "summarize_propagation",
 ]

@@ -23,7 +23,13 @@ Payload format (versioned; kept compact so it fits the default 1 KiB
      "members": n, "failed": n, "left": n,
      "mt": member_ltime, "et": event_ltime, "qt": query_ltime,
      "q": [intent_depth, event_depth, query_depth],
-     "lag": loop_lag_ms, "digest": 12-hex membership view digest}
+     "lag": loop_lag_ms, "digest": 12-hex membership view digest,
+     "prop": [seen, duplicates, rebroadcasts, {trace_hex: age_ms}]}
+
+The ``prop`` field is the node's propagation-ledger summary
+(``obs.propagation.PropagationLedger.summary``): dissemination
+provenance folded cluster-wide by :func:`fold_propagation` into
+``ClusterSnapshot.propagation``.
 """
 
 from __future__ import annotations
@@ -34,6 +40,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Sequence, Tuple
 
 from serf_tpu.obs.health import UNHEALTHY_THRESHOLD
+from serf_tpu.obs.propagation import fold_propagation
 from serf_tpu.obs.trace import span
 from serf_tpu.utils.metrics import percentile_of
 
@@ -80,6 +87,9 @@ def node_stats_payload(serf) -> bytes:
         "lag": round(serf.loop_lag_ms(), 2),
         "digest": digest,
     }
+    ledger = getattr(serf, "prop_ledger", None)
+    if ledger is not None:
+        st["prop"] = ledger.summary()
     return json.dumps(st, separators=(",", ":"), sort_keys=True).encode()
 
 
@@ -101,6 +111,7 @@ def decode_node_stats(raw: bytes) -> Dict[str, Any]:
     d.setdefault("q", [0, 0, 0])
     d.setdefault("lag", 0.0)
     d.setdefault("digest", "")
+    d.setdefault("prop", [0, 0, 0, {}])
     return d
 
 
@@ -115,6 +126,11 @@ class ClusterSnapshot:
     unhealthy: List[str] = field(default_factory=list)
     digests: Dict[str, str] = field(default_factory=dict)
     divergent: bool = False
+    #: cluster-wide propagation aggregate (obs.propagation
+    #: .fold_propagation over the per-node ``prop`` ledger summaries):
+    #: seen/duplicates/rebroadcasts sums, dup_ratio, per-trace
+    #: node-count + first-sight age spread
+    propagation: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def responders(self) -> int:
@@ -135,6 +151,7 @@ class ClusterSnapshot:
             "unhealthy": list(self.unhealthy),
             "digests": dict(sorted(self.digests.items())),
             "divergent": self.divergent,
+            "propagation": dict(self.propagation),
         }
 
 
@@ -200,10 +217,14 @@ class StatsPartial:
                            if d["health"] < UNHEALTHY_THRESHOLD)
         digests = {nid: d.get("digest", "") for nid, d in nodes.items()}
         divergent = len(set(digests.values())) > 1
+        propagation = fold_propagation(
+            {nid: d.get("prop", [0, 0, 0, {}])
+             for nid, d in nodes.items()})
         return ClusterSnapshot(origin=origin, expected=expected,
                                nodes=nodes, aggregates=aggregates,
                                unhealthy=unhealthy, digests=digests,
-                               divergent=divergent)
+                               divergent=divergent,
+                               propagation=propagation)
 
 
 def fold_snapshot(origin: str, expected: int,

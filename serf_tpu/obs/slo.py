@@ -130,6 +130,27 @@ SLO_TABLE: Tuple[SLODef, ...] = (
         description="queue-wait's share of sampled end-to-end message "
                     "latency — backpressure must not dominate the host "
                     "hot path"),
+    # propagation-observatory SLOs (obs/propagation.py — both planes)
+    SLODef(
+        name="coverage-settle",
+        metrics=("serf.propagation.cov-min", "serf.propagation.coverage"),
+        planes=("host", "device"),
+        better="lower", objective=1.0, unit="fraction of budget",
+        description="traced facts reach 99% of alive nodes within the "
+                    "run (device: t99 as a fraction of rounds run; "
+                    "host: probe time-to-all as a fraction of the "
+                    "settle budget) — a fact that never covers is a "
+                    "dissemination regression"),
+    SLODef(
+        name="redundancy-ceiling",
+        metrics=("serf.propagation.redundancy",
+                 "serf.propagation.dup-ratio"),
+        planes=("host", "device"),
+        better="lower", objective=0.995, unit="redundant/sent",
+        description="gossip redundancy stays below the ceiling — a "
+                    "ratio at ~1.0 means the fabric ships only slots "
+                    "nobody learns from (epidemic overhead is expected; "
+                    "total waste is a regression)"),
 )
 
 
@@ -385,6 +406,39 @@ def judge_host_run(result, plan, emit: bool = True) -> List[SLOVerdict]:
                     d, "host", share,
                     detail=f"queue-wait owns {share:.0%} of sampled "
                            "e2e latency", emit=emit))
+        elif d.name == "coverage-settle":
+            prop = getattr(result, "propagation", None)
+            if not prop or prop.get("trace") is None:
+                out.append(judge(d, "host", None,
+                                 detail="no propagation probe",
+                                 emit=emit))
+            elif prop.get("coverage", 0.0) < 1.0 - _EPS:
+                out.append(judge(
+                    d, "host", math.inf,
+                    detail=f"probe reached {prop.get('reached', 0)} of "
+                           f"{prop.get('nodes', 0)} node(s)", emit=emit))
+            else:
+                t_ms = prop.get("time_to_all_ms") or 0.0
+                value = (t_ms / 1e3) / max(plan.settle_s, _EPS)
+                out.append(judge(
+                    d, "host", value,
+                    detail=f"probe covered {prop.get('nodes', 0)} "
+                           f"node(s) in {t_ms:.1f}ms of "
+                           f"{plan.settle_s:.1f}s budget", emit=emit))
+        elif d.name == "redundancy-ceiling":
+            prop = getattr(result, "propagation", None)
+            if not prop or (prop.get("seen", 0)
+                            + prop.get("duplicates", 0)) <= 0:
+                out.append(judge(d, "host", None,
+                                 detail="no events disseminated",
+                                 emit=emit))
+            else:
+                dr = prop["dup_ratio"]
+                out.append(judge(
+                    d, "host", dr,
+                    detail=f"{prop['duplicates']} duplicate(s) of "
+                           f"{prop['seen'] + prop['duplicates']} "
+                           "delivered", emit=emit))
     return out
 
 
@@ -563,6 +617,43 @@ def judge_device_run(result, plan, rps: Optional[float] = None,
                     d, "device", rps / ceiling,
                     detail=f"measured {rps:.1f} rps vs analytic ceiling "
                            f"{ceiling:.1f} rps", emit=emit))
+        elif d.name == "coverage-settle":
+            prop = getattr(result, "propagation", None)
+            summary = (prop or {}).get("summary")
+            if not summary:
+                out.append(judge(d, "device", None,
+                                 detail="propagation not traced",
+                                 emit=emit))
+                continue
+            t99 = (summary.get("time_to") or {}).get("99")
+            rounds = max(1, summary.get("rounds", 1))
+            if t99 is None:
+                out.append(judge(
+                    d, "device", math.inf,
+                    detail=f"sentinels never reached 99% coverage "
+                           f"(final min "
+                           f"{summary.get('final_coverage', 0):.3f})",
+                    emit=emit))
+            else:
+                out.append(judge(
+                    d, "device", t99 / rounds,
+                    detail=f"99% coverage at round {t99} of {rounds}",
+                    emit=emit))
+        elif d.name == "redundancy-ceiling":
+            prop = getattr(result, "propagation", None)
+            summary = (prop or {}).get("summary")
+            if not summary or summary.get("slots_sent", 0) <= 0:
+                out.append(judge(d, "device", None,
+                                 detail="propagation not traced",
+                                 emit=emit))
+            else:
+                series = store.get("serf.propagation.redundancy") \
+                    if store is not None else None
+                out.append(judge(
+                    d, "device", summary["redundancy"], series=series,
+                    detail=f"{summary['slots_sent'] - summary['slots_learned']:.0f} "
+                           f"redundant of {summary['slots_sent']:.0f} "
+                           "slots sent", emit=emit))
     return out
 
 

@@ -1,11 +1,13 @@
 """Unified cross-plane timeline: every observability surface on ONE
 correlated timebase, exported as Chrome-trace-event JSON.
 
-The repo grew six observability surfaces across five PRs — trace spans
+The repo grew its observability surfaces across six PRs — trace spans
 (obs/trace), flight events (obs/flight), message-lifecycle stage clocks
 (obs/lifecycle), per-round device telemetry (models/swim →
-obs/timeseries), control decisions (serf_tpu/control), and SLO verdicts
-(obs/slo) — each excellent alone and none correlated with the others.
+obs/timeseries), control decisions (serf_tpu/control), SLO verdicts
+(obs/slo), and propagation tracing (obs/propagation: coverage /
+redundancy curves + traced-probe provenance) — each excellent alone and
+none correlated with the others.
 This module is the single view a real fleet consumes: one
 Perfetto-loadable JSON bundle (the Chrome ``traceEvents`` format) where
 a probe span, the flight event it caused, the lifecycle stage waterfall
@@ -44,8 +46,9 @@ from dataclasses import dataclass
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 #: the surfaces a full bundle carries (each is an event ``cat``); the
-#: six-surface tier-1 test holds an exported chaos bundle to this tuple
-SURFACES = ("span", "flight", "lifecycle", "device", "control", "slo")
+#: all-surface tier-1 test holds an exported chaos bundle to this tuple
+SURFACES = ("span", "flight", "lifecycle", "device", "control", "slo",
+            "propagation")
 
 #: fixed per-process thread lanes (lifecycle stages get 10 + stage idx;
 #: overlapping-span overflow lanes get 100 + lane idx)
@@ -53,6 +56,7 @@ TID_SPANS = 1
 TID_FLIGHT = 2
 TID_CONTROL = 3
 TID_SLO = 4
+TID_PROPAGATION = 5
 TID_STAGE_BASE = 10
 TID_SPAN_EXTRA = 100
 
@@ -63,7 +67,8 @@ PID_DEVICE = 1000
 
 #: flight kinds that belong to dedicated lanes rather than the flight one
 _FLIGHT_ROUTES = {"control-decision": ("control", TID_CONTROL),
-                  "slo-breach": ("slo", TID_SLO)}
+                  "slo-breach": ("slo", TID_SLO),
+                  "propagation-trace": ("propagation", TID_PROPAGATION)}
 
 #: minimum exported span duration (µs): matched B/E pairs must be
 #: strictly orderable even for sub-µs spans
@@ -289,15 +294,22 @@ class TimelineBuilder:
 
     def add_device_series(self, store, anchors: DeviceRunAnchors) -> None:
         """A round-indexed ``SeriesStore`` (DeviceChaosResult.telemetry)
-        as per-metric counter tracks in the device process."""
+        as per-metric counter tracks in the device process.  The
+        propagation observatory's ``serf.propagation.*`` series route to
+        their own lane (the Perfetto "propagation" thread) so coverage
+        and redundancy curves read beside — not under — the telemetry
+        row."""
         self._device_used = True
         for name in store.names():
             ts = store.get(name)
+            prop = name.startswith("serf.propagation.")
+            cat = "propagation" if prop else "device"
+            tid = TID_PROPAGATION if prop else TID_SPANS
             for t_round, v in ts.points():
-                self._push("C", "device", name,
+                self._push("C", cat, name,
                            anchors.round_wall(t_round), PID_DEVICE,
-                           TID_SPANS, args={"value": float(v),
-                                            "round": t_round})
+                           tid, args={"value": float(v),
+                                      "round": t_round})
 
     def add_control_decisions(self, decisions: Iterable[Dict[str, Any]],
                               anchors: DeviceRunAnchors) -> None:
@@ -381,6 +393,8 @@ class TimelineBuilder:
                     tname = "control"
                 elif tid == TID_SLO:
                     tname = "slo"
+                elif tid == TID_PROPAGATION:
+                    tname = "propagation"
                 elif tid == TID_STAGE_BASE - 1:
                     tname = "lifecycle.e2e"
                 elif tid >= TID_SPAN_EXTRA:

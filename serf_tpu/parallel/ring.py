@@ -295,7 +295,7 @@ def exchange_sharded(packets: jnp.ndarray, cfg: GossipConfig,
     return ex(*operands)
 
 
-def round_telemetry_sharded(state, cfg, mesh) -> jnp.ndarray:
+def round_telemetry_sharded(state, cfg, mesh, with_cols: bool = False):
     """The in-collective telemetry row (ISSUE 15 tentpole): the SAME
     ``f32[len(TELEMETRY_FIELDS)]`` row ``models/swim.round_telemetry``
     computes, produced as fused O(fields) collective legs on the
@@ -331,6 +331,13 @@ def round_telemetry_sharded(state, cfg, mesh) -> jnp.ndarray:
     Falls back to the gathered row (loud ``shard-fallback`` flight
     event) when the mesh does not divide ``n``, mirroring
     :func:`exchange_sharded`.
+
+    ``with_cols`` mirrors ``round_telemetry(with_cols=True)``: the leg
+    additionally returns the post-psum ``(colcnt i32[K], alive_cnt)``
+    stage-1 operands — replicated (exactly global) after the fused sum
+    leg, so they leave the shard_map under ``P()`` with no extra
+    collective; the propagation observatory folds sentinel coverage
+    from them.
     """
     from serf_tpu.models.failure import believed_subjects
     from serf_tpu.models.swim import (
@@ -347,7 +354,7 @@ def round_telemetry_sharded(state, cfg, mesh) -> jnp.ndarray:
         from serf_tpu import obs
         obs.record("shard-fallback", op="round_telemetry_sharded", n=n,
                    devices=d, reason="n % devices != 0; gathered row")
-        return round_telemetry(state, cfg)
+        return round_telemetry(state, cfg, with_cols=with_cols)
     n_local = n // d
     g = state.gossip
     stretch = telemetry_stretch(state, cfg)
@@ -385,8 +392,12 @@ def round_telemetry_sharded(state, cfg, mesh) -> jnp.ndarray:
         rows = jax.lax.dynamic_slice_in_dim(believed, gstart, n_local)
         fd = jnp.sum((rows | gs.tombstone) & gs.alive)
         false_dead = fd if d == 1 else jax.lax.psum(fd, NODE_AXIS)
-        return telemetry_finish(gs, cfg, alive_cnt, colcnt, false_dead,
-                                subj_inc=subj_inc)
+        row = telemetry_finish(gs, cfg, alive_cnt, colcnt, false_dead,
+                               subj_inc=subj_inc)
+        if with_cols:
+            # post-psum: replicated, exactly the global stage-1 counts
+            return row, colcnt, alive_cnt
+        return row
 
     operands = [g]
     specs = [partition_specs(g)]
@@ -397,15 +408,17 @@ def round_telemetry_sharded(state, cfg, mesh) -> jnp.ndarray:
     # provably replicated only through psum/pmax and the fact table —
     # the replication argument is the docstring's, pinned by the
     # bit-identity tests, not re-derivable by shard_map's checker
+    out_specs = (P(), P(), P()) if with_cols else P()
     tele = shard_map(leg, mesh=mesh, in_specs=tuple(specs),
-                     out_specs=P(), check_rep=False)
+                     out_specs=out_specs, check_rep=False)
     return tele(*operands)
 
 
 def sharded_round_step(state: GossipState, cfg: GossipConfig,
                        key: jax.Array, mesh, schedule: str = "ring",
                        group=None, drop_rate=None,
-                       eff_fanout=None) -> GossipState:
+                       eff_fanout=None,
+                       collect_propagation: bool = False):
     """One gossip round with the explicit sharded exchange — bit-exact
     with ``round_step(state, cfg, key, group, drop_rate)`` by
     construction: it IS ``round_step`` (same select/merge/quiet-gate/
@@ -418,9 +431,16 @@ def sharded_round_step(state: GossipState, cfg: GossipConfig,
     that forced the sharded round off the pallas path is gone.  The
     standalone (non-fused) kernels remain single-device; requesting
     them here falls back to the XLA phases with a loud
-    ``pallas-fallback`` flight event (``dissemination._pallas_mode``)."""
+    ``pallas-fallback`` flight event (``dissemination._pallas_mode``).
+
+    ``collect_propagation`` forwards the redundancy-ledger flag
+    (``round_step``'s docstring): the ledger reductions run on the
+    GSPMD-sharded global planes OUTSIDE the shard_map leg, where
+    integer sums globalize exactly — same code, same bits, sharded or
+    not."""
     return round_step(state, cfg, key, group=group, drop_rate=drop_rate,
                       exchange=functools.partial(exchange_sharded,
                                                  mesh=mesh,
                                                  schedule=schedule),
-                      mesh=mesh, eff_fanout=eff_fanout)
+                      mesh=mesh, eff_fanout=eff_fanout,
+                      collect_propagation=collect_propagation)

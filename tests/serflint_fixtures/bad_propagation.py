@@ -1,0 +1,25 @@
+"""serflint fixture: propagation-row declarations that MUST fire
+``propagation-field-drift``.
+
+Linted pure-AST as a toy project's ``serf_tpu/obs/propagation.py``
+(the ``bad_telemetry.py`` shape, over the propagation observatory's
+row contract):
+
+- ``orphan_field`` is a PROPAGATION_FIELDS entry with no
+  PROPAGATION_MERGE entry (``unreduced:orphan_field``);
+- PROPAGATION_MERGE reduces ``ghost_field`` which is not a row field
+  (``undeclared:ghost_field``);
+- ``slots_sent`` declares merge op ``"mean"`` which no leg implements
+  (``bad-op:slots_sent`` — means are not associative without a count
+  partial);
+- the toy README documents ``stale_field`` which the row does not
+  carry (``stale-row:stale_field``) and has no row for
+  ``orphan_field`` (``undocumented:orphan_field``).
+"""
+
+PROPAGATION_FIELDS = ("slots_sent", "orphan_field")
+
+PROPAGATION_MERGE = {
+    "slots_sent": "mean",
+    "ghost_field": "sum",
+}

@@ -1,0 +1,11 @@
+"""serflint fixture: the clean twin of bad_propagation.py — every row
+field has a merge entry with a legal op, every merge entry is a row
+field, and the toy README propagation table carries exactly these rows
+— must produce zero ``propagation-field-drift`` findings."""
+
+PROPAGATION_FIELDS = ("slots_sent", "cov_min")
+
+PROPAGATION_MERGE = {
+    "slots_sent": "sum",
+    "cov_min": "replicated",
+}

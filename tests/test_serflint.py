@@ -355,6 +355,51 @@ def test_telemetry_clean_twin_is_silent(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# propagation-field-drift (the propagation-row merge contract, ISSUE 16)
+# ---------------------------------------------------------------------------
+
+_README_PROPAGATION = """\
+## Propagation observability
+
+| Field | Merge | Notes |
+|---|---|---|
+| `slots_sent` | sum | fine |
+| `stale_field` | sum | row removed from the code |
+"""
+
+
+def test_propagation_bad_fixture_fires_every_direction(tmp_path):
+    project = toy_project(
+        tmp_path,
+        {"serf_tpu/obs/propagation.py":
+         (FIXTURES / "bad_propagation.py").read_text()},
+        readme=_README_PROPAGATION)
+    report = analysis.run_rules(project,
+                                rules=["propagation-field-drift"])
+    keys = {f.key for f in report.findings}
+    assert "unreduced:orphan_field" in keys    # row field, no merge leg
+    assert "undeclared:ghost_field" in keys    # merge leg, no row field
+    assert "bad-op:slots_sent" in keys         # op no leg implements
+    assert "undocumented:orphan_field" in keys # row field, no README row
+    assert "stale-row:stale_field" in keys     # README row, no field
+
+
+def test_propagation_clean_twin_is_silent(tmp_path):
+    readme = ("## Propagation observability\n\n"
+              "| Field | Merge | Notes |\n|---|---|---|\n"
+              "| `slots_sent` | sum | — |\n"
+              "| `cov_min` | replicated | — |\n")
+    project = toy_project(
+        tmp_path,
+        {"serf_tpu/obs/propagation.py":
+         (FIXTURES / "ok_propagation.py").read_text()},
+        readme=readme)
+    report = analysis.run_rules(project,
+                                rules=["propagation-field-drift"])
+    assert report.findings == []
+
+
+# ---------------------------------------------------------------------------
 # schema family: drift without a bump fails lint; bump clears it
 # ---------------------------------------------------------------------------
 
@@ -661,6 +706,7 @@ def test_rule_registry_is_exactly_the_shipped_set():
         "reg-flight-unknown", "reg-flight-unused",
         "slo-metric-unknown", "slo-decl-drift", "slo-doc-drift",
         "control-knob-drift", "telemetry-field-drift",
+        "propagation-field-drift",
         "schema-pytree-drift", "schema-wire-drift",
         "schema-recording-drift",
         "docs-rule-table",

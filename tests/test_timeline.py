@@ -2,8 +2,8 @@
 acceptance-pinned): exporter output validates against the trace-event
 schema (sorted ts, matched B/E pairs, stable pid/tid mapping), survives
 a JSON round-trip, and a loopback query-storm run's exported bundle
-carries all six surfaces — spans, flight, lifecycle, device rounds,
-control, SLO — on one correlated timebase."""
+carries every surface — spans, flight, lifecycle, device rounds,
+control, SLO, propagation — on one correlated timebase."""
 
 import asyncio
 import json
@@ -41,6 +41,9 @@ def _synthetic_builder():
          "slo": "false-dead"},
         {"seq": 3, "time": T0 + 0.03, "kind": "control-decision",
          "knobs": {"fanout": 3}},
+        # routes to the dedicated propagation lane (ISSUE 16)
+        {"seq": 5, "time": T0 + 0.04, "kind": "propagation-trace",
+         "plane": "host", "coverage": 1.0, "time_to_all_ms": 12.5},
         {"seq": 4, "time": T0 + 0.5, "kind": "slow-message",
          "node": "n1", "message": "user-event", "e2e_ms": 300.0,
          "stages_ms": {"transport": 100.0, "apply": 150.0,

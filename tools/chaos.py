@@ -70,7 +70,8 @@ def run_device(plan, n: int, k_facts: int, devices: int = 0,
         if d > 1:
             mesh = make_mesh(d)
     return (run_device_plan(plan, cfg, mesh=mesh, recorder=recorder,
-                            collect_telemetry=collect_telemetry),
+                            collect_telemetry=collect_telemetry,
+                            collect_propagation=True),
             (d if mesh else 1))
 
 
@@ -160,6 +161,7 @@ def main() -> int:
     ring_summaries = {}
     control_info = {}
     lifecycle_info = {}
+    propagation_info = {}
     ab = {}
     device_mesh = 1
     #: A/B mode runs each plane twice (static leg first); 'on' replaces
@@ -219,6 +221,8 @@ def main() -> int:
                 series = getattr(result, "series", None)
                 if series is not None:
                     ring_summaries[plane] = series.summaries()
+                if getattr(result, "propagation", None) is not None:
+                    propagation_info[plane] = result.propagation
                 if getattr(result, "control", None) is not None:
                     control_info[plane] = result.control
             else:
@@ -229,6 +233,11 @@ def main() -> int:
                 telemetry = getattr(result, "telemetry", None)
                 if telemetry is not None:
                     ring_summaries[plane] = telemetry.summaries()
+                prop = getattr(result, "propagation", None)
+                if prop is not None:
+                    # rows/coverage stay host-side arrays; the summary
+                    # is the JSON-safe, printable digest
+                    propagation_info[plane] = prop["summary"]
                 if getattr(result, "control_final", None) is not None:
                     control_info[plane] = {
                         "final": result.control_final,
@@ -305,6 +314,7 @@ def main() -> int:
             "lowering_notes": notes,
             "overload": overload,
             "lifecycle": lifecycle_info,
+            "propagation": propagation_info,
             "device_mesh_devices": device_mesh,
             "recordings": recordings,
             "timeline": timeline_path,
@@ -362,6 +372,12 @@ def main() -> int:
                 if lc.get("slow"):
                     print(f"  slow-message flight events: {lc['slow']} "
                           f"(> {lc['slow_ms']:g} ms e2e)")
+        if propagation_info:
+            # the coverage-curve verdict (obs/propagation.py), printed
+            # beside the invariant and SLO verdicts on both planes
+            from serf_tpu.obs.propagation import format_propagation
+            for plane, p in sorted(propagation_info.items()):
+                print(format_propagation(p, plane))
         print("degradation counters:")
         for name in sorted(counters):
             print(f"  {name} = {counters[name]:.0f}")

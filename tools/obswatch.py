@@ -67,8 +67,9 @@ def device_plan(degraded: bool = False):
 
 
 def run_device_leg(n: int, degraded: bool):
-    """Run the device scenario with telemetry + wall timing; returns
-    (verdict list, ring store, rps, ceiling)."""
+    """Run the device scenario with telemetry + the sentinel propagation
+    tracer + wall timing; returns (verdict list, ring store, rps,
+    ceiling, propagation summary dict)."""
     from serf_tpu.faults.device import run_device_plan
     from serf_tpu.models.accounting import round_traffic
     from serf_tpu.models.dissemination import GossipConfig
@@ -83,7 +84,8 @@ def run_device_leg(n: int, degraded: bool):
         push_pull_every=8)
     plan = device_plan(degraded)
     t0 = time.perf_counter()
-    result = run_device_plan(plan, cfg, collect_telemetry=True)
+    result = run_device_plan(plan, cfg, collect_telemetry=True,
+                             collect_propagation=True)
     elapsed = time.perf_counter() - t0
     # wall rps INCLUDING compile — an understatement, which is the safe
     # direction for the measurement-integrity SLO (measured <= ceiling)
@@ -91,7 +93,8 @@ def run_device_leg(n: int, degraded: bool):
     ceiling = round_traffic(cfg).ceiling_rounds_per_sec()
     verdicts = slo.judge_device_run(result, plan, rps=rps,
                                     ceiling=ceiling)
-    return verdicts, result.telemetry, rps, ceiling
+    prop = result.propagation["summary"] if result.propagation else None
+    return verdicts, result.telemetry, rps, ceiling, prop
 
 
 def run_host_leg():
@@ -107,7 +110,7 @@ def run_host_leg():
     with tempfile.TemporaryDirectory(prefix="serf-obswatch-") as td:
         result = asyncio.run(run_host_plan(plan, tmp_dir=td))
     return (slo.judge_host_run(result, plan), result.series,
-            result.lifecycle)
+            result.lifecycle, result.propagation)
 
 
 def main(argv=None) -> int:
@@ -132,17 +135,23 @@ def main(argv=None) -> int:
 
     verdicts = {}
     rings = {}
-    dev_verdicts, dev_store, rps, ceiling = run_device_leg(
+    propagation = {}
+    dev_verdicts, dev_store, rps, ceiling, dev_prop = run_device_leg(
         args.n, args.degraded)
     verdicts["device"] = dev_verdicts
     if dev_store is not None:
         rings["device"] = dev_store
+    if dev_prop is not None:
+        propagation["device"] = dev_prop
     lifecycle_snap = None
     if not args.device_only and not args.degraded:
-        host_verdicts, host_store, lifecycle_snap = run_host_leg()
+        host_verdicts, host_store, lifecycle_snap, host_prop = \
+            run_host_leg()
         verdicts["host"] = host_verdicts
         if host_store is not None:
             rings["host"] = host_store
+        if host_prop is not None:
+            propagation["host"] = host_prop
 
     ok = all(slo.all_ok(v) for v in verdicts.values())
     breaches = flight.flight_dump(kind="slo-breach")
@@ -157,10 +166,14 @@ def main(argv=None) -> int:
             "rings": {p: s.tail(last=args.tail)
                       for p, s in sorted(rings.items())},
             "lifecycle": lifecycle_snap,
+            "propagation": propagation,
         }, indent=1, sort_keys=True))
     else:
+        from serf_tpu.obs.propagation import format_propagation
         for plane in sorted(verdicts):
             print(slo.format_verdicts(verdicts[plane], plane))
+            if plane in propagation:
+                print(format_propagation(propagation[plane], plane))
         if lifecycle_snap is not None:
             from serf_tpu.obs.lifecycle import format_waterfall
             print(format_waterfall(lifecycle_snap))
