@@ -892,6 +892,103 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - never lose the headline to it
         detail["obs_overhead_error"] = repr(e)[:300]
 
+    # --- watchdog_overhead (ISSUE 17): the always-on watchdog must stay
+    # near-zero cost.  Device: the bounded-N sustained scan with the
+    # in-scan invariant row ON vs OFF; host: the query-storm loopback
+    # run with the watchdog task ticking vs disabled.  Both run their
+    # legs ABBA (on, off, off, on; best per leg) so clock drift cancels
+    # instead of biasing whichever leg ran second.  The
+    # blackbox_roundtrip self-check (synthetic breach -> dump ->
+    # validate/render/diff/timeline, tools/blackbox.py) rides along;
+    # BASELINE.json bands cap both overhead fractions and pin the
+    # roundtrip green.
+    try:
+        wd_n = int(os.environ.get("SERF_TPU_BENCH_TS_N",
+                                  min(N_NODES, 4096)))
+        wd_rounds = 48
+        cfg_wd = flagship_config(wd_n, k_facts=K_FACTS)
+        wdov = {"n": wd_n, "rounds": wd_rounds}
+        run_wd = {}
+        for flag in (True, False):
+            run_wd[flag] = jax.jit(functools.partial(
+                run_cluster_sustained, cfg=cfg_wd,
+                events_per_round=EVENTS_PER_ROUND,
+                collect_invariants=flag),
+                static_argnames=("num_rounds",))
+        # compile + warm both legs through the detection transient
+        # before any timing (same discipline as obs_overhead)
+        st = seeded_state(cfg_wd)
+        for flag in (True, False):
+            out = run_wd[flag](st, key=jax.random.key(16),
+                               num_rounds=wd_rounds)
+            st = out[0] if flag else out
+            int(jnp.asarray(st.gossip.round))     # barrier
+        best_wd = {True: 0.0, False: 0.0}
+        for i, flag in enumerate((True, False, False, True)):   # ABBA
+            t0 = time.perf_counter()
+            out = run_wd[flag](st, key=jax.random.key(17 + i),
+                               num_rounds=wd_rounds)
+            st = out[0] if flag else out
+            int(jnp.asarray(st.gossip.round))     # barrier
+            best_wd[flag] = max(best_wd[flag],
+                                wd_rounds / (time.perf_counter() - t0))
+        wdov["device_rps_invariants_on"] = round(best_wd[True], 2)
+        wdov["device_rps_invariants_off"] = round(best_wd[False], 2)
+        wdov["device_overhead_frac"] = round(
+            max(0.0, 1.0 - best_wd[True] / max(best_wd[False], 1e-9)), 4)
+
+        if "host_plane" in detail:
+            import asyncio
+
+            from serf_tpu.faults.host import (
+                _counter_total as _ctr_wd,
+                run_host_plan as _rhp_wd,
+            )
+            from serf_tpu.faults.plan import named_plan as _np_wd
+            plan_wd = _np_wd("query-storm")
+            eps_wd = {True: 0.0, False: 0.0}
+            for flag in (True, False, False, True):             # ABBA
+                base = _ctr_wd("serf.events")
+                t0 = time.perf_counter()
+                asyncio.run(_rhp_wd(plan_wd, watchdog=flag))
+                el = time.perf_counter() - t0
+                eps_wd[flag] = max(
+                    eps_wd[flag], (_ctr_wd("serf.events") - base) / el)
+            wdov["host_events_per_sec_watchdog_on"] = round(
+                eps_wd[True], 1)
+            wdov["host_events_per_sec_watchdog_off"] = round(
+                eps_wd[False], 1)
+            wdov["host_overhead_frac"] = round(
+                max(0.0, 1.0 - eps_wd[True] / max(eps_wd[False], 1e-9)),
+                4)
+
+        # forensic-path self-check: the breach -> bundle -> render/
+        # diff/timeline loop must round-trip (stdout redirected — the
+        # orchestrator parses this process's LAST stdout JSON line as
+        # the headline)
+        import contextlib
+        import importlib.util as _ilu
+        spec = _ilu.spec_from_file_location(
+            "_bb_tool", os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "tools", "blackbox.py"))
+        bb_tool = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(bb_tool)
+        with contextlib.redirect_stdout(sys.stderr):
+            wdov["blackbox_roundtrip_ok"] = int(
+                bb_tool.main(["self-check"]) == 0)
+        detail["watchdog_overhead"] = wdov
+        sys.stderr.write(
+            "watchdog overhead: device %.1f%% (invariant row on/off "
+            "%.2f/%.2f rps), host %s, blackbox roundtrip %s\n" % (
+                100 * wdov["device_overhead_frac"], best_wd[True],
+                best_wd[False],
+                ("%.1f%%" % (100 * wdov["host_overhead_frac"])
+                 if "host_overhead_frac" in wdov else "n/a"),
+                "ok" if wdov["blackbox_roundtrip_ok"] else "FAIL"))
+    except Exception as e:  # noqa: BLE001 - never lose the headline to it
+        detail["watchdog_overhead_error"] = repr(e)[:300]
+
     # --- unified timeline bundle (--export-timeline / ISSUE 15): one
     # Perfetto-loadable artifact beside the numbers — the telemetry
     # scan's device rounds on the wall clock plus the host-plane run's

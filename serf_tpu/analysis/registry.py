@@ -197,6 +197,15 @@ METRICS: tuple = (
     "serf.control.knob.<>",
     "serf.control.steps",
     "serf.control.shed",
+    # continuous verification (obs/watchdog.py) + black box
+    # (obs/blackbox.py)
+    "serf.watchdog.ticks",
+    "serf.watchdog.ok",
+    "serf.watchdog.armed",
+    "serf.watchdog.breach",
+    "serf.blackbox.bundles",
+    "serf.blackbox.bytes",
+    "serf.blackbox.rotated",
 )
 
 #: every flight-recorder event kind (obs/flight.py ``record`` call sites)
@@ -232,6 +241,7 @@ FLIGHT_KINDS: tuple = (
     "subscriber-drop",
     "swim-state",
     "user-event",
+    "watchdog-breach",
 )
 
 #: every SLO name ``serf_tpu/obs/slo.py`` SLO_TABLE defines.  Checked
@@ -310,6 +320,19 @@ PROPAGATION_SECTION = "## Propagation observability"
 #: GSPMD-exact integer sums outside the shard_map body, coverage
 #: fields fold the already-psum'd colcnt partials (replicated)
 PROPAGATION_MERGE_OPS = ("sum", "replicated")
+
+#: the invariant-row source the ``invariant-field-drift`` rule
+#: fingerprints (ISSUE 17): file -> (field-tuple literal, merge-dict
+#: literal), the telemetry/propagation contract shape — one README
+#: table row per field under the section below, enforced both ways.
+INVARIANT_SOURCES = {
+    "serf_tpu/obs/watchdog.py": ("INVARIANT_FIELDS", "INVARIANT_MERGE"),
+}
+INVARIANT_SECTION = "## Continuous verification & black box"
+#: the invariant row's globalization contract: every predicate folds
+#: from already-reduced telemetry/propagation operands plus replicated
+#: ledgers — identical on every chip, never a collective of its own
+INVARIANT_MERGE_OPS = ("replicated",)
 
 
 # ---------------------------------------------------------------------------
@@ -964,6 +987,93 @@ def check_propagation_field_drift(files: List[SourceFile],
                         "propagation-field-drift", readme_rel, line,
                         f"stale-row:{f_name}",
                         f"README documents propagation field {f_name!r} "
+                        "but the row does not carry it — delete the row "
+                        "or restore the field")
+
+
+# ---------------------------------------------------------------------------
+# invariant-row cross-check (pass family d, ISSUE 17): the watchdog's
+# device invariant row is registry-governed like the telemetry row
+# ---------------------------------------------------------------------------
+
+def documented_invariant_fields(readme: Path) -> Dict[str, int]:
+    """{field: line} from the README invariant table (the
+    ``INVARIANT_SECTION`` section's first column)."""
+    out: Dict[str, int] = {}
+    in_section = False
+    for i, line in enumerate(readme.read_text().splitlines(), start=1):
+        if line.startswith("## "):
+            in_section = line.strip() == INVARIANT_SECTION
+            continue
+        if not in_section:
+            continue
+        m = ROW_RE.match(line)
+        if m and m.group(1) not in ("Field", "Metric", "Predicate",
+                                    "Knob", "Section"):
+            out[m.group(1)] = i
+    return out
+
+
+@project_rule("invariant-field-drift",
+              "the device invariant row, its merge contract, and the "
+              "README invariant table out of sync (a field added to the "
+              "row but not reduced, reduced but undeclared, an unknown "
+              "merge op, or a missing/stale README row)",
+              'INVARIANT_FIELDS gains "new_field" with no '
+              "INVARIANT_MERGE entry")
+def check_invariant_field_drift(files: List[SourceFile],
+                                project: Project) -> Iterable[Finding]:
+    by_rel = {f.rel: f for f in files}
+    for rel, (fields_name, merge_name) in INVARIANT_SOURCES.items():
+        src = by_rel.get(rel)
+        if src is None:
+            continue
+        fields = _tuple_literal(src.tree, fields_name)
+        merge = _dict_literal(src.tree, merge_name)
+        if fields is None:
+            continue
+        merge = merge or []
+        merge_keys = {k for k, _v, _ln in merge}
+        field_set = {f for f, _ln in fields}
+        for f_name, lineno in fields:
+            if f_name not in merge_keys:
+                yield _reg_finding(
+                    "invariant-field-drift", rel, lineno,
+                    f"unreduced:{f_name}",
+                    f"invariant field {f_name!r} ({fields_name}) has "
+                    f"no {merge_name} entry — a row field without a "
+                    "declared globalization silently breaks the sharded "
+                    "row (declare its merge op, or drop the field)")
+        for k, op, lineno in merge:
+            if k not in field_set:
+                yield _reg_finding(
+                    "invariant-field-drift", rel, lineno,
+                    f"undeclared:{k}",
+                    f"{merge_name} reduces {k!r} which is not a "
+                    f"{fields_name} entry — dead merge leg (add the row "
+                    "field or delete the entry)")
+            if op not in INVARIANT_MERGE_OPS:
+                yield _reg_finding(
+                    "invariant-field-drift", rel, lineno,
+                    f"bad-op:{k}",
+                    f"{merge_name}[{k!r}] declares unknown merge op "
+                    f"{op!r} (one of {INVARIANT_MERGE_OPS}) — the "
+                    "invariant fold cannot implement it")
+        if project.readme is not None and project.readme.exists():
+            documented = documented_invariant_fields(project.readme)
+            readme_rel = project.readme.name
+            for f_name in sorted(field_set - set(documented)):
+                yield _reg_finding(
+                    "invariant-field-drift", readme_rel, 1,
+                    f"undocumented:{f_name}",
+                    f"invariant field {f_name!r} has no row in the "
+                    f"README '{INVARIANT_SECTION[3:]}' table")
+            for f_name, line in sorted(documented.items()):
+                if f_name not in field_set:
+                    yield _reg_finding(
+                        "invariant-field-drift", readme_rel, line,
+                        f"stale-row:{f_name}",
+                        f"README documents invariant field {f_name!r} "
                         "but the row does not carry it — delete the row "
                         "or restore the field")
 

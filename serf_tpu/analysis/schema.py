@@ -71,6 +71,13 @@ WIRE_REGISTRIES = ("MessageType", "QueryFlag", "SwimMessageType",
 RECORDING_SOURCE = "serf_tpu/replay/recording.py"
 RECORDING_DECL = "RECORDING_SCHEMA"
 
+#: the black-box bundle format (PR 17): the declared section -> field
+#: lists literal in ``obs/blackbox.py`` (``BLACKBOX_SCHEMA``); a bundle
+#: is a persisted forensic artifact read by ``tools/blackbox.py`` across
+#: versions, so it is drift-pinned exactly like a recording
+BLACKBOX_SOURCE = "serf_tpu/obs/blackbox.py"
+BLACKBOX_DECL = "BLACKBOX_SCHEMA"
+
 
 def _fingerprint(obj) -> str:
     blob = json.dumps(obj, sort_keys=True, separators=(",", ":"))
@@ -166,16 +173,17 @@ def _wire_spec_of(tree: ast.AST, spec: Dict[str, dict]) -> None:
             spec[node.name] = {"fields": fields, "wire": sorted(wire_nums)}
 
 
-def recording_spec(root: Path) -> Dict[str, List[str]]:
-    """Record kinds and their ordered field lists from the
-    ``RECORDING_SCHEMA`` literal (pure AST, like the other specs)."""
-    p = root / RECORDING_SOURCE
+def _dict_literal_spec(root: Path, source: str,
+                       decl: str) -> Dict[str, List[str]]:
+    """Extract a module-level ``NAME = {str: (str, ...)}`` literal as
+    {key: ordered field list} — pure AST, like the other specs."""
+    p = root / source
     if not p.exists():
         return {}
     for node in ast.walk(ast.parse(p.read_text())):
         if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                 and isinstance(node.targets[0], ast.Name) \
-                and node.targets[0].id == RECORDING_DECL \
+                and node.targets[0].id == decl \
                 and isinstance(node.value, ast.Dict):
             out: Dict[str, List[str]] = {}
             for key, val in zip(node.value.keys, node.value.values):
@@ -188,6 +196,18 @@ def recording_spec(root: Path) -> Dict[str, List[str]]:
     return {}
 
 
+def recording_spec(root: Path) -> Dict[str, List[str]]:
+    """Record kinds and their ordered field lists from the
+    ``RECORDING_SCHEMA`` literal."""
+    return _dict_literal_spec(root, RECORDING_SOURCE, RECORDING_DECL)
+
+
+def blackbox_spec(root: Path) -> Dict[str, List[str]]:
+    """Bundle sections and their ordered field lists from the
+    ``BLACKBOX_SCHEMA`` literal (``obs/blackbox.py``)."""
+    return _dict_literal_spec(root, BLACKBOX_SOURCE, BLACKBOX_DECL)
+
+
 def pytree_fingerprint(root: Path = REPO) -> str:
     return _fingerprint(pytree_spec(root))
 
@@ -198,6 +218,10 @@ def wire_fingerprint(root: Path = REPO) -> str:
 
 def recording_fingerprint(root: Path = REPO) -> str:
     return _fingerprint(recording_spec(root))
+
+
+def blackbox_fingerprint(root: Path = REPO) -> str:
+    return _fingerprint(blackbox_spec(root))
 
 
 # ---------------------------------------------------------------------------
@@ -223,7 +247,8 @@ def bump_pins(root: Path = REPO, path: Optional[Path] = None) -> dict:
     pins = json.loads(p.read_text()) if p.exists() else {}
     for kind, fp in (("pytree", pytree_fingerprint(root)),
                      ("wire", wire_fingerprint(root)),
-                     ("recording", recording_fingerprint(root))):
+                     ("recording", recording_fingerprint(root)),
+                     ("blackbox", blackbox_fingerprint(root))):
         pins.setdefault(kind, {"version": 0, "fingerprint": ""})
         if pins[kind]["fingerprint"] != fp:
             pins[kind] = {"version": pins[kind]["version"] + 1,
@@ -249,6 +274,13 @@ def recording_schema_version() -> int:
     """Runtime accessor (stamped into every record/replay recording
     header by ``serf_tpu.replay.recording``)."""
     return int(load_pins()["recording"]["version"])
+
+
+def blackbox_schema_version() -> int:
+    """Runtime accessor (stamped into every black-box bundle's
+    ``meta.version`` by ``serf_tpu.obs.blackbox``; validation fails
+    closed on a mismatch)."""
+    return int(load_pins()["blackbox"]["version"])
 
 
 # ---------------------------------------------------------------------------
@@ -309,6 +341,31 @@ def check_recording_drift(files: List[SourceFile],
     if current != pinned["fingerprint"]:
         yield _drift_finding("recording", "schema-recording-drift",
                              project, current, pinned, RECORDING_SOURCE)
+
+
+@project_rule("schema-blackbox-drift",
+              "the black-box bundle format (BLACKBOX_SCHEMA) changed "
+              "without a pinned-version bump — old bundles would stop "
+              "validating as a surprise",
+              "adding a bundle section, pin untouched")
+def check_blackbox_drift(files: List[SourceFile],
+                         project: Project) -> Iterable[Finding]:
+    if project.pins_path is None or not project.pins_path.exists():
+        return
+    pins = json.loads(project.pins_path.read_text())
+    current = blackbox_fingerprint(project.root)
+    pinned = pins.get("blackbox")
+    if pinned is None:
+        if blackbox_spec(project.root):
+            yield _drift_finding("blackbox", "schema-blackbox-drift",
+                                 project, current,
+                                 {"fingerprint": "<unpinned>",
+                                  "version": 0},
+                                 BLACKBOX_SOURCE)
+        return
+    if current != pinned["fingerprint"]:
+        yield _drift_finding("blackbox", "schema-blackbox-drift",
+                             project, current, pinned, BLACKBOX_SOURCE)
 
 
 @project_rule("schema-wire-drift",

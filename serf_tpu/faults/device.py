@@ -136,7 +136,9 @@ def run_phase(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
               collect_telemetry: bool = False,
               collect_control: bool = False,
               collect_propagation: bool = False,
-              sentinels=None):
+              sentinels=None,
+              collect_invariants: bool = False,
+              inv_cov0=None):
     """Scan ``num_rounds`` chaos rounds with one phase's masks applied.
     Jit with ``num_rounds`` static; group/drop/down are traced, so equal-
     length phases reuse the compiled executable.  ``mesh`` runs every
@@ -173,10 +175,23 @@ def run_phase(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
     (``round_telemetry(with_cols=True)``) and the same
     stay-on-device-until-one-device_get discipline.
 
+    ``collect_invariants`` (static) additionally judges the always-on
+    watchdog's invariant predicates every round (``models/swim
+    .invariant_row``, ``obs/watchdog.INVARIANT_FIELDS`` order): one
+    boolean/bitmask row per round folded from the SAME already-reduced
+    telemetry/propagation operands — zero extra transfers, and the
+    first violating round is named from the scan output instead of
+    inferred post-hoc.  When the propagation tracer rides too, the
+    coverage-monotonicity predicate threads the per-sentinel running
+    coverage maximum through the scan carry, seeded by ``inv_cov0``
+    (``f32[M]``) so chunked callers stay exact across chunk boundaries;
+    the invariant aux entry is then ``(irows f32[R, F], cov_fin
+    f32[M])`` instead of the bare ``irows``.
+
     Aux-output shape: exactly one flag returns its bare stream; several
     return a tuple in declared order (digests, telemetry, control,
-    propagation) — callers that predate a flag unpack exactly what they
-    always did.
+    propagation, invariants) — callers that predate a flag unpack
+    exactly what they always did.
 
     When ``cfg.control.enabled`` the control law ticks INSIDE the scan
     every round (``models/swim.control_tick``), sharing the telemetry
@@ -191,12 +206,17 @@ def run_phase(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
         from serf_tpu.control.device import control_row
     if collect_propagation:
         from serf_tpu.models.swim import propagation_row
+    if collect_invariants:
+        from serf_tpu.models.swim import invariant_row
 
     alive = init_alive & ~down
     st = state._replace(gossip=state.gossip._replace(alive=alive),
                         group=group)
+    track_cov = collect_invariants and collect_propagation
 
     def body(carry, subkey):
+        if track_cov:
+            carry, prev_cov = carry
         if collect_propagation:
             nxt, pair = cluster_round(carry, cfg, subkey, drop_rate=drop,
                                       mesh=mesh, collect_propagation=True)
@@ -206,7 +226,8 @@ def run_phase(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
             nxt = cluster_round(carry, cfg, subkey, drop_rate=drop,
                                 mesh=mesh)
             row = round_telemetry(nxt, cfg, mesh=mesh) \
-                if (collect_telemetry or cfg.control.enabled) else None
+                if (collect_telemetry or collect_invariants
+                    or cfg.control.enabled) else None
         nxt, row = control_tick(nxt, cfg, row, mesh=mesh)
         aux = []
         if collect_digests:
@@ -218,17 +239,39 @@ def run_phase(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
         if collect_control:
             aux.append(control_row(nxt.control))
         if collect_propagation:
-            aux.append(propagation_row(nxt.gossip, pair, colcnt,
-                                       alive_cnt, sentinels))
+            prop_out = propagation_row(nxt.gossip, pair, colcnt,
+                                       alive_cnt, sentinels)
+            aux.append(prop_out)
+        if collect_invariants:
+            irow, new_prev_cov = invariant_row(
+                nxt.gossip, row,
+                sentinels if track_cov else None,
+                colcnt if track_cov else None,
+                prev_cov if track_cov else None)
+            aux.append(irow)
+        ncarry = (nxt, new_prev_cov) if track_cov else nxt
         if not aux:
-            return nxt, ()
-        return nxt, (aux[0] if len(aux) == 1 else tuple(aux))
+            return ncarry, ()
+        return ncarry, (aux[0] if len(aux) == 1 else tuple(aux))
 
     keys = jax.random.split(key, num_rounds)
-    final, out = jax.lax.scan(body, st, keys)
+    carry0 = st
+    if track_cov:
+        if inv_cov0 is None:
+            inv_cov0 = (jnp.zeros(sentinels.shape, jnp.float32),
+                        jnp.float32(-1.0))
+        carry0 = (st, inv_cov0)
+    final, out = jax.lax.scan(body, carry0, keys)
+    if track_cov:
+        final, cov_fin = final
+        # the carried-out coverage maxima ride the invariant aux entry
+        # (always last, and never alone: track_cov implies propagation)
+        out = tuple(out)
+        out = out[:-1] + ((out[-1], cov_fin),)
     return (final, out) if (collect_digests or collect_telemetry
                             or collect_control
-                            or collect_propagation) else final
+                            or collect_propagation
+                            or collect_invariants) else final
 
 
 @functools.lru_cache(maxsize=16)
@@ -273,7 +316,8 @@ def phase_runner(cfg: ClusterConfig, mesh=None):
                    static_argnames=("num_rounds", "collect_digests",
                                     "include_nodes", "collect_telemetry",
                                     "collect_control",
-                                    "collect_propagation"))
+                                    "collect_propagation",
+                                    "collect_invariants"))
 
 
 @dataclass
@@ -317,6 +361,15 @@ class DeviceChaosResult:
     #: coverage curve, fetched by the SAME end-of-run device_get as the
     #: telemetry rows (zero extra transfers)
     propagation: Optional[dict] = None
+    #: the live device watchdog verdict (runs with
+    #: ``collect_invariants``): ``obs/watchdog.summarize_invariants``
+    #: over the in-scan invariant rows — per-field first violating
+    #: round, overall first breach, violation counts, plus the raw
+    #: ``"rows"`` (np[R, F], INVARIANT_FIELDS order).  Judged from scan
+    #: output, NOT post-hoc: ``report`` (above) re-derives run-end
+    #: invariants from the final state; this names WHEN each one first
+    #: broke.  Fetched by the same end-of-run device_get.
+    watchdog: Optional[dict] = None
     #: per-scan-chunk wall stamps ``(base_round, rounds, t0, t1)`` —
     #: the timeline exporter's piecewise round→wall-clock anchors
     #: (obs/timeline.PiecewiseAnchors).  Stamps bracket the DISPATCH of
@@ -333,7 +386,8 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
                     events_per_phase: int = 2,
                     mesh=None, recorder=None,
                     collect_telemetry: bool = False,
-                    collect_propagation: bool = False
+                    collect_propagation: bool = False,
+                    collect_invariants: bool = False
                     ) -> DeviceChaosResult:
     """Run ``plan`` against the flagship device cluster and check the
     invariants.  Injects ``events_per_phase`` fresh user events at the
@@ -460,7 +514,16 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
     tele_chunks: List[tuple] = []
     ctl_chunks: List[tuple] = []
     prop_chunks: List[tuple] = []
+    invar_chunks: List[tuple] = []
     scan_walls: List[tuple] = []
+    #: the coverage-monotonicity carry threaded ACROSS chunked scans (a
+    #: device array — handing it to the next scan is an operand, not a
+    #: transfer), so the watchdog's monotone predicate stays exact at
+    #: chunk boundaries.  Seeded eagerly: a None->array operand switch
+    #: between the first and second chunk would break the
+    #: one-compiled-phase-scan discipline (different treedef).
+    inv_cov = [(jnp.zeros((n_sent,), jnp.float32), jnp.float32(-1.0))
+               if (collect_invariants and collect_propagation) else None]
     #: the previous scan's last control row (host side) — the recorder's
     #: decision extraction is incremental across scans
     ctl_prev = [_ctl_base_row]
@@ -473,7 +536,7 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
         them."""
         want_dig = recorder is not None
         if (not want_dig and not collect_telemetry and not want_ctl
-                and not collect_propagation):
+                and not collect_propagation and not collect_invariants):
             t0 = time.time()
             st = run(st, key=k_run, num_rounds=num_rounds, group=group,
                      drop=drop, init_alive=init_alive, down=down)
@@ -492,12 +555,15 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
                       collect_telemetry=collect_telemetry,
                       collect_control=want_ctl,
                       collect_propagation=collect_propagation,
-                      sentinels=sentinels)
+                      sentinels=sentinels,
+                      collect_invariants=collect_invariants,
+                      inv_cov0=inv_cov[0])
         scan_walls.append((base_round, num_rounds, t0, time.time()))
         parts = list(out) if sum((want_dig, collect_telemetry,
-                                  want_ctl, collect_propagation)) > 1 \
+                                  want_ctl, collect_propagation,
+                                  collect_invariants)) > 1 \
             else [out]
-        dg = dn = rows = crows = prows = None
+        dg = dn = rows = crows = prows = irows = None
         if want_dig:
             dg, dn = parts.pop(0)
         if collect_telemetry:
@@ -506,6 +572,12 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
             crows = parts.pop(0)
         if collect_propagation:
             prows = parts.pop(0)
+        if collect_invariants:
+            ientry = parts.pop(0)
+            if collect_propagation:
+                irows, inv_cov[0] = ientry
+            else:
+                irows = ientry
         if want_dig:
             record_scan_views(recorder, base_round, dg, dn, include_nodes)
         if crows is not None:
@@ -524,6 +596,8 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
             tele_chunks.append((base_round, rows))
         if prows is not None:
             prop_chunks.append((base_round, prows))
+        if irows is not None:
+            invar_chunks.append((base_round, irows))
         return st
 
     total = 0
@@ -614,15 +688,17 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
     telemetry = None
     telemetry_final = None
     propagation = None
-    if tele_chunks or prop_chunks:
+    watchdog = None
+    if tele_chunks or prop_chunks or invar_chunks:
         # THE one telemetry transfer of the run: every scan's stacked
-        # telemetry AND propagation rows come back in a single
-        # device_get (never a per-round, never even a per-phase
-        # transfer — the propagation observatory rides for free), then
-        # land in the ring format keyed by declared metric names
-        host_rows, host_prop = jax.device_get(
+        # telemetry, propagation AND watchdog-invariant rows come back
+        # in a single device_get (never a per-round, never even a
+        # per-phase transfer — the riders come for free), then land in
+        # the ring format keyed by declared metric names
+        host_rows, host_prop, host_inv = jax.device_get(
             ([rows for _, rows in tele_chunks],
-             [p for _, p in prop_chunks]))
+             [p for _, p in prop_chunks],
+             [r for _, r in invar_chunks]))
         if tele_chunks:
             from serf_tpu.models.swim import TELEMETRY_FIELDS
             from serf_tpu.obs.timeseries import telemetry_to_store
@@ -657,6 +733,18 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
             propagation = {"rows": all_rows, "coverage": all_cov,
                            "summary": summary.to_dict(),
                            "base_round": prop_chunks[0][0]}
+        if invar_chunks:
+            import numpy as np
+
+            from serf_tpu.obs import watchdog as wd
+            all_inv = np.concatenate([np.asarray(r) for r in host_inv])
+            # the LIVE verdict: first violating round named from scan
+            # output (the post-hoc `report` above never sees per-round
+            # evidence) — breach lands a watchdog-breach flight event
+            watchdog = wd.summarize_invariants(
+                all_inv, base_round=invar_chunks[0][0])
+            watchdog["rows"] = all_inv
+            wd.emit_device_watchdog(watchdog)
     return DeviceChaosResult(plan=plan, schedule=sched, state=state,
                              report=report, rounds_run=total,
                              notes=sched.notes, injected=injected,
@@ -668,4 +756,5 @@ def run_device_plan(plan: FaultPlan, cfg: ClusterConfig,
                              control_final=control_final,
                              control_decisions=control_decisions,
                              propagation=propagation,
+                             watchdog=watchdog,
                              scan_walls=scan_walls)

@@ -301,6 +301,12 @@ class HostChaosResult:
     #: ``{"coverage", "time_to_all_ms", "reached", "nodes", "seen",
     #: "duplicates", "rebroadcasts", "dup_ratio", "trace"}``
     propagation: Optional[Dict] = None
+    #: live watchdog verdict (``obs.watchdog.Watchdog.state()``): the
+    #: run's continuous verification record — tick count, armed
+    #: invariants/SLO watches, the FIRST breach (named by tick, judged
+    #: as it happened, not reconstructed), and every black-box bundle
+    #: written.  None when the run was launched with ``watchdog=False``.
+    watchdog: Optional[Dict] = None
 
 
 async def measure_propagation(live, deadline_s: float = 5.0) -> Dict:
@@ -408,7 +414,9 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
                         controller: bool = False,
                         control_cfg=None,
                         lifecycle_sample_n: int = 4,
-                        lifecycle_slow_ms: float = 50.0
+                        lifecycle_slow_ms: float = 50.0,
+                        watchdog: bool = True,
+                        watchdog_cfg=None
                         ) -> HostChaosResult:
     """Run ``plan`` against a fresh in-process loopback cluster and check
     the invariants.  ``tmp_dir`` enables per-node snapshots (crash →
@@ -433,6 +441,16 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
     its duration and stashes the snapshot on
     ``HostChaosResult.lifecycle`` — the per-stage latency evidence the
     ``apply-stage-p99`` / ``queue-wait-share`` SLO rows judge.
+
+    ``watchdog`` (default ON — the always-on contract) attaches the
+    continuous verifier (``obs.watchdog.Watchdog``): one watchdog tick
+    per sampler tick evaluates the live invariants (clock monotonicity,
+    shed accounting, bounded buffers, health floor), the shed-ratio SLO
+    burn, and the ``spawn_logged`` failure-hook seam; a breach triggers
+    a black-box dump (``obs.blackbox``) on every node — bundles land
+    under ``tmp_dir/blackbox`` (verdicts-only when ``tmp_dir`` is None:
+    forensics need a disk home) and the verdict rides
+    ``HostChaosResult.watchdog``.
 
     ``controller`` attaches the adaptive control plane
     (``control.host.ControllerTick``, config via ``control_cfg``): one
@@ -522,6 +540,10 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
                               subscriber=sub)
         if ingress_tap is not None:
             s.set_ingress_tap(ingress_tap)
+        if wd is not None:
+            s.watchdog = wd
+            if blackbox_dir is not None:
+                s.blackbox = _box_for(i)
         return s
 
     base_admitted = _counter_total("serf.overload.ingress_admitted")
@@ -533,6 +555,45 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
     # the SLO judge's burn-rate evidence for this run
     from serf_tpu.obs.timeseries import MetricsSampler
     sampler = MetricsSampler(interval_s=traffic_period)
+
+    # continuous verification (obs.watchdog): constructed BEFORE the
+    # nodes so make_node can attach each node's black box as it comes up
+    # (a restart reuses the node's box — bundle sequence numbers must
+    # not collide).  The armed predicates read the LIVE node view per
+    # tick, so crashed/paused nodes never false-breach the health floor.
+    wd = None
+    boxes: Dict[int, object] = {}
+    blackbox_dir = (os.path.join(tmp_dir, "blackbox")
+                    if (watchdog and tmp_dir is not None) else None)
+    if watchdog:
+        from serf_tpu.obs.watchdog import (Watchdog, WatchdogConfig,
+                                           arm_serf_invariants,
+                                           arm_shed_ratio_watch)
+        wd = Watchdog(cfg=watchdog_cfg or WatchdogConfig(),
+                      store=sampler.store)
+        arm_serf_invariants(
+            wd, lambda: {i: nodes[i] for i in nodes if i not in down
+                         and nodes[i].state == SerfState.ALIVE})
+        arm_shed_ratio_watch(wd, sampler.store)
+        wd.install_task_hook()
+
+    def _box_for(i: int):
+        if i in boxes:
+            return boxes[i]
+        from serf_tpu.obs import lifecycle as lc
+        from serf_tpu.obs.blackbox import BlackBox
+        box = BlackBox(
+            blackbox_dir, node=f"n{i}", store=sampler.store,
+            lifecycle=lambda: lc.global_ledger().snapshot(),
+            health=lambda i=i: nodes[i].health_report().to_dict(),
+            slo_verdicts=lambda: [v.to_dict() for v in wd.history[-16:]],
+            recording=lambda: (
+                None if recorder is None else
+                {"plane": "host", "steps": recorder._seq,
+                 "finished": recorder._finished}))
+        boxes[i] = box
+        wd.add_blackbox(box)
+        return box
 
     for i in range(n):
         nodes[i] = await make_node(i)
@@ -600,6 +661,8 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
             sampler.sample()
             if ctl is not None:
                 ctl.tick()
+            if wd is not None:
+                wd.tick()
             live = live_indices()
             if live:
                 src = rng.choice(live)
@@ -730,6 +793,8 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
         sample_clocks()
         sample_buffers()
         sampler.sample()
+        if wd is not None:
+            wd.tick()   # one post-settle verdict rides the result
         # responsive-node false-DEAD count (same definition the
         # no-false-dead invariant judges): SLO-plane evidence on every
         # run, measured before shutdown tears the views down
@@ -785,9 +850,13 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
                                settle_converged=settle_converged,
                                false_dead=false_dead,
                                lifecycle=led.snapshot(),
-                               propagation=propagation)
+                               propagation=propagation,
+                               watchdog=wd.state() if wd is not None
+                               else None)
     finally:
         stop.set()
+        if wd is not None:
+            wd.uninstall_task_hook()
         for t in (bg, lg, *consumers.values()):
             if t is None:
                 continue

@@ -7,7 +7,10 @@ the four keyring ops, with size-aware truncation of key-list responses.
 
 Beyond the reference set, `_serf_stats` (PR 2) answers with this node's
 compact health/stats self-report (``serf_tpu.obs.cluster``) — the
-responder half of ``Serf.cluster_stats()``'s gossip-native aggregation.
+responder half of ``Serf.cluster_stats()``'s gossip-native aggregation —
+and `_serf_blackbox` (PR 17) with the node's black-box bundle inventory
+(``serf_tpu.obs.blackbox``), the responder half of
+``Serf.cluster_blackbox()``.
 """
 
 from __future__ import annotations
@@ -49,6 +52,8 @@ async def handle_internal_query(serf, ev: QueryEvent) -> None:
             await _handle_list_keys(serf, ev)
         elif ev.name == "_serf_stats":
             await _handle_stats(serf, ev)
+        elif ev.name == "_serf_blackbox":
+            await _handle_blackbox(serf, ev)
         else:
             log.warning("unhandled internal query %r", ev.name)
     except Exception:  # noqa: BLE001
@@ -75,6 +80,18 @@ async def _handle_stats(serf, ev: QueryEvent) -> None:
     from serf_tpu.obs.cluster import node_stats_payload
     try:
         await ev.respond(node_stats_payload(serf))
+    except (TimeoutError, ValueError) as e:
+        log.warning("could not respond to %r: %s", ev.name, e)
+
+
+async def _handle_blackbox(serf, ev: QueryEvent) -> None:
+    """Answer with this node's black-box bundle inventory (the scatter
+    half lives in ``serf_tpu.obs.blackbox.collect_cluster_blackbox``).
+    Nodes with no attached box still answer — an explicit empty
+    inventory, so the collector can tell "no bundles" from "no reply"."""
+    from serf_tpu.obs.blackbox import node_blackbox_payload
+    try:
+        await ev.respond(node_blackbox_payload(serf))
     except (TimeoutError, ValueError) as e:
         log.warning("could not respond to %r: %s", ev.name, e)
 

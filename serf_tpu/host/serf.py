@@ -491,6 +491,11 @@ class Serf:
         # OFFERED user_event/query is reported before admission — the
         # recording captures what was asked for, sheds replay as sheds
         self._ingress_tap = None
+        # forensics attachments (obs.watchdog / obs.blackbox): the chaos
+        # executor (or any embedder) attaches a per-node BlackBox and a
+        # shared Watchdog here; `_serf_blackbox` answers from them
+        self.blackbox = None
+        self.watchdog = None
 
         self._tasks: List[asyncio.Task] = []
         self._bg: set = set()
@@ -780,6 +785,14 @@ class Serf:
         clusters)."""
         from serf_tpu.obs.cluster import collect_cluster_stats
         return await collect_cluster_stats(self, params)
+
+    async def cluster_blackbox(self, params: Optional[QueryParam] = None):
+        """Scatter the ``_serf_blackbox`` internal query and fold every
+        node's black-box bundle inventory (``obs.blackbox``) into one
+        ``ClusterBlackbox`` — which nodes hold forensic bundles, their
+        latest dump reason, and where to read them."""
+        from serf_tpu.obs.blackbox import collect_cluster_blackbox
+        return await collect_cluster_blackbox(self, params)
 
     async def _health_monitor(self) -> None:
         """Periodic health plane tick: measure event-loop lag (sleep

@@ -27,6 +27,7 @@ from serf_tpu.models.dissemination import (
     GossipState,
     K_USER_EVENT,
     inject_facts_batch,
+    ltime_window_violation,
     make_state,
     rolled_rows,
     round_step,
@@ -407,7 +408,9 @@ def run_cluster_sustained(state: ClusterState, cfg: ClusterConfig,
                           key: jax.Array, num_rounds: int,
                           events_per_round: int = 2,
                           mesh=None, collect_telemetry: bool = False,
-                          collect_propagation: bool = False):
+                          collect_propagation: bool = False,
+                          collect_invariants: bool = False,
+                          inv_cov0=None):
     """``collect_telemetry`` (static) additionally stacks one
     :func:`round_telemetry` row per round as a scan output and returns
     ``(final_state, rows f32[R, F])`` — the continuous-telemetry plane's
@@ -425,7 +428,20 @@ def run_cluster_sustained(state: ClusterState, cfg: ClusterConfig,
     known-plane unpack serves both rows; ``with_cols`` below).  Appends
     ``(prop_rows f32[R, P], sentinel_cov f32[R, M])`` to the return
     tuple, after the telemetry rows when both are on; same
-    one-device_get discipline."""
+    one-device_get discipline.
+
+    ``collect_invariants`` (static) additionally judges the watchdog's
+    invariant predicates every round (the ISSUE-17 always-on watchdog):
+    one :func:`invariant_row` per round, folded from the SAME
+    already-reduced operands the telemetry row produced — appends
+    ``irows f32[R, F]`` LAST to the return tuple.  When the propagation
+    tracer rides too, the coverage-monotonicity predicate threads the
+    per-sentinel running coverage maximum through the scan carry;
+    ``inv_cov0`` (``f32[M]``, default zeros) seeds it, so a chunked
+    caller (``faults/device.run_device_plan``) can pass the previous
+    chunk's final maximum and keep the predicate exact across chunk
+    boundaries — the final maximum is returned as the LAST element of
+    the invariant entry, i.e. the entry becomes ``(irows, cov_fin)``."""
     if collect_propagation and events_per_round <= 0:
         raise ValueError(
             "collect_propagation traces the first injected batch as "
@@ -437,7 +453,14 @@ def run_cluster_sustained(state: ClusterState, cfg: ClusterConfig,
         sentinels = (state.gossip.round * m
                      + jnp.arange(m, dtype=jnp.int32) + 1)
 
+    # the coverage-monotonicity carry exists only when BOTH the
+    # invariant row and the propagation tracer ride (static flags: the
+    # off-path scan carry — and jaxpr — is untouched)
+    track_cov = collect_invariants and collect_propagation
+
     def body(carry, subkey):
+        if track_cov:
+            carry, prev_cov = carry
         if collect_propagation:
             nxt, pair = sustained_round(carry, cfg, subkey,
                                         events_per_round, mesh=mesh,
@@ -448,18 +471,39 @@ def run_cluster_sustained(state: ClusterState, cfg: ClusterConfig,
             nxt = sustained_round(carry, cfg, subkey, events_per_round,
                                   mesh=mesh)
             row = round_telemetry(nxt, cfg, mesh=mesh) \
-                if (collect_telemetry or cfg.control.enabled) else None
+                if (collect_telemetry or collect_invariants
+                    or cfg.control.enabled) else None
         nxt, row = control_tick(nxt, cfg, row, mesh=mesh)
         out = ()
         if collect_telemetry:
             out = out + (row,)
         if collect_propagation:
-            out = out + (propagation_row(nxt.gossip, pair, colcnt,
-                                         alive_cnt, sentinels),)
+            prop_out = propagation_row(nxt.gossip, pair, colcnt,
+                                       alive_cnt, sentinels)
+            out = out + (prop_out,)
+        if collect_invariants:
+            irow, new_prev_cov = invariant_row(
+                nxt.gossip, row,
+                sentinels if track_cov else None,
+                colcnt if track_cov else None,
+                prev_cov if track_cov else None)
+            out = out + (irow,)
+            if track_cov:
+                return (nxt, new_prev_cov), out
         return nxt, out
 
     keys = jax.random.split(key, num_rounds)
-    final, out = jax.lax.scan(body, state, keys)
+    carry0 = state
+    if track_cov:
+        if inv_cov0 is None:
+            inv_cov0 = (jnp.zeros((events_per_round,), jnp.float32),
+                        jnp.float32(-1.0))
+        carry0 = (state, inv_cov0)
+    final, out = jax.lax.scan(body, carry0, keys)
+    if track_cov:
+        final, cov_fin = final
+        out = tuple(out)
+        out = out[:-1] + ((out[-1], cov_fin),)
     return (final,) + tuple(out) if out else final
 
 
@@ -675,6 +719,66 @@ def propagation_row(g: GossipState, pair, colcnt, alive_cnt,
         jnp.max(cov),
     ])
     return row, cov
+
+
+def invariant_row(g: GossipState, row: jnp.ndarray, sentinels=None,
+                  colcnt=None, prev=None):
+    """Stage-2 of the watchdog's per-round invariant row
+    (``serf_tpu.obs.watchdog.INVARIANT_FIELDS`` order — hardcoded stack
+    below, exactly the :func:`propagation_row` convention): the
+    predicates the post-hoc checker (``faults/invariants.check_device``)
+    judges once per RUN become one boolean row per ROUND, computed
+    inside the jitted scan from operands the telemetry row already
+    reduced — the row itself, the replicated overflow/injection
+    ledgers, the replicated fact-table K-planes, and (when the
+    propagation tracer rides) the same globally-reduced ``colcnt``
+    partials.  Every field folds from already-global values, identical
+    on every chip: no collective of its own, no second known-plane
+    unpack (the INVARIANT_MERGE all-"replicated" contract).
+
+    ``sentinels``/``colcnt``/``prev`` (present only when the
+    propagation tracer is on) drive the coverage-monotonicity
+    predicate.  Gossip learning is monotone — a resident fact's knower
+    set only grows — so per-sentinel alive-knower coverage must never
+    regress while the population holds still.  The fold here is
+    KIND-filtered (user-event facts only: sentinel event ids share the
+    i32 subject namespace with SWIM's node ids, and a suspicion fact
+    about node 1 must not count as coverage of sentinel event 1 — the
+    raw :func:`propagation_row` curve tolerates that collision because
+    its callers cummax host-side; a per-round predicate cannot).  Two
+    legitimate regressions are exempt: a recycled ring slot reads 0,
+    and a round where the alive count moved (deaths remove knowers,
+    restarts add non-knowers) resets the baseline instead of judging.
+    ``prev`` is the carried ``(running-max coverage f32[M], previous
+    alive count f32)``; returns ``(irow f32[F], new_prev)`` —
+    ``new_prev`` is ``None`` untraced, where the field is fixed 1.0."""
+    overflow_ok = (g.overflow >= 0) & (g.overflow <= g.injected)
+    ltime_ok = ~ltime_window_violation(g.facts)
+    no_false_dead = row[TELEMETRY_FIELDS.index("false_dead")] <= 0.0
+    if sentinels is not None:
+        prev_cov, prev_alive = prev
+        match = (g.facts.subject[None, :] == sentinels[:, None]) \
+            & g.facts.valid[None, :] \
+            & (g.facts.kind[None, :] == K_USER_EVENT)
+        cov_cnt = jnp.sum(jnp.where(match, colcnt[None, :], 0), axis=1)
+        alive_f = row[TELEMETRY_FIELDS.index("alive")]
+        cov = jnp.minimum(
+            cov_cnt.astype(jnp.float32) / jnp.maximum(alive_f, 1.0), 1.0)
+        alive_moved = alive_f != prev_alive
+        regress = (cov < prev_cov - 1e-6) & (cov > 0.0) & ~alive_moved
+        coverage_monotone = ~jnp.any(regress)
+        new_prev = (jnp.where(alive_moved, cov,
+                              jnp.maximum(prev_cov, cov)), alive_f)
+    else:
+        coverage_monotone = jnp.asarray(True)
+        new_prev = None
+    flags = jnp.stack([overflow_ok, ltime_ok, no_false_dead,
+                       coverage_monotone])
+    bits = jnp.asarray([1, 2, 4, 8], jnp.int32)
+    viol_mask = jnp.sum(jnp.where(flags, 0, bits))
+    irow = jnp.concatenate([flags.astype(jnp.float32),
+                            viol_mask.astype(jnp.float32)[None]])
+    return irow, new_prev
 
 
 def emit_cluster_metrics(state: ClusterState, cfg: ClusterConfig,

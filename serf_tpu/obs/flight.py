@@ -34,6 +34,9 @@ Event kinds emitted by the engine (see README "Observability"):
 - ``slow-message``     a lifecycle-sampled message exceeded the slow
   threshold — the event carries the full per-stage breakdown
   (obs/lifecycle)
+- ``watchdog-breach``  the always-on watchdog tripped an invariant or a
+  sustained SLO burn (obs/watchdog) — names the first violating device
+  round / host tick and, on the host plane, the black-box bundle dumped
 
 Events recorded while a cross-node trace is active (``obs.trace
 .trace_scope``) carry a ``trace`` field — the hex trace id shared by

@@ -48,7 +48,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence
 #: the surfaces a full bundle carries (each is an event ``cat``); the
 #: all-surface tier-1 test holds an exported chaos bundle to this tuple
 SURFACES = ("span", "flight", "lifecycle", "device", "control", "slo",
-            "propagation")
+            "propagation", "watchdog")
 
 #: fixed per-process thread lanes (lifecycle stages get 10 + stage idx;
 #: overlapping-span overflow lanes get 100 + lane idx)
@@ -57,6 +57,7 @@ TID_FLIGHT = 2
 TID_CONTROL = 3
 TID_SLO = 4
 TID_PROPAGATION = 5
+TID_WATCHDOG = 6
 TID_STAGE_BASE = 10
 TID_SPAN_EXTRA = 100
 
@@ -68,7 +69,8 @@ PID_DEVICE = 1000
 #: flight kinds that belong to dedicated lanes rather than the flight one
 _FLIGHT_ROUTES = {"control-decision": ("control", TID_CONTROL),
                   "slo-breach": ("slo", TID_SLO),
-                  "propagation-trace": ("propagation", TID_PROPAGATION)}
+                  "propagation-trace": ("propagation", TID_PROPAGATION),
+                  "watchdog-breach": ("watchdog", TID_WATCHDOG)}
 
 #: minimum exported span duration (µs): matched B/E pairs must be
 #: strictly orderable even for sub-µs spans
@@ -350,6 +352,43 @@ class TimelineBuilder:
                        at_wall, pid_key, TID_SLO,
                        args={k: _jsonable(x) for k, x in v.items()})
 
+    def add_watchdog(self, state: Dict[str, Any], at_wall: float) -> None:
+        """A host watchdog run record (``obs.watchdog.Watchdog.state()``)
+        on the dedicated watchdog lane: every retained verdict as an
+        instant at ITS OWN wall time (breaches read as the odd ones out,
+        like the SLO lane), plus one summary counter sample at
+        ``at_wall`` so the lane exists even for a zero-tick run."""
+        for v in state.get("history") or ():
+            breaches = v.get("breaches") or []
+            name = ("tick:ok" if not breaches
+                    else "BREACH:" + ",".join(breaches))
+            self._push("i", "watchdog", name,
+                       float(v.get("wall_time", at_wall)), None,
+                       TID_WATCHDOG,
+                       args={k: _jsonable(x) for k, x in v.items()})
+        self._push("C", "watchdog", "watchdog", at_wall, None,
+                   TID_WATCHDOG,
+                   args={"ticks": state.get("ticks", 0),
+                         "breaches": state.get("breaches", 0),
+                         "bundles": len(state.get("bundles") or ())})
+
+    def add_device_invariants(self, rows: Sequence[Sequence[float]],
+                              anchors: DeviceRunAnchors,
+                              base_round: Optional[int] = None) -> None:
+        """Per-round device invariant rows (the in-scan watchdog output,
+        ``f32[R, F]``) as a counter track on the device process's
+        watchdog lane, rounds mapped like the telemetry track."""
+        from serf_tpu.obs.watchdog import INVARIANT_FIELDS
+        self._device_used = True
+        base = anchors.base_round if base_round is None else base_round
+        for i, row in enumerate(rows):
+            args = {f: float(v)
+                    for f, v in zip(INVARIANT_FIELDS, row)}
+            args["round"] = base + i + 1
+            self._push("C", "watchdog", "invariants",
+                       anchors.round_wall(base + i + 1), PID_DEVICE,
+                       TID_WATCHDOG, args=args)
+
     # -- assembly ------------------------------------------------------------
 
     def build(self) -> Dict[str, Any]:
@@ -395,6 +434,8 @@ class TimelineBuilder:
                     tname = "slo"
                 elif tid == TID_PROPAGATION:
                     tname = "propagation"
+                elif tid == TID_WATCHDOG:
+                    tname = "watchdog"
                 elif tid == TID_STAGE_BASE - 1:
                     tname = "lifecycle.e2e"
                 elif tid >= TID_SPAN_EXTRA:
@@ -549,6 +590,9 @@ def export_run_timeline(path: str, *,
         if host_verdicts:
             b.add_slo_verdicts(verdicts_to_dict(host_verdicts), now,
                                plane="host")
+        wd_state = getattr(host_result, "watchdog", None)
+        if wd_state:
+            b.add_watchdog(wd_state, now)
     if device_result is not None and device_anchors is not None:
         store = getattr(device_result, "telemetry", None)
         if store is not None:
@@ -563,4 +607,7 @@ def export_run_timeline(path: str, *,
         if device_verdicts:
             b.add_slo_verdicts(verdicts_to_dict(device_verdicts),
                                device_anchors.wall_end, plane="device")
+        dev_wd = getattr(device_result, "watchdog", None)
+        if dev_wd and dev_wd.get("rows") is not None:
+            b.add_device_invariants(dev_wd["rows"], device_anchors)
     return write_timeline(b.build(), path)

@@ -16,11 +16,31 @@ cancellation.
 from __future__ import annotations
 
 import asyncio
-from typing import Optional, Set
+from typing import Callable, List, Optional, Set
 
 from serf_tpu.utils.logging import get_logger
 
 log = get_logger("tasks")
+
+#: process-fatal-exception observers (``fn(task_name, exc)``): the
+#: watchdog (``obs/watchdog.Watchdog.install_task_hook``) registers here
+#: so a background task dying with a real exception counts as a breach
+#: and triggers the black-box dump — the "crash forensics" half of the
+#: spawn contract.  Hooks must never raise; a raising hook is logged and
+#: dropped for the event (never unregistered behind the owner's back).
+_failure_hooks: List[Callable[[str, BaseException], None]] = []
+
+
+def add_failure_hook(fn: Callable[[str, BaseException], None]) -> None:
+    if fn not in _failure_hooks:
+        _failure_hooks.append(fn)
+
+
+def remove_failure_hook(fn: Callable[[str, BaseException], None]) -> None:
+    try:
+        _failure_hooks.remove(fn)
+    except ValueError:
+        pass
 
 
 def log_task_exception(task: "asyncio.Task") -> None:
@@ -33,6 +53,11 @@ def log_task_exception(task: "asyncio.Task") -> None:
     exc = task.exception()
     if exc is not None:
         log.error("background task %r died: %r", task.get_name(), exc)
+        for fn in list(_failure_hooks):
+            try:
+                fn(task.get_name(), exc)
+            except Exception:  # noqa: BLE001 — the sink must not raise
+                log.exception("task failure hook %r raised", fn)
 
 
 def spawn_logged(coro, name: str,

@@ -1,0 +1,26 @@
+"""serflint fixture: invariant-row declarations that MUST fire
+``invariant-field-drift``.
+
+Linted pure-AST as a toy project's ``serf_tpu/obs/watchdog.py``
+(the ``bad_propagation.py`` shape, over the always-on watchdog's
+in-scan invariant row contract):
+
+- ``orphan_ok`` is an INVARIANT_FIELDS entry with no INVARIANT_MERGE
+  entry (``unreduced:orphan_ok``);
+- INVARIANT_MERGE reduces ``ghost_ok`` which is not a row field
+  (``undeclared:ghost_ok``);
+- ``overflow_ok`` declares merge op ``"sum"``, which the invariant row
+  does not implement (``bad-op:overflow_ok`` — invariant flags are
+  judged from replicated operands only; summing booleans across shards
+  would change the predicate's meaning);
+- the toy README documents ``stale_ok`` which the row does not carry
+  (``stale-row:stale_ok``) and has no row for ``orphan_ok``
+  (``undocumented:orphan_ok``).
+"""
+
+INVARIANT_FIELDS = ("overflow_ok", "orphan_ok")
+
+INVARIANT_MERGE = {
+    "overflow_ok": "sum",
+    "ghost_ok": "replicated",
+}

@@ -1,0 +1,13 @@
+"""serflint fixture: the clean twin of bad_invariant.py — every
+invariant row field has a merge entry with a legal op (``replicated``
+is the only one: invariant flags are judged from replicated operands),
+every merge entry is a row field, and the toy README invariant table
+carries exactly these rows — must produce zero
+``invariant-field-drift`` findings."""
+
+INVARIANT_FIELDS = ("overflow_ok", "viol_mask")
+
+INVARIANT_MERGE = {
+    "overflow_ok": "replicated",
+    "viol_mask": "replicated",
+}

@@ -400,6 +400,51 @@ def test_propagation_clean_twin_is_silent(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# invariant-field-drift (the watchdog invariant-row contract, ISSUE 17)
+# ---------------------------------------------------------------------------
+
+_README_INVARIANT = """\
+## Continuous verification & black box
+
+| Field | Merge | Notes |
+|---|---|---|
+| `overflow_ok` | replicated | fine |
+| `stale_ok` | replicated | row removed from the code |
+"""
+
+
+def test_invariant_bad_fixture_fires_every_direction(tmp_path):
+    project = toy_project(
+        tmp_path,
+        {"serf_tpu/obs/watchdog.py":
+         (FIXTURES / "bad_invariant.py").read_text()},
+        readme=_README_INVARIANT)
+    report = analysis.run_rules(project,
+                                rules=["invariant-field-drift"])
+    keys = {f.key for f in report.findings}
+    assert "unreduced:orphan_ok" in keys      # row field, no merge leg
+    assert "undeclared:ghost_ok" in keys      # merge leg, no row field
+    assert "bad-op:overflow_ok" in keys       # op no leg implements
+    assert "undocumented:orphan_ok" in keys   # row field, no README row
+    assert "stale-row:stale_ok" in keys       # README row, no field
+
+
+def test_invariant_clean_twin_is_silent(tmp_path):
+    readme = ("## Continuous verification & black box\n\n"
+              "| Field | Merge | Notes |\n|---|---|---|\n"
+              "| `overflow_ok` | replicated | — |\n"
+              "| `viol_mask` | replicated | — |\n")
+    project = toy_project(
+        tmp_path,
+        {"serf_tpu/obs/watchdog.py":
+         (FIXTURES / "ok_invariant.py").read_text()},
+        readme=readme)
+    report = analysis.run_rules(project,
+                                rules=["invariant-field-drift"])
+    assert report.findings == []
+
+
+# ---------------------------------------------------------------------------
 # schema family: drift without a bump fails lint; bump clears it
 # ---------------------------------------------------------------------------
 
@@ -442,18 +487,27 @@ RECORDING_SCHEMA = {
 '''
 
 
+_TOY_BLACKBOX = '''\
+BLACKBOX_SCHEMA = {
+    "meta": ("schema", "version", "node"),
+    "flight": ("events",),
+}
+'''
+
+
 def _schema_project(tmp_path):
     project = toy_project(tmp_path, {
         "serf_tpu/models/dissemination.py": _TOY_PYTREE,
         "serf_tpu/types/messages.py": _TOY_WIRE,
         "serf_tpu/replay/recording.py": _TOY_RECORDING,
+        "serf_tpu/obs/blackbox.py": _TOY_BLACKBOX,
     }, pins=True)
     schema_mod.bump_pins(root=tmp_path, path=project.pins_path)
     return project
 
 
 SCHEMA_RULES = ["schema-pytree-drift", "schema-wire-drift",
-                "schema-recording-drift"]
+                "schema-recording-drift", "schema-blackbox-drift"]
 
 
 def test_schema_pinned_is_silent(tmp_path):
@@ -500,6 +554,18 @@ def test_recording_field_change_without_bump_fails(tmp_path):
     assert report.findings == []
 
 
+def test_blackbox_field_change_without_bump_fails(tmp_path):
+    project = _schema_project(tmp_path)
+    p = tmp_path / "serf_tpu/obs/blackbox.py"
+    p.write_text(p.read_text().replace('("events",)',
+                                       '("events", "dropped")'))
+    report = analysis.run_rules(project, rules=SCHEMA_RULES)
+    assert rules_fired(report) == {"schema-blackbox-drift"}
+    schema_mod.bump_pins(root=tmp_path, path=project.pins_path)
+    report = analysis.run_rules(project, rules=SCHEMA_RULES)
+    assert report.findings == []
+
+
 def test_repo_pins_match_current_sources():
     """The committed pins match the committed schemas — a PR that edits
     GossipState or a wire message without --bump-schema fails HERE
@@ -509,6 +575,8 @@ def test_repo_pins_match_current_sources():
     assert pins["wire"]["fingerprint"] == schema_mod.wire_fingerprint()
     assert pins["recording"]["fingerprint"] \
         == schema_mod.recording_fingerprint()
+    assert pins["blackbox"]["fingerprint"] \
+        == schema_mod.blackbox_fingerprint()
     # the specs cover the real surface
     spec = schema_mod.pytree_spec(REPO)
     assert set(spec) == {"FactTable", "GossipState", "VivaldiState",
@@ -706,9 +774,9 @@ def test_rule_registry_is_exactly_the_shipped_set():
         "reg-flight-unknown", "reg-flight-unused",
         "slo-metric-unknown", "slo-decl-drift", "slo-doc-drift",
         "control-knob-drift", "telemetry-field-drift",
-        "propagation-field-drift",
+        "propagation-field-drift", "invariant-field-drift",
         "schema-pytree-drift", "schema-wire-drift",
-        "schema-recording-drift",
+        "schema-recording-drift", "schema-blackbox-drift",
         "docs-rule-table",
         "suppress-no-reason", "suppress-unused",
         "baseline-stale", "baseline-no-reason",

@@ -3,7 +3,7 @@ acceptance-pinned): exporter output validates against the trace-event
 schema (sorted ts, matched B/E pairs, stable pid/tid mapping), survives
 a JSON round-trip, and a loopback query-storm run's exported bundle
 carries every surface — spans, flight, lifecycle, device rounds,
-control, SLO, propagation — on one correlated timebase."""
+control, SLO, propagation, watchdog — on one correlated timebase."""
 
 import asyncio
 import json
@@ -60,6 +60,15 @@ def _synthetic_builder():
     b.add_control_decisions(
         [{"round": 1, "knobs": {"fanout": 4}, "shed": 0}], anchors)
     b.add_slo_verdicts([{"slo": "false-dead", "ok": True}], T0 + 0.7)
+    # the always-on watchdog lane (ISSUE 17): one ok tick + one breach
+    b.add_watchdog(
+        {"ticks": 2, "breaches": 1, "bundles": ["bb-0.json"],
+         "history": [{"tick": 1, "ok": True, "wall_time": T0 + 0.75,
+                      "breaches": []},
+                     {"tick": 2, "ok": False, "wall_time": T0 + 0.8,
+                      "breaches": ["shed-ratio"]}]},
+        T0 + 0.85)
+    b.add_device_invariants([[1, 1, 1, 1, 0], [1, 0, 1, 1, 2]], anchors)
     return b
 
 

@@ -1,0 +1,558 @@
+"""Always-on watchdog + black box (ISSUE 17 tentpole, acceptance-
+pinned): the per-round device invariant row obeys the house invariant —
+OFF (default) the sustained scan is jaxpr-identical to the plain path
+(the row is Python-gated out of existence, pinned by a poisoned
+``invariant_row``), ON it changes no ``GossipState`` leaf and adds ZERO
+per-run host transfers (device_get-count pinned) — and the verdict
+names the **first violating round straight from scan output**, no
+post-hoc judging.  The host ``Watchdog`` breaches LIVE (first breaching
+tick named mid-run), triggers bounded black-box dumps on every node
+(rotated, schema-valid, renderable), and the ``_serf_blackbox``
+internal query folds the cluster's bundle inventory like
+``_serf_stats``.
+
+Budget discipline: one tiny config (n=64, K=32), 10-round scans,
+module-scoped run pair; the stamp-flavor × mesh cross is ``@slow``.
+"""
+
+import importlib.util
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from serf_tpu.control.device import ControlConfig
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    K_USER_EVENT,
+    inject_fact,
+)
+from serf_tpu.models.failure import FailureConfig
+from serf_tpu.models.swim import (
+    ClusterConfig,
+    make_cluster,
+    run_cluster_sustained,
+)
+from serf_tpu.obs import flight
+from serf_tpu.obs.blackbox import (
+    BlackBox,
+    BlackboxPartial,
+    load_bundle,
+    validate_bundle,
+)
+from serf_tpu.obs.timeseries import SeriesStore
+from serf_tpu.obs.watchdog import (
+    INVARIANT_FIELDS,
+    INVARIANT_MERGE,
+    Watchdog,
+    WatchdogConfig,
+    arm_shed_ratio_watch,
+    emit_device_watchdog,
+    format_invariants,
+    summarize_invariants,
+)
+from serf_tpu.parallel.mesh import shard_state
+
+REPO = Path(__file__).resolve().parent.parent
+N, K, ROUNDS = 64, 32, 10
+IDX = {f: i for i, f in enumerate(INVARIANT_FIELDS)}
+FLAGS = INVARIANT_FIELDS[:-1]                      # all but viol_mask
+
+
+def _cfg(pack=True, schedule="ring"):
+    return ClusterConfig(
+        gossip=GossipConfig(n=N, k_facts=K, peer_sampling="rotation",
+                            pack_stamp=pack),
+        failure=FailureConfig(suspicion_rounds=8, max_new_facts=8,
+                              probe_schedule="round_robin"),
+        control=ControlConfig(enabled=False),
+        push_pull_every=8, probe_every=2, exchange_schedule=schedule)
+
+
+def _seeded(cfg):
+    st = make_cluster(cfg, jax.random.key(0))
+    g = inject_fact(st.gossip, cfg.gossip, subject=3, kind=K_USER_EVENT,
+                    incarnation=0, ltime=5, origin=0)
+    return st._replace(gossip=g)
+
+
+def _run(cfg, judged, mesh=None):
+    run = jax.jit(lambda s, k: run_cluster_sustained(
+        s, cfg, k, ROUNDS, 2, mesh=mesh, collect_invariants=judged))
+    st = _seeded(cfg)
+    if mesh is not None:
+        st = shard_state(st, mesh)
+    out = run(st, jax.random.key(3))
+    if judged:
+        final, irows = out
+        return final, jax.device_get(irows)
+    return out, None
+
+
+def _assert_leaves_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert (np.asarray(jax.device_get(x))
+                == np.asarray(jax.device_get(y))).all()
+
+
+@pytest.fixture(scope="module")
+def inv_pair():
+    """One off/on run pair, shared by the device-plane pins."""
+    cfg = _cfg()
+    f_off, _ = _run(cfg, judged=False)
+    f_on, irows = _run(cfg, judged=True)
+    return cfg, f_off, f_on, irows
+
+
+# ---------------------------------------------------------------------------
+# house invariant: judge off = plain path (jaxpr + Python gate),
+# judge on = same state, zero extra transfers
+# ---------------------------------------------------------------------------
+
+
+def test_off_path_is_python_gated(monkeypatch):
+    """THE off-is-free pin, both ways: with the flag off the jaxpr is
+    byte-identical to the plain call AND ``invariant_row`` is never even
+    called (poisoned here) — with it on, the poison trips at trace
+    time.  The row cannot cost the untraced path anything."""
+    from serf_tpu.models import swim as swim_mod
+
+    cfg = _cfg()
+    st = _seeded(cfg)
+    plain = str(jax.make_jaxpr(lambda s, k: run_cluster_sustained(
+        s, cfg, k, ROUNDS, 2))(st, jax.random.key(3)))
+    off = str(jax.make_jaxpr(lambda s, k: run_cluster_sustained(
+        s, cfg, k, ROUNDS, 2, collect_invariants=False))(
+            st, jax.random.key(3)))
+    assert off == plain
+
+    def _poison(*a, **k):
+        raise AssertionError("invariant_row reached with the flag off")
+    monkeypatch.setattr(swim_mod, "invariant_row", _poison)
+    jax.make_jaxpr(lambda s, k: run_cluster_sustained(
+        s, cfg, k, ROUNDS, 2))(st, jax.random.key(3))     # fine
+    with pytest.raises(AssertionError, match="flag off"):
+        jax.make_jaxpr(lambda s, k: run_cluster_sustained(
+            s, cfg, k, ROUNDS, 2, collect_invariants=True))(
+                st, jax.random.key(3))
+
+
+def test_judge_on_is_state_bit_exact(inv_pair):
+    """Judging on changes no GossipState leaf: the invariant rows are
+    extra scan OUTPUTS, never a state perturbation — and a fault-free
+    run judges green every round (viol_mask all-zero)."""
+    _, f_off, f_on, irows = inv_pair
+    _assert_leaves_equal(f_off, f_on)
+    assert irows.shape == (ROUNDS, len(INVARIANT_FIELDS))
+    assert (irows[:, : len(FLAGS)] == 1.0).all()
+    assert (irows[:, IDX["viol_mask"]] == 0.0).all()
+
+
+@pytest.mark.parametrize("pack", [False])
+def test_judge_on_is_state_bit_exact_unpacked(pack):
+    """Same pin for the other stamp flavor (packed rode the module
+    fixture)."""
+    cfg = _cfg(pack=pack)
+    f_off, _ = _run(cfg, judged=False)
+    f_on, irows = _run(cfg, judged=True)
+    _assert_leaves_equal(f_off, f_on)
+    assert irows.shape == (ROUNDS, len(INVARIANT_FIELDS))
+
+
+def test_judge_on_bit_exact_vmesh8(inv_pair, vmesh8):
+    """Sharded flagship: state bit-exact AND the sharded rows equal the
+    unsharded ones bit-for-bit — every predicate folds from replicated
+    operands (the all-``replicated`` INVARIANT_MERGE contract), so the
+    mesh cannot change a single bit."""
+    cfg, _, _, ref_rows = inv_pair
+    f_off, _ = _run(cfg, judged=False, mesh=vmesh8)
+    f_on, irows = _run(cfg, judged=True, mesh=vmesh8)
+    _assert_leaves_equal(f_off, f_on)
+    assert (irows == ref_rows).all()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("pack", [True, False])
+@pytest.mark.parametrize("schedule", ["ring", "allgather"])
+def test_judge_bit_exact_heavy_cross(vmesh8, pack, schedule):
+    """Redundant heavy parametrization: both stamp flavors × both ICI
+    schedules on the virtual mesh (each axis already covered above)."""
+    cfg = _cfg(pack=pack, schedule=schedule)
+    f_off, _ = _run(cfg, judged=False, mesh=vmesh8)
+    f_on, rows = _run(cfg, judged=True, mesh=vmesh8)
+    _assert_leaves_equal(f_off, f_on)
+    _, ref = _run(cfg, judged=True)
+    assert (rows == ref).all()
+
+
+def _count_device_gets(monkeypatch, **kwargs):
+    from serf_tpu.faults.device import run_device_plan
+    from serf_tpu.faults.plan import named_plan
+
+    real = jax.device_get
+    calls = []
+    monkeypatch.setattr(jax, "device_get",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    result = run_device_plan(named_plan("partition-heal-loss"), _cfg(),
+                             **kwargs)
+    monkeypatch.setattr(jax, "device_get", real)
+    return len(calls), result
+
+
+def test_judging_adds_zero_transfers(monkeypatch):
+    """THE acceptance pin: a chaos run judging every round performs
+    exactly as many jax.device_get calls as the telemetry-only run —
+    the invariant rows ride the existing end-of-run transfer.  The
+    legal-fault run judges green on every predicate, live."""
+    n_tele, _ = _count_device_gets(monkeypatch, collect_telemetry=True)
+    n_both, r = _count_device_gets(monkeypatch, collect_telemetry=True,
+                                   collect_invariants=True)
+    assert n_both == n_tele, (
+        f"judged run did {n_both} device_gets vs {n_tele} without")
+    assert r.watchdog is not None and r.watchdog["ok"]
+    assert r.watchdog["first_violation"] is None
+    assert set(r.watchdog["fields"]) == set(FLAGS)
+    assert np.asarray(r.watchdog["rows"]).shape[1] == len(INVARIANT_FIELDS)
+
+
+# ---------------------------------------------------------------------------
+# first-violation naming: straight from scan rows, no post-hoc judging
+# ---------------------------------------------------------------------------
+
+
+def _rows_with(violations):
+    """Green rows with {round_index: [field, ...]} violations stamped
+    in (exactly the scan's stacked-output shape)."""
+    rows = np.ones((8, len(INVARIANT_FIELDS)), np.float32)
+    rows[:, IDX["viol_mask"]] = 0.0
+    for i, fields in violations.items():
+        for f in fields:
+            rows[i, IDX[f]] = 0.0
+            rows[i, IDX["viol_mask"]] += float(1 << IDX[f])
+    return rows
+
+
+def test_summary_names_first_violating_round():
+    rows = _rows_with({3: ["no_false_dead"], 5: ["ltime_ok"],
+                       6: ["no_false_dead"]})
+    s = summarize_invariants(rows)
+    assert not s["ok"] and s["rounds"] == 8
+    assert s["first_violation"] == {"round": 4,
+                                    "fields": ["no_false_dead"]}
+    assert s["per_field"]["no_false_dead"] == {
+        "first_violation_round": 4, "violations": 2}
+    assert s["per_field"]["ltime_ok"] == {
+        "first_violation_round": 6, "violations": 1}
+    assert s["per_field"]["overflow_ok"]["first_violation_round"] is None
+    assert s["violations"] == 3
+    # absolute rounds: row i of a chunk starting at base describes the
+    # state AFTER round base+i+1 (the telemetry stamp convention)
+    assert summarize_invariants(rows, base_round=10)[
+        "first_violation"]["round"] == 14
+    # ties: two fields first violated on the same round are both named
+    tie = summarize_invariants(
+        _rows_with({2: ["overflow_ok", "coverage_monotone"]}))
+    assert tie["first_violation"]["round"] == 3
+    assert set(tie["first_violation"]["fields"]) == {
+        "overflow_ok", "coverage_monotone"}
+    green = summarize_invariants(_rows_with({}))
+    assert green["ok"] and green["first_violation"] is None
+
+
+def test_device_breach_lands_flight_event_and_report():
+    """A breaching summary emits the ``watchdog-breach`` flight event
+    naming the first violating round, and formats as one FAIL block."""
+    rec = flight.global_recorder()
+    since = rec.last_seq
+    s = summarize_invariants(_rows_with({4: ["overflow_ok"]}),
+                             base_round=20)
+    emit_device_watchdog(s)
+    ev = [e for e in rec.dump(kind="watchdog-breach", since_seq=since)]
+    assert len(ev) == 1
+    assert ev[0]["plane"] == "device" and ev[0]["round"] == 25
+    assert ev[0]["invariants"] == ["overflow_ok"]
+    text = format_invariants(s)
+    assert "BREACHED" in text and "first violated at round 25" in text
+    assert "FAIL" in text and "ltime_ok" in text
+
+
+def test_merge_contract_is_replicated_everywhere():
+    """The serflint ``invariant-field-drift`` contract, asserted at the
+    source: every row field reduces, and only via ``replicated``."""
+    assert set(INVARIANT_MERGE) == set(INVARIANT_FIELDS)
+    assert set(INVARIANT_MERGE.values()) == {"replicated"}
+
+
+# ---------------------------------------------------------------------------
+# host plane: the continuous watchdog
+# ---------------------------------------------------------------------------
+
+
+def _flag_box(tmp_path, node="u0", **wd_kw):
+    rec = flight.FlightRecorder()
+    wd = Watchdog(cfg=WatchdogConfig(**wd_kw), recorder=rec)
+    box = BlackBox(str(tmp_path), node=node, recorder=rec)
+    wd.add_blackbox(box)
+    tripped = {"on": False}
+    wd.arm("trip", lambda: (not tripped["on"], "tripped flag"))
+    return wd, box, rec, tripped
+
+
+def test_live_breach_names_first_tick_and_dumps(tmp_path):
+    """THE host acceptance pin (unit flavor): the verdict is produced
+    AT the breaching tick — ``first_breach`` names it live, the flight
+    event and the bundle exist before the run is over."""
+    since = flight.global_recorder().last_seq
+    wd, box, rec, tripped = _flag_box(tmp_path, dump_every_ticks=1)
+    assert wd.tick().ok and wd.tick().ok
+    tripped["on"] = True
+    v = wd.tick()
+    assert not v.ok and v.tick == 3 and v.breaches == ["trip"]
+    assert wd.first_breach is v and wd.breaches == 1
+    ev = flight.global_recorder().dump(kind="watchdog-breach",
+                                       since_seq=since)
+    assert ev and ev[-1]["tick"] == 3 and ev[-1]["plane"] == "host"
+    paths = box.bundle_paths()
+    assert len(paths) == 1
+    b = load_bundle(paths[0])
+    assert validate_bundle(b) == []
+    assert b["meta"]["reason"] == "breach"
+    assert b["watchdog"]["state"]["first_breach"]["tick"] == 3
+    # verdict history (the timeline lane's feed) carries the live tick
+    st = wd.state()
+    assert st["ok"] is False
+    assert [h["tick"] for h in st["history"] if not h["ok"]] == [3]
+
+
+def test_dump_debounce_and_disjoint_flight_tails(tmp_path):
+    """Dumps are debounced to one per ``dump_every_ticks``; consecutive
+    dumps carry DISJOINT flight tails (the watchdog-owned cursor)."""
+    wd, box, rec, tripped = _flag_box(tmp_path, dump_every_ticks=3)
+    tripped["on"] = True
+    rec.record("queue-overflow", queue="a")
+    wd.tick()                                 # breach -> dump 1
+    wd.tick()
+    wd.tick()                                 # debounced
+    assert len(box.bundle_paths()) == 1
+    rec.record("queue-overflow", queue="b")
+    wd.tick()                                 # 3 ticks later -> dump 2
+    paths = box.bundle_paths()
+    assert len(paths) == 2
+    first, second = (load_bundle(p)["flight"] for p in paths)
+    seqs_a = {e["seq"] for e in first["events"]}
+    seqs_b = {e["seq"] for e in second["events"]}
+    assert seqs_a and seqs_b and not (seqs_a & seqs_b)
+    assert any(e["queue"] == "b" for e in second["events"])
+
+
+def test_rotation_is_bounded(tmp_path):
+    """max_bundles evicts oldest-first; the retained set never grows."""
+    rec = flight.FlightRecorder()
+    box = BlackBox(str(tmp_path), node="rot", max_bundles=2,
+                   recorder=rec)
+    for i in range(5):
+        box.dump(reason=f"r{i}")
+    paths = box.bundle_paths()
+    assert len(paths) == 2 and box.rotated == 3
+    assert [load_bundle(p)["meta"]["seq"] for p in paths] == [4, 5]
+
+
+def test_broken_predicate_is_a_breach(tmp_path):
+    """A predicate that raises is itself a breach (a broken verifier
+    must never read as green)."""
+    wd, _, _, _ = _flag_box(tmp_path)
+
+    def boom():
+        raise RuntimeError("sensor gone")
+    wd.arm("sensor", boom)
+    v = wd.tick()
+    assert not v.ok and "sensor" in v.breaches
+    assert "predicate raised" in v.detail
+
+
+def test_shed_ratio_burn_breaches_only_when_sustained():
+    """The shed-ratio SLO watch breaches on BOTH burn windows only —
+    a healthy run stays green, a sustained >objective shed ratio names
+    the first breaching tick."""
+    store = SeriesStore()
+    rec = flight.FlightRecorder()
+    wd = Watchdog(store=store, recorder=rec)
+    arm_shed_ratio_watch(wd, store)
+    t = 0.0
+    for _ in range(10):                       # healthy: 20% shed
+        store.append("serf.overload.ingress_shed", t, 2, kind="delta")
+        store.append("serf.overload.ingress_admitted", t, 8,
+                     kind="delta")
+        t += 1.0
+        assert wd.tick().ok
+    for _ in range(40):                       # storm: ~99.8% shed
+        store.append("serf.overload.ingress_shed", t, 500, kind="delta")
+        store.append("serf.overload.ingress_admitted", t, 1,
+                     kind="delta")
+        t += 1.0
+        wd.tick()
+    assert wd.first_breach is not None
+    assert wd.first_breach.breaches == ["slo:shed-ratio"]
+    assert "sustained burn" in wd.first_breach.detail
+
+
+async def test_task_failure_hook_is_a_breach(tmp_path):
+    """A process-fatal task exception through the ``spawn_logged`` seam
+    is a breach: verdict + undebounced dump."""
+    import asyncio
+
+    from serf_tpu.utils.tasks import spawn_logged
+
+    wd, box, _, _ = _flag_box(tmp_path, dump_every_ticks=8)
+    wd.install_task_hook()
+    try:
+        async def die():
+            raise RuntimeError("fatal")
+        t = spawn_logged(die(), "doomed-task")
+        await asyncio.wait([t])
+        await asyncio.sleep(0)                # let done-callbacks run
+        assert wd.breaches == 1
+        assert wd.first_breach.breaches == ["task-exception"]
+        assert "doomed-task" in wd.first_breach.detail
+        paths = box.bundle_paths()
+        assert len(paths) == 1
+        assert load_bundle(paths[0])["meta"]["reason"] == "task-exception"
+    finally:
+        wd.uninstall_task_hook()
+
+
+# ---------------------------------------------------------------------------
+# cluster forensics: _serf_blackbox (the _serf_stats contract)
+# ---------------------------------------------------------------------------
+
+
+def _blackbox_tool():
+    spec = importlib.util.spec_from_file_location(
+        "blackbox_tool", REPO / "tools" / "blackbox.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_blackbox_partials_merge_like_stats():
+    """Partials over disjoint responder sets fold to the union —
+    associative, commutative, relay-safe (the ``StatsPartial``
+    contract verbatim)."""
+    a = BlackboxPartial.of({"n0": {"id": "n0", "n": 1}})
+    b = BlackboxPartial.of({"n1": {"id": "n1", "n": 2}})
+    c = BlackboxPartial.of({"n2": {"id": "n2", "n": 0}})
+    left = a.merge(b).merge(c)
+    right = a.merge(b.merge(c))
+    assert left.nodes == right.nodes == b.merge(a).merge(c).nodes
+    snap = left.finish("n0", 3)
+    assert snap.complete and snap.bundles == 3
+
+
+async def test_cluster_blackbox_covers_every_node(tmp_path):
+    """Scatter ``_serf_blackbox`` across a live loopback cluster: every
+    node answers with its bundle inventory, the fold is complete, and
+    each latest bundle is schema-valid and renderable."""
+    import asyncio
+
+    from serf_tpu.host import LoopbackNetwork, Serf
+    from serf_tpu.host.query import QueryParam
+    from serf_tpu.options import Options
+
+    net = LoopbackNetwork()
+    nodes = [await Serf.create(net.bind(f"addr-{i}"), Options.local(),
+                               f"node-{i}") for i in range(3)]
+    try:
+        for s in nodes[1:]:
+            await s.join("addr-0")
+        deadline = asyncio.get_running_loop().time() + 10.0
+        while asyncio.get_running_loop().time() < deadline and \
+                not all(len(s.members()) == 3 for s in nodes):
+            await asyncio.sleep(0.02)
+        tool = _blackbox_tool()
+        for s in nodes:
+            s.blackbox = BlackBox(str(tmp_path), node=s.local_id,
+                                  recorder=flight.FlightRecorder())
+            s.blackbox.dump(reason="test-sweep")
+        snap = await nodes[0].cluster_blackbox(QueryParam(timeout=3.0))
+        assert set(snap.nodes) == {"node-0", "node-1", "node-2"}
+        assert snap.complete and snap.bundles == 3
+        for nid, inv in snap.nodes.items():
+            assert inv["n"] == 1 and inv["latest"]["seq"] == 1
+            assert inv["latest"]["reason"] == "test-sweep"
+            bundle = load_bundle(inv["latest"]["path"])
+            assert validate_bundle(bundle) == []
+            assert nid in tool.render_bundle(bundle)
+        # round-trips through JSON (the obstop --json contract)
+        assert json.loads(json.dumps(snap.to_dict()))["responders"] == 3
+    finally:
+        for s in nodes:
+            await s.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance scenario: a live mid-run breach on the host plane
+# ---------------------------------------------------------------------------
+
+
+async def test_host_plan_live_breach_dumps_every_node(tmp_path):
+    """A storm a tight admission config MUST shed >objective: the
+    always-on watchdog breaches the shed-ratio burn LIVE (first
+    breaching tick named by a verdict produced mid-run, not by any
+    post-hoc judge), and the triggered black boxes leave a schema-valid,
+    renderable bundle for EVERY node."""
+    from serf_tpu.faults.host import run_host_plan
+    from serf_tpu.faults.plan import FaultPhase, FaultPlan
+    from serf_tpu.options import Options
+
+    plan = FaultPlan(
+        name="watchdog-shed", n=3, seed=11,
+        phases=(
+            FaultPhase(name="warm", duration_s=0.3),
+            FaultPhase(name="storm1", duration_s=1.2, event_rate=1200.0),
+            FaultPhase(name="storm2", duration_s=1.2, event_rate=1200.0),
+            FaultPhase(name="storm3", duration_s=1.2, event_rate=1200.0),
+        ),
+        settle_s=6.0,
+    )
+    opts = Options.local(
+        user_event_rate=1.0, user_event_burst=1,
+        query_rate=1.0, query_burst=1,
+        event_queue_bytes=64 * 1024, query_queue_bytes=64 * 1024)
+    since = flight.global_recorder().last_seq
+    result = await run_host_plan(plan, tmp_dir=str(tmp_path), opts=opts)
+    wd = result.watchdog
+    assert wd is not None and wd["ok"] is False
+    fb = wd["first_breach"]
+    assert fb is not None and fb["tick"] >= 1 and fb["breaches"]
+    breached = {b for v in wd["history"] for b in v["breaches"]}
+    assert "slo:shed-ratio" in breached
+    # the verdict was produced AT its tick: the first_breach precedes
+    # (or is) every breaching verdict in the live-accumulated history
+    # (state() keeps the last 16), and the flight ring carries the
+    # breach event stamped with that same tick
+    bad_ticks = [v["tick"] for v in wd["history"] if not v["ok"]]
+    assert bad_ticks and fb["tick"] <= min(bad_ticks)
+    ev = flight.global_recorder().dump(kind="watchdog-breach",
+                                       since_seq=since)
+    assert any(e.get("plane") == "host" and e.get("tick") in bad_ticks
+               for e in ev), "no live breach event survived in the ring"
+    # forensics on EVERY node: one+ bundle each, schema-valid, renderable
+    tool = _blackbox_tool()
+    by_node = {}
+    for p in sorted((Path(str(tmp_path)) / "blackbox").glob("*.json")):
+        b = load_bundle(str(p))
+        assert validate_bundle(b) == []
+        by_node.setdefault(b["meta"]["node"], []).append(b)
+    assert set(by_node) == {"n0", "n1", "n2"}, sorted(by_node)
+    for node, bundles in by_node.items():
+        latest = bundles[-1]
+        assert latest["meta"]["reason"] in ("breach", "task-exception")
+        assert latest["watchdog"]["state"]["first_breach"] is not None
+        text = tool.render_bundle(latest)
+        assert node in text and "black box" in text
+    assert wd["bundles"], "watchdog state must list the bundle paths"

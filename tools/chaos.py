@@ -71,8 +71,25 @@ def run_device(plan, n: int, k_facts: int, devices: int = 0,
             mesh = make_mesh(d)
     return (run_device_plan(plan, cfg, mesh=mesh, recorder=recorder,
                             collect_telemetry=collect_telemetry,
-                            collect_propagation=True),
+                            collect_propagation=True,
+                            collect_invariants=True),
             (d if mesh else 1))
+
+
+def _dump_red_bundle(record_dir: str, plan, plane: str, result) -> str:
+    """A red run's forensic half: one black-box bundle beside the replay
+    artifact, fed from the process flight ring + the run's live watchdog
+    verdict (host ``Watchdog.state()`` / device invariant summary)."""
+    from serf_tpu.obs import flight
+    from serf_tpu.obs.blackbox import BlackBox
+
+    wd = getattr(result, "watchdog", None)
+    if isinstance(wd, dict) and "rows" in wd:
+        wd = {k: v for k, v in wd.items() if k != "rows"}  # host-side array
+    box = BlackBox(record_dir, node=f"{plan.name}-{plane}",
+                   recorder=flight.global_recorder())
+    return box.dump(reason="invariant-red",
+                    detail=f"plan {plan.name} [{plane}]", watchdog=wd)
 
 
 def main() -> int:
@@ -94,8 +111,8 @@ def main() -> int:
                     action="store_true", default=None,
                     help="attach the record/replay recorder and, on any "
                          "invariant failure, write the run's recording "
-                         "as a repro artifact (default: on for "
-                         "--self-check)")
+                         "plus a black-box bundle beside it as repro "
+                         "artifacts (default: on for --self-check)")
     ap.add_argument("--no-record-on-fail", dest="record_on_fail",
                     action="store_false")
     ap.add_argument("--record-dir", default=".",
@@ -157,6 +174,8 @@ def main() -> int:
     notes = []
     overload = {}
     recordings = {}
+    blackboxes = {}
+    watchdog_info = {}
     slo_verdicts = {}
     ring_summaries = {}
     control_info = {}
@@ -245,9 +264,13 @@ def main() -> int:
                     }
             slo_verdicts[plane] = verdicts
             reports.append(result.report)
-            # a red run writes its repro artifact (recording + digest
-            # stream); green runs keep nothing — the recorder stayed
-            # in-memory
+            wd = getattr(result, "watchdog", None)
+            if isinstance(wd, dict):
+                watchdog_info[plane] = {k: v for k, v in wd.items()
+                                        if k != "rows"}
+            # a red run writes its repro artifacts (recording + digest
+            # stream, and the black-box bundle beside it); green runs
+            # keep neither — the recorder stayed in-memory
             if recorder is not None and not result.report.ok:
                 path = os.path.join(
                     args.record_dir,
@@ -260,6 +283,12 @@ def main() -> int:
                     # exactly the red run it was meant to make debuggable
                     print(f"record-on-fail: could not write {path}: {e}",
                           file=sys.stderr)
+                try:
+                    blackboxes[plane] = _dump_red_bundle(
+                        args.record_dir, plan, plane, result)
+                except (OSError, TypeError, ValueError) as e:
+                    print(f"record-on-fail: could not dump black box: "
+                          f"{e}", file=sys.stderr)
 
     timeline_path = None
     if args.export_timeline:
@@ -317,6 +346,8 @@ def main() -> int:
             "propagation": propagation_info,
             "device_mesh_devices": device_mesh,
             "recordings": recordings,
+            "blackboxes": blackboxes,
+            "watchdog": watchdog_info,
             "timeline": timeline_path,
         }
         if args.controller != "off":
@@ -345,9 +376,17 @@ def main() -> int:
             print(f"controller [{plane}]: {len(decs)} decision(s)"
                   + (f", final {d['final']}" if "final" in d
                      else f", values {d.get('values')}"))
+        for plane, wd in sorted(watchdog_info.items()):
+            first = wd.get("first_breach") or wd.get("first_violation")
+            print(f"watchdog [{plane}]: "
+                  f"{'ok' if wd.get('ok') else 'BREACHED'}"
+                  + (f" (first: {first})" if first else ""))
         for plane, path in sorted(recordings.items()):
             print(f"repro recording [{plane}]: {path} "
                   "(replay with `python tools/replay.py replay <path>`)")
+        for plane, path in sorted(blackboxes.items()):
+            print(f"black-box bundle [{plane}]: {path} "
+                  "(render with `python tools/blackbox.py render <path>`)")
         if timeline_path:
             print(f"timeline bundle: {timeline_path} "
                   "(open at https://ui.perfetto.dev)")

@@ -23,8 +23,9 @@ plus a sparkline of the last-W deltas per aggregate — so rates and
 trends are visible, not just levels — followed by the message-lifecycle
 stage waterfall (``obs/lifecycle.py``: per-stage latency bars,
 transport/decode/dispatch/apply/queue-wait/tee, over sampled
-messages).  ``--iterations`` bounds the demo (default 3; a live
-embedding would loop forever).
+messages) and the live watchdog verdict (``obs/watchdog.py``: last
+tick, armed invariants, black-box bundles written).  ``--iterations``
+bounds the demo (default 3; a live embedding would loop forever).
 
 Embedding against a live cluster is one call on any node:
 ``snap = await serf.cluster_stats()``; ``obs.render_table(snap)``.
@@ -143,6 +144,11 @@ async def _watch(n: int, interval: float, iterations: int,
     prev_led = lifecycle.set_global_ledger(led)
     _net, nodes = await _demo_cluster(n)
     sampler = MetricsSampler(interval_s=interval)
+    # the always-on watchdog rides the same tick: each refresh also
+    # prints its live verdict (last tick, armed invariants, bundles)
+    from serf_tpu.obs.watchdog import Watchdog, arm_serf_invariants
+    wd = Watchdog(store=sampler.store)
+    arm_serf_invariants(wd, lambda: dict(enumerate(nodes)))
     try:
         i = 0
         while iterations <= 0 or i < iterations:
@@ -153,18 +159,22 @@ async def _watch(n: int, interval: float, iterations: int,
                 pass
             await asyncio.sleep(interval)
             sampler.sample()
+            wd.tick()
             i += 1
             if not as_json:
                 print(_render_rings(sampler.store, i))
                 print(lifecycle.format_waterfall(led.snapshot()))
+                print(wd.format())
         if as_json:
             print(json.dumps({
                 "ticks": sampler.ticks,
                 "series": sampler.store.names(),
                 "tail": sampler.store.tail(last=tail),
                 "lifecycle": led.snapshot(),
+                "watchdog": wd.state(),
             }, indent=1, sort_keys=True))
-        return 0 if sampler.ticks > 0 and len(sampler.store) > 0 else 1
+        return 0 if (sampler.ticks > 0 and len(sampler.store) > 0
+                     and wd.ticks > 0) else 1
     finally:
         # teardown first, restore after: shutdown traffic must land on
         # the demo's scoped ledger, not leak onto the restored one

@@ -85,7 +85,8 @@ def run_device_leg(n: int, degraded: bool):
     plan = device_plan(degraded)
     t0 = time.perf_counter()
     result = run_device_plan(plan, cfg, collect_telemetry=True,
-                             collect_propagation=True)
+                             collect_propagation=True,
+                             collect_invariants=True)
     elapsed = time.perf_counter() - t0
     # wall rps INCLUDING compile — an understatement, which is the safe
     # direction for the measurement-integrity SLO (measured <= ceiling)
@@ -94,7 +95,9 @@ def run_device_leg(n: int, degraded: bool):
     verdicts = slo.judge_device_run(result, plan, rps=rps,
                                     ceiling=ceiling)
     prop = result.propagation["summary"] if result.propagation else None
-    return verdicts, result.telemetry, rps, ceiling, prop
+    wd = {k: v for k, v in (result.watchdog or {}).items()
+          if k != "rows"}
+    return verdicts, result.telemetry, rps, ceiling, prop, wd
 
 
 def run_host_leg():
@@ -110,7 +113,7 @@ def run_host_leg():
     with tempfile.TemporaryDirectory(prefix="serf-obswatch-") as td:
         result = asyncio.run(run_host_plan(plan, tmp_dir=td))
     return (slo.judge_host_run(result, plan), result.series,
-            result.lifecycle, result.propagation)
+            result.lifecycle, result.propagation, result.watchdog)
 
 
 def main(argv=None) -> int:
@@ -136,22 +139,27 @@ def main(argv=None) -> int:
     verdicts = {}
     rings = {}
     propagation = {}
-    dev_verdicts, dev_store, rps, ceiling, dev_prop = run_device_leg(
-        args.n, args.degraded)
+    watchdog = {}
+    dev_verdicts, dev_store, rps, ceiling, dev_prop, dev_wd = \
+        run_device_leg(args.n, args.degraded)
     verdicts["device"] = dev_verdicts
     if dev_store is not None:
         rings["device"] = dev_store
     if dev_prop is not None:
         propagation["device"] = dev_prop
+    if dev_wd:
+        watchdog["device"] = dev_wd
     lifecycle_snap = None
     if not args.device_only and not args.degraded:
-        host_verdicts, host_store, lifecycle_snap, host_prop = \
+        host_verdicts, host_store, lifecycle_snap, host_prop, host_wd = \
             run_host_leg()
         verdicts["host"] = host_verdicts
         if host_store is not None:
             rings["host"] = host_store
         if host_prop is not None:
             propagation["host"] = host_prop
+        if host_wd:
+            watchdog["host"] = host_wd
 
     ok = all(slo.all_ok(v) for v in verdicts.values())
     breaches = flight.flight_dump(kind="slo-breach")
@@ -167,13 +175,24 @@ def main(argv=None) -> int:
                       for p, s in sorted(rings.items())},
             "lifecycle": lifecycle_snap,
             "propagation": propagation,
+            "watchdog": watchdog,
         }, indent=1, sort_keys=True))
     else:
         from serf_tpu.obs.propagation import format_propagation
+        from serf_tpu.obs.watchdog import format_invariants
         for plane in sorted(verdicts):
             print(slo.format_verdicts(verdicts[plane], plane))
             if plane in propagation:
                 print(format_propagation(propagation[plane], plane))
+        if "device" in watchdog:
+            print(format_invariants(watchdog["device"], "device"))
+        if "host" in watchdog:
+            wd = watchdog["host"]
+            print(f"[host] watchdog: "
+                  f"{'GREEN' if wd.get('ok') else 'BREACHED'} "
+                  f"({wd.get('ticks', 0)} tick(s), "
+                  f"{len(wd.get('armed') or ())} armed, "
+                  f"{len(wd.get('bundles') or ())} bundle(s))")
         if lifecycle_snap is not None:
             from serf_tpu.obs.lifecycle import format_waterfall
             print(format_waterfall(lifecycle_snap))
