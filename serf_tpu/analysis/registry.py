@@ -277,6 +277,7 @@ CONTROL_KNOBS: tuple = (
     "probe_mult",
     "stretch_q",
     "inject_limit",
+    "stamp_unit",
     # host plane (control/host.py HOST_KNOBS)
     "user_event_rate",
     "query_rate",

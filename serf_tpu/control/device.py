@@ -43,7 +43,17 @@ formerly-static config, now traced leaves (``KNOB_FIELDS`` order):
   (``inject_tokens`` refills to it every round): the device analog of
   the PR-5 ingress buckets.  :func:`gate_injections` spends the tokens
   on every injection batch; refusals land in the ``shed`` ledger and
-  ``serf.control.shed``.
+  ``serf.control.shed``;
+- ``stamp_unit`` — the deferred-stamp cohort size as ``log2(unit)``
+  (0/1/2 = flush every 1/2/4 rounds; readers compute ``1 << knob``):
+  byte-budget burn (overflow pressure) defers harder, convergence-
+  settle burn (agreement low) flushes sooner.  Pinned at 0 when the
+  config is per-round (``gossip.stamp_flush_unit == 1``) — the knob
+  only actuates on configs that built the overlay machinery.  Every
+  unit divides STAMP_UNIT, so every multiple-of-STAMP_UNIT round is a
+  flush boundary under ANY unit value: a mid-run unit switch can never
+  strand a pending cohort past its quarter (the
+  ``stamp_staleness_ok`` watchdog field pins this live).
 
 With ``ControlConfig.enabled=False`` (the default) none of this is
 read: the control leaves ride the pytree untouched and every round is
@@ -62,7 +72,8 @@ import numpy as np
 #: the controller-writable knob set, in ControlState.knobs order.
 #: serflint's ``control-knob-drift`` holds this literal to the declared
 #: registry (analysis/registry.py CONTROL_KNOBS) and to DEVICE_LAWS.
-KNOB_FIELDS = ("fanout", "probe_mult", "stretch_q", "inject_limit")
+KNOB_FIELDS = ("fanout", "probe_mult", "stretch_q", "inject_limit",
+               "stamp_unit")
 
 #: the declarative control-law table: (signal, knob, direction).  Every
 #: KNOB_FIELDS entry must appear as a law's knob (a knob nobody actuates
@@ -78,6 +89,8 @@ DEVICE_LAWS = (
     ("false-dead-clear", "stretch_q", "down"),
     ("overflow-pressure", "inject_limit", "down"),
     ("overflow-calm", "inject_limit", "up"),
+    ("overflow-pressure", "stamp_unit", "up"),
+    ("agreement-low", "stamp_unit", "down"),
 )
 
 #: per-round control-row field order (``control_row``): the knob vector
@@ -92,8 +105,10 @@ KNOB_FANOUT = KNOB_FIELDS.index("fanout")
 KNOB_PROBE_MULT = KNOB_FIELDS.index("probe_mult")
 KNOB_STRETCH_Q = KNOB_FIELDS.index("stretch_q")
 KNOB_INJECT_LIMIT = KNOB_FIELDS.index("inject_limit")
-_FANOUT, _PROBE_MULT, _STRETCH_Q, _INJECT_LIMIT = (
-    KNOB_FANOUT, KNOB_PROBE_MULT, KNOB_STRETCH_Q, KNOB_INJECT_LIMIT)
+KNOB_STAMP_UNIT = KNOB_FIELDS.index("stamp_unit")
+_FANOUT, _PROBE_MULT, _STRETCH_Q, _INJECT_LIMIT, _STAMP_UNIT = (
+    KNOB_FANOUT, KNOB_PROBE_MULT, KNOB_STRETCH_Q, KNOB_INJECT_LIMIT,
+    KNOB_STAMP_UNIT)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -196,11 +211,17 @@ def knob_bounds(ccfg: ControlConfig, gcfg, fcfg):
     inj_base = ccfg.inject_limit_base or 4 * k
     inj_floor = ccfg.inject_limit_floor or max(1, k // 2)
     inj_step = ccfg.inject_limit_step or max(1, k // 2)
-    base = np.array([fan_base, 1, 0, inj_base], np.int32)
-    lo = np.array([ccfg.fanout_min, 1, 0, inj_floor], np.int32)
+    # stamp_unit carries log2(stamp_flush_unit) — units are {1, 2, 4}
+    # by GossipConfig validation, so the band is [0, 2].  A per-round
+    # config pins the knob at 0: actuating deferral requires the
+    # overlay machinery the config opted out of.
+    su_base = gcfg.stamp_flush_unit.bit_length() - 1
+    su_hi = 2 if gcfg.stamp_flush_unit > 1 else 0
+    base = np.array([fan_base, 1, 0, inj_base, su_base], np.int32)
+    lo = np.array([ccfg.fanout_min, 1, 0, inj_floor, 0], np.int32)
     hi = np.array([gcfg.fanout, ccfg.probe_mult_max, stretch_max,
-                   inj_base], np.int32)
-    step = np.array([1, 1, 1, inj_step], np.int32)
+                   inj_base, su_hi], np.int32)
+    step = np.array([1, 1, 1, inj_step, 1], np.int32)
     return base, lo, hi, step
 
 
@@ -220,8 +241,9 @@ def make_control(ccfg: ControlConfig, gcfg, fcfg) -> ControlState:
 
 #: which direction is the PROTECTIVE move per knob (gets hyst_up; the
 #: opposite, relaxing direction gets hyst_down): widen fanout, slow
-#: probes, stretch suspicion, TIGHTEN injection admission
-_PROTECT_DIR = np.array([1, 1, 1, -1], np.int32)
+#: probes, stretch suspicion, TIGHTEN injection admission, DEFER stamp
+#: flushes harder (amortize bytes under pressure)
+_PROTECT_DIR = np.array([1, 1, 1, -1, 1], np.int32)
 
 
 def control_step(control: ControlState, sig: ControlSignals,
@@ -251,7 +273,13 @@ def control_step(control: ControlState, sig: ControlSignals,
             + ccfg.overflow_alpha * delta)
     inj_sig = jnp.where(ewma > ccfg.overflow_hi, -1,
                         jnp.where(ewma < ccfg.overflow_hi / 4.0, 1, 0))
-    sig_v = jnp.stack([fan_sig, fd_sig, fd_sig, inj_sig]).astype(jnp.int32)
+    # overflow-pressure / agreement-low -> stamp_unit (byte-budget burn
+    # defers flushes harder; convergence-settle burn flushes sooner —
+    # same EWMA operand as inj_sig, same agreement operand as fan_sig)
+    su_sig = jnp.where(ewma > ccfg.overflow_hi, 1,
+                       jnp.where(sig.agreement < ccfg.agreement_low, -1, 0))
+    sig_v = jnp.stack([fan_sig, fd_sig, fd_sig, inj_sig,
+                       su_sig]).astype(jnp.int32)
 
     # -- hysteresis streaks --------------------------------------------------
     cont = jnp.sign(control.streak) == sig_v

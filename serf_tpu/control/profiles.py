@@ -47,10 +47,17 @@ def device_ab_config(plan_name: str, n: int, k_facts: int,
     if plan_name == "control-overload-shed":
         # overload profile: the storm bursts far past ring capacity;
         # static admits everything (and clobbers it), the controlled
-        # twin's injection budget adapts down under overflow pressure
+        # twin's injection budget adapts down under overflow pressure.
+        # Both legs run quarter-deferred stamp flushes at base unit 2
+        # (shared protocol constant, same as fanout_base): the overflow
+        # burn that tightens admission also drives STAMP_UNIT up
+        # (defer harder), and the relax law walks it back to base —
+        # the knob actuates both directions on this plan, and the
+        # recorded controlled run replays the DEFERRED path bit-exactly
         return ClusterConfig(
             gossip=GossipConfig(n=n, k_facts=k_facts,
-                                peer_sampling="rotation"),
+                                peer_sampling="rotation",
+                                stamp_flush_unit=2),
             failure=FailureConfig(suspicion_rounds=8, max_new_facts=8,
                                   probe_schedule="round_robin"),
             push_pull_every=8,

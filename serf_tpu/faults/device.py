@@ -247,7 +247,8 @@ def run_phase(state: ClusterState, cfg: ClusterConfig, key: jax.Array,
                 nxt.gossip, row,
                 sentinels if track_cov else None,
                 colcnt if track_cov else None,
-                prev_cov if track_cov else None)
+                prev_cov if track_cov else None,
+                deferred=cfg.gossip.stamp_deferred)
             aux.append(irow)
         ncarry = (nxt, new_prev_cov) if track_cov else nxt
         if not aux:

@@ -45,7 +45,11 @@ from __future__ import annotations
 import dataclasses
 from typing import List, Optional
 
-from serf_tpu.models.dissemination import CLAMP_EVERY, GossipConfig
+from serf_tpu.models.dissemination import (
+    CLAMP_EVERY,
+    STAMP_UNIT,
+    GossipConfig,
+)
 
 #: v5e HBM bandwidth, bytes/s (the ceiling arithmetic in STATUS.md)
 V5E_HBM_BYTES_PER_S = 819e9
@@ -154,7 +158,8 @@ KERNEL_PATHS = ("xla", "kernels", "fused")
 
 def round_traffic(cfg, regime: str = "sustained",
                   sustained_rate: int = 2,
-                  path: str = "xla") -> TrafficReport:
+                  path: str = "xla",
+                  stamp_deferred: Optional[bool] = None) -> TrafficReport:
     """Analytic HBM model of one flagship ``cluster_round`` (swim.py).
 
     ``cfg`` is a ``ClusterConfig``; pass ``regime`` per the module
@@ -165,24 +170,50 @@ def round_traffic(cfg, regime: str = "sustained",
     the HLO cross-check in tests keeps that assumption honest; the
     pallas paths' entries are authored DMA streams, exact by
     construction.
+
+    ``stamp_deferred`` models the quarter-deferred flush path (ISSUE
+    18): ``None`` follows ``cfg.gossip.stamp_deferred``; an explicit
+    True/False overrides it for A/B at a matched config (True on a
+    per-round config models the max unit, ``STAMP_UNIT``).  Deferred,
+    the per-learn-round stamp R+W becomes a once-per-cohort flush
+    (``flush_stamp_pass`` / ``ops.fused_flush``) — amortized by the
+    flush unit — plus the overlay fold+clear and cache recompute at the
+    same cadence.  **Model convention** (the STATUS round-8 floor
+    arithmetic): the mid-cohort learned-bit ORs (``overlay |=
+    new_words``, ``sendable |= new_words``) ride the merge's fused
+    elementwise word loop beside the ``known`` merge and are charged at
+    the flush boundary where the overlay is actually folded into the
+    stamp plane, not as separate per-round plane passes — the
+    compiled-HLO cross-check carries whatever slack that convention
+    hides, same as every other fusion assumption on the "xla" path.
     """
     if regime not in ("sustained", "active", "quiescent", "detection"):
         raise ValueError(f"unknown regime {regime!r}")
     if path not in KERNEL_PATHS:
         raise ValueError(f"unknown path {path!r} (one of {KERNEL_PATHS})")
     g: GossipConfig = cfg.gossip
+    if stamp_deferred is None:
+        stamp_deferred = g.stamp_deferred
+    # the modeled flush cadence: the config's unit, or the max cohort
+    # (STAMP_UNIT = one quarter) when deferral is forced onto a
+    # per-round config for the A/B
+    unit = float(g.stamp_flush_unit if g.stamp_deferred else STAMP_UNIT) \
+        if stamp_deferred else 1.0
     n, k = g.n, g.k_facts
     w = g.words
     d = cfg.vivaldi.dimensionality
 
     stamp = float(n * g.stamp_cols)  # u8[N, K/2] packed (u8[N, K] A/B)
     known = float(n * w * 4)        # u32[N, W]
+    overlay = known                 # u32[N, W] learned-since-flush bits
     alive = float(n)                # bool[N]
     vec = float(n * d * 4)          # f32[N, D]
     col = float(n * 4)              # one f32/i32 column
     pos = float(n * 3 * 4)          # f32[N, 3] hidden positions
     plane_sizes = {"stamp": stamp, "known": known, "packets": known,
                    "sendable": known, "alive": alive}
+    if stamp_deferred:
+        plane_sizes["overlay"] = overlay
 
     E: List[Entry] = []
     add = E.append
@@ -264,11 +295,30 @@ def round_traffic(cfg, regime: str = "sustained",
         # wrap clamp AND (fused path) the sendable-cache recompute ride
         # the same streaming pass.
         if learns or path != "xla":
-            add(Entry("merge", "stamp", "RW", 2 * stamp, 1.0,
-                      merge_where + " stamp+clamp"))
-            if g.use_sendable_cache and path != "kernels":
-                add(Entry("merge", "sendable", "W", known, 1.0,
-                          merge_where + " cache recompute"))
+            if stamp_deferred:
+                # ISSUE 18 quarter-deferred flushes: the stamp R+W runs
+                # once per cohort (flush_stamp_pass / ops.fused_flush),
+                # reading the overlay it retires and clearing it; the
+                # cache recompute rides the same flush.  Mid-cohort
+                # learned-bit ORs ride the merge word loop (module
+                # convention, see round_traffic docstring).
+                flush_where = ("ops.fused_flush" if path == "fused"
+                               else "dissemination.flush_stamp_pass")
+                add(Entry("merge", "stamp", "RW", 2 * stamp, 1.0 / unit,
+                          flush_where + " per-cohort flush+clamp"))
+                add(Entry("merge", "overlay", "RW", 2 * overlay,
+                          1.0 / unit,
+                          flush_where + " overlay fold + clear"))
+                if g.use_sendable_cache and path != "kernels":
+                    add(Entry("merge", "sendable", "W", known,
+                              1.0 / unit,
+                              flush_where + " cache recompute"))
+            else:
+                add(Entry("merge", "stamp", "RW", 2 * stamp, 1.0,
+                          merge_where + " stamp+clamp"))
+                if g.use_sendable_cache and path != "kernels":
+                    add(Entry("merge", "sendable", "W", known, 1.0,
+                              merge_where + " cache recompute"))
 
     if not learns and (path != "kernels" or not gossip_on):
         # standalone wraparound clamp: only fires when no stamp-
@@ -308,6 +358,13 @@ def round_traffic(cfg, regime: str = "sustained",
             add(Entry("declare", "stamp", "R", stamp,
                       1.0 / cfg.probe_every,
                       "failure._declare_round_body mod_age scan"))
+            if stamp_deferred:
+                # deferred: the expiry scan masks pending overlay
+                # learns (q-age 0, never expired) — one extra word-
+                # plane read beside the stamp scan
+                add(Entry("declare", "overlay", "R", overlay,
+                          1.0 / cfg.probe_every,
+                          "failure._declare_round_body overlay mask"))
             add(Entry("declare", "known", "R", known,
                       1.0 / cfg.probe_every,
                       "failure._declare_round_body"))
@@ -335,9 +392,19 @@ def round_traffic(cfg, regime: str = "sustained",
         if learns:
             if g.use_sendable_cache:
                 pp_bytes += 2 * known   # sendable OR of the learn bits
-            add(Entry("push_pull", "stamp", "RW", 2 * stamp,
-                      1.0 / cfg.push_pull_every,
-                      "antientropy.push_pull_round stamp+clamp"))
+            if stamp_deferred:
+                # no stamp pass at all: the sync's learns ride the
+                # overlay (antientropy deferred branch) and the next
+                # cohort flush retires them.  With the cache on, the
+                # overlay OR shares the cache OR's fused word loop
+                # (module convention — same new_words operand); cache
+                # off it is the only plane OR and is charged
+                if not g.use_sendable_cache:
+                    pp_bytes += 2 * overlay
+            else:
+                add(Entry("push_pull", "stamp", "RW", 2 * stamp,
+                          1.0 / cfg.push_pull_every,
+                          "antientropy.push_pull_round stamp+clamp"))
         add(Entry("push_pull", "known", "RW", pp_bytes,
                   1.0 / cfg.push_pull_every,
                   "antientropy.push_pull_round"))
@@ -372,14 +439,19 @@ def kernel_path_summary(cfg, regime: str = "sustained",
       ``hlo_bytes_per_round``) into construction guarantees: every pass
       is one authored DMA stream.
 
-    The ≥2x-vs-the-233.4-pin aspiration is NOT reachable under the
-    bit-exactness constraint and is documented with its floor
-    arithmetic in STATUS.md: exchange (separate hookable leg) + the
-    merge's known/incoming words + the per-learn-round stamp R+W +
-    probe/push-pull/vivaldi already exceed half the pin.  Removing the
-    per-round stamp R+W needs quarter-deferred stamp flushes — a
-    semantics change (stamps stale up to 3 rounds, every mod_age reader
-    amended), recorded as the next lever, not this PR.
+    The ≥2x-vs-the-233.4-pin aspiration is NOT reachable under strict
+    per-round bit-exactness and is documented with its floor arithmetic
+    in STATUS.md: exchange (separate hookable leg) + the merge's
+    known/incoming words + the per-learn-round stamp R+W +
+    probe/push-pull/vivaldi already exceed half the pin.  ISSUE 18
+    pulled the remaining lever: quarter-deferred stamp flushes
+    (``GossipConfig.stamp_flush_unit``) — a deliberate semantics change
+    (stamps stale up to 3 rounds mid-cohort, every mod_age reader
+    amended by the overlay) that breaks the 217 floor on a deferred
+    config (``round_traffic(..., stamp_deferred=True)`` prices it;
+    bench's ``stamp_flush_ab`` carries the A/B).  This summary prices
+    the config as given — pass a deferred config to see the broken
+    floor per path.
     """
     out = {"regime": regime, "paths": {}}
     for path in KERNEL_PATHS:

@@ -59,6 +59,41 @@ def push_pull_round(state: GossipState, cfg: GossipConfig, key: jax.Array,
     known = state.known | new_words
     learned_any = jnp.any(new_words != 0)
 
+    if cfg.stamp_deferred:
+        # deferred flavor: the sync's learns ride the overlay (q-age 0
+        # through every mod_age reader) and the next cohort flush
+        # retires them — no stamp pass here at all, and no last_clamp
+        # bump (the flush owns the clamp).  The flush writes them with
+        # the quarter of flush-1, which IS this round's quarter: the
+        # first cohort boundary after ``round`` is < the first quarter
+        # boundary after it (units divide STAMP_UNIT).  One intra-round
+        # ordering wrinkle: a flush may have already run THIS round
+        # (round_step's merge), leaving ``last_flush == round`` — these
+        # learns are newer than that flush, so re-arm the pending
+        # predicate by backdating last_flush below last_learn
+        # (last_flush is only ever compared, never used as a stamp
+        # operand).
+        last_learn = bump_last_learn(learned_any, state.round,
+                                     state.last_learn)
+        last_flush = jnp.where(
+            learned_any,
+            jnp.minimum(state.last_flush,
+                        jnp.asarray(state.round - 1, jnp.int32)),
+            state.last_flush)
+        if cfg.use_sendable_cache:
+            sendable = state.sendable | new_words
+            sendable_round = state.sendable_round
+        else:
+            sendable = state.sendable
+            sendable_round = jnp.where(learned_any, jnp.int32(-1),
+                                       state.sendable_round)
+        return state._replace(known=known,
+                              overlay=state.overlay | new_words,
+                              sendable=sendable,
+                              sendable_round=sendable_round,
+                              last_learn=last_learn,
+                              last_flush=last_flush)
+
     # a fresh stamp = q-age 0 = fresh transmit budget for newly synced
     # facts.  Gated on learned_any: a fully in-sync pair exchange learns
     # nothing and the stamp where-pass (R+W the whole stamp plane) is a

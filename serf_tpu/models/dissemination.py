@@ -213,6 +213,31 @@ class GossipState(NamedTuple):
                                 # never exceed it, and
                                 # ``injected - overflow`` is the count
                                 # that got a full dissemination window.
+    overlay: jnp.ndarray        # u32[N, W]  learned-since-flush word
+                                # overlay (quarter-deferred stamp
+                                # flushes, ``cfg.stamp_flush_unit``): a
+                                # set bit marks a fact learned by a
+                                # mid-cohort merge/push-pull whose
+                                # stamp nibble has NOT been written yet
+                                # — its effective q-age is 0 and every
+                                # mod_age reader (selection, declare,
+                                # believed_dead, the cache recompute)
+                                # reads through it.  Cleared by the
+                                # cohort flush, which writes the
+                                # pending nibbles in one streaming
+                                # pass.  All-zero (inert) on the
+                                # per-round path (stamp_flush_unit=1).
+    last_flush: jnp.ndarray     # i32 scalar: the ``next``-round value
+                                # of the most recent cohort flush (the
+                                # merge that streamed the stamp plane
+                                # and cleared the overlay).  Powers the
+                                # flush-due predicate under a traced
+                                # STAMP_UNIT knob and the watchdog's
+                                # ``stamp_staleness_ok`` row:
+                                # ``last_learn > last_flush`` is the
+                                # scalar proxy for "overlay nonempty".
+                                # Stays 0 (inert) on the per-round
+                                # path.
 
 
 @dataclasses.dataclass(frozen=True)
@@ -256,11 +281,36 @@ class GossipConfig:
     #: tests/test_stamp_packing.py.  Default ON: it halves the round's
     #: dominant HBM plane (accounting.py).
     pack_stamp: bool = True
+    #: quarter-deferred stamp flushes (README "Deferred stamp flushes"):
+    #: rounds per stamp-plane flush cohort, in {1, 2, 4} (must divide
+    #: STAMP_UNIT so a cohort never spans a quarter boundary).  1 (the
+    #: default) is today's per-round behavior — leaf-for-leaf identical,
+    #: the overlay/last_flush leaves ride inert.  >1 defers the merge's
+    #: stamp R+W to one streaming flush per cohort; mid-cohort learns
+    #: land in the ``overlay`` word bitplane, which every q-age reader
+    #: reads through — derived ages, membership views and detection
+    #: outcomes stay bit-exact with the per-round path at EVERY round,
+    #: only the raw stamp plane is stale <= STAMP_UNIT-1 rounds
+    #: mid-cohort (the deliberate semantics change that breaks the
+    #: 217 MB/round floor; accounting.round_traffic(stamp_deferred=)
+    #: prices it).  Under adaptive control the live unit is the
+    #: ``stamp_unit`` knob (log2, control/device.py) seeded from this
+    #: value.
+    stamp_flush_unit: int = 1
 
     def __post_init__(self):
         if self.peer_sampling not in ("iid", "rotation"):
             raise ValueError(
                 f"unknown peer_sampling {self.peer_sampling!r}")
+        if self.stamp_flush_unit not in (1, 2, 4):
+            # units must divide STAMP_UNIT: a flush cohort then never
+            # spans a quarter boundary, so every pending overlay bit
+            # shares the flush's write quarter (round_q(flush-1)) and
+            # the deferred write is value-exact
+            raise ValueError(
+                f"stamp_flush_unit {self.stamp_flush_unit} must be one "
+                f"of (1, 2, 4) — a divisor of STAMP_UNIT={STAMP_UNIT}, "
+                "so flush cohorts never span a stamp quarter")
         if self.transmit_limit_q > AGE_PIN_Q:
             # derived q-ages are pinned at AGE_PIN_Q by the stamp clamp;
             # a limit above the pin would let pinned (very old) facts
@@ -307,6 +357,14 @@ class GossipConfig:
     def stamp_cols(self) -> int:
         """Byte columns of the stamp plane for this flavor."""
         return self.k_facts // 2 if self.pack_stamp else self.k_facts
+
+    @property
+    def stamp_deferred(self) -> bool:
+        """True when the quarter-deferred flush machinery is COMPILED
+        (``stamp_flush_unit > 1``) — the static gate every deferred
+        branch keys on, so the unit=1 path traces exactly today's
+        jaxpr (the leaf-for-leaf identity the tier-1 suite pins)."""
+        return self.stamp_flush_unit > 1
 
 
 #: log2 of the stamp resolution: stamps record the learn round in units
@@ -355,6 +413,8 @@ def make_state(cfg: GossipConfig) -> GossipState:
         slot_round=jnp.full((k,), -(1 << 30), jnp.int32),
         overflow=jnp.asarray(0, jnp.uint32),
         injected=jnp.asarray(0, jnp.uint32),
+        overlay=jnp.zeros((n, w), jnp.uint32),
+        last_flush=jnp.asarray(0, jnp.int32),
     )
 
 
@@ -452,10 +512,24 @@ def mod_age(state: GossipState, cfg: GossipConfig, round_=None
     """u8[N, K]: quarter-round ticks since learned via wrapping 4-bit
     subtraction.  VALID ONLY where the known bit is set — callers must
     gate on the ``known`` bitset (every protocol predicate already
-    does)."""
+    does).
+
+    Deferred-flush flavor (``cfg.stamp_flush_unit > 1``): cells whose
+    overlay bit is set were learned since the last cohort flush — their
+    stamp nibble is stale/unwritten and their TRUE q-age is 0 (a cohort
+    never spans a quarter boundary, so a mid-cohort learn is always in
+    the current quarter).  THE one overlay read-through for every
+    bool-plane age consumer (sending_mask, believer_counts, the
+    unpacked declare scan, budgets_of/age_of); packed word-space sites
+    amend their ``nibble_age_pred_words`` result with the overlay words
+    directly (select_words, declare's packed scan)."""
     r = state.round if round_ is None else round_
     nib = stamp_nibbles(state.stamp, cfg.k_facts, cfg.pack_stamp)
-    return (round_q(r) - nib) & jnp.uint8(0xF)
+    age = (round_q(r) - nib) & jnp.uint8(0xF)
+    if cfg.stamp_deferred:
+        age = jnp.where(unpack_bits(state.overlay, cfg.k_facts),
+                        jnp.uint8(0), age)
+    return age
 
 
 def age_of(state: GossipState, cfg: GossipConfig) -> jnp.ndarray:
@@ -541,6 +615,11 @@ def select_words(state: GossipState, cfg: GossipConfig) -> jnp.ndarray:
         b = state.stamp
         age_ok = nibble_age_pred_words(b & jnp.uint8(0xF), b >> 4,
                                        state.round, cfg.transmit_limit_q)
+        if cfg.stamp_deferred:
+            # overlay read-through in word space: a learned-since-flush
+            # fact's true q-age is 0 < limit_q, whatever its stale
+            # nibble says (transmit_limit_q >= 1 by config validation)
+            age_ok = age_ok | state.overlay
         alive_words = jnp.where(state.alive[:, None],
                                 jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
         return state.known & age_ok & alive_words
@@ -964,6 +1043,13 @@ def pallas_dispatch_mode(cfg: GossipConfig,
         return "", "use_pallas off"
     from serf_tpu.ops import round_kernels
     if not cfg.fused_kernels:
+        if cfg.stamp_deferred:
+            # the PR-3 standalone family predates the overlay plane
+            # (its merge writes stamps per-round and its select never
+            # reads the overlay) — deferred configs take the fused
+            # family or the XLA path, never half-deferred kernels
+            return "", ("standalone kernels do not maintain the "
+                        "deferred-stamp overlay; use fused_kernels")
         if n_devices == 0 and round_kernels.pallas_ok(cfg.n, cfg.k_facts):
             return "kernels", ""
         return "", ("standalone kernels are single-device; use "
@@ -973,7 +1059,8 @@ def pallas_dispatch_mode(cfg: GossipConfig,
     if cfg.n % d != 0:
         return "", f"n % devices != 0 (n={cfg.n}, devices={d})"
     ok, reason = round_kernels.fused_ok(cfg.n // d, cfg.k_facts,
-                                        cfg.stamp_cols)
+                                        cfg.stamp_cols,
+                                        deferred=cfg.stamp_deferred)
     return ("fused", "") if ok else ("", reason)
 
 
@@ -1022,6 +1109,13 @@ def select_phase(state: GossipState, cfg: GossipConfig,
         from serf_tpu.ops import round_kernels
 
         def recompute(s):
+            if cfg.stamp_deferred:
+                # stale-cache recompute on the deferred path must read
+                # through the overlay (mid-cohort learns are not in the
+                # stamp plane yet); the stamp-only kernel can't — take
+                # the overlay-aware XLA recompute.  Rare by design: the
+                # deferred merge keeps the cache valid mid-cohort.
+                return select_words(s, cfg)
             return round_kernels.select_packets(
                 s.stamp, s.known, s.alive[:, None].astype(jnp.uint8),
                 cfg.transmit_limit_q, s.round, packed=cfg.pack_stamp,
@@ -1156,8 +1250,60 @@ def learn_stamp_pass(stamp: jnp.ndarray, known: jnp.ndarray,
     return stamp2, fallback_sendable, jnp.asarray(-1, jnp.int32)
 
 
+def flush_stamp_pass(stamp: jnp.ndarray, known: jnp.ndarray,
+                     new_words: jnp.ndarray, overlay: jnp.ndarray,
+                     next_round, cfg: GossipConfig,
+                     fallback_sendable: jnp.ndarray):
+    """THE cohort flush (quarter-deferred flavor of
+    :func:`learn_stamp_pass`): the one stamp-plane streaming pass of a
+    ``stamp_flush_unit``-round cohort.  In the same fusion it (a)
+    re-pins wrap-stale nibbles (clamp), (b) writes every pending
+    overlay cell with the COHORT quarter ``round_q(next_round - 1)`` —
+    exact, because a cohort never spans a quarter boundary (config
+    validation: the unit divides STAMP_UNIT), so every mid-cohort learn
+    happened in that quarter — (c) stamps THIS merge's fresh learns
+    (``new_words``) with ``round_q(next_round)`` (fresh learns at a
+    flush merge go to the stamp plane directly, never the overlay;
+    ``new_words`` wins where a stale overlay bit survives slot
+    recycling), and (d) recomputes the sendable cache for
+    ``next_round`` from the final nibbles.  The caller clears the
+    overlay and sets ``last_flush = next_round``.
+
+    Returns ``(stamp', sendable', sendable_round')`` — the
+    :func:`learn_stamp_pass` contract."""
+    k = cfg.k_facts
+    rq = round_q(next_round)
+    rq_prev = round_q(jnp.asarray(next_round, jnp.int32) - 1)
+    limit_q = jnp.uint8(cfg.transmit_limit_q)
+    if cfg.pack_stamp:
+        lo = clamp_nibbles(stamp & jnp.uint8(0xF), next_round)
+        hi = clamp_nibbles(stamp >> 4, next_round)
+        o_lo, o_hi = learn_pairs_words(overlay, k)
+        lo = jnp.where(o_lo, rq_prev, lo)
+        hi = jnp.where(o_hi, rq_prev, hi)
+        n_lo, n_hi = learn_pairs_words(new_words, k)
+        lo = jnp.where(n_lo, rq, lo)
+        hi = jnp.where(n_hi, rq, hi)
+        stamp2 = lo | (hi << 4)
+        if cfg.use_sendable_cache:
+            age_ok = nibble_age_pred_words(lo, hi, next_round, limit_q)
+            return (stamp2, known & age_ok,
+                    jnp.asarray(next_round, jnp.int32))
+        return stamp2, fallback_sendable, jnp.asarray(-1, jnp.int32)
+    nib = clamp_nibbles(stamp, next_round)
+    nib = jnp.where(unpack_bits(overlay, k), rq_prev, nib)
+    nib = jnp.where(unpack_bits(new_words, k), rq, nib)
+    if cfg.use_sendable_cache:
+        kb = unpack_bits(known, k)
+        q_next = (rq - nib) & jnp.uint8(0xF)
+        return (nib, pack_bits(kb & (q_next < limit_q)),
+                jnp.asarray(next_round, jnp.int32))
+    return nib, fallback_sendable, jnp.asarray(-1, jnp.int32)
+
+
 def merge_phase(state: GossipState, incoming: jnp.ndarray,
-                cfg: GossipConfig, mesh=None) -> GossipState:
+                cfg: GossipConfig, mesh=None,
+                stamp_unit=None) -> GossipState:
     """Phases 4+5 — Lamport merge + the stamp learn pass.
 
     Learn facts we did not know (dead learn nothing), then the round's
@@ -1182,10 +1328,22 @@ def merge_phase(state: GossipState, incoming: jnp.ndarray,
     and cache validity included).  The standalone flavor keeps its PR-3
     semantics: clamp every active round, cache invalidated.
 
+    DEFERRED flavor (``cfg.stamp_deferred``, PR-18): the stamp-plane
+    write is amortized to once per ``stamp_flush_unit``-round cohort —
+    mid-cohort merges are word-plane ORs only (known/overlay/sendable),
+    and the cohort's one flush pass (:func:`flush_stamp_pass` /
+    ``ops.fused_flush``) retires the overlay into the stamp plane.
+    ``stamp_unit`` (optional i32 scalar, may be traced) overrides the
+    config's static unit — the adaptive control plane's STAMP_UNIT knob
+    rides this; only ever passed on deferred configs.
+
     Does NOT increment ``state.round`` (the caller owns the round
     counter and the standalone clamp)."""
     k = cfg.k_facts
     mode = _pallas_mode(cfg, mesh, record=False)
+    if cfg.stamp_deferred:
+        return _merge_phase_deferred(state, incoming, cfg, mode, mesh,
+                                     stamp_unit)
     if mode == "fused":
         from serf_tpu.ops import round_kernels
         alive_u8 = state.alive[:, None].astype(jnp.uint8)
@@ -1253,10 +1411,92 @@ def merge_phase(state: GossipState, incoming: jnp.ndarray,
                           last_clamp=last_clamp)
 
 
+def _merge_phase_deferred(state: GossipState, incoming: jnp.ndarray,
+                          cfg: GossipConfig, mode: str, mesh,
+                          stamp_unit) -> GossipState:
+    """:func:`merge_phase`, deferred-stamp flavor (``stamp_flush_unit``
+    > 1).  The word-plane merge (learn bits into known/overlay/sendable)
+    runs EVERY active round; the stamp plane is only touched by the
+    once-per-cohort flush:
+
+    - ``flush_due``: the post-increment round is a cohort boundary
+      (``(round+1) % unit == 0`` — units divide STAMP_UNIT by config
+      validation, so a cohort never spans a stamp quarter and every
+      pending overlay cell shares the quarter ``round_q(flush-1)``).
+    - ``do_flush = flush_due & (learned_any | pending)``: a boundary
+      with nothing pending and nothing learned skips the pass entirely
+      (the deferred analog of the per-round path's ``learned_any``
+      gate), where ``pending = last_learn > last_flush`` — mid-cohort
+      learns that still owe a stamp write.
+
+    Mid-cohort the sendable cache stays VALID: the defer branch ORs the
+    learn bits in (their overlay-derived q-age is 0 < limit) and no
+    expiry transition can occur (ages only change at quarter
+    boundaries, which are always cohort boundaries) — so the validity
+    round advances, EXCEPT across a skipped boundary (``~flush_due``
+    gate), where a quarter crossing may expire cached bits and the
+    cache must go stale for the readers' recompute to see it.
+
+    The word-plane ORs stay XLA on every dispatch mode — they fuse
+    bandwidth-optimally and there is no stamp pass to ride; the fused
+    family contributes its streaming flush kernel (``ops.fused_flush``)
+    under the same ``do_flush`` cond, so both paths are bit-exact on
+    every leaf."""
+    nxt = jnp.asarray(state.round + 1, jnp.int32)
+    unit = jnp.asarray(
+        cfg.stamp_flush_unit if stamp_unit is None else stamp_unit,
+        jnp.int32)
+    alive_col = state.alive[:, None]
+    new_words = incoming & ~state.known & jnp.where(
+        alive_col, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+    known = state.known | new_words
+    learned_any = jnp.any(new_words != 0)
+
+    flush_due = (nxt % unit) == 0
+    pending = state.last_learn > state.last_flush
+    do_flush = flush_due & (learned_any | pending)
+
+    def flush(_):
+        if mode == "fused":
+            from serf_tpu.ops import round_kernels
+            stamp2, send2 = round_kernels.fused_flush(
+                known, new_words, state.overlay, state.stamp, nxt,
+                limit_q=cfg.transmit_limit_q, packed=cfg.pack_stamp,
+                k_facts=cfg.k_facts, with_cache=cfg.use_sendable_cache,
+                mesh=mesh)
+            if not cfg.use_sendable_cache:
+                send2, sr2 = state.sendable, jnp.asarray(-1, jnp.int32)
+            else:
+                sr2 = nxt
+        else:
+            stamp2, send2, sr2 = flush_stamp_pass(
+                state.stamp, known, new_words, state.overlay, nxt, cfg,
+                state.sendable)
+        return (stamp2, jnp.zeros_like(state.overlay), send2,
+                jnp.asarray(sr2, jnp.int32), nxt, nxt)
+
+    def defer(_):
+        sr2 = jnp.where(
+            (state.sendable_round == state.round) & ~flush_due,
+            nxt, state.sendable_round)
+        return (state.stamp, state.overlay | new_words,
+                state.sendable | new_words, sr2,
+                state.last_clamp, state.last_flush)
+
+    (stamp, overlay, sendable, sendable_round, last_clamp,
+     last_flush) = jax.lax.cond(do_flush, flush, defer, None)
+    last_learn = bump_last_learn(learned_any, nxt, state.last_learn)
+    return state._replace(known=known, stamp=stamp, overlay=overlay,
+                          last_learn=last_learn, sendable=sendable,
+                          sendable_round=sendable_round,
+                          last_clamp=last_clamp, last_flush=last_flush)
+
+
 def round_step(state: GossipState, cfg: GossipConfig,
                key: jax.Array, group=None, drop_rate=None,
                exchange=None, mesh=None, eff_fanout=None,
-               collect_propagation: bool = False):
+               collect_propagation: bool = False,
+               stamp_unit=None):
     """One gossip round: select packets, pull-exchange, Lamport-merge
     (the :func:`select_phase`/:func:`exchange_phase`/:func:`merge_phase`
     composition — the profiler jits the same phases in isolation,
@@ -1321,9 +1561,12 @@ def round_step(state: GossipState, cfg: GossipConfig,
         kw = {} if eff_fanout is None else {"eff_fanout": eff_fanout}
         incoming = ex(packets, cfg, key, group=group,
                       drop_rate=drop_rate, **kw)
-        st = merge_phase(state, incoming, cfg, mesh=mesh)
+        st = merge_phase(state, incoming, cfg, mesh=mesh,
+                         stamp_unit=stamp_unit)
         out = (st.known, st.stamp, st.last_learn, st.sendable,
                st.sendable_round, st.last_clamp)
+        if cfg.stamp_deferred:
+            out = out + (st.overlay, st.last_flush)
         if collect_propagation:
             eff = (jnp.asarray(cfg.fanout, jnp.int32) if eff_fanout is None
                    else jnp.asarray(eff_fanout, jnp.int32))
@@ -1340,6 +1583,12 @@ def round_step(state: GossipState, cfg: GossipConfig,
     def quiet(state):
         out = (state.known, state.stamp, state.last_learn,
                state.sendable, state.sendable_round, state.last_clamp)
+        if cfg.stamp_deferred:
+            # quiet implies nothing pending: a learn keeps the gate open
+            # >= transmit_window_rounds (>= STAMP_UNIT), and the cohort
+            # flush fires within stamp_flush_unit-1 < STAMP_UNIT rounds
+            # of it — so the overlay is zero here and stays zero
+            out = out + (state.overlay, state.last_flush)
         if collect_propagation:
             # sending set provably empty: nothing shipped, nothing learned
             zero = jnp.asarray(0, jnp.int32)
@@ -1349,11 +1598,12 @@ def round_step(state: GossipState, cfg: GossipConfig,
     res = jax.lax.cond(state.round - state.last_learn
                        < cfg.transmit_window_rounds,
                        active, quiet, state)
+    (known, stamp, last_learn, sendable, sendable_round, last_clamp,
+     *extra) = res
+    if cfg.stamp_deferred:
+        overlay, last_flush, *extra = extra
     if collect_propagation:
-        (known, stamp, last_learn, sendable, sendable_round, last_clamp,
-         slots_sent, slots_learned) = res
-    else:
-        known, stamp, last_learn, sendable, sendable_round, last_clamp = res
+        slots_sent, slots_learned = extra
 
     # standalone wraparound guard: runs only when no streaming pass has
     # clamped for CLAMP_EVERY rounds (quiet/no-learn windows — the merge
@@ -1361,11 +1611,15 @@ def round_step(state: GossipState, cfg: GossipConfig,
     # re-pins stamps whose derived q-age exceeds AGE_PIN_Q
     # (>= transmit_limit_q by config validation), i.e. cells that are
     # non-sendable before AND after — the sendable invariant holds.
+    # Deferred-safe for the same reason: a pending overlay cell's stale
+    # nibble is fully overwritten at its flush, clamped or not.
     stamp, last_clamp = clamp_stamps(stamp, state.round + 1, last_clamp,
                                      cfg)
     nxt = state._replace(known=known, stamp=stamp, last_learn=last_learn,
                          sendable=sendable, sendable_round=sendable_round,
                          last_clamp=last_clamp, round=state.round + 1)
+    if cfg.stamp_deferred:
+        nxt = nxt._replace(overlay=overlay, last_flush=last_flush)
     if collect_propagation:
         return nxt, (slots_sent, slots_learned)
     return nxt
@@ -1418,6 +1672,14 @@ def push_round_step(state: GossipState, cfg: GossipConfig,
     # unconditional stamp pass (conformance mode): clamp rides it free
     nib = clamp_nibbles(stamp_nibbles(state.stamp, k, cfg.pack_stamp),
                         state.round + 1)
+    if cfg.stamp_deferred:
+        # the unconditional pass doubles as a cohort flush: retire any
+        # pending overlay cells at their cohort quarter — the previous
+        # round's, like flush_stamp_pass (pending cells always share the
+        # current write quarter: a flush fires within STAMP_UNIT rounds
+        # of any learn, never across a quarter boundary)
+        nib = jnp.where(unpack_bits(state.overlay, k),
+                        round_q(state.round), nib)
     nib = jnp.where(new_mask, round_q(state.round + 1), nib)
     stamp = pack_stamp_nibbles(nib, cfg.pack_stamp)
     last_learn = bump_last_learn(jnp.any(new_mask), state.round + 1,
@@ -1425,11 +1687,16 @@ def push_round_step(state: GossipState, cfg: GossipConfig,
     # this conformance-mode kernel learns without maintaining the
     # sendable cache — invalidate so a later cached selection can't read
     # a plane that misses these learns
-    return state._replace(known=known, stamp=stamp, last_learn=last_learn,
-                          sendable_round=jnp.asarray(-1, jnp.int32),
-                          last_clamp=jnp.asarray(state.round + 1,
-                                                 jnp.int32),
-                          round=state.round + 1)
+    out = state._replace(known=known, stamp=stamp, last_learn=last_learn,
+                         sendable_round=jnp.asarray(-1, jnp.int32),
+                         last_clamp=jnp.asarray(state.round + 1,
+                                                jnp.int32),
+                         round=state.round + 1)
+    if cfg.stamp_deferred:
+        out = out._replace(overlay=jnp.zeros_like(state.overlay),
+                           last_flush=jnp.asarray(state.round + 1,
+                                                  jnp.int32))
+    return out
 
 
 # -- Lamport-time wrap window ------------------------------------------------

@@ -416,6 +416,12 @@ def _declare_round_body(state: GossipState, cfg: GossipConfig,
         b = state.stamp
         aged_words = nibble_age_pred_words(b & jnp.uint8(0xF), b >> 4,
                                            state.round, sq, ge=True)
+        if cfg.stamp_deferred:
+            # deferred flavor: a learned-since-flush cell's q-age is 0
+            # (< any window) regardless of its stale nibble — the packed
+            # read-through twin of mod_age's overlay amendment, which the
+            # unpacked branch below gets centrally
+            aged_words = aged_words & ~state.overlay
     else:
         aged_words = pack_bits(mod_age(state, cfg) >= sq)
     alive_words = jnp.where(state.alive[:, None],

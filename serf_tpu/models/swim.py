@@ -58,6 +58,7 @@ from serf_tpu.models.vivaldi import (
 from serf_tpu.control.device import (
     KNOB_FANOUT,
     KNOB_PROBE_MULT,
+    KNOB_STAMP_UNIT,
     KNOB_STRETCH_Q,
     ControlConfig,
     ControlSignals,
@@ -205,9 +206,15 @@ def cluster_round(state: ClusterState, cfg: ClusterConfig,
     ctrl = state.control if cfg.control.enabled else None
     eff_fanout = None
     stretch_q = None
+    stamp_unit = None
     if ctrl is not None:
         eff_fanout = ctrl.knobs[KNOB_FANOUT]
         stretch_q = ctrl.knobs[KNOB_STRETCH_Q]
+        if cfg.gossip.stamp_deferred:
+            # live flush cadence: knob stores log2(unit) (control.device)
+            # — only consulted on deferred configs so the per-round
+            # path's jaxpr never reads it
+            stamp_unit = jnp.int32(1) << ctrl.knobs[KNOB_STAMP_UNIT]
         # probe-cadence multiplier: probes (declare + Vivaldi ride the
         # same tick) run every probe_every * probe_mult rounds — always
         # the traced-cond path under control
@@ -229,10 +236,12 @@ def cluster_round(state: ClusterState, cfg: ClusterConfig,
                                schedule=cfg.exchange_schedule,
                                group=state.group, drop_rate=drop_rate,
                                eff_fanout=eff_fanout,
+                               stamp_unit=stamp_unit,
                                collect_propagation=collect_propagation)
     else:
         g = round_step(g, cfg.gossip, k_gossip, group=state.group,
                        drop_rate=drop_rate, eff_fanout=eff_fanout,
+                       stamp_unit=stamp_unit,
                        collect_propagation=collect_propagation)
     if collect_propagation:
         g, prop = g
@@ -486,7 +495,8 @@ def run_cluster_sustained(state: ClusterState, cfg: ClusterConfig,
                 nxt.gossip, row,
                 sentinels if track_cov else None,
                 colcnt if track_cov else None,
-                prev_cov if track_cov else None)
+                prev_cov if track_cov else None,
+                deferred=cfg.gossip.stamp_deferred)
             out = out + (irow,)
             if track_cov:
                 return (nxt, new_prev_cov), out
@@ -722,7 +732,7 @@ def propagation_row(g: GossipState, pair, colcnt, alive_cnt,
 
 
 def invariant_row(g: GossipState, row: jnp.ndarray, sentinels=None,
-                  colcnt=None, prev=None):
+                  colcnt=None, prev=None, deferred: bool = False):
     """Stage-2 of the watchdog's per-round invariant row
     (``serf_tpu.obs.watchdog.INVARIANT_FIELDS`` order — hardcoded stack
     below, exactly the :func:`propagation_row` convention): the
@@ -772,9 +782,24 @@ def invariant_row(g: GossipState, row: jnp.ndarray, sentinels=None,
     else:
         coverage_monotone = jnp.asarray(True)
         new_prev = None
+    if deferred:
+        # deferred stamp flushes (PR-18): pending overlay learns must be
+        # no older than the current stamp quarter — the cohort flush is
+        # due within stamp_flush_unit <= STAMP_UNIT rounds of any learn,
+        # so a pending learn that predates the quarter floor means a
+        # flush was missed and the overlay's age-0 read-through is lying
+        # about a fact that should have aged.  pending compares the
+        # learn/flush watermarks (push_pull backdates last_flush below a
+        # same-round flush, hence >=, never >, on the floor compare).
+        pending = g.last_learn > g.last_flush
+        stamp_staleness_ok = ~pending | (
+            g.last_learn >= ((g.round >> 2) << 2))
+    else:
+        # per-round configs flush every round by definition
+        stamp_staleness_ok = jnp.asarray(True)
     flags = jnp.stack([overflow_ok, ltime_ok, no_false_dead,
-                       coverage_monotone])
-    bits = jnp.asarray([1, 2, 4, 8], jnp.int32)
+                       coverage_monotone, stamp_staleness_ok])
+    bits = jnp.asarray([1, 2, 4, 8, 16], jnp.int32)
     viol_mask = jnp.sum(jnp.where(flags, 0, bits))
     irow = jnp.concatenate([flags.astype(jnp.float32),
                             viol_mask.astype(jnp.float32)[None]])

@@ -56,10 +56,16 @@ from serf_tpu.utils import metrics
 #: - ``coverage_monotone`` — no still-resident sentinel fact's coverage
 #:   regressed (propagation-traced runs; a recycled ring slot
 #:   legitimately reads 0 and is exempt.  Trivially 1.0 untraced);
+#: - ``stamp_staleness_ok`` — deferred-stamp configs only: pending
+#:   overlay learns are never older than the current stamp quarter
+#:   (the cohort flush fires within STAMP_UNIT rounds of any learn —
+#:   a pending learn predating the quarter floor means a missed flush
+#:   and a lying age-0 read-through.  Trivially 1.0 per-round);
 #: - ``viol_mask``        — bitmask of the violated fields above
 #:   (bit i = field i), one scalar a breach scanner can threshold.
 INVARIANT_FIELDS = ("overflow_ok", "ltime_ok", "no_false_dead",
-                    "coverage_monotone", "viol_mask")
+                    "coverage_monotone", "stamp_staleness_ok",
+                    "viol_mask")
 
 #: the row's globalization contract (serflint ``invariant-field-drift``
 #: holds this dict, INVARIANT_FIELDS and the README table to each other
@@ -72,6 +78,7 @@ INVARIANT_MERGE = {
     "ltime_ok": "replicated",
     "no_false_dead": "replicated",
     "coverage_monotone": "replicated",
+    "stamp_staleness_ok": "replicated",
     "viol_mask": "replicated",
 }
 

@@ -18,6 +18,7 @@ name list here to drift.
 
 from serf_tpu.ops.round_kernels import (
     VMEM_BUDGET_BYTES,
+    fused_flush,
     fused_merge,
     fused_ok,
     fused_select_cached,
@@ -28,7 +29,7 @@ from serf_tpu.ops.round_kernels import (
 )
 
 __all__ = [
-    "VMEM_BUDGET_BYTES", "fused_merge", "fused_ok",
+    "VMEM_BUDGET_BYTES", "fused_flush", "fused_merge", "fused_ok",
     "fused_select_cached", "fused_vmem_bytes", "merge_incoming",
     "pallas_ok", "select_packets",
 ]

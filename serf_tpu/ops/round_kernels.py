@@ -97,41 +97,52 @@ def pallas_ok(n: int, k_facts: int) -> bool:
 VMEM_BUDGET_BYTES = 12 << 20
 
 
-def fused_vmem_bytes(block_n: int, k_facts: int, stamp_cols: int) -> int:
+def fused_vmem_bytes(block_n: int, k_facts: int, stamp_cols: int,
+                     deferred: bool = False) -> int:
     """Worst-case VMEM resident set of one fused-merge grid step: the
     known/incoming/known'/sendable' u32 blocks, the stamp block in and
     out, and the alive column — times 2 for the double-buffered DMA
     windows the pipelined grid keeps in flight.  The select kernels'
-    sets are strict subsets, so one estimate gates the family."""
+    sets are strict subsets, so one estimate gates the family.
+    ``deferred`` grows the set by the flush kernel's overlay term (the
+    overlay block streams in beside the stamp block on flush rounds —
+    see :func:`fused_flush`)."""
     w = k_facts // 32
     per_row = 4 * 4 * w + 2 * stamp_cols + 1
+    if deferred:
+        per_row += 4 * w
     return 2 * block_n * per_row
 
 
-def _fused_block(n: int, k_facts: int, stamp_cols: int) -> int:
+def _fused_block(n: int, k_facts: int, stamp_cols: int,
+                 deferred: bool = False) -> int:
     """Largest node block dividing N whose fused working set fits the
     VMEM budget (0 = none does)."""
     if k_facts % 32 != 0:
         return 0
     for b in (512, 256, 128, 64, 32):
-        if n % b == 0 and fused_vmem_bytes(b, k_facts,
-                                           stamp_cols) <= VMEM_BUDGET_BYTES:
+        if n % b == 0 and fused_vmem_bytes(
+                b, k_facts, stamp_cols, deferred) <= VMEM_BUDGET_BYTES:
             return b
     return 0
 
 
-def fused_ok(n: int, k_facts: int, stamp_cols: int) -> Tuple[bool, str]:
+def fused_ok(n: int, k_facts: int, stamp_cols: int,
+             deferred: bool = False) -> Tuple[bool, str]:
     """Can the fused kernel family run on an ``n``-row shard?  Returns
     ``(ok, reason)`` — the reason string is what the loud fallback
     (flight event + ``serf.pallas.fused_fallback`` counter) records, so
     an operator can tell a shape rejection from a VMEM rejection.  On
-    the sharded path callers pass the PER-CHIP row count n/P."""
+    the sharded path callers pass the PER-CHIP row count n/P.
+    ``deferred`` configs gate on the flush kernel's larger working set
+    (overlay term included) so a config that fits per-round but not
+    deferred falls back loudly rather than OOMing at the first flush."""
     if k_facts % 32 != 0:
         return False, f"k_facts {k_facts} not a multiple of 32"
     if _block_for(n) == 0:
         return False, f"no supported node block divides n={n}"
-    if _fused_block(n, k_facts, stamp_cols) == 0:
-        smallest = fused_vmem_bytes(32, k_facts, stamp_cols)
+    if _fused_block(n, k_facts, stamp_cols, deferred) == 0:
+        smallest = fused_vmem_bytes(32, k_facts, stamp_cols, deferred)
         return False, (
             f"VMEM working set {smallest >> 20} MiB at the smallest "
             f"block exceeds the {VMEM_BUDGET_BYTES >> 20} MiB budget "
@@ -589,3 +600,143 @@ def fused_merge(known: jnp.ndarray, incoming: jnp.ndarray,
     if with_cache:
         return out[0], out[1], out[2], out[3]
     return out[0], out[1], None, out[2]
+
+
+# ---------------------------------------------------------------------------
+# deferred-stamp flush (quarter-deferred flavor, PR-18)
+# ---------------------------------------------------------------------------
+
+
+def _make_fused_flush_kernel(packed: bool, k: int, pin: int,
+                             with_cache: bool):
+    """The cohort flush in one streaming stamp pass — the in-kernel twin
+    of ``dissemination.flush_stamp_pass``, sharing its exact arithmetic
+    (clamp at the flush round, overlay cells to the cohort's quarter,
+    fresh learns to the flush round's quarter — new wins over a stale
+    overlay bit — then the cache recompute from the final nibbles).
+
+    There is deliberately NO defer-round kernel: a mid-cohort merge is
+    word-plane ORs only (known/overlay/sendable — no stamp touch), which
+    XLA already fuses bandwidth-optimally; the stamp pass this kernel
+    amortizes IS the pass the deferred flavor removes from those
+    rounds."""
+
+    def kernel(round_ref, prev_ref, limit_ref, known_ref, new_ref,
+               overlay_ref, stamp_ref, *out_refs):
+        if with_cache:
+            stamp_out_ref, send_out_ref = out_refs
+        else:
+            stamp_out_ref, = out_refs
+        known2 = known_ref[:]                      # (B, W) u32, POST-merge
+        new_words = new_ref[:]                     # (B, W) u32 this merge
+        overlay = overlay_ref[:]                   # (B, W) u32 cohort learns
+        rq = round_ref[0, 0]                       # i32, already mod 16
+        rq_prev = prev_ref[0, 0]                   # i32: the cohort quarter
+        limit_q = limit_ref[0, 0]                  # i32
+        if packed:
+            b = stamp_ref[:].astype(jnp.int32)     # (B, C)
+            lo = _clamped(b & 0xF, rq, pin)
+            hi = _clamped((b >> 4) & 0xF, rq, pin)
+            o_lo, o_hi = _learn_pairs(overlay, b.shape[1])
+            lo = jnp.where(o_lo, rq_prev, lo)
+            hi = jnp.where(o_hi, rq_prev, hi)
+            n_lo, n_hi = _learn_pairs(new_words, b.shape[1])
+            nlo = jnp.where(n_lo, rq, lo)
+            nhi = jnp.where(n_hi, rq, hi)
+            stamp_out_ref[:] = (nlo | (nhi << 4)).astype(jnp.uint8)
+            if with_cache:
+                ok_lo = (((rq - nlo) & 0xF) < limit_q).astype(jnp.int32)
+                ok_hi = (((rq - nhi) & 0xF) < limit_q).astype(jnp.int32)
+                send_out_ref[:] = known2 & _weave_pair_words(ok_lo, ok_hi,
+                                                             k)
+        else:
+            nib = _clamped(stamp_ref[:].astype(jnp.int32), rq, pin)
+            nib = jnp.where(_unpack_words(overlay, k), rq_prev, nib)
+            nib2 = jnp.where(_unpack_words(new_words, k), rq, nib)
+            stamp_out_ref[:] = nib2.astype(jnp.uint8)
+            if with_cache:
+                ok = ((rq - nib2) & 0xF) < limit_q
+                send_out_ref[:] = known2 & _pack_bits(ok, k)
+
+    return kernel
+
+
+def fused_flush(known2: jnp.ndarray, new_words: jnp.ndarray,
+                overlay: jnp.ndarray, stamp: jnp.ndarray, next_round,
+                *, limit_q: int, packed: bool, k_facts: int,
+                with_cache: bool, mesh=None):
+    """The deferred flavor's once-per-cohort stamp flush:
+    ``(stamp', sendable'|None)`` in ONE streaming pass over the stamp
+    plane — re-pin wrap-stale stamps at ``next_round``, write every
+    pending overlay cell with the cohort quarter
+    ``round_q(next_round - 1)``, stamp this merge's fresh learns with
+    ``round_q(next_round)``, and (when ``with_cache``) recompute the
+    sendable cache for ``next_round`` from the in-register nibbles.
+    ``known2`` is the POST-merge known plane; the caller owns the
+    word-plane merge (mid-cohort rounds never call this — they are
+    word-plane ORs with no stamp touch at all) and clears the overlay /
+    bumps ``last_flush`` afterwards.
+
+    With ``mesh`` the call runs under shard_map over the node axis, the
+    same per-chip streaming contract as :func:`fused_merge`."""
+    from serf_tpu.models.dissemination import AGE_PIN_Q, round_q
+
+    n, c = stamp.shape
+    k = k_facts
+    w = k // 32
+    round_arr = round_q(next_round).astype(jnp.int32).reshape(1, 1)
+    prev_arr = round_q(
+        jnp.asarray(next_round, jnp.int32) - 1).astype(jnp.int32).reshape(
+            1, 1)
+    limit_arr = jnp.asarray(limit_q, jnp.int32).reshape(1, 1)
+
+    def call(round_arr, prev_arr, limit_arr, known2, new_words, overlay,
+             stamp):
+        nl = stamp.shape[0]
+        block = _fused_block(nl, k, c, deferred=True)
+        grid = (nl // block,)
+        out_specs = [
+            pl.BlockSpec((block, c), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((nl, c), jnp.uint8),
+        ]
+        if with_cache:
+            out_specs.append(pl.BlockSpec((block, w), lambda i: (i, 0),
+                                          memory_space=pltpu.VMEM))
+            out_shape.append(jax.ShapeDtypeStruct((nl, w), jnp.uint32))
+        return pl.pallas_call(
+            _make_fused_flush_kernel(packed, k, AGE_PIN_Q, with_cache),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1), lambda i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, 1), lambda i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, 1), lambda i: (0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((block, w), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((block, w), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((block, w), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((block, c), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=out_specs if with_cache else out_specs[0],
+            out_shape=out_shape if with_cache else out_shape[0],
+            interpret=_interpret(),
+        )(round_arr, prev_arr, limit_arr, known2, new_words, overlay,
+          stamp)
+
+    with dispatch_timer("ops.fused_flush",
+                        signature=(n, k, packed, with_cache)):
+        out = _maybe_shard(call, mesh, n_arrays=4, n_scalars=3,
+                           n_out=2 if with_cache else 1)(
+            round_arr, prev_arr, limit_arr, known2, new_words, overlay,
+            stamp)
+    if with_cache:
+        return out[0], out[1]
+    return out, None

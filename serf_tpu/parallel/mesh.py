@@ -82,7 +82,11 @@ def _path_names(path) -> list:
 def _spec_for(path, arr) -> P:
     """Per-node arrays shard on their first (N) axis; facts, ring-slot
     planes, scalars, and query-slot metadata are replicated; query [Q, N]
-    planes and fault-schedule [P, N] masks shard on their second axis."""
+    planes and fault-schedule [P, N] masks shard on their second axis.
+    New N-leading leaves need no registration: the deferred-stamp
+    ``overlay`` (u32[N, W]) lands on ``P(NODE_AXIS)`` through the default
+    rule and its ``last_flush`` scalar replicates, exactly like the
+    stamp plane and ``last_clamp`` they amend."""
     if not hasattr(arr, "ndim") or arr.ndim == 0:
         # python scalars (static per-phase round counts) and 0-d arrays
         return P()

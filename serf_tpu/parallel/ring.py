@@ -417,7 +417,7 @@ def round_telemetry_sharded(state, cfg, mesh, with_cols: bool = False):
 def sharded_round_step(state: GossipState, cfg: GossipConfig,
                        key: jax.Array, mesh, schedule: str = "ring",
                        group=None, drop_rate=None,
-                       eff_fanout=None,
+                       eff_fanout=None, stamp_unit=None,
                        collect_propagation: bool = False):
     """One gossip round with the explicit sharded exchange — bit-exact
     with ``round_step(state, cfg, key, group, drop_rate)`` by
@@ -443,4 +443,5 @@ def sharded_round_step(state: GossipState, cfg: GossipConfig,
                                                  mesh=mesh,
                                                  schedule=schedule),
                       mesh=mesh, eff_fanout=eff_fanout,
+                      stamp_unit=stamp_unit,
                       collect_propagation=collect_propagation)

@@ -167,7 +167,7 @@ def test_controller_off_never_reads_the_control_leaf():
     key = jax.random.key(3)
     st = make_cluster(cfg, key)
     mangled = st._replace(control=st.control._replace(
-        knobs=jnp.asarray([4, 7, 8, 1], jnp.int32),
+        knobs=jnp.asarray([4, 7, 8, 1, 2], jnp.int32),
         inject_tokens=jnp.asarray(0, jnp.int32),
         shed=jnp.asarray(999, jnp.uint32)))
     fin_a = run_cluster_sustained(st, cfg, key, 8, events_per_round=2)

@@ -130,3 +130,34 @@ def test_roundprof_fused_attribution_and_removed_pass():
                                         path="kernels").entries
         if e.phase == "selection")
     assert sel["model_bytes"] < phased_sel
+
+
+def test_roundprof_stamp_unit_ab_removed_pass_and_attribution(capsys):
+    """ISSUE 18 tier-1 smoke: the ``--stamp-unit`` A/B profiles both
+    flavors with >=90% byte attribution (the deferral must REMOVE the
+    per-round stamp pass, not hide bytes), the deferred leg streams the
+    stamp plane strictly fewer times, prices overlay passes, and the
+    modeled amortized bytes drop."""
+    import tools.roundprof as roundprof
+
+    rc = roundprof.main(["--n", "512", "--k", "32", "--calls", "1",
+                         "--warm", "4", "--stamp-unit", "4", "--json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    delta = out["delta"]
+    assert delta["stamp_passes_removed"] > 0
+    assert out["deferred"]["full_plane_passes"]["stamp"] \
+        < out["per_round"]["full_plane_passes"]["stamp"]
+    assert delta["overlay_passes_added"] > 0
+    assert delta["model_bytes"]["deferred"] \
+        < delta["model_bytes"]["per_round"]
+    for leg in ("deferred", "per_round"):
+        frac = delta["attributed_bytes_frac"][leg]
+        assert frac is not None and frac >= 0.9, (leg, frac)
+
+
+def test_roundprof_stamp_unit_rejects_kernel_and_mesh_crosses(capsys):
+    import tools.roundprof as roundprof
+
+    assert roundprof.main(["--stamp-unit", "4", "--fused"]) == 2
+    assert roundprof.main(["--stamp-unit", "4", "--mesh", "2"]) == 2

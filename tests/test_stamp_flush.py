@@ -1,0 +1,523 @@
+"""Quarter-deferred stamp flushes (ISSUE 18): the semantics contract.
+
+- Derived views (known plane, coverage, detection outcomes, the
+  selection predicate) are bit-exact vs the per-round flavor EVERY
+  round; the packed stamp plane itself is bit-exact at flush
+  boundaries (overlay drained) — for both stamp flavors and, sharded,
+  both ICI schedules (heavy crosses ride ``-m slow``).
+- ``stamp_flush_unit=1`` is the inert default: the overlay/last_flush
+  leaves are never read (mangling them changes no other leaf).
+- Wrap/clamp edges: a cohort crossing the mod-16 quarter wrap and a
+  cohort whose flush carries the standalone clamp stay view-exact.
+- A mid-cohort checkpoint (overlay pending) restores bit-exactly and
+  the continued run matches the uninterrupted one.
+- STAMP_UNIT as a live knob: the control law actuates both directions
+  within its clamps, and a traced mid-run cadence change keeps the
+  views bit-exact.
+- The watchdog's ``stamp_staleness_ok`` invariant is green on a
+  deferred sustained run.
+- The ``fused_flush`` kernel is leaf-exact with ``flush_stamp_pass``
+  (interpret mode); the standalone kernel family refuses deferred
+  configs loudly at dispatch.
+- The byte model: deferred @1M breaks the round-8 217 MB floor
+  (flush + overlay decomposition pinned; per-round unchanged).
+
+Budget discipline: everything is small-N; redundant flavor crosses
+ride ``-m slow``.
+"""
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from serf_tpu.models.dissemination import (
+    GossipConfig,
+    K_USER_EVENT,
+    STAMP_UNIT,
+    coverage,
+    flush_stamp_pass,
+    inject_fact,
+    make_state,
+    mod_age,
+    pallas_dispatch_mode,
+    round_q,
+    round_step,
+    select_words,
+    stamp_nibbles,
+    unpack_bits,
+)
+from serf_tpu.models.failure import FailureConfig, believed_dead
+from serf_tpu.models.swim import (
+    ClusterConfig,
+    cluster_round,
+    make_cluster,
+    run_cluster_sustained,
+)
+
+
+def _cfg(n=96, pack=True, unit=4, cache=True, schedule="ring"):
+    return ClusterConfig(
+        gossip=GossipConfig(n=n, k_facts=32, peer_sampling="rotation",
+                            pack_stamp=pack, stamp_flush_unit=unit,
+                            use_sendable_cache=cache),
+        failure=FailureConfig(suspicion_rounds=8, max_new_facts=8,
+                              probe_schedule="round_robin"),
+        push_pull_every=8, probe_every=2, exchange_schedule=schedule)
+
+
+def _seeded(cfg):
+    st = make_cluster(cfg, jax.random.key(0))
+    g = inject_fact(st.gossip, cfg.gossip, subject=3, kind=K_USER_EVENT,
+                    incarnation=0, ltime=5, origin=0)
+    # two silent crashes so detection outcomes are part of the parity
+    g = g._replace(alive=g.alive.at[jnp.asarray([7, cfg.gossip.n // 2])]
+                   .set(False))
+    return st._replace(gossip=g)
+
+
+def _assert_views_equal(gd, gp, gcfg_d, gcfg_p, fcfg, ctx=""):
+    """The derived-view oracle: everything a protocol consumer can
+    observe must match between the deferred and per-round states."""
+    for name in ("known", "alive", "tombstone", "round", "incarnation",
+                 "next_slot", "overflow", "injected", "last_learn"):
+        assert bool(jnp.all(getattr(gd, name) == getattr(gp, name))), \
+            f"{name} diverged {ctx}"
+    assert bool(jnp.all(select_words(gd, gcfg_d)
+                        == select_words(gp, gcfg_p))), \
+        f"selection predicate diverged {ctx}"
+    assert bool(jnp.all(coverage(gd, gcfg_d) == coverage(gp, gcfg_p))), \
+        f"coverage diverged {ctx}"
+    assert bool(jnp.all(believed_dead(gd, gcfg_d, fcfg)
+                        == believed_dead(gp, gcfg_p, fcfg))), \
+        f"believed_dead diverged {ctx}"
+
+
+def _assert_stamps_equal_where_known(gd, gp, gcfg):
+    k = gcfg.k_facts
+    kb = unpack_bits(gd.known, k)
+    nd = stamp_nibbles(gd.stamp, k, gcfg.pack_stamp)
+    np_ = stamp_nibbles(gp.stamp, k, gcfg.pack_stamp)
+    assert bool(jnp.all(jnp.where(kb, nd == np_, True)))
+
+
+# ---------------------------------------------------------------------------
+# cluster-level lockstep: views exact every round, stamps at boundaries
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("pack,unit", [
+    (True, 4),
+    (False, 4),
+    pytest.param(True, 2, marks=pytest.mark.slow),
+    pytest.param(False, 2, marks=pytest.mark.slow),
+])
+def test_deferred_cluster_views_bit_exact(pack, unit):
+    """Full protocol rounds (gossip + probes + declare + push/pull +
+    Vivaldi) in lockstep, same keys, mid-run injections: every derived
+    view matches the per-round flavor every round; at cohort boundaries
+    the overlay is drained and the packed stamp plane agrees wherever a
+    fact is known."""
+    cfg_d = _cfg(pack=pack, unit=unit)
+    cfg_p = _cfg(pack=pack, unit=1)
+    step_d = jax.jit(functools.partial(cluster_round, cfg=cfg_d))
+    step_p = jax.jit(functools.partial(cluster_round, cfg=cfg_p))
+    sd, sp = _seeded(cfg_d), _seeded(cfg_p)
+    for r in range(16):
+        if r in (3, 9):       # mid-cohort injections (slot recycling)
+            sd = sd._replace(gossip=inject_fact(
+                sd.gossip, cfg_d.gossip, subject=5 + r,
+                kind=K_USER_EVENT, incarnation=0, ltime=9 + r, origin=1))
+            sp = sp._replace(gossip=inject_fact(
+                sp.gossip, cfg_p.gossip, subject=5 + r,
+                kind=K_USER_EVENT, incarnation=0, ltime=9 + r, origin=1))
+        key = jax.random.key(100 + r)
+        sd, sp = step_d(sd, key=key), step_p(sp, key=key)
+        _assert_views_equal(sd.gossip, sp.gossip, cfg_d.gossip,
+                            cfg_p.gossip, cfg_d.failure,
+                            ctx=f"round {r + 1}")
+        if int(sd.gossip.round) % unit == 0:  # flush boundary
+            assert not bool(jnp.any(sd.gossip.overlay)), \
+                f"overlay not drained at boundary round {r + 1}"
+            _assert_stamps_equal_where_known(sd.gossip, sp.gossip,
+                                             cfg_d.gossip)
+        assert int(sd.gossip.round) - int(sd.gossip.last_flush) < unit \
+            or not bool(jnp.any(sd.gossip.overlay))
+
+
+@pytest.mark.parametrize("schedule", [
+    "ring",
+    pytest.param("allgather", marks=pytest.mark.slow),
+])
+def test_deferred_sharded_bit_exact(vmesh8, schedule):
+    """The deferred flavor under the 8-virtual-device sharded flagship
+    round: every GossipState leaf — overlay and last_flush included —
+    matches the single-device deferred run."""
+    from serf_tpu.parallel.mesh import shard_state
+
+    cfg = _cfg(n=128, unit=4, schedule=schedule)
+    st = _seeded(cfg)
+    key = jax.random.key(2)
+    fin1 = run_cluster_sustained(st, cfg, key, 12, events_per_round=2)
+    fin8 = run_cluster_sustained(shard_state(st, vmesh8), cfg, key, 12,
+                                 events_per_round=2, mesh=vmesh8)
+    for (path, a), b in zip(
+            jax.tree_util.tree_leaves_with_path(fin1.gossip),
+            jax.tree_util.tree_leaves(fin8.gossip)):
+        assert bool(jnp.all(a == b)), jax.tree_util.keystr(path)
+
+
+def test_unit1_never_reads_the_deferred_leaves():
+    """stamp_flush_unit=1 IS the per-round path: mangling the overlay
+    and last_flush leaves changes no other GossipState leaf — the
+    default config's round never reads them (the leaf-for-leaf identity
+    with the pre-deferral behavior)."""
+    cfg = _cfg(unit=1)
+    key = jax.random.key(3)
+    st = _seeded(cfg)
+    mangled = st._replace(gossip=st.gossip._replace(
+        overlay=jnp.full_like(st.gossip.overlay, 0xDEADBEEF),
+        last_flush=jnp.asarray(-123, jnp.int32)))
+    fin_a = run_cluster_sustained(st, cfg, key, 8, events_per_round=2)
+    fin_b = run_cluster_sustained(mangled, cfg, key, 8,
+                                  events_per_round=2)
+    for (path, a), b in zip(
+            jax.tree_util.tree_leaves_with_path(fin_a.gossip),
+            jax.tree_util.tree_leaves(fin_b.gossip)):
+        name = jax.tree_util.keystr(path)
+        if "overlay" in name or "last_flush" in name:
+            continue                      # the mangled leaves ride through
+        assert bool(jnp.all(a == b)), name
+    # and they DO ride through untouched (nothing wrote them either)
+    assert int(fin_b.gossip.last_flush) == -123
+
+
+# ---------------------------------------------------------------------------
+# wrap/clamp edges (gossip-level lockstep across the mod-16 wrap)
+# ---------------------------------------------------------------------------
+
+
+def test_deferred_views_exact_across_quarter_wrap():
+    """A cohort sequence crossing the 64-round stamp wrap (and riding
+    the flush-pass clamp): views stay exact while old facts age past
+    AGE_PIN_Q and get re-pinned by differently-timed clamp passes."""
+    gcfg_d = GossipConfig(n=64, k_facts=32, peer_sampling="rotation",
+                          stamp_flush_unit=4)
+    gcfg_p = dataclasses.replace(gcfg_d, stamp_flush_unit=1)
+    fcfg = FailureConfig(suspicion_rounds=8, max_new_facts=8,
+                         probe_schedule="round_robin")
+    base = make_state(gcfg_d)
+    # a fact learned by everyone long ago (stamped in quarter 0), with
+    # the round cursor about to cross the wrap: ages pin at AGE_PIN_Q
+    g = inject_fact(base, gcfg_d, subject=3, kind=K_USER_EVENT,
+                    incarnation=0, ltime=5, origin=0)
+    start = 56
+    g = g._replace(round=jnp.asarray(start, jnp.int32),
+                   last_clamp=jnp.asarray(start, jnp.int32),
+                   last_flush=jnp.asarray(start, jnp.int32),
+                   last_learn=jnp.asarray(start, jnp.int32),
+                   sendable_round=jnp.asarray(-1, jnp.int32))
+    step_d = jax.jit(functools.partial(round_step, cfg=gcfg_d))
+    step_p = jax.jit(functools.partial(round_step, cfg=gcfg_p))
+    gd, gp = g, g
+    for r in range(16):                   # 56 -> 72, across the wrap
+        if r == 2:                        # fresh mid-cohort learn
+            gd = inject_fact(gd, gcfg_d, subject=9, kind=K_USER_EVENT,
+                             incarnation=0, ltime=7, origin=1)
+            gp = inject_fact(gp, gcfg_p, subject=9, kind=K_USER_EVENT,
+                             incarnation=0, ltime=7, origin=1)
+        key = jax.random.key(200 + r)
+        gd, gp = step_d(gd, key=key), step_p(gp, key=key)
+        kb = unpack_bits(gd.known, 32)
+        # the protocol-effective age: every threshold lives at or under
+        # AGE_PIN_Q, so ages are equivalent once both sides saturate —
+        # RAW nibbles legitimately differ mid-cohort for wrap-stale
+        # cells (the per-round clamp rides every learn pass, the
+        # deferred clamp rides the flush; the bound is what matters)
+        aged = jnp.minimum(mod_age(gd, gcfg_d), 8)
+        agep = jnp.minimum(mod_age(gp, gcfg_p), 8)
+        assert bool(jnp.all(jnp.where(kb, aged == agep, True))), \
+            f"effective mod_age diverged at round {56 + r + 1}"
+        assert bool(jnp.all(gd.known == gp.known))
+        assert bool(jnp.all(select_words(gd, gcfg_d)
+                            == select_words(gp, gcfg_p)))
+        assert bool(jnp.all(coverage(gd, gcfg_d)
+                            == coverage(gp, gcfg_p)))
+
+
+def test_flush_pass_overlay_new_and_clamp_edges():
+    """flush_stamp_pass cell semantics, both stamp flavors: pending
+    overlay cells get the COHORT quarter round_q(next-1), this merge's
+    fresh learns get round_q(next) and WIN over a stale surviving
+    overlay bit, wrap-stale cells are re-pinned by the riding clamp."""
+    for pack in (True, False):
+        gcfg = GossipConfig(n=8, k_facts=32, peer_sampling="rotation",
+                            stamp_flush_unit=4, pack_stamp=pack)
+        st = make_state(gcfg)
+        nxt = 68                               # boundary; quarter 17&0xF=1
+        rq, rq_prev = int(round_q(nxt)), int(round_q(nxt - 1))
+        assert rq != rq_prev                   # cohort ends ON a quarter
+        nib = jnp.zeros((8, 32), jnp.uint8)
+        # fact 0: stamped 9 quarters ago (wrap-stale, must re-pin)
+        nib = nib.at[:, 0].set((rq - 9) & 0xF)
+        stamp = nib if not pack else (
+            nib[:, 0::2] | (nib[:, 1::2] << 4))
+        overlay = jnp.zeros_like(st.overlay)
+        overlay = overlay.at[:, 0].set(jnp.uint32(0b0110))  # facts 1, 2
+        new = jnp.zeros_like(st.overlay)
+        new = new.at[:, 0].set(jnp.uint32(0b0100))          # fact 2 again
+        known = jnp.full_like(st.known, jnp.uint32(0b0111))
+        stamp2, _, sr2 = flush_stamp_pass(
+            stamp, known, new, overlay, jnp.asarray(nxt, jnp.int32),
+            gcfg, st.sendable)
+        out = stamp_nibbles(stamp2, 32, pack)
+        assert int(sr2) == nxt                 # cache valid for `nxt`
+        # pending overlay cell -> the cohort quarter
+        assert bool(jnp.all(out[:, 1] == rq_prev))
+        # fresh learn wins over the overlay bit
+        assert bool(jnp.all(out[:, 2] == rq))
+        # wrap-stale cell re-pinned: derived q-age is AGE_PIN_Q, not 9
+        age0 = (rq - out[:, 0].astype(jnp.int32)) & 0xF
+        assert bool(jnp.all(age0 == 8))
+
+
+# ---------------------------------------------------------------------------
+# mid-cohort checkpoint
+# ---------------------------------------------------------------------------
+
+
+def test_mid_cohort_checkpoint_restart_bit_exact(tmp_path):
+    """Save at a mid-cohort round with a NONEMPTY overlay, restore into
+    a fresh template, continue — every leaf matches the uninterrupted
+    run (the overlay and last_flush round-trip; the next boundary flush
+    retires the restored pending learns exactly)."""
+    from serf_tpu.models import checkpoint
+
+    cfg = _cfg(n=64, unit=4)
+    st = _seeded(cfg)
+    key = jax.random.key(4)
+    mid = run_cluster_sustained(st, cfg, key, 6, events_per_round=2)
+    assert int(mid.gossip.round) % 4 != 0      # genuinely mid-cohort
+    assert bool(jnp.any(mid.gossip.overlay)), \
+        "sustained load must leave pending overlay learns mid-cohort"
+    path = str(tmp_path / "mid_cohort.ckpt")
+    checkpoint.save(path, mid)
+    restored = checkpoint.restore(path, make_cluster(cfg,
+                                                     jax.random.key(9)))
+    for (p, a), b in zip(jax.tree_util.tree_leaves_with_path(mid),
+                         jax.tree_util.tree_leaves(restored)):
+        assert bool(jnp.all(a == b)), jax.tree_util.keystr(p)
+    key2 = jax.random.key(5)
+    fin_a = run_cluster_sustained(mid, cfg, key2, 6, events_per_round=2)
+    fin_b = run_cluster_sustained(restored, cfg, key2, 6,
+                                  events_per_round=2)
+    for (p, a), b in zip(jax.tree_util.tree_leaves_with_path(fin_a),
+                         jax.tree_util.tree_leaves(fin_b)):
+        assert bool(jnp.all(a == b)), jax.tree_util.keystr(p)
+
+
+# ---------------------------------------------------------------------------
+# STAMP_UNIT as a live controller knob
+# ---------------------------------------------------------------------------
+
+
+def test_stamp_unit_law_actuates_both_directions():
+    """The control law (control/device.py): sustained overflow pressure
+    defers harder (log2 knob up to 2 = unit 4); sustained low agreement
+    walks it back down, stopping at the configured base — never below."""
+    from serf_tpu.control.device import (ControlConfig, ControlSignals,
+                                         KNOB_FIELDS, control_step,
+                                         knob_bounds, make_control)
+
+    su = KNOB_FIELDS.index("stamp_unit")
+    ccfg = ControlConfig(enabled=True, hyst_up=1, hyst_down=1)
+    gcfg = GossipConfig(n=64, k_facts=32, peer_sampling="rotation",
+                        stamp_flush_unit=2)
+    fcfg = FailureConfig(suspicion_rounds=8, max_new_facts=8,
+                         probe_schedule="round_robin")
+    base, lo, hi, step = knob_bounds(ccfg, gcfg, fcfg)
+    assert (base[su], lo[su], hi[su], step[su]) == (1, 0, 2, 1)
+
+    def drive(ctl, sigs):
+        rows = []
+        for s in sigs:
+            ctl = control_step(ctl, s, ccfg, gcfg, fcfg)
+            rows.append(int(ctl.knobs[su]))
+        return ctl, rows
+
+    ctl = make_control(ccfg, gcfg, fcfg)
+    # overflow burn (ledger growing 8/round): defer harder, clamp at 2
+    ctl, up = drive(ctl, [ControlSignals(agreement=jnp.float32(1.0),
+                                         false_dead=jnp.float32(0.0),
+                                         overflow=jnp.float32(8.0 * (i + 1)))
+                          for i in range(8)])
+    assert max(up) == 2 and up[-1] == 2
+    # convergence burning (ledger frozen — the overflow EWMA needs
+    # ~16 rounds to decay under overflow_hi before the agreement leg
+    # of the law can win): flush sooner, stop at base
+    ctl, down = drive(ctl, [ControlSignals(agreement=jnp.float32(0.5),
+                                           false_dead=jnp.float32(0.0),
+                                           overflow=jnp.float32(64.0))
+                            ] * 30)
+    assert down[-1] == int(base[su])
+    assert min(down) >= int(base[su])      # the relax never crosses base
+    # a per-round base pins the knob: no headroom in either direction
+    g1 = dataclasses.replace(gcfg, stamp_flush_unit=1)
+    b1, l1, h1, _ = knob_bounds(ccfg, g1, fcfg)
+    assert (b1[su], l1[su], h1[su]) == (0, 0, 0)
+
+
+def test_traced_stamp_unit_change_mid_run_stays_view_exact():
+    """round_step with a TRACED stamp_unit (the controller's live
+    cadence): switching 4 -> 2 -> 4 mid-run — without retracing — keeps
+    every derived view bit-exact vs the per-round reference."""
+    gcfg_d = GossipConfig(n=64, k_facts=32, peer_sampling="rotation",
+                          stamp_flush_unit=2)
+    gcfg_p = dataclasses.replace(gcfg_d, stamp_flush_unit=1)
+    g0 = inject_fact(make_state(gcfg_d), gcfg_d, subject=3,
+                     kind=K_USER_EVENT, incarnation=0, ltime=5, origin=0)
+    step_d = jax.jit(functools.partial(round_step, cfg=gcfg_d))
+    step_p = jax.jit(functools.partial(round_step, cfg=gcfg_p))
+    units = [4, 4, 4, 2, 2, 4, 2, 4, 4, 2, 2, 2]
+    gd, gp = g0, g0
+    n_traces = 0
+    for r, u in enumerate(units):
+        if r == 4:
+            gd = inject_fact(gd, gcfg_d, subject=9, kind=K_USER_EVENT,
+                             incarnation=0, ltime=8, origin=2)
+            gp = inject_fact(gp, gcfg_p, subject=9, kind=K_USER_EVENT,
+                             incarnation=0, ltime=8, origin=2)
+        key = jax.random.key(300 + r)
+        gd = step_d(gd, key=key, stamp_unit=jnp.asarray(u, jnp.int32))
+        gp = step_p(gp, key=key)
+        kb = unpack_bits(gd.known, 32)
+        assert bool(jnp.all(gd.known == gp.known)), f"round {r}"
+        assert bool(jnp.all(jnp.where(
+            kb, mod_age(gd, gcfg_d) == mod_age(gp, gcfg_p), True)))
+        assert bool(jnp.all(select_words(gd, gcfg_d)
+                            == select_words(gp, gcfg_p)))
+    n_traces = step_d._cache_size()
+    assert n_traces == 1, "a traced unit must not retrace per value"
+
+
+# ---------------------------------------------------------------------------
+# watchdog: the staleness invariant rides the deferred run green
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_staleness_invariant_green_on_deferred_run():
+    from serf_tpu.obs.watchdog import INVARIANT_FIELDS
+
+    idx = INVARIANT_FIELDS.index("stamp_staleness_ok")
+    cfg = _cfg(n=64, unit=4)
+    st = _seeded(cfg)
+    _, irows = run_cluster_sustained(st, cfg, jax.random.key(6), 12,
+                                     events_per_round=2,
+                                     collect_invariants=True)
+    irows = np.asarray(irows)
+    assert irows.shape == (12, len(INVARIANT_FIELDS))
+    assert (irows[:, idx] == 1.0).all()
+    assert (irows[:, INVARIANT_FIELDS.index("viol_mask")] == 0.0).all()
+
+
+# ---------------------------------------------------------------------------
+# kernel family: fused_flush parity; standalone kernels refuse deferred
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("pack", [
+    True,
+    pytest.param(False, marks=pytest.mark.slow),
+])
+def test_fused_flush_leaf_exact_with_xla_deferred(pack):
+    """The fused family on a deferred config (interpret mode): every
+    GossipState leaf matches the XLA deferred reference after every
+    round — the flush kernel lands the same nibbles, cache, and
+    overlay clear under the same do_flush cond."""
+    gcfg = GossipConfig(n=128, k_facts=32, peer_sampling="rotation",
+                        stamp_flush_unit=4, pack_stamp=pack)
+    fast = dataclasses.replace(gcfg, use_pallas=True, fused_kernels=True)
+    assert pallas_dispatch_mode(fast) == ("fused", "")
+    g0 = inject_fact(make_state(gcfg), gcfg, subject=3,
+                     kind=K_USER_EVENT, incarnation=0, ltime=5, origin=0)
+    step_a = jax.jit(functools.partial(round_step, cfg=gcfg))
+    step_b = jax.jit(functools.partial(round_step, cfg=fast))
+    a, b = g0, g0
+    for r in range(6):
+        if r == 2:
+            a = inject_fact(a, gcfg, subject=9, kind=K_USER_EVENT,
+                            incarnation=0, ltime=8, origin=2)
+            b = inject_fact(b, fast, subject=9, kind=K_USER_EVENT,
+                            incarnation=0, ltime=8, origin=2)
+        key = jax.random.key(400 + r)
+        a, b = step_a(a, key=key), step_b(b, key=key)
+        for (path, la), lb in zip(jax.tree_util.tree_leaves_with_path(a),
+                                  jax.tree_util.tree_leaves(b)):
+            assert bool(jnp.all(la == lb)), (
+                f"leaf {jax.tree_util.keystr(path)} diverged round {r}")
+
+
+def test_standalone_kernels_refuse_deferred_configs():
+    deferred = GossipConfig(n=128, k_facts=32, peer_sampling="rotation",
+                            stamp_flush_unit=4, use_pallas=True,
+                            fused_kernels=False)
+    mode, reason = pallas_dispatch_mode(deferred)
+    assert mode == "" and "overlay" in reason
+    # same shape, per-round: the standalone family still dispatches
+    per_round = dataclasses.replace(deferred, stamp_flush_unit=1)
+    assert pallas_dispatch_mode(per_round) == ("kernels", "")
+
+
+def test_bad_flush_unit_rejected():
+    for bad in (3, 8, 0):
+        with pytest.raises(ValueError, match="stamp_flush_unit"):
+            GossipConfig(n=64, k_facts=32, peer_sampling="rotation",
+                         stamp_flush_unit=bad)
+
+
+# ---------------------------------------------------------------------------
+# the byte model: the 217 floor breaks, decomposition pinned
+# ---------------------------------------------------------------------------
+
+
+def test_deferred_byte_model_breaks_the_floor():
+    """The STATUS round-9 re-pin: deferred @1M unit 4 under 180 MB/round
+    (xla) vs the unchanged 233.4 per-round model — with the flush +
+    overlay entries present and the overlay plane priced."""
+    from serf_tpu.models.accounting import round_traffic
+    from serf_tpu.models.swim import flagship_config
+
+    cfg = flagship_config(1_000_000)
+    per_round = round_traffic(cfg, sustained_rate=2)
+    assert per_round.total_bytes == pytest.approx(233.3875e6, rel=1e-3)
+    deferred = round_traffic(cfg, sustained_rate=2, stamp_deferred=True)
+    assert deferred.total_bytes <= 180e6           # the floor is broken
+    assert deferred.total_bytes >= 170e6           # and honestly priced
+    dcfg = dataclasses.replace(
+        cfg, gossip=dataclasses.replace(cfg.gossip, stamp_flush_unit=2))
+    half = round_traffic(dcfg, sustained_rate=2)
+    assert deferred.total_bytes < half.total_bytes < per_round.total_bytes
+    # the decomposition: per-cohort flush (stamp RW at 1/unit) + the
+    # overlay fold, and the overlay plane shows up in the plane sizes
+    merge_planes = {(e.plane, e.rw): e for e in deferred.entries
+                    if e.phase == "merge"}
+    flush = merge_planes[("stamp", "RW")]
+    assert flush.cadence == pytest.approx(1.0 / STAMP_UNIT)
+    assert "flush" in flush.where
+    fold = merge_planes[("overlay", "RW")]
+    assert fold.cadence == pytest.approx(1.0 / STAMP_UNIT)
+    assert deferred.plane_sizes["overlay"] \
+        == deferred.plane_sizes["known"]
+    assert "overlay" not in per_round.plane_sizes
+    assert not any(e.plane == "overlay" for e in per_round.entries)
+    # fused flush kernel stays within a pass of the XLA model; the
+    # standalone family is priced (dispatch refuses it anyway)
+    fused = round_traffic(cfg, sustained_rate=2, path="fused",
+                          stamp_deferred=True)
+    assert fused.total_bytes <= 181e6
+    kernels = round_traffic(cfg, sustained_rate=2, path="kernels",
+                            stamp_deferred=True)
+    assert kernels.total_bytes > fused.total_bytes

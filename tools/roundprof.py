@@ -57,6 +57,13 @@ def main(argv=None) -> int:
                          "the phased kernels cannot shard, so --mesh is "
                          "rejected); --json emits "
                          "{'fused': ..., 'phased': ..., 'delta': ...}")
+    ap.add_argument("--stamp-unit", type=int, default=0,
+                    help="profile quarter-deferred stamp flushes at this "
+                         "unit (2 or 4) against the per-round flavor, "
+                         "same config/seeds, and print the removed "
+                         "stamp-pass delta (0 = off); --json emits "
+                         "{'deferred': ..., 'per_round': ..., 'delta': "
+                         "...}")
     ap.add_argument("--json", action="store_true",
                     help="print the JSON contract on stdout")
     args = ap.parse_args(argv)
@@ -64,6 +71,12 @@ def main(argv=None) -> int:
     from serf_tpu.models.swim import flagship_config
     from serf_tpu.obs.profile import profile_round, profile_table
 
+    if args.stamp_unit:
+        if args.fused or args.mesh:
+            sys.stderr.write("--stamp-unit is a single-device XLA-path "
+                             "A/B; drop --fused/--mesh\n")
+            return 2
+        return _stamp_ab(args)
     if args.fused:
         if args.mesh:
             # the phased (standalone-kernel) side of the A/B is
@@ -162,6 +175,64 @@ def _fused_ab(args) -> int:
     if args.json:
         print(json.dumps({"fused": profs["fused"],
                           "phased": profs["phased"], "delta": delta}))
+    return 0
+
+
+def _stamp_ab(args) -> int:
+    """``--stamp-unit U``: quarter-deferred stamp flushes vs the
+    per-round flavor, same config/seeds — the observational side of
+    ``accounting.round_traffic(stamp_deferred=)`` (the per-learn-round
+    stamp R+W becomes a once-per-cohort flush plus the overlay ride;
+    ISSUE 18)."""
+    import dataclasses
+
+    from serf_tpu.models.swim import flagship_config
+    from serf_tpu.obs.profile import profile_round, profile_table
+
+    base = flagship_config(args.n, k_facts=args.k)
+    profs = {}
+    for name, unit in (("per_round", 1), ("deferred", args.stamp_unit)):
+        cfg = dataclasses.replace(
+            base, gossip=dataclasses.replace(base.gossip,
+                                             stamp_flush_unit=unit))
+        profs[name] = profile_round(cfg, events_per_round=args.events,
+                                    timed_calls=args.calls,
+                                    warm_rounds=args.warm)
+        sys.stderr.write(profile_table(profs[name]) + "\n\n")
+    dp = profs["deferred"]["full_plane_passes"]
+    pp = profs["per_round"]["full_plane_passes"]
+    planes = sorted(set(dp) | set(pp))
+    delta = {
+        "stamp_passes_removed": round(pp.get("stamp", 0.0)
+                                      - dp.get("stamp", 0.0), 3),
+        "overlay_passes_added": round(dp.get("overlay", 0.0), 3),
+        "passes": {p: {"per_round": pp.get(p, 0.0),
+                       "deferred": dp.get(p, 0.0)} for p in planes},
+        "model_bytes": {
+            name: profs[name]["whole_round"]["model_amortized_bytes"]
+            for name in profs},
+        "wall_ms": {name: round(sum(r["wall_ms"]
+                                    for r in profs[name]["phases"]), 3)
+                    for name in profs},
+        "attributed_bytes_frac": {
+            name: profs[name]["attributed_bytes_frac"] for name in profs},
+    }
+    sys.stderr.write(
+        "deferred (unit %d) vs per-round stamps @n=%d: stamp-plane "
+        "passes %.2f -> %.2f (%.2f full-plane pass(es)/round removed — "
+        "the per-learn-round stamp R+W now flushes once per cohort; "
+        "+%.2f overlay pass(es)); modeled %.1f -> %.1f MB/round; "
+        "phase wall %s -> %s ms\n" % (
+            args.stamp_unit, args.n, pp.get("stamp", 0.0),
+            dp.get("stamp", 0.0), delta["stamp_passes_removed"],
+            delta["overlay_passes_added"],
+            delta["model_bytes"]["per_round"] / 1e6,
+            delta["model_bytes"]["deferred"] / 1e6,
+            delta["wall_ms"]["per_round"], delta["wall_ms"]["deferred"]))
+    if args.json:
+        print(json.dumps({"deferred": profs["deferred"],
+                          "per_round": profs["per_round"],
+                          "delta": delta}))
     return 0
 
 
