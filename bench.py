@@ -871,6 +871,78 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - never lose the headline to it
         detail["host_plane_error"] = repr(e)[:300]
 
+    # --- proc_cluster (ISSUE 19): the SAME query-storm offered load,
+    # but through a REAL 5-process cluster — one OS process per node
+    # (serf_tpu.host.agent, jax-free) on real loopback sockets, driven
+    # over the control channel.  Rates are the folded per-process
+    # engine counters (every agent's accepted handlings) over the run
+    # wall clock, so they price the full process + socket + ctl-channel
+    # stack; the per-node lifecycle ledgers run hot (sample_n=4) and
+    # the message-weighted attribution band keeps the decomposition
+    # complete across process boundaries.
+    try:
+        import asyncio
+        import tempfile as _tf
+
+        from serf_tpu.faults.plan import named_plan
+        from serf_tpu.faults.proc import run_proc_plan
+
+        proc_plan = named_plan("query-storm")    # n=5, storm load phases
+        t0 = time.perf_counter()
+        with _tf.TemporaryDirectory(prefix="serf-bench-proc-") as _td:
+            proc_result = asyncio.run(run_proc_plan(
+                proc_plan, tmp_dir=_td, lifecycle_sample_n=4))
+        proc_elapsed = time.perf_counter() - t0
+        pc = proc_result.counters
+        plcs = proc_result.lifecycle or {}
+        weighted = [(lc["attributed_frac"], lc.get("sampled", 0))
+                    for lc in plcs.values()
+                    if lc.get("attributed_frac") is not None]
+        tot_sampled = sum(s for _, s in weighted)
+        proc_attr = (sum(a * s for a, s in weighted) / tot_sampled
+                     if tot_sampled else None)
+        proc_p99 = max((lc.get("e2e", {}).get("p99_ms", 0.0)
+                        for lc in plcs.values()), default=0.0)
+        proc_load = proc_result.load
+        detail["proc_cluster"] = {
+            "plan": proc_plan.name,
+            "processes": proc_plan.n,
+            "elapsed_s": round(proc_elapsed, 2),
+            "events_per_sec": round(
+                pc.get("serf.events", 0.0) / proc_elapsed, 1),
+            "queries_per_sec": round(
+                pc.get("serf.queries", 0.0) / proc_elapsed, 1),
+            "events_offered": proc_load.events_offered,
+            "queries_offered": proc_load.queries_offered,
+            "events_admitted": proc_load.events_admitted,
+            "events_shed": proc_load.events_shed,
+            "queries_admitted": proc_load.queries_admitted,
+            "queries_shed": proc_load.queries_shed,
+            "invariants_ok": proc_result.report.ok,
+            "settle_convergence_s": round(
+                proc_result.settle_convergence_s, 3),
+            "lifecycle": {
+                "attributed_frac": (round(proc_attr, 4)
+                                    if proc_attr is not None else None),
+                "e2e_p99_ms": round(proc_p99, 2),
+                "sampled": tot_sampled,
+                "per_node": plcs,
+            },
+        }
+        sys.stderr.write(
+            "proc cluster @%d processes (query-storm): %.0f events/s + "
+            "%.0f queries/s handled in %.1fs; invariants %s, "
+            "attribution %s\n" % (
+                proc_plan.n,
+                detail["proc_cluster"]["events_per_sec"],
+                detail["proc_cluster"]["queries_per_sec"],
+                proc_elapsed,
+                "ok" if proc_result.report.ok else "RED",
+                ("%.0f%%" % (100 * proc_attr)
+                 if proc_attr is not None else "n/a")))
+    except Exception as e:  # noqa: BLE001 - never lose the headline to it
+        detail["proc_cluster_error"] = repr(e)[:300]
+
     # --- obs_overhead (ISSUE 15): the observability plane must never
     # silently become the load.  Device: the same bounded-N sustained
     # scan with per-round telemetry collection ON vs OFF; host: the

@@ -102,11 +102,25 @@ METRICS: tuple = (
     "serf.queue.bytes.<>",
     "serf.snapshot.append_line",
     "serf.snapshot.compact",
+    "serf.snapshot.lock_conflict",
     "serf.snapshot.torn_tail",
     "serf.snapshot.unknown_record",
     "serf.subscriber.dropped",
     "serf.subscriber.lossless_violation",
     "serf.trace.span-ms",
+    # multi-process plane (host/agent.py control channel +
+    # faults/proc.py real-process harness)
+    "serf.proc.bind_retry",
+    "serf.proc.chaos_installs",
+    "serf.proc.crashed",
+    "serf.proc.ctl.requests",
+    "serf.proc.generation",
+    "serf.proc.paused",
+    "serf.proc.reaped",
+    "serf.proc.restarted",
+    "serf.proc.resumed",
+    "serf.proc.spawned",
+    "serf.proc.task_failures",
     # chaos / faults plane
     "serf.faults.corrupted",
     "serf.faults.delayed",
@@ -224,6 +238,7 @@ FLIGHT_KINDS: tuple = (
     "packet-dropped",
     "pallas-fallback",
     "probe-failed",
+    "proc-agent",
     "propagation-trace",
     "query-fastfail",
     "query-overloaded-response",

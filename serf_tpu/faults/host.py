@@ -374,7 +374,7 @@ def degradation_counters() -> Dict[str, float]:
     out: Dict[str, float] = {}
     for (name, _labels), v in sink.counters.items():
         if name.startswith(("serf.faults.", "serf.degraded.",
-                            "serf.overload.")):
+                            "serf.overload.", "serf.proc.")):
             out[name] = out.get(name, 0.0) + v
     return out
 

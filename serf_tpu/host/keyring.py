@@ -101,9 +101,11 @@ class SecretKeyring:
         # primary first, so load() restores the rotation state
         keys = [self._primary] + [k for k in self.keys() if k != self._primary]
         data = json.dumps([b64encode(k).decode() for k in keys])
-        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
-        with os.fdopen(fd, "w") as f:
-            f.write(data)
+        # atomic write-tmp-fsync-rename (ISSUE 19 satellite): a process
+        # killed mid-save must leave the OLD keyring intact, never a
+        # torn file a restart then fails to decrypt the cluster with
+        from serf_tpu.utils.files import atomic_write_text
+        atomic_write_text(path, data, mode=0o600)
 
     @classmethod
     def load(cls, path: str) -> "SecretKeyring":

@@ -44,6 +44,13 @@ CLOCK_INTERVAL = 0.5
 MEMBER_RECORD_SIZE_HINT = 128  # bytes/member estimate for compaction threshold
 
 
+class SnapshotLockError(RuntimeError):
+    """A second process already owns this snapshot file (ISSUE 19
+    satellite: two agents pointed at one snapshot dir must fail closed —
+    interleaved appends from two writers would corrupt the log for
+    both)."""
+
+
 def _record(ty: int, payload: bytes = b"") -> bytes:
     return bytes([ty]) + codec.encode_varint(len(payload)) + payload
 
@@ -175,6 +182,34 @@ class Snapshotter:
         self._alive: Dict[str, Node] = {n.id: n for n in replay.alive_nodes}
         self._last_clocks = (replay.last_clock, replay.last_event_clock,
                              replay.last_query_clock)
+        # EXCLUSIVITY GUARD (before any repair or append): one writer per
+        # snapshot file, enforced with a non-blocking flock on a sidecar
+        # lock file.  The lock dies with the process (SIGKILL included),
+        # so a crash-restart re-acquires it immediately — while a second
+        # LIVE process fails closed instead of interleaving appends.
+        self._lock_path = path + ".lock"
+        self._lock_fd = os.open(self._lock_path,
+                                os.O_WRONLY | os.O_CREAT, 0o644)
+        try:
+            import fcntl
+            fcntl.flock(self._lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError as e:
+            holder = ""
+            try:
+                with open(self._lock_path) as lf:
+                    holder = lf.read().strip()
+            except OSError:
+                pass
+            os.close(self._lock_fd)
+            self._lock_fd = -1
+            metrics.incr("serf.snapshot.lock_conflict", 1)
+            raise SnapshotLockError(
+                f"snapshot {path} is owned by another process"
+                + (f" (pid {holder})" if holder else "") + f": {e}") from e
+        # pid is diagnostic only (flock is the guard): truncate-then-write
+        # keeps stale pids from a previous holder out of the message
+        os.ftruncate(self._lock_fd, 0)
+        os.write(self._lock_fd, str(os.getpid()).encode())
         # torn-tail repair: a crash mid-append left bytes past the last
         # complete record — truncate them BEFORE appending, so the new
         # records never interleave with garbage (a later replay would
@@ -302,6 +337,14 @@ class Snapshotter:
         self._stopped = True
         self._fsync()
         self._f.close()
+        # release the exclusivity lock LAST: the file is closed, a
+        # successor (e.g. a restart in the same process tree) may open
+        if self._lock_fd >= 0:
+            try:
+                os.close(self._lock_fd)
+            except OSError:
+                pass
+            self._lock_fd = -1
 
     def _fsync(self) -> None:
         try:

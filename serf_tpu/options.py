@@ -176,6 +176,28 @@ class MemberlistOptions:
         )
 
     @classmethod
+    def proc(cls) -> "MemberlistOptions":
+        """Timings for MULTI-PROCESS loopback clusters (ISSUE 19): each
+        node owns its event loop, so probes tolerate interpreter startup
+        and scheduler jitter rather than co-located loop lag.  Push/pull
+        runs hot (0.5s) so a kill window reliably catches an anti-entropy
+        sync mid-flight, and the breaker opens after 2 consecutive
+        failures so a SIGKILLed peer shows up in the survivors'
+        ``serf.degraded.*`` counters within one chaos phase."""
+        return cls(
+            gossip_interval=0.02,
+            probe_interval=0.2,
+            probe_timeout=0.1,
+            suspicion_mult=3,
+            push_pull_interval=0.5,
+            timeout=2.0,
+            dial_backoff_base=0.02,
+            dial_backoff_max=0.2,
+            breaker_threshold=2,
+            breaker_cooldown=0.5,
+        )
+
+    @classmethod
     def local(cls) -> "MemberlistOptions":
         """Compressed timings for in-process tests (reference base/tests.rs:25-39)."""
         return cls(
@@ -305,6 +327,22 @@ class Options:
             queue_check_interval=1.0,
             health_interval=0.25,
             query_sweep_interval=0.1,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def proc(cls, **kw) -> "Options":
+        """Profile for multi-process loopback clusters (the serf agent's
+        default; see MemberlistOptions.proc)."""
+        defaults = dict(
+            memberlist=MemberlistOptions.proc(),
+            reap_interval=2.0,
+            reconnect_interval=1.0,
+            recent_intent_timeout=10.0,
+            queue_check_interval=1.0,
+            health_interval=0.25,
+            query_sweep_interval=0.2,
         )
         defaults.update(kw)
         return cls(**defaults)

@@ -1,16 +1,20 @@
 #!/usr/bin/env python
-"""Run a named FaultPlan on either plane and print the invariant report.
+"""Run a named FaultPlan on any plane and print the invariant report.
 
     python tools/chaos.py --plan partition-heal-loss --plane both
     python tools/chaos.py --plan crash-restart --plane host --json
+    python tools/chaos.py --plan crash-restart --plane proc
     python tools/chaos.py --self-check          # tier-1 hook
 
 The host plane stands up an in-process loopback cluster (snapshots in a
 temp dir, so crash/restart plans exercise replay); the device plane runs
-the flagship ``cluster_round`` with the plan lowered to per-round masks.
-Exit 0 iff every invariant on every requested plane is green.  The
-degradation counter block is the ``serf.faults.*`` / ``serf.degraded.*``
-totals accumulated during the run — the measured half of "graceful".
+the flagship ``cluster_round`` with the plan lowered to per-round masks;
+the proc plane spawns one OS process per node (``serf_tpu.host.agent``
+on real sockets) and lowers crashes to SIGKILL, pauses to SIGSTOP, and
+restarts to re-exec from the same snapshot directory.  Exit 0 iff every
+invariant on every requested plane is green.  The degradation counter
+block is the ``serf.faults.*`` / ``serf.degraded.*`` totals accumulated
+during the run — the measured half of "graceful".
 """
 
 from __future__ import annotations
@@ -76,6 +80,34 @@ def run_device(plan, n: int, k_facts: int, devices: int = 0,
             (d if mesh else 1))
 
 
+def run_proc(plan, record_dir: str = ".", record_on_fail: bool = False):
+    """Proc plane: real processes on real sockets.  On a red run with
+    ``record_on_fail``, EVERY process dumps its black-box bundle over
+    the control channel and the bundles are copied out of the temp
+    cluster dir before it is torn down."""
+    import shutil
+
+    from serf_tpu.faults.proc import run_proc_plan
+
+    bundles = {}
+    with tempfile.TemporaryDirectory(prefix="serf-chaos-proc-") as td:
+        result = asyncio.run(run_proc_plan(
+            plan, tmp_dir=td, blackbox_on_fail=record_on_fail))
+        if record_on_fail and not result.report.ok:
+            dest_root = os.path.join(record_dir,
+                                     f"chaos-{plan.name}-proc.blackbox")
+            for node_id, bdir in sorted(result.blackbox_dirs.items()):
+                try:
+                    if bdir and os.path.isdir(bdir) and os.listdir(bdir):
+                        dst = os.path.join(dest_root, node_id)
+                        shutil.copytree(bdir, dst, dirs_exist_ok=True)
+                        bundles[node_id] = dst
+                except OSError as e:
+                    print(f"record-on-fail: could not copy {node_id} "
+                          f"black box: {e}", file=sys.stderr)
+    return result, bundles
+
+
 def _dump_red_bundle(record_dir: str, plan, plane: str, result) -> str:
     """A red run's forensic half: one black-box bundle beside the replay
     artifact, fed from the process flight ring + the run's live watchdog
@@ -95,8 +127,11 @@ def _dump_red_bundle(record_dir: str, plan, plane: str, result) -> str:
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--plan", default="partition-heal-loss")
-    ap.add_argument("--plane", choices=("host", "device", "both"),
-                    default="both")
+    ap.add_argument("--plane", choices=("host", "device", "proc", "both"),
+                    default="both",
+                    help="'proc' spawns one real OS process per node "
+                         "(serf_tpu.host.agent over real sockets); "
+                         "'both' = host + device")
     ap.add_argument("--n", type=int, default=256,
                     help="device-plane simulated node count")
     ap.add_argument("--k-facts", type=int, default=32)
@@ -212,7 +247,34 @@ def main() -> int:
         final_verdicts[plane] = verdicts
         return result, verdicts
 
+    proc_info = {}
     for plane in planes:
+        if plane == "proc":
+            # real processes, one run: no controller legs, no SLO
+            # judging (host-plane SLOs assume in-process series access)
+            result, proc_bundles = run_proc(plan, args.record_dir,
+                                            record_on_fail)
+            reports.append(result.report)
+            if result.load is not None:
+                overload["proc"] = result.load.to_dict()
+            degraded = {k: v for k, v in sorted(
+                result.survivor_counters.items())
+                if k.startswith("serf.degraded.")
+                or k == "memberlist.probe.failed"}
+            proc_info = {
+                "survivor_degradation": degraded,
+                "settle_convergence_s": result.settle_convergence_s,
+                "quiet_convergence_s": result.quiet_convergence_s,
+                "processes": len(result.views),
+                "spawned_pids": len(result.all_pids),
+            }
+            lifecycle_info.update(
+                {f"proc:{nid}": lc
+                 for nid, lc in sorted(result.lifecycle.items())}
+                if args.json else {})
+            for node_id, path in sorted(proc_bundles.items()):
+                blackboxes[f"proc:{node_id}"] = path
+            continue
         for controlled in legs:
             is_final = controlled == legs[-1]
             recorder = make_recorder() if is_final else None
@@ -350,6 +412,8 @@ def main() -> int:
             "watchdog": watchdog_info,
             "timeline": timeline_path,
         }
+        if proc_info:
+            out["proc"] = proc_info
         if args.controller != "off":
             out["controller"] = args.controller
             out["control"] = control_info
@@ -376,6 +440,14 @@ def main() -> int:
             print(f"controller [{plane}]: {len(decs)} decision(s)"
                   + (f", final {d['final']}" if "final" in d
                      else f", values {d.get('values')}"))
+        if proc_info:
+            deg = ", ".join(f"{k}={v:.0f}" for k, v in
+                            proc_info["survivor_degradation"].items()) \
+                or "none"
+            print(f"[proc] {proc_info['processes']} processes "
+                  f"({proc_info['spawned_pids']} incarnations), settle "
+                  f"convergence {proc_info['settle_convergence_s']:.2f}s, "
+                  f"survivor degradation: {deg}")
         for plane, wd in sorted(watchdog_info.items()):
             first = wd.get("first_breach") or wd.get("first_violation")
             print(f"watchdog [{plane}]: "

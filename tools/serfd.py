@@ -1,0 +1,41 @@
+#!/usr/bin/env python3
+"""serfd: the serf agent entry point (one cluster member per process).
+
+Thin wrapper over ``serf_tpu.host.agent`` — the importable module the
+proc-plane chaos executor re-execs (``python -m serf_tpu.host.agent``).
+Operators run this one:
+
+    python tools/serfd.py --config agent.json
+
+The config file is an ``AgentConfig`` JSON document::
+
+    {
+      "node_id": "p0",
+      "bind": "127.0.0.1:0",
+      "ctl": "127.0.0.1:0",
+      "join": ["127.0.0.1:7946"],
+      "snapshot_path": "/var/lib/serf/p0.snap",
+      "ready_file": "/run/serf/p0.ready",
+      "profile": "lan",
+      "options": {"memberlist": {"probe_interval": "1s"}}
+    }
+
+``bind``/``ctl`` port 0 means ephemeral; once live the agent atomically
+writes the ready file with the bound addresses, pid and restart
+generation.  SIGTERM leaves gracefully (peers see Left, the snapshot
+flushes the leave record); the control channel speaks the length-framed
+JSON protocol in ``serf_tpu.host.ctl``.
+
+Deliberately jax-free: agents are host-plane processes and must start
+in fractions of a second.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from serf_tpu.host.agent import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
