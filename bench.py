@@ -943,6 +943,133 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - never lose the headline to it
         detail["proc_cluster_error"] = repr(e)[:300]
 
+    # --- encryption_ab (ISSUE 20): the crypto tax, priced three ways.
+    # (a) AEAD microbench: seal+open round-trip of a gossip-sized frame
+    # on the ACTIVE backend (CRYPTO_BACKEND names it — AES-GCM with the
+    # wheel, stdlib HMAC-SHA256-CTR without; the band is set for the
+    # slower stdlib path).  (b) macro A/B: the SAME query-storm plan
+    # plaintext (the host_plane leg above) vs encrypted, run twice —
+    # gossip fan-out amortized (seal once per BATCH frame, default) vs
+    # per-packet (amortize off) — crypto_tax is plaintext/encrypted
+    # handled-throughput, amortize_gain is the deterministic
+    # would-have-sealed/actually-sealed counter ratio (>= 1 by
+    # construction whenever fan-out > 1), and batched >= per-packet is
+    # pinned on seals-per-opportunity, not wall clock.  (c) rotation
+    # headline: the rotate-under-partition chaos plan end-to-end, its
+    # measured post-heal reconvergence latency against the 5 s SLO.
+    try:
+        import asyncio
+        import dataclasses as _dc
+        import tempfile as _tf
+
+        from serf_tpu.faults.host import (
+            _counter_total as _ctr,
+            _load_opts,
+            run_host_plan,
+        )
+        from serf_tpu.faults.plan import named_plan
+        from serf_tpu.host import keyring as _kr
+
+        _ring = _kr.SecretKeyring(b"\x07" * 32)
+        _frame = b"\xa5" * 512
+        for _ in range(20):                      # warm the hash paths
+            _ring.decrypt(_ring.encrypt(_frame))
+        _iters = 300
+        t0 = time.perf_counter()
+        for _ in range(_iters):
+            _ring.decrypt(_ring.encrypt(_frame))
+        seal_open_us = (time.perf_counter() - t0) / _iters * 1e6
+
+        storm = named_plan("query-storm")
+        enc_plan = _dc.replace(storm, name="query-storm-encrypted",
+                               encrypted=True)
+        lopts = _load_opts(enc_plan)
+        enc_legs = {}
+        for leg, amortize in (("amortized", True), ("per_packet", False)):
+            o = lopts.replace(memberlist=_dc.replace(
+                lopts.memberlist, gossip_encrypt_amortize=amortize))
+            b_ev, b_q = _ctr("serf.events"), _ctr("serf.queries")
+            b_enc = _ctr("serf.keyring.encrypt")
+            b_sav = _ctr("serf.keyring.encrypt_amortized")
+            b_fail = _ctr("serf.keyring.decrypt_fail")
+            # no tmp_dir: the plaintext host_plane leg above runs
+            # without snapshots, so the encrypted legs must too — the
+            # tax measured is crypto, not snapshot I/O (rings stay
+            # in-memory; persistence is the rotation plan's job)
+            t0 = time.perf_counter()
+            enc_res = asyncio.run(run_host_plan(enc_plan, opts=o))
+            el = time.perf_counter() - t0
+            seals = _ctr("serf.keyring.encrypt") - b_enc
+            saved = _ctr("serf.keyring.encrypt_amortized") - b_sav
+            enc_legs[leg] = {
+                "elapsed_s": round(el, 2),
+                "events_per_sec": round(
+                    (_ctr("serf.events") - b_ev) / el, 1),
+                "queries_per_sec": round(
+                    (_ctr("serf.queries") - b_q) / el, 1),
+                "seals": seals,
+                "seals_saved": saved,
+                # seals per seal-opportunity: 1.0 on the per-packet
+                # path, < 1.0 whenever amortization collapsed a fan-out
+                "seals_per_opportunity": round(
+                    seals / max(1, seals + saved), 4),
+                "decrypt_fail": _ctr("serf.keyring.decrypt_fail") - b_fail,
+                "invariants_ok": enc_res.report.ok,
+            }
+        amort = enc_legs["amortized"]
+        per_pkt = enc_legs["per_packet"]
+        plain = detail.get("host_plane")
+        if not plain or not plain.get("events_per_sec"):
+            # host_plane leg errored: run our own plaintext reference
+            b_ev = _ctr("serf.events")
+            t0 = time.perf_counter()
+            asyncio.run(run_host_plan(storm))
+            el = time.perf_counter() - t0
+            plain = {"events_per_sec": round(
+                (_ctr("serf.events") - b_ev) / el, 1)}
+        crypto_tax = round(
+            plain["events_per_sec"] / max(1e-9, amort["events_per_sec"]),
+            4)
+        amortize_gain = round(
+            (amort["seals"] + amort["seals_saved"])
+            / max(1, amort["seals"]), 4)
+
+        rot_plan = named_plan("rotate-under-partition")
+        with _tf.TemporaryDirectory(prefix="serf-bench-rot-") as _td:
+            rot_res = asyncio.run(run_host_plan(rot_plan, tmp_dir=_td))
+        rot = rot_res.rotation or {}
+        rot_latency = (float(rot.get("latency_s", float("inf")))
+                       if rot.get("converged") else float("inf"))
+        detail["encryption_ab"] = {
+            "backend": _kr.CRYPTO_BACKEND,
+            "seal_open_us": round(seal_open_us, 1),
+            "plaintext_events_per_sec": plain["events_per_sec"],
+            "encrypted": enc_legs,
+            "crypto_tax": crypto_tax,
+            "amortize_gain": amortize_gain,
+            # the batched-codec claim, deterministically: the amortized
+            # path never seals MORE per opportunity than per-packet
+            "batched_le_per_packet": (
+                amort["seals_per_opportunity"]
+                <= per_pkt["seals_per_opportunity"] + 1e-9),
+            "rotation_latency_s": (round(rot_latency, 3)
+                                   if rot_latency != float("inf")
+                                   else None),
+            "rotation_converged": bool(rot.get("converged")),
+            "rotation_invariants_ok": rot_res.report.ok,
+        }
+        sys.stderr.write(
+            "encryption A/B (%s): seal+open %.0f us/op @%dB; "
+            "query-storm %.0f ev/s plain vs %.0f ev/s encrypted "
+            "(tax %.2fx), amortize gain %.2fx (%d seals saved); "
+            "rotation reconverged in %.3fs (SLO 5s)\n" % (
+                _kr.CRYPTO_BACKEND, seal_open_us, len(_frame),
+                plain["events_per_sec"], amort["events_per_sec"],
+                crypto_tax, amortize_gain, amort["seals_saved"],
+                rot_latency))
+    except Exception as e:  # noqa: BLE001 - never lose the headline to it
+        detail["encryption_ab_error"] = repr(e)[:300]
+
     # --- obs_overhead (ISSUE 15): the observability plane must never
     # silently become the load.  Device: the same bounded-N sustained
     # scan with per-round telemetry collection ON vs OFF; host: the

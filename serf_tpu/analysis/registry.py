@@ -220,6 +220,16 @@ METRICS: tuple = (
     "serf.blackbox.bundles",
     "serf.blackbox.bytes",
     "serf.blackbox.rotated",
+    # encrypted transport + key rotation (host/keyring.py,
+    # host/key_manager.py, faults/host.py rotation finale)
+    "serf.keyring.encrypt",
+    "serf.keyring.encrypt_amortized",
+    "serf.keyring.decrypt_fallback",
+    "serf.keyring.decrypt_fail",
+    "serf.rotation.latency-ms",
+    "serf.rotation.partial",
+    "serf.rotation.reconcile-s",
+    "serf.rotation.retry",
 )
 
 #: every flight-recorder event kind (obs/flight.py ``record`` call sites)
@@ -233,6 +243,7 @@ FLIGHT_KINDS: tuple = (
     "event-shed",
     "fault-phase",
     "ingress-shed",
+    "key-rotation",
     "member-state",
     "paced-drop",
     "packet-dropped",
@@ -273,6 +284,7 @@ SLOS: tuple = (
     "query-p99",
     "queue-wait-share",
     "redundancy-ceiling",
+    "rotation-latency",
     "shed-ratio",
     "sustained-rps-ceiling",
 )

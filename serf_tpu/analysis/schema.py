@@ -78,6 +78,17 @@ RECORDING_DECL = "RECORDING_SCHEMA"
 BLACKBOX_SOURCE = "serf_tpu/obs/blackbox.py"
 BLACKBOX_DECL = "BLACKBOX_SCHEMA"
 
+#: the encrypted transport frame (PR 20): the declared frame layout +
+#: encrypt-pipeline order + BATCH amortization literal in
+#: ``host/keyring.py`` (``ENCRYPTION_FRAME_SCHEMA``).  The frame is a
+#: cross-node wire format exactly like the message field lists — a
+#: re-ordered pipeline stage or nonce-size change skews every
+#: mixed-version encrypted cluster — so it folds into the WIRE
+#: fingerprint (one pin, one version: ``WIRE_SCHEMA_VERSION`` already
+#: guards packet compatibility and the frame rides packets)
+ENCRYPTION_SOURCE = "serf_tpu/host/keyring.py"
+ENCRYPTION_DECL = "ENCRYPTION_FRAME_SCHEMA"
+
 
 def _fingerprint(obj) -> str:
     blob = json.dumps(obj, sort_keys=True, separators=(",", ":"))
@@ -123,6 +134,12 @@ def wire_spec(root: Path) -> Dict[str, dict]:
         p = root / rel
         if p.exists():
             _wire_spec_of(ast.parse(p.read_text()), spec)
+    # the encrypted frame is wire surface too (PR 20): frame layout,
+    # encrypt-pipeline stage order, and the BATCH amortization contract
+    # all skew mixed-version encrypted clusters when changed silently
+    enc = _dict_literal_spec(root, ENCRYPTION_SOURCE, ENCRYPTION_DECL)
+    if enc:
+        spec["__encryption__"] = enc
     return spec
 
 

@@ -21,6 +21,7 @@ Two entry points:
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import random
 import time
 from dataclasses import dataclass, field
@@ -307,6 +308,13 @@ class HostChaosResult:
     #: as it happened, not reconstructed), and every black-box bundle
     #: written.  None when the run was launched with ``watchdog=False``.
     watchdog: Optional[Dict] = None
+    #: key-rotation evidence (``plan.encrypted`` runs only): the per-op
+    #: rows the phase driver issued, the post-heal message-loss probes,
+    #: the reconcile verdict (converged? how long?), decrypt
+    #: fallback/fail counter deltas, and every live node's keyring
+    #: digest — what the keyring-divergence / no-message-loss
+    #: invariants and the rotation-latency SLO row judge
+    rotation: Optional[Dict] = None
 
 
 async def measure_propagation(live, deadline_s: float = 5.0) -> Dict:
@@ -366,6 +374,114 @@ async def measure_propagation(live, deadline_s: float = 5.0) -> Dict:
     return out
 
 
+async def _rotation_finale(plan, nodes, live, live_indices, rotation_ops,
+                           rot_base: bytes, rot_next: bytes,
+                           base_fallback: float, base_fail: float) -> Dict:
+    """Post-heal rotation evidence for an encrypted plan.
+
+    Three acts, in an order that matters: (1) message-loss probes fire
+    BEFORE reconciling, so they cross whatever primary-key split the
+    chaos left behind — delivery then proves decrypt fallback carried
+    the cluster, not that the keys already matched; (2) a bounded
+    reconcile loop re-issues use(next)/remove(base) until every live
+    ring reports the next key as its sole primary (the convergence half
+    of the keyring-divergence invariant); (3) every live node's keyring
+    digest is read for the divergence comparison and red-run forensics.
+    """
+    from serf_tpu.host.admission import OverloadError
+    from serf_tpu.host.keyring import key_digest
+
+    deadline = max(2.0, plan.settle_s)
+    # (1) one traced user_event per live node, polled to full coverage.
+    # A storm plan leaves the admission buckets drained, so each probe
+    # retries through OverloadError until its node's bucket refills —
+    # shed probes would prove admission control, not message loss.
+    traces: Dict[str, str] = {}
+    offered = 0
+    for s in live:
+        offered += 1
+        probe_end = time.monotonic() + min(3.0, deadline)
+        sent = False
+        while time.monotonic() < probe_end:
+            try:
+                await s.user_event(f"rot-probe-{s.local_id}", b"",
+                                   coalesce=False)
+                sent = True
+                break
+            except OverloadError:
+                await asyncio.sleep(0.1)
+            except Exception:  # noqa: BLE001 — an unsent probe counts
+                break          # against delivered, which is the point
+        if not sent:
+            continue
+        th = next(reversed(s.prop_ledger._recent), None)
+        if th is not None:
+            traces[s.local_id] = th
+    t0 = time.monotonic()
+    delivered = 0
+    while time.monotonic() - t0 <= deadline:
+        delivered = sum(
+            1 for th in traces.values()
+            if all(s.prop_ledger.first_seen(th) is not None for s in live))
+        if delivered >= len(traces):
+            break
+        await asyncio.sleep(0.02)
+    probes = {"offered": offered, "sent": len(traces),
+              "delivered": delivered, "nodes": len(live),
+              "probe_s": round(time.monotonic() - t0, 3)}
+    # (2) reconcile: use(next) first (a node still on the base primary
+    # would refuse the remove), then remove(base), then verify via
+    # list_keys — every op is itself retried by the KeyManager
+    km = nodes[min(live_indices())].key_manager()
+    t1 = time.monotonic()
+    converged = False
+    rounds = 0
+    while time.monotonic() - t1 <= deadline:
+        rounds += 1
+        try:
+            await km.use_key(rot_next)
+            await km.remove_key(rot_base)
+            lk = await km.list_keys()
+        except Exception:  # noqa: BLE001 — transient mid-heal failures
+            await asyncio.sleep(0.1)
+            continue
+        want = len(live)
+        if (lk.num_resp >= want
+                and lk.primary_keys.get(rot_next, 0) >= want
+                and rot_base not in lk.keys):
+            converged = True
+            break
+        await asyncio.sleep(0.1)
+    reconcile_s = round(time.monotonic() - t1, 3)
+    metrics.gauge("serf.rotation.reconcile-s", reconcile_s)
+    # (3) non-secret ring digests, straight off each live node
+    keyrings = {}
+    for s in live:
+        ring = s.memberlist.keyring()
+        if ring is not None:
+            keyrings[s.local_id] = ring.digest()
+    out = {
+        "ops": rotation_ops,
+        "probes": probes,
+        "converged": converged,
+        "reconcile_s": reconcile_s,
+        "reconcile_rounds": rounds,
+        "latency_s": reconcile_s,
+        "expected_primary": key_digest(rot_next),
+        "decrypt_fallback": int(
+            _counter_total("serf.keyring.decrypt_fallback") - base_fallback),
+        "decrypt_fail": int(
+            _counter_total("serf.keyring.decrypt_fail") - base_fail),
+        "keyrings": keyrings,
+    }
+    flight.record("key-rotation", op="finale", plan=plan.name,
+                  converged=converged, reconcile_s=reconcile_s,
+                  probes_delivered=delivered, probes_offered=offered,
+                  decrypt_fallback=out["decrypt_fallback"],
+                  decrypt_fail=out["decrypt_fail"])
+    return out
+
+
 def degradation_counters() -> Dict[str, float]:
     """Sum every ``serf.faults.*`` / ``serf.degraded.*`` /
     ``serf.overload.*`` counter in the global sink across label sets —
@@ -383,6 +499,16 @@ def _counter_total(name: str) -> float:
     """Sum one counter across every label set in the global sink."""
     sink = metrics.global_sink()
     return sum(v for (n, _l), v in sink.counters.items() if n == name)
+
+
+def rotation_keys(seed: int) -> Tuple[bytes, bytes]:
+    """Deterministic ``(base, next)`` 32-byte rotation keys for a plan
+    seed: every executor (host loopback, proc agents, bench, the chaos
+    CLI) derives the SAME pair, so cross-plane runs of one rotate-*
+    plan move through identical keyrings and their digests compare."""
+    base = hashlib.sha256(f"serf-rot-base-{seed}".encode()).digest()
+    nxt = hashlib.sha256(f"serf-rot-next-{seed}".encode()).digest()
+    return base, nxt
 
 
 def _load_opts(plan: FaultPlan):
@@ -471,6 +597,14 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
     n = plan.n
     with_load = plan.has_load()
     base_opts = opts or (_load_opts(plan) if with_load else Options.local())
+    # encrypted plans: every node boots on the SAME deterministic base
+    # key; phases rotate to the next key via KeyManager ops.  With a
+    # tmp_dir each node also persists its ring, so a crash-restart
+    # resumes from the snapshotted keyring (the crash-recovery proof).
+    rot_base = rot_next = None
+    rotation_ops: List[Dict] = []
+    if plan.encrypted:
+        rot_base, rot_next = rotation_keys(plan.seed)
     if recorder is not None:
         from serf_tpu.replay.recording import plan_to_dict
         recorder.header(
@@ -500,8 +634,14 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
     def node_opts(i: int):
         if tmp_dir is None:
             return base_opts
-        return base_opts.replace(
+        o = base_opts.replace(
             snapshot_path=os.path.join(tmp_dir, f"chaos-n{i}.snap"))
+        if plan.encrypted:
+            # keyring mutations persist through the internal-query
+            # handlers' atomic save — a restart below reloads this file
+            o = o.replace(keyring_file=os.path.join(
+                tmp_dir, f"chaos-n{i}.keyring"))
+        return o
 
     generation = {i: 0 for i in range(n)}
     nodes: Dict[int, Serf] = {}
@@ -536,8 +676,21 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
                 old.cancel()
             consumers[i] = spawn_logged(consume(sub, gate),
                                         f"chaos-consume-n{i}")
+        ring = None
+        if plan.encrypted:
+            from serf_tpu.host.keyring import SecretKeyring
+            kf = node_opts(i).keyring_file
+            if kf and os.path.exists(kf):
+                # restart path: resume from the snapshotted keyring —
+                # a node killed mid-rotation comes back with whatever
+                # key state it had persisted and must catch up
+                ring = SecretKeyring.load(kf)
+            else:
+                ring = SecretKeyring(rot_base)
+                if kf:
+                    ring.save(kf)
         s = await Serf.create(net.bind(f"n{i}"), node_opts(i), f"n{i}",
-                              subscriber=sub)
+                              subscriber=sub, keyring=ring)
         if ingress_tap is not None:
             s.set_ingress_tap(ingress_tap)
         if wd is not None:
@@ -549,6 +702,8 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
     base_admitted = _counter_total("serf.overload.ingress_admitted")
     base_shed = _counter_total("serf.overload.ingress_shed")
     base_lossless = _counter_total("serf.subscriber.lossless_violation")
+    base_fallback = _counter_total("serf.keyring.decrypt_fallback")
+    base_fail = _counter_total("serf.keyring.decrypt_fail")
 
     # continuous telemetry: one sampler tick per traffic tick lands
     # counter deltas / gauge levels / flight-kind rates in ring series —
@@ -575,6 +730,9 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
             wd, lambda: {i: nodes[i] for i in nodes if i not in down
                          and nodes[i].state == SerfState.ALIVE})
         arm_shed_ratio_watch(wd, sampler.store)
+        if plan.encrypted:
+            from serf_tpu.obs.watchdog import arm_rotation_latency_watch
+            arm_rotation_latency_watch(wd, sampler.store)
         wd.install_task_hook()
 
     def _box_for(i: int):
@@ -651,6 +809,38 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
     def live_indices() -> List[int]:
         return [i for i in nodes
                 if i not in down and nodes[i].state == SerfState.ALIVE]
+
+    async def issue_rotation(op: str, phase_name: str) -> None:
+        """One phase-entry rotation op, issued by the lowest live node
+        (install -> next key, use -> next key, remove -> base key).
+        The row — success or failure — is evidence, not control flow:
+        a partition is SUPPOSED to make these partial."""
+        from serf_tpu.host.keyring import key_digest
+        row: Dict = {"phase": phase_name, "op": op}
+        live = live_indices()
+        if not live:
+            row["error"] = "no live node to issue from"
+            rotation_ops.append(row)
+            return
+        km = nodes[min(live)].key_manager()
+        key = rot_base if op == "remove" else rot_next
+        row["key"] = key_digest(key)
+        try:
+            if op == "install":
+                r = await km.install_key(key)
+            elif op == "use":
+                r = await km.use_key(key)
+            else:
+                r = await km.remove_key(key)
+        except Exception as e:  # noqa: BLE001 — a failed op is evidence
+            row["error"] = repr(e)[:200]
+        else:
+            row.update(num_nodes=r.num_nodes, num_resp=r.num_resp,
+                       num_err=r.num_err, attempts=r.attempts,
+                       quorum_ok=r.quorum_ok)
+            if r.messages:
+                row["messages"] = dict(list(r.messages.items())[:4])
+        rotation_ops.append(row)
 
     async def background() -> None:
         nonlocal events_sent
@@ -771,6 +961,11 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
                         except (ConnectionError, TimeoutError, OSError):
                             pass
             down = ex.down_nodes()
+            # rotation ops fire at phase ENTRY, after crash/restart and
+            # under the phase's faults — a rotate issued into a
+            # partition or beside a SIGKILL is the point of the plan
+            for op in phase.rotate:
+                await issue_rotation(op, phase.name)
             for i in phase.stall:
                 gates.setdefault(i, asyncio.Event()).clear()
             current_phase[0] = phase
@@ -830,11 +1025,25 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
         # own admission does not skew the shed-accounting invariant
         propagation = await measure_propagation(
             live, deadline_s=max(1.0, min(plan.settle_s, 5.0)))
+        # rotation finale (rotating plans): probe the possibly still
+        # mixed-key fabric for message loss FIRST (decrypt fallback is
+        # fine, loss is not), then reconcile every ring to the next key
+        # and read the digests — runs after the ingress-delta read for
+        # the same reason the propagation probe does.  Encrypted plans
+        # WITHOUT rotate ops (e.g. the bench crypto-tax A/B) skip it:
+        # their rings never leave the base key, so "converge to K2"
+        # would wait out the full reconcile deadline and judge red
+        rotation = None
+        if plan.encrypted and plan.has_rotation():
+            rotation = await _rotation_finale(
+                plan, nodes, live, live_indices, rotation_ops,
+                rot_base, rot_next, base_fallback, base_fail)
         if recorder is not None:
             recorder.finish()
         report = inv.check_host(plan, nodes, samples, generation,
                                 snapshots=tmp_dir is not None,
-                                load=load if with_load else None)
+                                load=load if with_load else None,
+                                rotation=rotation)
         if ctl is not None:
             inv.check_control_host(report, ctl)
         return HostChaosResult(plan=plan, report=report,
@@ -852,7 +1061,8 @@ async def run_host_plan(plan: FaultPlan, tmp_dir: Optional[str] = None,
                                lifecycle=led.snapshot(),
                                propagation=propagation,
                                watchdog=wd.state() if wd is not None
-                               else None)
+                               else None,
+                               rotation=rotation)
     finally:
         stop.set()
         if wd is not None:

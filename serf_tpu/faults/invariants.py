@@ -84,7 +84,8 @@ async def wait_host_convergence(nodes, deadline_s: float,
 
 def check_host(plan: FaultPlan, nodes: Dict[int, object],
                samples: Dict[str, List], generation: Dict[int, int],
-               snapshots: bool = False, load=None) -> InvariantReport:
+               snapshots: bool = False, load=None,
+               rotation=None) -> InvariantReport:
     """Judge the host-plane invariants on a finished chaos run.
 
     ``nodes``: index -> Serf (some possibly SHUTDOWN); ``samples``:
@@ -181,6 +182,8 @@ def check_host(plan: FaultPlan, nodes: Dict[int, object],
 
     if load is not None:
         _check_host_overload(rep, load)
+    if rotation is not None:
+        check_rotation(rep, rotation)
     return rep
 
 
@@ -251,6 +254,66 @@ def _check_host_overload(rep: InvariantReport, load) -> None:
 
 
 # ---------------------------------------------------------------------------
+# key-rotation invariants (ISSUE 20) — shared by the host and proc planes
+# ---------------------------------------------------------------------------
+
+
+def check_rotation(rep: InvariantReport, rotation: Dict) -> None:
+    """Append the key-rotation invariants for an encrypted chaos run.
+
+    ``rotation`` is the executor's rotation-evidence dict (host
+    ``_rotation_finale`` / the proc runner's equivalent): phase-entry op
+    rows, post-heal message-loss probes, the reconcile verdict, decrypt
+    fallback/fail counter deltas, and every live node's NON-SECRET
+    keyring digest (``keyring.SecretKeyring.digest``)."""
+    # 9. keyring divergence: post-heal, every live ring converged to ONE
+    # primary — the rotation's next key — and one identical key set.
+    # A node left encrypting with a retired primary would partition the
+    # cluster silently the moment the old key is removed elsewhere.
+    rings = rotation.get("keyrings", {})
+    expect = rotation.get("expected_primary")
+    bad_primary = sorted(n for n, d in rings.items()
+                         if d.get("primary") != expect)
+    keysets = {tuple(d.get("keys", ())) for d in rings.values()}
+    ok = (bool(rings) and rotation.get("converged", False)
+          and not bad_primary and len(keysets) == 1)
+    if ok:
+        detail = (f"{len(rings)} live rings on primary {expect} "
+                  f"(reconciled in {rotation.get('reconcile_s')}s, "
+                  f"{rotation.get('reconcile_rounds')} round(s))")
+    else:
+        parts = []
+        if not rings:
+            parts.append("no keyring digests collected")
+        if not rotation.get("converged", False):
+            parts.append("reconcile did not converge "
+                         f"within {rotation.get('reconcile_s')}s")
+        if bad_primary:
+            parts.append(f"wrong primary on {bad_primary}")
+        if len(keysets) > 1:
+            parts.append(f"{len(keysets)} distinct key sets")
+        detail = "; ".join(parts)
+    rep.add("keyring-divergence", ok, detail)
+
+    # 10. no message loss mid-rotation: every probe offered into the
+    # (possibly still mixed-key) post-heal window was delivered on
+    # every live node.  Decrypt fallbacks are the MECHANISM (a peer on
+    # an older/newer primary), decrypt fails are transient drops gossip
+    # retransmit recovers — both are accounted in the detail, neither
+    # may surface as a lost message.
+    probes = rotation.get("probes", {})
+    offered = probes.get("offered", 0)
+    sent = probes.get("sent", 0)
+    delivered = probes.get("delivered", 0)
+    ok = offered > 0 and sent == offered and delivered == sent
+    rep.add("no-message-loss-mid-rotation", ok,
+            f"{delivered}/{offered} probes delivered to all "
+            f"{probes.get('nodes', 0)} node(s); decrypt fallbacks "
+            f"{rotation.get('decrypt_fallback', 0)}, fails "
+            f"{rotation.get('decrypt_fail', 0)} (transient, accounted)")
+
+
+# ---------------------------------------------------------------------------
 # process plane (ISSUE 19) — judged from per-process artifacts
 # ---------------------------------------------------------------------------
 
@@ -259,7 +322,8 @@ def check_proc(plan: FaultPlan, views: Dict[str, Dict[str, list]],
                samples: Dict[str, List], generation: Dict[int, int],
                survivor_counters: Optional[Dict[str, float]] = None,
                folded_counters: Optional[Dict[str, float]] = None,
-               load=None, settle_converged: bool = True) -> InvariantReport:
+               load=None, settle_converged: bool = True,
+               rotation=None) -> InvariantReport:
     """Judge the SAME invariants as the host plane, but ACROSS process
     boundaries, from artifacts polled over each agent's control channel:
 
@@ -389,6 +453,12 @@ def check_proc(plan: FaultPlan, views: Dict[str, Dict[str, list]],
                 f"!={load.events_offered} or queries "
                 f"{load.queries_admitted}+{load.queries_shed}"
                 f"!={load.queries_offered}")
+
+    # 8. key rotation (encrypted plans): the SAME keyring-divergence /
+    # no-message-loss invariants as the host plane, judged from the
+    # agents' ctl-channel key ops and digests
+    if rotation is not None:
+        check_rotation(rep, rotation)
     return rep
 
 

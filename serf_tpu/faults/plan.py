@@ -84,6 +84,12 @@ class FaultPhase:
     event_rate: float = 0.0          # offered user events/sec (cluster)
     query_rate: float = 0.0          # offered queries/sec (cluster)
     stall: Tuple[int, ...] = ()      # event consumers stalled this phase
+    #: key-rotation ops issued at phase ENTRY (ISSUE 20), in order, by
+    #: the lowest-index live node: "install" (new key everywhere),
+    #: "use" (new key becomes primary), "remove" (old key retired).
+    #: Requires FaultPlan.encrypted.  The device executor ignores these
+    #: (no crypto plane in the simulation — a lowering note records it).
+    rotate: Tuple[str, ...] = ()
 
     def has_load(self) -> bool:
         return (self.event_rate > 0 or self.query_rate > 0
@@ -118,6 +124,11 @@ class FaultPhase:
                 raise ValueError(
                     f"phase {self.name!r}: edge ({e.src},{e.dst}) "
                     f"outside 0..{n - 1}")
+        for op in self.rotate:
+            if op not in ("install", "use", "remove"):
+                raise ValueError(
+                    f"phase {self.name!r}: unknown rotation op {op!r} "
+                    "(install/use/remove)")
 
 
 @dataclass(frozen=True)
@@ -132,6 +143,11 @@ class FaultPlan:
     #: the cluster gets to re-converge before invariants are judged
     settle_s: float = 8.0
     settle_rounds: int = 40
+    #: encrypted transport (ISSUE 20): the host/proc executors stand the
+    #: cluster up with a shared keyring (keys derived from ``seed``) and
+    #: judge the keyring-divergence / no-message-loss-mid-rotation
+    #: invariants + the rotation-latency SLO after the run
+    encrypted: bool = False
 
     def validate(self) -> None:
         if self.n < 2:
@@ -140,6 +156,10 @@ class FaultPlan:
             raise ValueError("a chaos plan needs at least one phase")
         for ph in self.phases:
             ph.validate(self.n)
+        if self.has_rotation() and not self.encrypted:
+            raise ValueError(
+                f"plan {self.name!r} rotates keys but is not encrypted "
+                "(set encrypted=True)")
         down: set = set()
         for ph in self.phases:
             down |= set(ph.crash) | set(ph.pause)
@@ -165,6 +185,11 @@ class FaultPlan:
         """Peak offered ops/sec across phases (admission sizing aid)."""
         return max((ph.event_rate + ph.query_rate for ph in self.phases),
                    default=0.0)
+
+    def has_rotation(self) -> bool:
+        """Any phase issues key-rotation ops (the executors then drive
+        the rotation protocol and collect the rotation evidence)."""
+        return any(ph.rotate for ph in self.phases)
 
     def ever_down(self) -> frozenset:
         """Nodes the plan crashes or pauses at any point — exempt from
@@ -340,6 +365,91 @@ def _control_overload_shed(n: int = 6) -> FaultPlan:
     )
 
 
+def _rotate_under_churn(n: int = 5) -> FaultPlan:
+    """Key-rotation acceptance #1 (ISSUE 20): install→use→remove while
+    nodes crash and restart under live event load.  Every restarted node
+    reloads its snapshotted keyring, and each restart phase re-issues
+    "use" so a node that missed the switch catches up BEFORE the old key
+    is removed — the plan must never retire a key some live node still
+    encrypts with (that would be a standing crypto split, not chaos)."""
+    return FaultPlan(
+        name="rotate-under-churn",
+        n=n,
+        seed=31,
+        encrypted=True,
+        phases=(
+            FaultPhase(name="warm+install", duration_s=0.6, rounds=12,
+                       rotate=("install",)),
+            FaultPhase(name="use+crash", duration_s=1.0, rounds=12,
+                       crash=(n - 1,), rotate=("use",), event_rate=80.0),
+            FaultPhase(name="churn", duration_s=1.0, rounds=12,
+                       restart=(n - 1,), crash=(n - 2,), rotate=("use",),
+                       event_rate=80.0),
+            FaultPhase(name="recover", duration_s=0.8, rounds=12,
+                       restart=(n - 2,), rotate=("use",)),
+            FaultPhase(name="retire-old", duration_s=0.6, rounds=12,
+                       rotate=("remove",)),
+        ),
+        settle_s=10.0,
+        settle_rounds=48,
+    )
+
+
+def _rotate_under_partition(n: int = 6) -> FaultPlan:
+    """Key-rotation acceptance #2 (THE ISSUE-20 acceptance plan):
+    "use" fires while the cluster is bisected, so one side switches
+    primaries and the other keeps encrypting with the old key.  The heal
+    phase deliberately issues NO catch-up op — the mixed-primary window
+    is genuine, and cross-group delivery must ride the decrypt fallback
+    (counted, transient).  The post-heal reconcile (executor finale)
+    converges everyone to the new primary and retires the old key; the
+    keyring-divergence invariant and the rotation-latency SLO judge it."""
+    half = n // 2
+    return FaultPlan(
+        name="rotate-under-partition",
+        n=n,
+        seed=37,
+        encrypted=True,
+        phases=(
+            FaultPhase(name="warm+install", duration_s=0.6, rounds=12,
+                       rotate=("install",)),
+            FaultPhase(name="bisect+use", duration_s=1.0, rounds=12,
+                       partitions=(tuple(range(half)),
+                                   tuple(range(half, n))),
+                       rotate=("use",), event_rate=60.0),
+            FaultPhase(name="mixed-heal", duration_s=0.8, rounds=12,
+                       event_rate=60.0),
+        ),
+        settle_s=10.0,
+        settle_rounds=48,
+    )
+
+
+def _rotate_crash_restart(n: int = 5) -> FaultPlan:
+    """Key-rotation acceptance #3 (ISSUE 20): a node dies AT the "use"
+    switch (proc plane: real SIGKILL mid-rotation), restarts from its
+    snapshotted keyring — which may predate the switch — and must catch
+    up via the re-issued "use" before the old key is retired."""
+    return FaultPlan(
+        name="rotate-crash-restart",
+        n=n,
+        seed=41,
+        encrypted=True,
+        phases=(
+            FaultPhase(name="warm+install", duration_s=0.6, rounds=12,
+                       rotate=("install",)),
+            FaultPhase(name="kill-mid-rotation", duration_s=1.0, rounds=12,
+                       crash=(n - 1,), rotate=("use",), event_rate=60.0),
+            FaultPhase(name="restart-from-keyring", duration_s=0.8,
+                       rounds=12, restart=(n - 1,), rotate=("use",)),
+            FaultPhase(name="retire-old", duration_s=0.6, rounds=12,
+                       rotate=("remove",)),
+        ),
+        settle_s=10.0,
+        settle_rounds=48,
+    )
+
+
 def _self_check(n: int = 4) -> FaultPlan:
     """Tiny fast plan for ``tools/chaos.py --self-check`` (tier-1)."""
     return FaultPlan(
@@ -365,6 +475,9 @@ _PLANS: Dict[str, object] = {
     "self-check": _self_check,
     "control-loss-converge": _control_loss_converge,
     "control-overload-shed": _control_overload_shed,
+    "rotate-under-churn": _rotate_under_churn,
+    "rotate-under-partition": _rotate_under_partition,
+    "rotate-crash-restart": _rotate_crash_restart,
 }
 
 

@@ -137,6 +137,11 @@ class ProcChaosResult:
     all_pids: List[int] = field(default_factory=list)
     #: folded per-node lifecycle ledger snapshots (final poll)
     lifecycle: Dict[str, dict] = field(default_factory=dict)
+    #: key-rotation evidence (``plan.encrypted`` runs): same shape as
+    #: the host plane's ``HostChaosResult.rotation`` — ctl-driven op
+    #: rows, list-query message-loss probes, the reconcile verdict,
+    #: decrypt fallback/fail folds and per-agent keyring digests
+    rotation: Optional[Dict] = None
 
 
 class ProcCluster:
@@ -148,12 +153,18 @@ class ProcCluster:
 
     def __init__(self, n: int, tmp_dir: str, profile: str = "proc",
                  options: Optional[dict] = None, seed: int = 0,
-                 lifecycle_sample_n: Optional[int] = None):
+                 lifecycle_sample_n: Optional[int] = None,
+                 initial_keyring: Optional[List[bytes]] = None):
         self.n = n
         self.tmp_dir = tmp_dir
         self.profile = profile
         self.options = options
         self.lifecycle_sample_n = lifecycle_sample_n
+        #: encrypted clusters: every agent's generation-0 keyring file
+        #: is seeded with these keys (first = primary) before spawn; a
+        #: RESTART finds the file already there — possibly mutated and
+        #: persisted by rotation ops — and resumes from it
+        self.initial_keyring = initial_keyring
         self.rng = random.Random(seed ^ 0x9C0C)
         # serflint: ignore[async-shared-mut] -- phase ops run strictly
         # sequentially in the executor's single task; the sampler/load
@@ -186,6 +197,17 @@ class ProcCluster:
             "options": self.options,
             "lifecycle_sample_n": self.lifecycle_sample_n,
         }
+        if self.initial_keyring is not None:
+            keyring_file = os.path.join(node_dir, "serf.keyring")
+            cfg["keyring_file"] = keyring_file
+            if not os.path.exists(keyring_file):
+                # seed only when absent: a restart must load the ring
+                # the dead incarnation last PERSISTED (possibly already
+                # rotated), not the plan's day-zero keys
+                from serf_tpu.host.keyring import SecretKeyring
+                SecretKeyring(self.initial_keyring[0],
+                              list(self.initial_keyring[1:])
+                              ).save(keyring_file)
         config_path = os.path.join(node_dir, f"agent.g{generation}.json")
         # harness-written config is atomic (satellite): a harness crash
         # mid-write must never leave a torn config a respawn then trusts
@@ -444,9 +466,16 @@ async def run_proc_plan(plan: FaultPlan, tmp_dir: str,
     the report comes back red (``tools/chaos.py --record-on-fail``)."""
     plan.validate()
     n = plan.n
+    rot_base = rot_next = None
+    rotation_ops: List[Dict] = []
+    if plan.encrypted:
+        from serf_tpu.faults.host import rotation_keys
+        rot_base, rot_next = rotation_keys(plan.seed)
     cluster = ProcCluster(n, tmp_dir, profile=profile, options=options,
                           seed=plan.seed,
-                          lifecycle_sample_n=lifecycle_sample_n)
+                          lifecycle_sample_n=lifecycle_sample_n,
+                          initial_keyring=[rot_base] if plan.encrypted
+                          else None)
     samples: Dict[str, List[ClockSample]] = {f"p{i}": [] for i in range(n)}
     generation = {i: 0 for i in range(n)}
     load = ProcLoadReport()
@@ -509,6 +538,115 @@ async def run_proc_plan(plan: FaultPlan, tmp_dir: str,
             load.queries_admitted += resp["queries_admitted"]
             load.queries_shed += resp["queries_shed"]
 
+    async def issue_rotation(op: str, phase_name: str) -> None:
+        """One phase-entry rotation op over the lowest live agent's ctl
+        channel (install -> next key, use -> next key, remove -> base).
+        Mirrors the host executor: the row is evidence either way."""
+        from serf_tpu.host.keyring import key_digest
+        row: Dict = {"phase": phase_name, "op": op}
+        live = cluster.live()
+        if not live:
+            row["error"] = "no live agent to issue from"
+            rotation_ops.append(row)
+            return
+        agent = min(live, key=lambda a: a.index)
+        key = rot_base if op == "remove" else rot_next
+        row["key"] = key_digest(key)
+        try:
+            resp = await agent.client.call("keys", action=op,
+                                           key_b64=ctl.b64(key),
+                                           timeout=30.0)
+        except (ConnectionError, TimeoutError, RuntimeError, OSError) as e:
+            row["error"] = repr(e)[:200]
+        else:
+            row.update(num_nodes=resp["num_nodes"],
+                       num_resp=resp["num_resp"],
+                       num_err=resp["num_err"],
+                       attempts=resp["attempts"],
+                       quorum_ok=resp["quorum_ok"])
+            if resp.get("messages"):
+                row["messages"] = dict(
+                    list(resp["messages"].items())[:4])
+        rotation_ops.append(row)
+
+    async def rotation_finale() -> Dict:
+        """Proc sibling of the host ``_rotation_finale``: (1) message-
+        loss probes — every live agent issues a cluster-wide ``keys
+        list`` query through the (possibly still mixed-key) encrypted
+        fabric; a full response set proves round-trip delivery on every
+        node; (2) bounded reconcile — use(next)/remove(base) off one
+        agent until every ring reports the next key as sole primary;
+        (3) per-agent local ring digests over ctl."""
+        from serf_tpu.host.keyring import key_digest
+        deadline = max(2.0, plan.settle_s)
+        live = cluster.live()
+        nlive = len(live)
+        next_digest = key_digest(rot_next)
+        base_digest = key_digest(rot_base)
+        offered = sent = delivered = 0
+        t0 = time.monotonic()
+        for a in live:
+            offered += 1
+            try:
+                resp = await a.client.call("keys", action="list",
+                                           timeout=30.0)
+            except (ConnectionError, TimeoutError, RuntimeError, OSError):
+                continue
+            sent += 1
+            if resp["num_resp"] >= nlive:
+                delivered += 1
+        probes = {"offered": offered, "sent": sent,
+                  "delivered": delivered, "nodes": nlive,
+                  "probe_s": round(time.monotonic() - t0, 3)}
+        driver = min(live, key=lambda a: a.index) if live else None
+        t1 = time.monotonic()
+        converged = False
+        rounds = 0
+        while driver is not None and time.monotonic() - t1 <= deadline:
+            rounds += 1
+            try:
+                await driver.client.call("keys", action="use",
+                                         key_b64=ctl.b64(rot_next),
+                                         timeout=30.0)
+                await driver.client.call("keys", action="remove",
+                                         key_b64=ctl.b64(rot_base),
+                                         timeout=30.0)
+                lk = await driver.client.call("keys", action="list",
+                                              timeout=30.0)
+            except (ConnectionError, TimeoutError, RuntimeError, OSError):
+                await asyncio.sleep(0.2)
+                continue
+            if (lk["num_resp"] >= nlive
+                    and lk["primary_keys"].get(next_digest, 0) >= nlive
+                    and base_digest not in lk["keys"]):
+                converged = True
+                break
+            await asyncio.sleep(0.2)
+        reconcile_s = round(time.monotonic() - t1, 3)
+        keyrings = {}
+        for a in cluster.live():
+            try:
+                d = await a.client.call("keys", action="digest",
+                                        timeout=10.0)
+            except (ConnectionError, TimeoutError, RuntimeError, OSError):
+                continue
+            keyrings[a.node_id] = d["digest"]
+        metrics.gauge("serf.rotation.reconcile-s", reconcile_s)
+        flight.record("key-rotation", op="finale", plan=plan.name,
+                      plane="proc", converged=converged,
+                      reconcile_s=reconcile_s,
+                      probes_delivered=delivered, probes_offered=offered)
+        return {
+            "ops": rotation_ops,
+            "probes": probes,
+            "converged": converged,
+            "reconcile_s": reconcile_s,
+            "reconcile_rounds": rounds,
+            "latency_s": reconcile_s,
+            "expected_primary": next_digest,
+            "keyrings": keyrings,
+        }
+
     from serf_tpu.utils.tasks import spawn_logged
     sample_task = spawn_logged(sampler(), "proc-chaos-sampler")
     load_task = (spawn_logged(load_gen(), "proc-chaos-load")
@@ -558,6 +696,10 @@ async def run_proc_plan(plan: FaultPlan, tmp_dir: str,
                     except (ConnectionError, TimeoutError, RuntimeError,
                             OSError):
                         pass
+            # rotation ops at phase ENTRY, after crash/restart and under
+            # the phase's installed faults (mirrors the host executor)
+            for op in phase.rotate:
+                await issue_rotation(op, phase.name)
             if phase.stall:
                 log.info("phase %r: stall lowering note — agents run "
                          "without subscribers on the proc plane",
@@ -592,6 +734,13 @@ async def run_proc_plan(plan: FaultPlan, tmp_dir: str,
         # every incarnation ever spawned, restarts included — the leak
         # test asserts each of these is reaped after teardown
         result.all_pids = [p.pid for p in cluster.all_procs]
+        # rotation finale BEFORE the stats fold, so the fold's decrypt
+        # counters include the probe/reconcile traffic.  Encrypted
+        # plans without rotate ops skip it (rings never leave the base
+        # key — "converge to K2" would wait out the deadline and judge
+        # red), matching the host executor
+        if plan.encrypted and plan.has_rotation():
+            result.rotation = await rotation_finale()
         crashed_or_paused = {f"p{i}" for i in plan.ever_down()}
         for a in cluster.live():
             try:
@@ -607,12 +756,21 @@ async def run_proc_plan(plan: FaultPlan, tmp_dir: str,
 
         from serf_tpu.faults import invariants as inv
         result.load = load if with_load else None
+        if result.rotation is not None:
+            # decrypt fallback/fail evidence: folded engine counters
+            # from every live agent's final stats (fresh processes —
+            # no baseline subtraction needed)
+            result.rotation["decrypt_fallback"] = int(
+                result.counters.get("serf.keyring.decrypt_fallback", 0))
+            result.rotation["decrypt_fail"] = int(
+                result.counters.get("serf.keyring.decrypt_fail", 0))
         result.report = inv.check_proc(
             plan, result.views, samples, generation,
             survivor_counters=result.survivor_counters,
             folded_counters=result.counters,
             load=result.load,
-            settle_converged=result.settle_converged)
+            settle_converged=result.settle_converged,
+            rotation=result.rotation)
         result.clock_samples = samples
 
         if blackbox_on_fail and not result.report.ok:

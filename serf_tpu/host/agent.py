@@ -392,6 +392,41 @@ class Agent:
         metrics.incr("serf.proc.chaos_installs", 1, self._labels)
         return {"installed": rule is not None}
 
+    async def _op_keys(self, req: dict) -> dict:
+        """Keyring ops over the control channel (the proc-plane rotation
+        driver): ``install``/``use``/``remove``/``list`` run CLUSTER-wide
+        through this agent's KeyManager; ``digest`` reads the LOCAL
+        ring.  Responses carry non-secret key digests only — raw key
+        material rides the request (``key_b64``) but never a response."""
+        from serf_tpu.host.keyring import key_digest
+        action = req.get("action")
+        if action == "digest":
+            ring = self.serf.memberlist.keyring()
+            if ring is None:
+                raise RuntimeError("encryption is not enabled")
+            return {"digest": ring.digest()}
+        km = self.serf.key_manager()
+        if km is None:
+            raise RuntimeError("encryption is not enabled")
+        if action == "install":
+            r = await km.install_key(ctl.unb64(req.get("key_b64")))
+        elif action == "use":
+            r = await km.use_key(ctl.unb64(req.get("key_b64")))
+        elif action == "remove":
+            r = await km.remove_key(ctl.unb64(req.get("key_b64")))
+        elif action == "list":
+            r = await km.list_keys()
+        else:
+            raise ValueError(f"unknown keys action {action!r}")
+        return {
+            "num_nodes": r.num_nodes, "num_resp": r.num_resp,
+            "num_err": r.num_err, "attempts": r.attempts,
+            "quorum_ok": r.quorum_ok, "messages": r.messages,
+            "keys": {key_digest(k): c for k, c in r.keys.items()},
+            "primary_keys": {key_digest(k): c
+                             for k, c in r.primary_keys.items()},
+        }
+
     async def _op_blackbox(self, req: dict) -> dict:
         if self.box is None:
             raise RuntimeError("agent has no blackbox_dir configured")

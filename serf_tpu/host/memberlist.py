@@ -872,8 +872,23 @@ class Memberlist:
         if not parts:
             return
         packet = sm.encode_compound(parts) if len(parts) > 1 else parts[0]
-        for target in candidates[: self.opts.gossip_nodes]:
-            await self._send_packet(target.addr, packet)
+        targets = candidates[: self.opts.gossip_nodes]
+        if (self._keyring is not None and len(targets) > 1
+                and self.opts.gossip_encrypt_amortize):
+            # one-encrypt-per-fanout (ISSUE 20): the same payload goes to
+            # every target, so run the wire pipeline (compress/checksum/
+            # encrypt — ONE fresh-nonce AEAD seal) once and fan the
+            # pre-sealed bytes out, saving k-1 AEAD calls per tick
+            buf = self._encode_wire(packet)
+            metrics.incr("serf.keyring.encrypt_amortized",
+                         len(targets) - 1, self.opts.metric_labels)
+            for target in targets:
+                metrics.observe("memberlist.packet.sent", len(buf),
+                                self.opts.metric_labels)
+                await self.transport.send_packet(target.addr, buf)
+        else:
+            for target in targets:
+                await self._send_packet(target.addr, packet)
 
     async def _push_pull_loop(self) -> None:
         while not self._shutdown:

@@ -151,6 +151,16 @@ SLO_TABLE: Tuple[SLODef, ...] = (
                     "ratio at ~1.0 means the fabric ships only slots "
                     "nobody learns from (epidemic overhead is expected; "
                     "total waste is a regression)"),
+    # key-rotation SLO (host/keyring + key_manager — encrypted runs)
+    SLODef(
+        name="rotation-latency",
+        metrics=("serf.rotation.latency-ms",),
+        planes=("host", "proc"),
+        better="lower", objective=5.0, unit="s",
+        description="post-heal keyring reconvergence — every live ring "
+                    "on the rotation's next key as sole primary, old "
+                    "key retired — completes within the bound (an "
+                    "encrypted run that never reconverges judges inf)"),
 )
 
 
@@ -439,6 +449,23 @@ def judge_host_run(result, plan, emit: bool = True) -> List[SLOVerdict]:
                     detail=f"{prop['duplicates']} duplicate(s) of "
                            f"{prop['seen'] + prop['duplicates']} "
                            "delivered", emit=emit))
+        elif d.name == "rotation-latency":
+            rot = getattr(result, "rotation", None)
+            if rot is None:
+                out.append(judge(d, "host", None,
+                                 detail="plan not encrypted", emit=emit))
+            elif not rot.get("converged", False):
+                out.append(judge(
+                    d, "host", math.inf,
+                    detail="keyrings never reconverged within "
+                           f"{rot.get('reconcile_s')}s", emit=emit))
+            else:
+                out.append(judge(
+                    d, "host", float(rot.get("latency_s", 0.0)),
+                    detail=f"{len(rot.get('keyrings', {}))} ring(s) on "
+                           f"primary {rot.get('expected_primary')} in "
+                           f"{rot.get('reconcile_rounds')} round(s)",
+                    emit=emit))
     return out
 
 

@@ -564,6 +564,23 @@ def arm_shed_ratio_watch(wd: Watchdog, store) -> None:
     wd.watch_slo("shed-ratio", series)
 
 
+def arm_rotation_latency_watch(wd: Watchdog, store) -> None:
+    """Watch the ``rotation-latency`` SLO live over the sampled
+    ``serf.rotation.latency-ms`` gauge (each ``KeyManager`` op gauges
+    its wall latency; the sampler folds gauge levels into the store) —
+    converted to the SLO's own seconds so a key op stuck re-querying a
+    partitioned cluster burns while the run is still going, not only
+    at the post-run judgment."""
+
+    def series() -> Optional[List[float]]:
+        ts = store.get("serf.rotation.latency-ms")
+        if ts is None:
+            return None
+        return [v / 1e3 for v in ts.values()]
+
+    wd.watch_slo("rotation-latency", series)
+
+
 def arm_false_dead_watch(wd: Watchdog, store) -> None:
     """Watch the ``false-dead`` SLO live over the device telemetry ring
     (obswatch's device leg folds rows into the same store) — any

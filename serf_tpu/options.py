@@ -115,6 +115,11 @@ class MemberlistOptions:
     # SWIM probe/ack/gossip plane is never paced.  0 = disabled.
     peer_send_rate: float = 0.0
     peer_send_burst: int = 64
+    # encrypted gossip fan-out (ISSUE 20): seal the per-tick gossip
+    # payload ONCE and send the same ciphertext to all k targets (one
+    # AEAD call instead of k); False restores per-packet encryption —
+    # the bench encryption_ab A/B flips this knob
+    gossip_encrypt_amortize: bool = True
     metric_labels: Dict[str, str] = field(default_factory=dict)
 
     def validate(self) -> None:

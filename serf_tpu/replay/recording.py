@@ -91,11 +91,13 @@ def plan_from_dict(d: Dict[str, Any]) -> FaultPlan:
         ph = dict(ph)
         ph["partitions"] = tuple(tuple(g) for g in ph.get("partitions", ()))
         ph["edges"] = tuple(EdgeFault(**e) for e in ph.get("edges", ()))
-        for key in ("crash", "pause", "restart", "stall"):
+        for key in ("crash", "pause", "restart", "stall", "rotate"):
             ph[key] = tuple(ph.get(key, ()))
         phases.append(FaultPhase(**ph))
     plan = FaultPlan(name=d["name"], n=int(d["n"]), phases=tuple(phases),
                      seed=int(d.get("seed", 0)),
+                     # pre-PR-20 recordings carry no encrypted flag
+                     encrypted=bool(d.get("encrypted", False)),
                      settle_s=float(d.get("settle_s", 8.0)),
                      settle_rounds=int(d.get("settle_rounds", 40)))
     plan.validate()

@@ -83,8 +83,6 @@ async def test_arq_recovers_from_heavy_loss():
 
 
 async def test_encrypted_segments_and_foreign_injection_dropped():
-    pytest.importorskip(
-        "cryptography", reason="cryptography not installed in this image")
     key = os.urandom(32)
     a, b = await _pair(keyring=SecretKeyring(key))
     # an attacker (or misconfigured node) without the cluster key

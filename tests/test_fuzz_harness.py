@@ -44,8 +44,6 @@ def test_arbitrary_messages_cover_every_envelope_type():
 
 def test_dstream_segment_fuzz_slice():
     """CI slice of the dstream segment fuzzer (untrusted-UDP parser)."""
-    pytest.importorskip(
-        "cryptography", reason="cryptography not installed in this image")
     from fuzz_dstream import run as run_dstream
 
     # fixed case budget, not a wall-clock throughput floor (a loaded CI

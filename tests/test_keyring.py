@@ -1,13 +1,33 @@
-"""Keyring: install/use/remove semantics, encryption round-trip, persistence."""
+"""Keyring: install/use/remove semantics, encryption round-trip,
+decrypt robustness (wrong key, truncated/malformed frames, torn files),
+fallback ordering, and persistence.  Runs on whichever AEAD backend the
+image has (AES-GCM via the ``cryptography`` wheel, else the stdlib
+HMAC-SHA256-CTR fallback) — no importorskip: encrypted transport must
+work on wheel-less images too."""
+
+import json
 
 import pytest
 
-pytest.importorskip(
-    "cryptography", reason="cryptography not installed in this image")
-
-from serf_tpu.host.keyring import KeyringError, SecretKeyring  # noqa: E402
+from serf_tpu.host.keyring import (
+    CRYPTO_BACKEND,
+    ENCRYPTION_FRAME_SCHEMA,
+    KeyringError,
+    SecretKeyring,
+    key_digest,
+)
+from serf_tpu.utils import metrics
 
 K1, K2, K3 = bytes(range(16)), bytes(range(16, 48)), bytes(range(8, 32))
+
+
+def _counter(name: str) -> float:
+    return sum(v for (n, _l), v in metrics.global_sink().counters.items()
+               if n == name)
+
+
+def test_backend_is_named():
+    assert CRYPTO_BACKEND in ("aes-gcm", "hmac-sha256-ctr")
 
 
 def test_encrypt_decrypt_round_trip():
@@ -36,6 +56,73 @@ def test_rotation_any_installed_key_decrypts():
         ring.decrypt(ct_old)                       # removed key no longer decrypts
 
 
+def test_wrong_key_frame_fails_closed_and_counts():
+    ours = SecretKeyring(K1)
+    theirs = SecretKeyring(K2)
+    frame = theirs.encrypt(b"not ours")
+    before = _counter("serf.keyring.decrypt_fail")
+    with pytest.raises(KeyringError):
+        ours.decrypt(frame)
+    assert _counter("serf.keyring.decrypt_fail") == before + 1
+
+
+def test_truncated_and_malformed_ciphertext():
+    ring = SecretKeyring(K1)
+    frame = ring.encrypt(b"payload of reasonable length")
+    # shorter than version+nonce+tag: malformed, not an index error
+    for cut in (0, 1, 12, 28):
+        with pytest.raises(KeyringError):
+            ring.decrypt(frame[:cut])
+    # full-length but wrong version byte
+    with pytest.raises(KeyringError):
+        ring.decrypt(b"\x7f" + frame[1:])
+    # truncated ciphertext (tag present but ct shortened): auth fails
+    with pytest.raises(KeyringError):
+        ring.decrypt(frame[:1 + 12] + frame[1 + 12 + 4:])
+    # single flipped bit anywhere in the body: auth fails
+    tampered = bytearray(frame)
+    tampered[len(frame) // 2] ^= 0x40
+    with pytest.raises(KeyringError):
+        ring.decrypt(bytes(tampered))
+
+
+def test_fallback_order_primary_then_secondaries():
+    # sender still on the OLD key; receiver already rotated primary to
+    # K2 but keeps K1 installed — decrypt must fall back and count it
+    sender = SecretKeyring(K1)
+    receiver = SecretKeyring(K1, [K2])
+    receiver.use_key(K2)
+    frame = sender.encrypt(b"late packet")
+    fb = _counter("serf.keyring.decrypt_fallback")
+    assert receiver.decrypt(frame) == b"late packet"
+    assert _counter("serf.keyring.decrypt_fallback") == fb + 1
+    # primary-path decrypt does NOT count a fallback
+    fb = _counter("serf.keyring.decrypt_fallback")
+    assert receiver.decrypt(receiver.encrypt(b"hot")) == b"hot"
+    assert _counter("serf.keyring.decrypt_fallback") == fb
+
+
+def test_torn_keyring_file_fails_closed(tmp_path):
+    good = tmp_path / "good.keyring"
+    SecretKeyring(K1, [K2]).save(str(good))
+    blob = good.read_text()
+    # torn tail (crash mid-write of a non-atomic writer)
+    torn = tmp_path / "torn.keyring"
+    torn.write_text(blob[: len(blob) // 2])
+    with pytest.raises(KeyringError):
+        SecretKeyring.load(str(torn))
+    # valid JSON, invalid base64
+    bad64 = tmp_path / "bad64.keyring"
+    bad64.write_text(json.dumps(["!!!not-base-64!!!"]))
+    with pytest.raises(KeyringError):
+        SecretKeyring.load(str(bad64))
+    # empty list
+    empty = tmp_path / "empty.keyring"
+    empty.write_text("[]")
+    with pytest.raises(KeyringError):
+        SecretKeyring.load(str(empty))
+
+
 def test_save_load_preserves_rotated_primary(tmp_path):
     ring = SecretKeyring(K1)
     ring.install(K2)
@@ -47,6 +134,24 @@ def test_save_load_preserves_rotated_primary(tmp_path):
     loaded = SecretKeyring.load(p)
     assert loaded.primary_key() == K2              # rotation survives persistence
     assert set(loaded.keys()) == {K1, K2}
+
+
+def test_digest_is_non_secret_and_comparable():
+    a = SecretKeyring(K1, [K2])
+    b = SecretKeyring(K1, [K2])
+    assert a.digest() == b.digest()
+    d = a.digest()
+    assert d["primary"] == key_digest(K1)
+    assert sorted(d["keys"]) == sorted([key_digest(K1), key_digest(K2)])
+    # digests are 12-hex identities, never key material
+    assert all(len(x) == 12 for x in [d["primary"], *d["keys"]])
+
+
+def test_frame_schema_literal_shape():
+    # the serflint-pinned wire surface: keep the declared shape honest
+    assert set(ENCRYPTION_FRAME_SCHEMA) == {
+        "encrypted-frame", "encrypt-pipeline", "batch-encryption"}
+    assert ENCRYPTION_FRAME_SCHEMA["encrypt-pipeline"][-1] == "encrypt"
 
 
 def test_bad_key_sizes_rejected():

@@ -164,8 +164,6 @@ async def test_partition_and_heal():
 
 
 async def test_encrypted_cluster_converges():
-    pytest.importorskip(
-        "cryptography", reason="cryptography not installed in this image")
     key = bytes(range(32))
     ring = SecretKeyring(key)
     net = LoopbackNetwork()
@@ -179,8 +177,6 @@ async def test_encrypted_cluster_converges():
 
 
 async def test_encrypted_rejects_plaintext_peer():
-    pytest.importorskip(
-        "cryptography", reason="cryptography not installed in this image")
     ring = SecretKeyring(bytes(range(16)))
     net = LoopbackNetwork()
     enc = await make_cluster(net, 2, keyring=ring)

@@ -559,8 +559,6 @@ async def test_key_manager_cluster_rotation():
     """Cluster-wide keyring orchestration (reference key_manager.rs):
     install a new key everywhere, rotate the primary, remove the old key,
     and keep gossiping through every stage."""
-    pytest.importorskip(
-        "cryptography", reason="cryptography not installed in this image")
     from serf_tpu.host.keyring import SecretKeyring
 
     k1 = bytes(range(16))

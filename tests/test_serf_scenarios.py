@@ -244,8 +244,6 @@ async def test_drop_leave_messages_blocks_leave_dissemination():
 def test_dropper_classification_unit():
     """The classifier decodes the real wire format: swim types, compound
     parts, USER-wrapped serf envelopes, and RELAY nesting (review findings)."""
-    pytest.importorskip(
-        "cryptography", reason="cryptography not installed in this image")
     from serf_tpu.host import messages as sm
     from serf_tpu.host.keyring import SecretKeyring
     from serf_tpu.types.member import Node

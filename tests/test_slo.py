@@ -135,7 +135,7 @@ def test_slo_table_matches_registry_declaration():
     declared = {reg.normalize(m) for m in reg.METRICS}
     for d in slo.SLO_TABLE:
         assert d.better in ("lower", "higher")
-        assert d.planes and set(d.planes) <= {"host", "device"}
+        assert d.planes and set(d.planes) <= {"host", "device", "proc"}
         for m in d.metrics:
             assert reg.normalize(m) in declared, \
                 f"SLO {d.name} watches undeclared metric {m}"

@@ -154,8 +154,6 @@ async def test_encrypted_rotation_storm():
     persisted keyring (per serf rotation guidance, a node missing a key
     cannot decrypt replies encrypted with the new primary — verified
     separately as correct fail-loudly behavior)."""
-    pytest.importorskip(
-        "cryptography", reason="cryptography not installed in this image")
     import dataclasses
 
     from serf_tpu.host.keyring import SecretKeyring

@@ -265,8 +265,6 @@ async def test_cluster_key_rotation(transport, tmp_path):
     """Keyring orchestration over encrypted wire traffic on every
     transport (reference key_manager.rs): install a second key, rotate
     the primary to it, remove the old key, and keep disseminating."""
-    pytest.importorskip(
-        "cryptography", reason="cryptography not installed in this image")
     k1, k2 = bytes(range(16)), bytes(range(16, 32))
     fabric = _Fabric(transport, tmp_path)
     nodes = await _cluster(fabric, 3, keyring=lambda: SecretKeyring(k1))

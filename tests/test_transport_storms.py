@@ -190,8 +190,6 @@ async def test_api_storm_over_real_sockets(stream, seed, tmp_path):
     stream segments) with 5% segment loss, so AIMD + SACK recovery +
     keyring decrypt + churn all interleave — the combination round 4
     shipped untested."""
-    pytest.importorskip(
-        "cryptography", reason="cryptography not installed in this image")
     from serf_tpu.host.keyring import SecretKeyring
 
     from tests.storm_ops import run_api_storm
@@ -235,8 +233,6 @@ async def test_key_rotation_storm_over_dstream(tmp_path):
     encrypted with the same keyring: the rotation must propagate to both
     the gossip wire and the stream segments (shared mutable keyring), and
     a post-rotation rejoiner with the rotated ring must converge."""
-    pytest.importorskip(
-        "cryptography", reason="cryptography not installed in this image")
     from serf_tpu.host.keyring import SecretKeyring
     from serf_tpu.options import MemberlistOptions
 

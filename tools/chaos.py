@@ -105,7 +105,33 @@ def run_proc(plan, record_dir: str = ".", record_on_fail: bool = False):
                 except OSError as e:
                     print(f"record-on-fail: could not copy {node_id} "
                           f"black box: {e}", file=sys.stderr)
+            rot = getattr(result, "rotation", None)
+            if rot is not None:
+                # per-process keyring digests beside the copied bundles:
+                # a red encrypted run must show which ring each process
+                # died holding (never raw key material — digests only)
+                try:
+                    os.makedirs(dest_root, exist_ok=True)
+                    with open(os.path.join(dest_root, "keyrings.json"),
+                              "w", encoding="utf-8") as f:
+                        json.dump(_rotation_forensics(rot), f, indent=1,
+                                  sort_keys=True)
+                        f.write("\n")
+                    bundles["keyrings"] = os.path.join(dest_root,
+                                                       "keyrings.json")
+                except OSError as e:
+                    print(f"record-on-fail: could not write keyring "
+                          f"digests: {e}", file=sys.stderr)
     return result, bundles
+
+
+def _rotation_forensics(rot):
+    """The JSON-safe keyring-state slice of a rotation evidence dict:
+    per-node ring digests, the expected post-rotation primary, and the
+    convergence verdict (digests only — raw keys never leave a node)."""
+    return {k: rot.get(k) for k in
+            ("keyrings", "expected_primary", "converged", "latency_s",
+             "reconcile_rounds") if k in rot}
 
 
 def _dump_red_bundle(record_dir: str, plan, plane: str, result) -> str:
@@ -118,6 +144,13 @@ def _dump_red_bundle(record_dir: str, plan, plane: str, result) -> str:
     wd = getattr(result, "watchdog", None)
     if isinstance(wd, dict) and "rows" in wd:
         wd = {k: v for k, v in wd.items() if k != "rows"}  # host-side array
+    rot = getattr(result, "rotation", None)
+    if rot is not None:
+        # keyring state digests ride the bundle's free-form watchdog
+        # state (the schema pins sections, not state keys): a red
+        # encrypted run is undiagnosable without "who held which ring"
+        wd = dict(wd or {})
+        wd["rotation"] = _rotation_forensics(rot)
     box = BlackBox(record_dir, node=f"{plan.name}-{plane}",
                    recorder=flight.global_recorder())
     return box.dump(reason="invariant-red",
@@ -216,6 +249,7 @@ def main() -> int:
     control_info = {}
     lifecycle_info = {}
     propagation_info = {}
+    rotation_info = {}
     ab = {}
     device_mesh = 1
     #: A/B mode runs each plane twice (static leg first); 'on' replaces
@@ -268,6 +302,21 @@ def main() -> int:
                 "processes": len(result.views),
                 "spawned_pids": len(result.all_pids),
             }
+            rot = getattr(result, "rotation", None)
+            if rot is not None:
+                # rotation-latency is the ONE host SLO the proc plane can
+                # judge without in-process series access: the finale hands
+                # back the measured reconvergence latency directly
+                import math as _math
+                rot_val = (float(rot.get("latency_s", _math.inf))
+                           if rot.get("converged") else _math.inf)
+                probes = rot.get("probes", {})
+                slo_verdicts["proc"] = [slo.judge(
+                    slo.slo_def("rotation-latency"), "proc", rot_val,
+                    detail=f"{len(rot.get('keyrings', {}))} ring(s), "
+                           f"{rot.get('reconcile_rounds', 0)} reconcile "
+                           f"round(s)")]
+                rotation_info["proc"] = rot
             lifecycle_info.update(
                 {f"proc:{nid}": lc
                  for nid, lc in sorted(result.lifecycle.items())}
@@ -304,6 +353,8 @@ def main() -> int:
                     ring_summaries[plane] = series.summaries()
                 if getattr(result, "propagation", None) is not None:
                     propagation_info[plane] = result.propagation
+                if getattr(result, "rotation", None) is not None:
+                    rotation_info[plane] = result.rotation
                 if getattr(result, "control", None) is not None:
                     control_info[plane] = result.control
             else:
@@ -406,6 +457,7 @@ def main() -> int:
             "overload": overload,
             "lifecycle": lifecycle_info,
             "propagation": propagation_info,
+            "rotation": rotation_info,
             "device_mesh_devices": device_mesh,
             "recordings": recordings,
             "blackboxes": blackboxes,
@@ -489,6 +541,18 @@ def main() -> int:
             from serf_tpu.obs.propagation import format_propagation
             for plane, p in sorted(propagation_info.items()):
                 print(format_propagation(p, plane))
+        for plane, rot in sorted(rotation_info.items()):
+            probes = rot.get("probes", {})
+            print(f"rotation [{plane}]: "
+                  f"{'converged' if rot.get('converged') else 'NOT CONVERGED'}"
+                  f" in {rot.get('latency_s', float('nan')):.3f}s "
+                  f"({rot.get('reconcile_rounds', 0)} reconcile round(s)), "
+                  f"{len(rot.get('ops', []))} op(s), mid-rotation probes "
+                  f"{probes.get('delivered', 0)}/{probes.get('offered', 0)}"
+                  f" delivered, decrypt fallback/fail "
+                  f"{rot.get('decrypt_fallback', 0):.0f}/"
+                  f"{rot.get('decrypt_fail', 0):.0f}, rings -> "
+                  f"{rot.get('expected_primary', '?')}")
         print("degradation counters:")
         for name in sorted(counters):
             print(f"  {name} = {counters[name]:.0f}")
@@ -497,7 +561,12 @@ def main() -> int:
         # AND SLOs) — the static legs are allowed (expected, for the
         # control-* plans) to breach
         return 0 if all(ab[p]["controlled"]["ok"] for p in ab) else 1
-    return 0 if all(r.ok for r in reports) else 1
+    # rotation-latency is part of the rotation proof, not advisory: an
+    # encrypted run that reconverges too slowly (or never) exits red even
+    # when every invariant held (other SLOs stay report-only here)
+    rotation_ok = all(v.ok for vs in slo_verdicts.values()
+                      for v in vs if v.slo == "rotation-latency")
+    return 0 if (all(r.ok for r in reports) and rotation_ok) else 1
 
 
 def _ab_header(plane: str, plan_name: str, controlled: bool) -> str:
